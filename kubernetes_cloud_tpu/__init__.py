@@ -1,0 +1,23 @@
+"""kubernetes_cloud_tpu — a TPU-native ML workload framework.
+
+A from-scratch JAX/XLA/Pallas/pjit re-design of the capabilities of
+CoreWeave's ``kubernetes-cloud`` examples repo: parameterized finetuning
+workflows (causal-LM, Stable Diffusion, DreamBooth), KServe-style inference
+services, streaming weight serialization for fast cold starts, distributed
+tokenization/packing, and multi-host training expressed as device-mesh
+shardings over ICI/DCN.
+
+Subpackages
+-----------
+core      mesh construction, multi-host bootstrap, memory telemetry
+config    typed configs + dash/underscore-tolerant CLI flag system
+data      mmap token datasets, image/caption datasets, tokenizer driver
+weights   streaming tensor serialization (Tensorizer-equivalent), checkpoints
+models    causal LMs (GPT-J/NeoX/Pythia/BLOOM), Stable Diffusion, ResNet
+ops       Pallas TPU kernels (flash attention, ring attention) + core layers
+parallel  sharding policies: DP / FSDP / TP / PP / sequence parallel
+train     trainers with checkpoint-resume, perf metrics, in-training sampling
+serve     KServe V1 data-plane HTTP serving + generation runtime
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+from kubernetes_cloud_tpu.core.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    AXIS_STAGE,
+    BATCH_AXES,
+    MeshSpec,
+    build_mesh,
+    local_batch_size,
+)
+from kubernetes_cloud_tpu.core.distributed import maybe_initialize_distributed  # noqa: F401
+from kubernetes_cloud_tpu.core.memory import MemoryUsage  # noqa: F401

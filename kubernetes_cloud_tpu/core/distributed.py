@@ -1,0 +1,98 @@
+"""Multi-host bootstrap from the Kubernetes environment.
+
+The reference relies on the training-operator injecting the
+``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE``/``RANK`` rendezvous contract
+(``kubeflow/training-operator/resnet50/k8s/imagenet-pytorchjob.yaml:21-24``)
+consumed by ``torch.distributed.init_process_group``
+(``resnet50_pytorch.py:16-17,93-125``) and by the finetuner's world-size
+discovery (``finetuner-workflow/finetuner/finetuner.py:316-341``).
+
+On TPU every host runs the same program (no MPI launcher/worker asymmetry —
+contrast the MPIJob launcher hack at
+``kubeflow/training-operator/gpt-neox/04-finetune-workflow.yaml:420-425``)
+and rendezvous is ``jax.distributed.initialize``.  We honor, in priority
+order:
+
+1. TPU-native autodetection (GKE TPU slices / JobSet set the TPU metadata
+   env; ``jax.distributed.initialize()`` with no args handles it).
+2. An explicit ``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES`` / ``PROCESS_ID``
+   triple (the JobSet headless-service contract).
+3. The legacy torch-style ``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE``/
+   ``RANK`` quadruple, so the reference's manifests port 1:1.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Mapping, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+def maybe_initialize_distributed(
+    env: Optional[Mapping[str, str]] = None,
+) -> bool:
+    """Initialize ``jax.distributed`` if the environment asks for it.
+
+    Returns True iff multi-process initialization ran.  Safe to call more
+    than once and safe in single-process runs (mirrors the reference's
+    world-size-1 default at ``finetuner.py:336-341``).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    if env is None:
+        env = os.environ
+
+    coordinator = env.get("COORDINATOR_ADDRESS")
+    num_processes = env.get("NUM_PROCESSES")
+    process_id = env.get("PROCESS_ID")
+
+    if coordinator is None and "MASTER_ADDR" in env:
+        port = env.get("MASTER_PORT", "1234")
+        coordinator = f"{env['MASTER_ADDR']}:{port}"
+        num_processes = num_processes or env.get("WORLD_SIZE")
+        process_id = process_id or env.get("RANK")
+        # JobSet pods get their index via the completion-index annotation.
+        if process_id is None:
+            process_id = env.get("JOB_COMPLETION_INDEX")
+
+    if coordinator is None:
+        if env.get("TPU_WORKER_HOSTNAMES") or env.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            # GKE TPU slice: args are autodetected from the TPU metadata.
+            log.info("jax.distributed.initialize() via TPU autodetection")
+            jax.distributed.initialize()
+            _INITIALIZED = True
+            return True
+        return False
+
+    if num_processes is None or process_id is None:
+        raise RuntimeError(
+            "COORDINATOR_ADDRESS/MASTER_ADDR set but NUM_PROCESSES/WORLD_SIZE "
+            "or PROCESS_ID/RANK missing"
+        )
+    if int(num_processes) <= 1:
+        return False
+
+    log.info(
+        "jax.distributed.initialize(%s, num_processes=%s, process_id=%s)",
+        coordinator, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_processes),
+        process_id=int(process_id),
+    )
+    _INITIALIZED = True
+    return True
+
+
+def is_primary() -> bool:
+    """True on the process that should write checkpoints / logs / wandb
+    (the reference gates on ``LOCAL_RANK in (0, -1)``, ``finetuner.py:362``)."""
+    return jax.process_index() == 0

@@ -1,0 +1,83 @@
+"""Device + host memory telemetry.
+
+TPU-native re-design of the reference's ``MemoryUsage`` triple
+(``finetuner-workflow/finetuner/utils.py:28-108``): CUDA ``mem_get_info`` →
+TPU ``device.memory_stats()`` (HBM bytes in use / limit), torch allocator
+stats → XLA live-buffer stats, RUSAGE/psutil host stats kept as-is.
+Formatted the same way so log lines stay grep-compatible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import resource
+from typing import Optional
+
+import jax
+
+
+def _mib(n: Optional[int]) -> Optional[int]:
+    return None if n is None else n >> 20
+
+
+@dataclasses.dataclass
+class DeviceMemoryUsage:
+    """HBM usage for one device (reference: ``GlobalGPUMemoryUsage``,
+    ``utils.py:28-47``)."""
+
+    used: Optional[int]
+    limit: Optional[int]
+
+    @classmethod
+    def now(cls, device: Optional[jax.Device] = None) -> "DeviceMemoryUsage":
+        if device is None:
+            local = jax.local_devices()
+            device = local[0] if local else None
+        stats = {}
+        if device is not None:
+            try:
+                stats = device.memory_stats() or {}
+            except (RuntimeError, AttributeError):
+                stats = {}
+        return cls(
+            used=stats.get("bytes_in_use"),
+            limit=stats.get("bytes_limit") or stats.get("bytes_reservable_limit"),
+        )
+
+    def __str__(self) -> str:
+        if self.used is None:
+            return "HBM: <unavailable>"
+        if self.limit:
+            return f"HBM: {_mib(self.used)}MiB used of {_mib(self.limit)}MiB"
+        return f"HBM: {_mib(self.used)}MiB used"
+
+
+@dataclasses.dataclass
+class HostMemoryUsage:
+    """Host RSS via getrusage (reference: ``CPUMemoryUsage``,
+    ``utils.py:78-95``)."""
+
+    maxrss_kib: int
+
+    @classmethod
+    def now(cls) -> "HostMemoryUsage":
+        return cls(maxrss_kib=resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+
+    def __str__(self) -> str:
+        return f"Host: {self.maxrss_kib >> 10}MiB peak RSS"
+
+
+@dataclasses.dataclass
+class MemoryUsage:
+    """Combined snapshot (reference: ``MemoryUsage.now()``,
+    ``utils.py:98-108``)."""
+
+    device: DeviceMemoryUsage
+    host: HostMemoryUsage
+
+    @classmethod
+    def now(cls) -> "MemoryUsage":
+        return cls(device=DeviceMemoryUsage.now(), host=HostMemoryUsage.now())
+
+    def __str__(self) -> str:
+        return f"{self.device}, {self.host}"

@@ -1,0 +1,170 @@
+"""Device-mesh construction — the single parallelism substrate.
+
+The reference expresses data parallelism, ZeRO, tensor parallelism and
+pipeline parallelism as four different engines (PyTorch DDP, DeepSpeed ZeRO
+stages, Megatron ``model-parallel-size``, DeepSpeed ``pipe-parallel-size`` —
+see reference ``kubeflow/training-operator/gpt-neox/04-finetune-workflow.yaml:201-202,236-244``
+and ``finetuner-workflow/finetuner/ds_config.json:27-42``).  On TPU all of
+them are one thing: a named ``jax.sharding.Mesh`` plus per-array
+``PartitionSpec``s; XLA emits the collectives (the NCCL equivalent) from the
+shardings.
+
+Axis convention (fixed across the whole framework):
+
+==========  =========================================================
+axis        meaning
+==========  =========================================================
+``data``    pure data parallelism (gradient all-reduce only)
+``fsdp``    fully-sharded data parallelism (ZeRO-3 analogue: params,
+            grads and optimizer state sharded; batch also sharded here)
+``stage``   pipeline stage (usually mapped onto DCN between slices)
+``seq``     sequence/context parallelism (ring attention over ICI)
+``model``   tensor parallelism (Megatron-style attn-head/MLP sharding)
+==========  =========================================================
+
+The batch dimension is sharded over ``("data", "fsdp")`` jointly
+(``BATCH_AXES``), parameters over ``fsdp``/``model``, activations'
+sequence dimension over ``seq``.
+
+Axis order in the mesh is chosen so the highest-bandwidth-hungry axes
+(``model``, ``seq``) land on adjacent devices in the ICI torus, while
+``stage`` and ``data`` can span DCN between slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_STAGE = "stage"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+
+#: Mesh axis order, outermost (DCN-friendly) to innermost (ICI-adjacent).
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_STAGE, AXIS_SEQ, AXIS_MODEL)
+
+#: Axes over which the batch dimension is sharded.
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism degrees.  ``-1`` on exactly one ICI axis means
+    "fill with all remaining devices" (mirrors the reference's dynamic GPU
+    count podSpecPatch, ``finetuner-workflow/finetune-workflow.yaml:490-503``).
+
+    ``dcn_*`` fields describe the outer (multi-slice / multi-host-group)
+    mesh laid over DCN; the plain fields describe the per-slice ICI mesh.
+    The reference's analogue is NVLINK-intra-node + InfiniBand-inter-node
+    (``04-finetune-workflow.yaml:482,485``).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    stage: int = 1
+    seq: int = 1
+    model: int = 1
+    # Outer mesh over DCN (multi-slice). Product must equal num_slices.
+    dcn_data: int = 1
+    dcn_fsdp: int = 1
+    dcn_stage: int = 1
+
+    def ici_shape(self, n_devices: int) -> tuple[int, ...]:
+        sizes = [self.data, self.fsdp, self.stage, self.seq, self.model]
+        n_fill = sizes.count(-1)
+        if n_fill > 1:
+            raise ValueError(f"at most one axis may be -1, got {sizes}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if n_fill == 1:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[sizes.index(-1)] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}"
+            )
+        return tuple(sizes)
+
+    def dcn_shape(self) -> tuple[int, ...]:
+        return (self.dcn_data, self.dcn_fsdp, self.dcn_stage, 1, 1)
+
+    @property
+    def is_multislice(self) -> bool:
+        return math.prod(self.dcn_shape()) > 1
+
+
+def build_mesh(
+    spec: MeshSpec = MeshSpec(),
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global named mesh.
+
+    Single-slice: ``mesh_utils.create_device_mesh`` assigns logical axes to
+    the physical ICI torus so that inner axes (``model``, ``seq``) are
+    ICI-adjacent.  Multi-slice (``spec.dcn_* != 1``):
+    ``create_hybrid_device_mesh`` nests the ICI mesh inside the DCN mesh —
+    this replaces the reference's NCCL-over-NVLINK + NCCL-over-IB split.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+
+    if spec.is_multislice:
+        n_dcn = math.prod(spec.dcn_shape())
+        if len(devices) % n_dcn:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by dcn product {n_dcn}"
+            )
+        per_slice = len(devices) // n_dcn
+        ici_shape = spec.ici_shape(per_slice)
+        mesh_devices = mesh_utils.create_hybrid_device_mesh(
+            ici_shape,
+            spec.dcn_shape(),
+            devices=devices,
+            allow_split_physical_axes=True,
+        )
+        # Merge the outer DCN axis into the matching inner axis so user code
+        # sees exactly one axis per logical meaning.
+        merged_shape = tuple(
+            d * i for d, i in zip(spec.dcn_shape(), ici_shape)
+        )
+        mesh_devices = mesh_devices.reshape(merged_shape)
+    else:
+        ici_shape = spec.ici_shape(len(devices))
+        try:
+            mesh_devices = mesh_utils.create_device_mesh(
+                ici_shape, devices=devices, allow_split_physical_axes=True
+            )
+        except (ValueError, NotImplementedError, AssertionError):
+            # Topology-unaware fallback (CPU simulation meshes, odd shapes).
+            mesh_devices = np.asarray(devices).reshape(ici_shape)
+
+    return Mesh(mesh_devices, MESH_AXES)
+
+
+def local_batch_size(global_batch_size: int, mesh: Mesh) -> int:
+    """Per-process batch size for host-sharded data loading.
+
+    Replaces ``torch.utils.data.DistributedSampler``
+    (reference ``kubeflow/training-operator/resnet50/util.py:169-199``):
+    each host loads only its shard and the global array is assembled with
+    ``jax.make_array_from_process_local_data``.
+    """
+    n_batch_shards = math.prod(mesh.shape[a] for a in BATCH_AXES)
+    if global_batch_size % n_batch_shards:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"batch shards {n_batch_shards}"
+        )
+    return global_batch_size // jax.process_count()

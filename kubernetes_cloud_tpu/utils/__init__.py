@@ -1,0 +1,5 @@
+from kubernetes_cloud_tpu.utils.cli import (  # noqa: F401
+    DashParser,
+    FuzzyBoolAction,
+    validators,
+)

@@ -1,0 +1,127 @@
+"""CLI flag system: dash/underscore-tolerant flags, fuzzy booleans, typed
+validators.
+
+Behavioral parity with the reference's flag framework
+(``finetuner-workflow/finetuner/utils.py:149-356``): every workflow YAML in
+the reference templates flags in ``--dash-case`` while the Python uses
+``underscore_case``; ``DashParser`` accepts both spellings for every option
+so the ported Argo parameter lists (``finetune-workflow.yaml:8-199``) work
+verbatim.  ``FuzzyBoolAction`` accepts the boolean spellings the workflows
+pass (``true/false/yes/no/on/off/1/0``, bare flag = true).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from types import SimpleNamespace
+
+_TRUE = {"true", "t", "yes", "y", "on", "1"}
+_FALSE = {"false", "f", "no", "n", "off", "0"}
+
+
+def parse_bool(value: str) -> bool:
+    v = value.strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    raise argparse.ArgumentTypeError(f"not a boolean: {value!r}")
+
+
+class FuzzyBoolAction(argparse.Action):
+    """``--flag``, ``--flag true``, ``--flag=no`` all work
+    (reference ``utils.py:229-292``)."""
+
+    def __init__(self, option_strings, dest, nargs="?", default=False, **kwargs):
+        kwargs.pop("type", None)
+        super().__init__(option_strings, dest, nargs=nargs, default=default,
+                         **kwargs)
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        if values is None:
+            result = True
+        elif isinstance(values, bool):
+            result = values
+        else:
+            result = parse_bool(values)
+        setattr(namespace, self.dest, result)
+
+
+class DashParser(argparse.ArgumentParser):
+    """ArgumentParser where every long option gets a dash and an underscore
+    alias, parsing to the underscore destination
+    (reference ``utils.py:149-226``)."""
+
+    def add_argument(self, *names, **kwargs):
+        long_names = [n for n in names if n.startswith("--")]
+        other = [n for n in names if not n.startswith("--")]
+        aliases: list[str] = []
+        seen = set()
+        for name in long_names:
+            body = name[2:]
+            for variant in {body, body.replace("-", "_"), body.replace("_", "-")}:
+                flag = "--" + variant
+                if flag not in seen:
+                    seen.add(flag)
+                    aliases.append(flag)
+        if long_names and "dest" not in kwargs:
+            kwargs["dest"] = long_names[0][2:].replace("-", "_")
+        return super().add_argument(*other, *aliases, **kwargs)
+
+    def add_bool_argument(self, *names, default=False, help=None):
+        return self.add_argument(*names, action=FuzzyBoolAction,
+                                 default=default, help=help)
+
+
+def _positive(type_):
+    def check(value):
+        v = type_(value)
+        if v <= 0:
+            raise argparse.ArgumentTypeError(f"must be > 0, got {v}")
+        return v
+    return check
+
+
+def _non_negative(type_):
+    def check(value):
+        v = type_(value)
+        if v < 0:
+            raise argparse.ArgumentTypeError(f"must be >= 0, got {v}")
+        return v
+    return check
+
+
+def _at_most_1(type_):
+    def check(value):
+        v = type_(value)
+        if not (0 <= v <= 1):
+            raise argparse.ArgumentTypeError(f"must be in [0, 1], got {v}")
+        return v
+    return check
+
+
+def _at_most_32_bit(type_):
+    def check(value):
+        v = type_(value)
+        if not (0 <= v < 2 ** 32):
+            raise argparse.ArgumentTypeError(f"must fit in 32 bits, got {v}")
+        return v
+    return check
+
+
+def _extant_file(value: str) -> str:
+    if not os.path.isfile(value):
+        raise argparse.ArgumentTypeError(f"no such file: {value}")
+    return value
+
+
+#: Typed argument validators (reference ``utils.py:295-356``).
+validators = SimpleNamespace(
+    positive=_positive,
+    non_negative=_non_negative,
+    at_most_1=_at_most_1,
+    at_most_32_bit=_at_most_32_bit,
+    extant_file=_extant_file,
+    parse_bool=parse_bool,
+)

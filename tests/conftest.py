@@ -1,0 +1,31 @@
+"""Test fixtures: an 8-device CPU-simulated mesh.
+
+Multi-device behavior (sharding, collectives, pjit) is tested without real
+TPU hardware via ``--xla_force_host_platform_device_count=8`` — the
+JAX-native fake backend (SURVEY.md §4).  The flag must be set before jax
+initializes its backends, hence the module-level env mutation.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+def cpu_devices(n=8):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip(f"need {n} cpu devices, have {len(devs)}")
+    return devs[:n]
+
+
+@pytest.fixture
+def devices8():
+    return cpu_devices(8)

@@ -1,0 +1,54 @@
+import argparse
+
+import pytest
+
+from kubernetes_cloud_tpu.utils import DashParser, FuzzyBoolAction, validators
+
+
+def make_parser():
+    p = DashParser(prog="t", exit_on_error=False)
+    p.add_argument("--run-name", type=str, default="run")
+    p.add_argument("--train-ratio", type=validators.at_most_1(float), default=0.9)
+    p.add_argument("--seed", type=validators.at_most_32_bit(int), default=42)
+    p.add_bool_argument("--no-resume")
+    return p
+
+
+def test_dash_and_underscore_both_parse():
+    p = make_parser()
+    assert p.parse_args(["--run-name", "a"]).run_name == "a"
+    assert p.parse_args(["--run_name", "b"]).run_name == "b"
+
+
+def test_fuzzy_bools():
+    p = make_parser()
+    assert p.parse_args(["--no-resume"]).no_resume is True
+    assert p.parse_args(["--no_resume", "false"]).no_resume is False
+    assert p.parse_args(["--no-resume=yes"]).no_resume is True
+    assert p.parse_args([]).no_resume is False
+    with pytest.raises(
+        (argparse.ArgumentError, argparse.ArgumentTypeError, SystemExit)
+    ):
+        p.parse_args(["--no-resume", "maybe"])
+
+
+def test_validators():
+    p = make_parser()
+    with pytest.raises((argparse.ArgumentError, SystemExit)):
+        p.parse_args(["--train-ratio", "1.5"])
+    with pytest.raises((argparse.ArgumentError, SystemExit)):
+        p.parse_args(["--seed", str(2 ** 33)])
+    assert p.parse_args(["--train-ratio", "0.5"]).train_ratio == 0.5
+    assert validators.positive(int)("3") == 3
+    with pytest.raises(argparse.ArgumentTypeError):
+        validators.positive(int)("0")
+    with pytest.raises(argparse.ArgumentTypeError):
+        validators.non_negative(float)("-0.1")
+    with pytest.raises(argparse.ArgumentTypeError):
+        validators.extant_file("/definitely/not/a/file")
+
+
+def test_memory_usage_smoke():
+    from kubernetes_cloud_tpu.core import MemoryUsage
+    s = str(MemoryUsage.now())
+    assert "Host:" in s

@@ -1,0 +1,63 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubernetes_cloud_tpu.core import (
+    BATCH_AXES,
+    MeshSpec,
+    build_mesh,
+    local_batch_size,
+)
+
+
+def test_default_spec_fills_data_axis(devices8):
+    mesh = build_mesh(MeshSpec(), devices=devices8)
+    assert mesh.shape["data"] == 8
+    assert mesh.shape["model"] == 1
+
+
+def test_fsdp_tp_mesh(devices8):
+    mesh = build_mesh(MeshSpec(data=1, fsdp=4, model=2), devices=devices8)
+    assert mesh.shape["fsdp"] == 4
+    assert mesh.shape["model"] == 2
+    assert mesh.axis_names == ("data", "fsdp", "stage", "seq", "model")
+
+
+def test_bad_spec_raises(devices8):
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec(data=3, model=2), devices=devices8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1).ici_shape(8)
+
+
+def test_sharded_computation_runs(devices8):
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2), devices=devices8)
+    x = jax.device_put(
+        jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+        NamedSharding(mesh, P(BATCH_AXES, None)),
+    )
+    y = jax.jit(lambda a: a @ a.T)(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) @ np.asarray(x).T)
+
+
+def test_psum_over_mesh(devices8):
+    mesh = build_mesh(MeshSpec(data=8), devices=devices8)
+    x = jax.device_put(
+        jnp.ones((8, 4)), NamedSharding(mesh, P("data", None))
+    )
+    out = jax.jit(
+        jax.shard_map(
+            lambda a: jax.lax.psum(a, "data"),
+            mesh=mesh, in_specs=P("data", None), out_specs=P(None, None),
+        )
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((1, 4), 8.0))
+
+
+def test_local_batch_size(devices8):
+    mesh = build_mesh(MeshSpec(data=4, fsdp=2), devices=devices8)
+    assert local_batch_size(32, mesh) == 32  # single process owns all shards
+    with pytest.raises(ValueError):
+        local_batch_size(12, mesh)
