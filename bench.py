@@ -1,0 +1,74 @@
+"""Benchmark: flagship causal-LM training throughput on the local device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (SURVEY.md §6) — its machinery reports
+wandb ``perf/*`` samples/sec (``finetuner-workflow/finetuner/finetuner.py:516-533``).
+We report trained tokens/sec for a pythia-410m-class model, the metric its
+flagship finetuner path optimizes; ``vs_baseline`` is vs. the best value
+recorded in prior rounds (1.0 until a baseline exists).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_cloud_tpu.models.causal_lm import PRESETS
+from kubernetes_cloud_tpu.parallel.sharding import shard_batch
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.train.train_step import (
+    TrainConfig,
+    init_train_state,
+    make_train_step,
+)
+
+BATCH = 16
+SEQ = 1024
+WARMUP_STEPS = 2
+BENCH_STEPS = 10
+
+
+def main() -> None:
+    import dataclasses
+
+    model_cfg = dataclasses.replace(PRESETS["pythia-410m"], remat=True)
+    train_cfg = TrainConfig(warmup_steps=10, total_steps=1000)
+    mesh = build_mesh(MeshSpec())
+    state = init_train_state(model_cfg, train_cfg, jax.random.key(0), mesh)
+    step = jax.jit(make_train_step(model_cfg, train_cfg), donate_argnums=0)
+
+    rng = jax.random.key(1)
+    batch = shard_batch(
+        {
+            "input_ids": jax.random.randint(
+                rng, (BATCH, SEQ), 0, model_cfg.vocab_size, dtype=jnp.int32),
+            "attention_mask": jnp.ones((BATCH, SEQ), jnp.int32),
+        },
+        mesh,
+    )
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(BENCH_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = BATCH * SEQ * BENCH_STEPS / dt
+    print(json.dumps({
+        "metric": "pythia410m_train_tokens_per_sec_bs16_seq1024",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

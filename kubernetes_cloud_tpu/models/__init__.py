@@ -1,0 +1,7 @@
+from kubernetes_cloud_tpu.models.causal_lm import (  # noqa: F401
+    CausalLMConfig,
+    PRESETS,
+    forward,
+    init_params,
+    loss_fn,
+)

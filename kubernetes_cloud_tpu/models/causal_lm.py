@@ -1,0 +1,319 @@
+"""Decoder-only causal language models, TPU-first.
+
+One configurable architecture covers the model families the reference
+finetunes and serves — GPT-NeoX/Pythia (parallel residual + partial rotary,
+reference ``finetuner-workflow/`` + ``kubeflow/training-operator/gpt-neox/``),
+GPT-J (parallel residual, full rotary,
+``online-inference/fastertransformer/``), BLOOM (ALiBi + serial residual,
+``online-inference/bloom-176b*/``), and GPT-2 (learned positions,
+``online-inference/gpt-2/``).
+
+Design (deliberately not a torch translation):
+
+* **Pure pytrees + functions.** ``init_params`` returns a nested dict of
+  arrays; ``forward``/``loss_fn`` are pure and jit-compiled with the config
+  static.  Sharding is applied by pairing the pytree with a matching
+  ``PartitionSpec`` pytree (:mod:`kubernetes_cloud_tpu.parallel.sharding`) —
+  no module system, no parameter registry.
+* **Stacked layers + ``lax.scan``.** All transformer blocks live in one
+  pytree node with a leading layer dimension, scanned at trace time: one
+  block is traced/compiled regardless of depth, and rematerialization is a
+  single ``jax.checkpoint`` policy over the scanned body.
+* **bf16 compute, fp32 where it matters.** Matmuls run in bfloat16 on the
+  MXU; norm statistics, softmax and the final loss run in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_cloud_tpu.ops.attention import attention
+from kubernetes_cloud_tpu.ops.layers import (
+    alibi_slopes,
+    apply_rotary,
+    gelu,
+    layer_norm,
+    rms_norm,
+    rope_cache,
+)
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class CausalLMConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # GQA; None => MHA
+    intermediate_size: Optional[int] = None  # None => 4 * hidden
+    max_seq_len: int = 2048
+    # position scheme: "rope" (neox/gptj), "alibi" (bloom), "learned" (gpt2)
+    pos_emb: str = "rope"
+    rope_theta: float = 10000.0
+    rotary_pct: float = 1.0  # GPT-NeoX uses 0.25
+    parallel_residual: bool = True  # neox/gptj True, bloom/gpt2 False
+    norm: str = "layernorm"  # or "rmsnorm"
+    use_bias: bool = True
+    tie_embeddings: bool = False
+    embed_layernorm: bool = False  # BLOOM's post-embedding LayerNorm
+    layernorm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16  # compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False  # rematerialize each block in the backward pass
+    # GPT-J uses interleaved (rotate_every_two) rotary channel pairing;
+    # NeoX/LLaMA use the half-split convention.
+    rope_interleaved: bool = False
+
+    def __post_init__(self):
+        if self.pos_emb not in ("rope", "alibi", "learned"):
+            raise ValueError(f"unknown pos_emb: {self.pos_emb!r}")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"unknown norm: {self.norm!r}")
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must divide evenly into heads")
+        if self.num_kv_heads and self.num_heads % self.num_kv_heads:
+            raise ValueError("num_heads must be a multiple of num_kv_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def rotary_dim(self) -> int:
+        rot = int(self.head_dim * self.rotary_pct)
+        return rot - rot % 2
+
+
+#: Architecture presets for the model families the reference targets.
+#: Sizes follow the public configs of each family (vocab/hidden/layers/heads);
+#: a "-test" preset keeps CI fast.
+PRESETS: dict[str, CausalLMConfig] = {
+    "test-tiny": CausalLMConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        max_seq_len=128, rotary_pct=0.25),
+    "pythia-70m": CausalLMConfig(
+        vocab_size=50304, hidden_size=512, num_layers=6, num_heads=8,
+        rotary_pct=0.25),
+    "pythia-410m": CausalLMConfig(
+        vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
+        rotary_pct=0.25),
+    "pythia-1.4b": CausalLMConfig(
+        vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+        rotary_pct=0.25),
+    "gpt-j-6b": CausalLMConfig(
+        vocab_size=50400, hidden_size=4096, num_layers=28, num_heads=16,
+        rope_theta=10000.0, rotary_pct=64 / 256, tie_embeddings=False,
+        rope_interleaved=True),
+    "gpt-neox-20b": CausalLMConfig(
+        vocab_size=50432, hidden_size=6144, num_layers=44, num_heads=64,
+        rotary_pct=0.25),
+    "bloom-560m": CausalLMConfig(
+        vocab_size=250880, hidden_size=1024, num_layers=24, num_heads=16,
+        pos_emb="alibi", parallel_residual=False, embed_layernorm=True,
+        tie_embeddings=True),
+    "bloom-176b": CausalLMConfig(
+        vocab_size=250880, hidden_size=14336, num_layers=70, num_heads=112,
+        pos_emb="alibi", parallel_residual=False, embed_layernorm=True,
+        tie_embeddings=True),
+    "gpt2-xl": CausalLMConfig(
+        vocab_size=50257, hidden_size=1600, num_layers=48, num_heads=25,
+        pos_emb="learned", parallel_residual=False, tie_embeddings=True,
+        max_seq_len=1024),
+}
+
+
+def _norm_params(cfg: CausalLMConfig, shape_prefix=()) -> Params:
+    shape = (*shape_prefix, cfg.hidden_size)
+    p: Params = {"scale": jnp.ones(shape, cfg.param_dtype)}
+    if cfg.norm == "layernorm":
+        p["bias"] = jnp.zeros(shape, cfg.param_dtype)
+    return p
+
+
+def init_params(cfg: CausalLMConfig, rng: jax.Array) -> Params:
+    """Initialize the parameter pytree.
+
+    Layout (leading ``L`` = num_layers on every block leaf):
+
+    ``embed.wte [V, D]``, optional ``embed.wpe [S, D]``, optional
+    ``embed.ln``; ``blocks.ln1/ln2 [L, D]``, ``blocks.attn.wqkv
+    [L, D, H + 2*Hkv, Dh]``, ``blocks.attn.wo [L, H, Dh, D]``,
+    ``blocks.mlp.wi [L, D, F]``, ``blocks.mlp.wo [L, F, D]``;
+    ``final_ln``; ``lm_head [D, V]`` unless tied.
+    """
+    keys = jax.random.split(rng, 8)
+    d, l, h, hkv, dh, f = (cfg.hidden_size, cfg.num_layers, cfg.num_heads,
+                           cfg.kv_heads, cfg.head_dim, cfg.ffn_size)
+    std = 0.02
+    wo_std = std / math.sqrt(2 * l)  # GPT-2-style scaled residual init
+
+    def normal(key, shape, s=std):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(
+            cfg.param_dtype)
+
+    embed: Params = {"wte": normal(keys[0], (cfg.vocab_size, d))}
+    if cfg.pos_emb == "learned":
+        embed["wpe"] = normal(keys[1], (cfg.max_seq_len, d))
+    if cfg.embed_layernorm:
+        embed["ln"] = _norm_params(cfg)
+
+    blocks: Params = {
+        "ln1": _norm_params(cfg, (l,)),
+        "attn": {
+            "wqkv": normal(keys[2], (l, d, h + 2 * hkv, dh)),
+            "wo": normal(keys[3], (l, h, dh, d), wo_std),
+        },
+        "mlp": {
+            "wi": normal(keys[4], (l, d, f)),
+            "wo": normal(keys[5], (l, f, d), wo_std),
+        },
+    }
+    blocks["ln2"] = _norm_params(cfg, (l,))
+    if cfg.use_bias:
+        blocks["attn"]["bqkv"] = jnp.zeros((l, h + 2 * hkv, dh),
+                                           cfg.param_dtype)
+        blocks["attn"]["bo"] = jnp.zeros((l, d), cfg.param_dtype)
+        blocks["mlp"]["bi"] = jnp.zeros((l, f), cfg.param_dtype)
+        blocks["mlp"]["bo"] = jnp.zeros((l, d), cfg.param_dtype)
+
+    params: Params = {"embed": embed, "blocks": blocks,
+                      "final_ln": _norm_params(cfg)}
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(keys[6], (d, cfg.vocab_size))
+    return params
+
+
+def _norm(cfg: CausalLMConfig, p: Params, x: jax.Array) -> jax.Array:
+    if cfg.norm == "rmsnorm":
+        return rms_norm(x, p["scale"], cfg.layernorm_eps)
+    return layer_norm(x, p["scale"], p["bias"], cfg.layernorm_eps)
+
+
+def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
+           rope: Optional[tuple[jax.Array, jax.Array]],
+           bias: Optional[jax.Array], mask: Optional[jax.Array]) -> jax.Array:
+    b, s, d = x.shape
+    h, hkv, dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+
+    attn_in = _norm(cfg, p["ln1"], x)
+    qkv = jnp.einsum("bsd,dnk->bsnk", attn_in,
+                     p["attn"]["wqkv"].astype(cfg.dtype))
+    if cfg.use_bias:
+        qkv = qkv + p["attn"]["bqkv"].astype(cfg.dtype)
+    q, k, v = jnp.split(qkv, [h, h + hkv], axis=2)
+    if rope is not None:
+        cos, sin = rope
+        q = apply_rotary(q, cos, sin, interleaved=cfg.rope_interleaved)
+        k = apply_rotary(k, cos, sin, interleaved=cfg.rope_interleaved)
+    attn_out = attention(q, k, v, causal=True, bias=bias, mask=mask)
+    attn_out = jnp.einsum("bsnk,nkd->bsd", attn_out,
+                          p["attn"]["wo"].astype(cfg.dtype))
+    if cfg.use_bias:
+        attn_out = attn_out + p["attn"]["bo"].astype(cfg.dtype)
+
+    if cfg.parallel_residual:
+        # GPT-NeoX/GPT-J: x + attn(ln1(x)) + mlp(ln2(x))
+        mlp_in = _norm(cfg, p["ln2"], x)
+    else:
+        x = x + attn_out
+        mlp_in = _norm(cfg, p["ln2"], x)
+
+    hmid = jnp.einsum("bsd,df->bsf", mlp_in, p["mlp"]["wi"].astype(cfg.dtype))
+    if cfg.use_bias:
+        hmid = hmid + p["mlp"]["bi"].astype(cfg.dtype)
+    hmid = gelu(hmid)
+    mlp_out = jnp.einsum("bsf,fd->bsd", hmid, p["mlp"]["wo"].astype(cfg.dtype))
+    if cfg.use_bias:
+        mlp_out = mlp_out + p["mlp"]["bo"].astype(cfg.dtype)
+
+    if cfg.parallel_residual:
+        return x + attn_out + mlp_out
+    return x + mlp_out
+
+
+def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
+            attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Token ids [B, S] → logits [B, S, V] (float32)."""
+    b, s = input_ids.shape
+    x = params["embed"]["wte"][input_ids].astype(cfg.dtype)
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["wpe"][:s].astype(cfg.dtype)
+    if cfg.embed_layernorm:
+        x = _norm(cfg, params["embed"]["ln"], x)
+
+    rope = None
+    bias = None
+    if cfg.pos_emb == "rope":
+        rope = rope_cache(s, cfg.rotary_dim, cfg.rope_theta)
+    elif cfg.pos_emb == "alibi":
+        slopes = alibi_slopes(cfg.num_heads)
+        kpos = jnp.arange(s, dtype=jnp.float32)
+        # [1, H, 1, S]: per-key distance bias; combined with the causal mask
+        # this is exactly ALiBi's -slope * (i - j).
+        bias = (slopes[None, :, None, None] * kpos[None, None, None, :])
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(
+            _block, static_argnums=(0,),
+            policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(carry, layer_params):
+        return block(cfg, layer_params, carry, rope, bias,
+                     attention_mask), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+
+    x = _norm(cfg, params["final_ln"], x)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed"]["wte"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["lm_head"].astype(cfg.dtype))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
+            ) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Next-token cross-entropy with attention-mask label masking.
+
+    Matches the reference trainer's semantics (labels are the inputs,
+    positions with ``attention_mask == 0`` excluded from the loss —
+    ``finetuner-workflow/finetuner/finetuner.py:469-493``).
+    """
+    input_ids = batch["input_ids"]
+    # attention_mask=None stays None through forward (keeps the unpadded
+    # fast path / pallas dispatch eligible); the ones-mask is only for
+    # label accounting.
+    attn_mask = batch.get("attention_mask")
+    logits = forward(cfg, params, input_ids, attention_mask=attn_mask)
+    mask = jnp.ones_like(input_ids) if attn_mask is None else attn_mask
+    targets = input_ids[:, 1:]
+    logits = logits[:, :-1]
+    tgt_mask = (mask[:, 1:] != 0) & (mask[:, :-1] != 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(tgt_mask.sum(), 1)
+    loss = jnp.where(tgt_mask, nll, 0.0).sum() / denom
+    n_tokens = tgt_mask.sum()
+    return loss, {"loss": loss, "tokens": n_tokens}
+
+
+def param_count(params: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

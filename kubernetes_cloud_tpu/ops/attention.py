@@ -1,0 +1,113 @@
+"""Multi-head attention with selectable backend.
+
+``impl="xla"`` is the reference implementation (einsum + fp32 softmax) that
+runs anywhere, including the CPU-simulated test mesh.  ``impl="pallas"``
+dispatches to the fused flash-attention TPU kernel in
+:mod:`kubernetes_cloud_tpu.ops.flash_attention`.  ``impl="auto"`` picks
+pallas on TPU backends when shapes are tile-aligned, xla otherwise.
+
+This replaces the reference's stack of attention engines — torch SDPA in the
+finetuner, FasterTransformer fused CUDA decoders
+(``online-inference/fastertransformer/build/Dockerfile:16-70``), and
+DeepSpeed-Inference kernel injection
+(``online-inference/bloom-176b-deepspeed/Dockerfile:1-15``) — with one
+mesh-sharded op: head dimension sharded over the ``model`` axis, batch over
+``data``/``fsdp``, sequence over ``seq`` (ring attention).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e15
+
+
+def _mha_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    bias: Optional[jax.Array],
+    mask: Optional[jax.Array],
+    scale: float,
+) -> jax.Array:
+    # q: [B, Sq, H, Dh], k/v: [B, Sk, Hkv, Dh] (GQA when Hkv < H)
+    b, sq, h, dh = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        group = h // hkv
+        q = q.reshape(b, sq, hkv, group, dh)
+        logits = jnp.einsum("bqkgd,bskd->bkgqs", q, k) * scale
+        logits = logits.reshape(b, h, sq, k.shape[1])
+    else:
+        logits = jnp.einsum("bqhd,bshd->bhqs", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    sk = k.shape[1]
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    if mask is not None:
+        # mask: [B, Sk] (1 = attend) or [B, 1, Sq, Sk]
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        logits = jnp.where(mask != 0, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if hkv != h:
+        group = h // hkv
+        probs_g = probs.reshape(b, hkv, group, sq, sk)
+        out = jnp.einsum("bkgqs,bskd->bqkgd", probs_g, v)
+        return out.reshape(b, sq, h, dh)
+    return jnp.einsum("bhqs,bshd->bqhd", probs, v)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+    mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Scaled dot-product attention over [B, S, H, Dh] tensors.
+
+    ``bias``: additive [B or 1, H, Sq, Sk] bias (ALiBi).
+    ``mask``: [B, Sk] key padding mask or full [B, 1, Sq, Sk] mask, nonzero
+    = attend (the reference trains with exactly this padding-mask semantics,
+    ``finetuner-workflow/finetuner/finetuner.py:475-493``).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if impl == "auto":
+        impl = _pick_impl(q, bias, mask)
+    if impl == "pallas":
+        from kubernetes_cloud_tpu.ops import flash_attention
+
+        return flash_attention.flash_attention(
+            q, k, v, causal=causal, bias=bias, mask=mask, scale=scale
+        )
+    return _mha_xla(q, k, v, causal=causal, bias=bias, mask=mask, scale=scale)
+
+
+def _pick_impl(q, bias, mask) -> str:
+    try:
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    except RuntimeError:
+        on_tpu = False
+    if not on_tpu:
+        return "xla"
+    dh = q.shape[-1]
+    if q.shape[1] % 128 or dh % 128 or bias is not None or mask is not None:
+        return "xla"
+    from kubernetes_cloud_tpu.ops import flash_attention
+
+    return "pallas" if flash_attention.available() else "xla"

@@ -1,0 +1,16 @@
+"""Fused flash-attention Pallas TPU kernel (placeholder wiring).
+
+Real kernel lands with the serving/long-context milestone; until then
+``available()`` returns False and :func:`attention` uses the XLA path,
+which XLA already fuses well on TPU for training shapes.
+"""
+
+from __future__ import annotations
+
+
+def available() -> bool:
+    return False
+
+
+def flash_attention(q, k, v, *, causal, bias, mask, scale):
+    raise NotImplementedError("pallas flash attention not yet wired in")

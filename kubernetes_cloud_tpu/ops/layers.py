@@ -1,0 +1,92 @@
+"""Core numeric layers, written TPU-first.
+
+Everything here is shape-static and jit-traceable; reductions that are
+numerically delicate (norm statistics, softmax) run in float32 while the
+bulk compute stays bfloat16 so matmuls hit the MXU at full rate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float = 1e-5) -> jax.Array:
+    """LayerNorm with fp32 statistics, output in x.dtype."""
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = jnp.square(x32 - mean).mean(-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.square(x32).mean(-1, keepdims=True) + eps)
+    return (y * scale).astype(x.dtype)
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x, approximate=True)
+
+
+def rope_cache(seq_len: int, rotary_dim: int, theta: float = 10000.0,
+               dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+    """Precompute rotary cos/sin tables of shape [seq_len, rotary_dim // 2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, rotary_dim, 2,
+                                           dtype=jnp.float32) / rotary_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                 positions: jax.Array | None = None,
+                 interleaved: bool = False) -> jax.Array:
+    """Rotate the first ``2 * cos.shape[-1]`` channels of each head.
+
+    x: [B, S, H, Dh]; cos/sin: [max_S, rot/2] (or gathered [B, S, rot/2]).
+    Partial rotary (GPT-NeoX ``rotary_pct`` < 1) leaves trailing channels
+    untouched.  ``interleaved=False`` is the half-split ("rotate_half")
+    convention of GPT-NeoX / LLaMA; ``interleaved=True`` is GPT-J's
+    rotate-every-two pairing (channels (0,1), (2,3), ...).
+    """
+    rot = 2 * cos.shape[-1]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    if positions is None:
+        c = cos[: x.shape[1]][None, :, None, :]
+        s = sin[: x.shape[1]][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    c = c.astype(x.dtype)
+    s = s.astype(x.dtype)
+    if interleaved:
+        x1 = x_rot[..., 0::2]
+        x2 = x_rot[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        out = jnp.stack([r1, r2], axis=-1).reshape(x_rot.shape)
+    else:
+        x1, x2 = jnp.split(x_rot, 2, axis=-1)
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return jnp.concatenate([out, x_pass], axis=-1) if x_pass.shape[-1] else out
+
+
+def alibi_slopes(num_heads: int) -> jax.Array:
+    """ALiBi per-head slopes (BLOOM position scheme).
+
+    Standard geometric construction: for ``n = 2**floor(log2(H))`` heads the
+    slopes are ``2**(-8i/n)``; leftover heads interleave at half offsets.
+    """
+    import math
+
+    n = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-8.0 / n)
+    slopes = [base ** (i + 1) for i in range(n)]
+    if n < num_heads:
+        extra_base = 2.0 ** (-4.0 / n)
+        extra = [extra_base ** (2 * i + 1) for i in range(num_heads - n)]
+        slopes += extra
+    return jnp.asarray(slopes, dtype=jnp.float32)

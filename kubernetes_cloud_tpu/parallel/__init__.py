@@ -1,0 +1,7 @@
+from kubernetes_cloud_tpu.parallel.sharding import (  # noqa: F401
+    batch_spec,
+    logical_to_physical,
+    param_specs,
+    shard_batch,
+    shard_params,
+)

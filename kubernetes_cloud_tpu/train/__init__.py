@@ -1,0 +1,6 @@
+from kubernetes_cloud_tpu.train.train_step import (  # noqa: F401
+    TrainConfig,
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+)

@@ -1,0 +1,136 @@
+"""Training step: loss → grad → optimizer update, all under one jit.
+
+The reference splits this across HF ``Trainer`` + DeepSpeed engine + fused
+CPU-Adam C++ op (``finetuner-workflow/finetuner/ds_config.json:10-18,35-40``,
+``Dockerfile:28-35``).  On TPU the whole step is one XLA program: optax
+AdamW with warmup (the ds_config optimizer/scheduler equivalent), gradients
+reduced by XLA collectives implied by the param/batch shardings, optimizer
+state sharded exactly like the parameters (the ZeRO analogue) — no
+launcher, no engine, no offload op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+
+from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig, init_params, loss_fn
+from kubernetes_cloud_tpu.parallel.sharding import (
+    logical_to_physical,
+    param_specs,
+)
+
+TrainState = dict[str, Any]  # {"params", "opt_state", "step"}
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Optimizer/schedule hyperparameters.
+
+    Defaults mirror the reference's DeepSpeed config
+    (``ds_config.json:10-26``: AdamW lr 5e-5, betas (0.9, 0.999), eps 1e-8,
+    weight-decay 0, WarmupLR) and its ``--lr`` / ``--warmup-ratio`` flags.
+    """
+
+    learning_rate: float = 5e-5
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+    weight_decay: float = 0.0
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    grad_clip: Optional[float] = 1.0
+    lr_schedule: str = "warmup_cosine"  # or "warmup_constant"
+
+    def __post_init__(self):
+        if self.lr_schedule not in ("warmup_cosine", "warmup_constant"):
+            raise ValueError(f"unknown lr_schedule: {self.lr_schedule!r}")
+
+
+#: Leaf names excluded from weight decay (standard HF-Trainer exclusion the
+#: reference inherits: biases and norm parameters).  Name-based because the
+#: stacked-layer layout makes even bias leaves 2-3D.
+_NO_DECAY = frozenset({"scale", "bias", "bqkv", "bo", "bi"})
+
+
+def decay_mask(params) -> Any:
+    def leaf_mask(path, _):
+        last = path[-1]
+        name = last.key if hasattr(last, "key") else getattr(last, "name",
+                                                            str(last))
+        return name not in _NO_DECAY
+
+    return jax.tree_util.tree_map_with_path(leaf_mask, params)
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    if cfg.lr_schedule == "warmup_cosine":
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.learning_rate, cfg.warmup_steps,
+            max(cfg.total_steps, cfg.warmup_steps + 1))
+    else:
+        schedule = optax.linear_schedule(
+            0.0, cfg.learning_rate, cfg.warmup_steps)
+    chain = []
+    if cfg.grad_clip:
+        chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+    chain.append(optax.adamw(
+        schedule, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
+        weight_decay=cfg.weight_decay, mask=decay_mask))
+    return optax.chain(*chain)
+
+
+def init_train_state(
+    model_cfg: CausalLMConfig,
+    train_cfg: TrainConfig,
+    rng: jax.Array,
+    mesh=None,
+) -> TrainState:
+    """Initialize params + optimizer state, sharded over ``mesh`` if given.
+
+    Initialization runs *inside* jit with sharded out-shardings so a model
+    larger than one device's HBM is born sharded (the reference needs
+    ``no_init`` + Tensorizer streaming to avoid host-RAM blowups,
+    ``finetuner.py:801-830``; here XLA just materializes each shard on its
+    device).
+    """
+    optimizer = make_optimizer(train_cfg)
+
+    def init():
+        params = init_params(model_cfg, rng)
+        return {"params": params, "opt_state": optimizer.init(params),
+                "step": jax.numpy.zeros((), jax.numpy.int32)}
+
+    if mesh is None:
+        return jax.jit(init)()
+    shapes = jax.eval_shape(init)
+    specs = param_specs(shapes)  # rule table works on the full state tree
+    shardings = logical_to_physical(specs, mesh)
+    return jax.jit(init, out_shardings=shardings)()
+
+
+def make_train_step(
+    model_cfg: CausalLMConfig,
+    train_cfg: TrainConfig,
+    loss: Callable = loss_fn,
+) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
+    """Build the (unjitted) train step; callers jit with
+    ``donate_argnums=0`` so parameter/optimizer buffers are reused."""
+    optimizer = make_optimizer(train_cfg)
+
+    def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        (l, metrics), grads = jax.value_and_grad(loss, argnums=1,
+                                                 has_aux=True)(
+            model_cfg, state["params"], batch)
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return ({"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1}, metrics)
+
+    return step

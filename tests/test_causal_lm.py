@@ -1,0 +1,88 @@
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.core import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.models import PRESETS, forward, init_params, loss_fn
+from kubernetes_cloud_tpu.parallel import shard_batch, shard_params
+
+CFG = PRESETS["test-tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def ids():
+    return jax.random.randint(jax.random.key(1), (8, 16), 0, CFG.vocab_size)
+
+
+def test_forward_shape_and_dtype(params, ids):
+    logits = jax.jit(forward, static_argnums=0)(CFG, params, ids)
+    assert logits.shape == (8, 16, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality(params, ids):
+    """Perturbing token t must not change logits before t."""
+    f = jax.jit(forward, static_argnums=0)
+    base = f(CFG, params, ids)
+    ids2 = ids.at[:, 10].set((ids[:, 10] + 1) % CFG.vocab_size)
+    pert = f(CFG, params, ids2)
+    np.testing.assert_allclose(base[:, :10], pert[:, :10], atol=1e-5)
+    assert not np.allclose(base[:, 10:], pert[:, 10:])
+
+
+def test_initial_loss_near_uniform(params, ids):
+    batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids)}
+    loss, metrics = jax.jit(loss_fn, static_argnums=0)(CFG, params, batch)
+    assert abs(float(loss) - np.log(CFG.vocab_size)) < 0.5
+    assert int(metrics["tokens"]) == 8 * 15
+
+
+def test_attention_mask_excludes_padding(params, ids):
+    """Loss over a padded batch must equal loss over the unpadded rows."""
+    mask = jnp.ones_like(ids).at[:, 12:].set(0)
+    batch = {"input_ids": ids, "attention_mask": mask}
+    _, metrics = jax.jit(loss_fn, static_argnums=0)(CFG, params, batch)
+    assert int(metrics["tokens"]) == 8 * 11  # pairs fully inside the mask
+
+
+@pytest.mark.parametrize("variant", ["bloom", "gpt2", "rmsnorm_gqa"])
+def test_architecture_variants(variant, ids):
+    overrides = {
+        "bloom": dict(pos_emb="alibi", parallel_residual=False,
+                      embed_layernorm=True, tie_embeddings=True),
+        "gpt2": dict(pos_emb="learned", parallel_residual=False,
+                     tie_embeddings=True),
+        "rmsnorm_gqa": dict(norm="rmsnorm", use_bias=False, num_kv_heads=2),
+    }[variant]
+    cfg = dataclasses.replace(CFG, **overrides)
+    p = init_params(cfg, jax.random.key(0))
+    logits = jax.jit(forward, static_argnums=0)(cfg, p, ids)
+    assert logits.shape == (8, 16, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_remat_matches_no_remat(params, ids):
+    cfg_r = dataclasses.replace(CFG, remat=True)
+    batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids)}
+    g1 = jax.jit(jax.grad(lambda p: loss_fn(CFG, p, batch)[0]))(params)
+    g2 = jax.jit(jax.grad(lambda p: loss_fn(cfg_r, p, batch)[0]))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-2, atol=2e-3),
+        g1, g2)
+
+
+def test_sharded_matches_unsharded(devices8, params, ids):
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2), devices=devices8)
+    batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids)}
+    loss, _ = jax.jit(loss_fn, static_argnums=0)(CFG, params, batch)
+    sloss, _ = jax.jit(loss_fn, static_argnums=0)(
+        CFG, shard_params(params, mesh), shard_batch(batch, mesh))
+    np.testing.assert_allclose(float(loss), float(sloss), rtol=1e-3)

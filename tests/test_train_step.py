@@ -1,0 +1,65 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_cloud_tpu.core import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.models import PRESETS
+from kubernetes_cloud_tpu.parallel import shard_batch
+from kubernetes_cloud_tpu.train import (
+    TrainConfig,
+    init_train_state,
+    make_train_step,
+)
+
+CFG = PRESETS["test-tiny"]
+TCFG = TrainConfig(learning_rate=1e-3, warmup_steps=2, total_steps=50)
+
+
+def _batch(key, n=8, s=32):
+    ids = jax.random.randint(key, (n, s), 0, CFG.vocab_size)
+    return {"input_ids": ids, "attention_mask": jnp.ones_like(ids)}
+
+
+def test_loss_decreases_single_device():
+    state = init_train_state(CFG, TCFG, jax.random.key(0))
+    step = jax.jit(make_train_step(CFG, TCFG), donate_argnums=0)
+    batch = _batch(jax.random.key(1))
+    first = None
+    for _ in range(20):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first - 0.5, (
+        f"loss did not decrease: {first} -> {float(metrics['loss'])}")
+    assert int(state["step"]) == 20
+    assert np.isfinite(float(metrics["grad_norm"]))
+
+
+def test_sharded_training_matches_single_device(devices8):
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2), devices=devices8)
+    batch = _batch(jax.random.key(1))
+
+    state1 = init_train_state(CFG, TCFG, jax.random.key(0))
+    step1 = jax.jit(make_train_step(CFG, TCFG))
+    state8 = init_train_state(CFG, TCFG, jax.random.key(0), mesh)
+    step8 = jax.jit(make_train_step(CFG, TCFG))
+
+    sbatch = shard_batch(batch, mesh)
+    for _ in range(3):
+        state1, m1 = step1(state1, batch)
+        state8, m8 = step8(state8, sbatch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]),
+                               rtol=1e-3)
+
+
+def test_opt_state_is_sharded(devices8):
+    mesh = build_mesh(MeshSpec(data=1, fsdp=4, model=2), devices=devices8)
+    state = init_train_state(CFG, TCFG, jax.random.key(0), mesh)
+    # adam mu for the qkv kernel must be sharded like the kernel itself
+    leaves = jax.tree.leaves(
+        state["opt_state"],
+        is_leaf=lambda x: hasattr(x, "sharding") and x.ndim >= 2)
+    big = [x for x in leaves if hasattr(x, "sharding") and x.ndim >= 3]
+    assert any(
+        any(s is not None for s in x.sharding.spec) for x in big
+    ), "no optimizer leaf is sharded"
