@@ -36,7 +36,6 @@ from kubernetes_cloud_tpu.ops.attention import attention
 from kubernetes_cloud_tpu.ops.layers import (
     alibi_slopes,
     apply_rotary,
-    gelu,
     layer_norm,
     rms_norm,
     rope_cache,
@@ -60,6 +59,8 @@ class CausalLMConfig:
     rotary_pct: float = 1.0  # GPT-NeoX uses 0.25
     parallel_residual: bool = True  # neox/gptj True, bloom/gpt2 False
     norm: str = "layernorm"  # or "rmsnorm"
+    # "gelu_tanh" (GPT-2/GPT-J/BLOOM) or "gelu_exact" (erf; GPT-NeoX/Pythia)
+    act: str = "gelu_tanh"
     use_bias: bool = True
     tie_embeddings: bool = False
     embed_layernorm: bool = False  # BLOOM's post-embedding LayerNorm
@@ -76,6 +77,8 @@ class CausalLMConfig:
             raise ValueError(f"unknown pos_emb: {self.pos_emb!r}")
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError(f"unknown norm: {self.norm!r}")
+        if self.act not in ("gelu_tanh", "gelu_exact"):
+            raise ValueError(f"unknown act: {self.act!r}")
         if self.hidden_size % self.num_heads:
             raise ValueError("hidden_size must divide evenly into heads")
         if self.num_kv_heads and self.num_heads % self.num_kv_heads:
@@ -107,12 +110,15 @@ PRESETS: dict[str, CausalLMConfig] = {
         vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
         max_seq_len=128, rotary_pct=0.25),
     "pythia-70m": CausalLMConfig(
+        act="gelu_exact",
         vocab_size=50304, hidden_size=512, num_layers=6, num_heads=8,
         rotary_pct=0.25),
     "pythia-410m": CausalLMConfig(
+        act="gelu_exact",
         vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
         rotary_pct=0.25),
     "pythia-1.4b": CausalLMConfig(
+        act="gelu_exact",
         vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
         rotary_pct=0.25),
     "gpt-j-6b": CausalLMConfig(
@@ -120,6 +126,7 @@ PRESETS: dict[str, CausalLMConfig] = {
         rope_theta=10000.0, rotary_pct=64 / 256, tie_embeddings=False,
         rope_interleaved=True),
     "gpt-neox-20b": CausalLMConfig(
+        act="gelu_exact",
         vocab_size=50432, hidden_size=6144, num_layers=44, num_heads=64,
         rotary_pct=0.25),
     "bloom-560m": CausalLMConfig(
@@ -236,7 +243,7 @@ def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
     hmid = jnp.einsum("bsd,df->bsf", mlp_in, p["mlp"]["wi"].astype(cfg.dtype))
     if cfg.use_bias:
         hmid = hmid + p["mlp"]["bi"].astype(cfg.dtype)
-    hmid = gelu(hmid)
+    hmid = jax.nn.gelu(hmid, approximate=cfg.act == "gelu_tanh")
     mlp_out = jnp.einsum("bsf,fd->bsd", hmid, p["mlp"]["wo"].astype(cfg.dtype))
     if cfg.use_bias:
         mlp_out = mlp_out + p["mlp"]["bo"].astype(cfg.dtype)
@@ -286,6 +293,8 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
     else:
         logits = jnp.einsum("bsd,dv->bsv", x,
                             params["lm_head"].astype(cfg.dtype))
+    if "lm_head_bias" in params:  # GPT-J's biased output projection
+        logits = logits + params["lm_head_bias"].astype(cfg.dtype)
     return logits.astype(jnp.float32)
 
 

@@ -1,7 +1,6 @@
 from kubernetes_cloud_tpu.ops.layers import (  # noqa: F401
     alibi_slopes,
     apply_rotary,
-    gelu,
     layer_norm,
     rms_norm,
     rope_cache,

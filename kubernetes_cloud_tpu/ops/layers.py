@@ -27,10 +27,6 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
     return (y * scale).astype(x.dtype)
 
 
-def gelu(x: jax.Array) -> jax.Array:
-    return jax.nn.gelu(x, approximate=True)
-
-
 def rope_cache(seq_len: int, rotary_dim: int, theta: float = 10000.0,
                dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
     """Precompute rotary cos/sin tables of shape [seq_len, rotary_dim // 2]."""

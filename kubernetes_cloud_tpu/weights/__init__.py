@@ -1,0 +1,11 @@
+from kubernetes_cloud_tpu.weights.tensorstream import (  # noqa: F401
+    read_index,
+    load_pytree,
+    write_pytree,
+)
+from kubernetes_cloud_tpu.weights.checkpoint import (  # noqa: F401
+    Checkpointer,
+    latest_checkpoint,
+    mark_ready,
+    wait_ready,
+)

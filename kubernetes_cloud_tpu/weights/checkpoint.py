@@ -1,0 +1,105 @@
+"""Training checkpoints + artifact-ready signaling.
+
+Keeps the reference's operational contracts:
+
+* ``checkpoint-N`` directory naming with newest-step auto-discovery for
+  crash resume (``finetuner-workflow/finetuner/finetuner.py:349-360``,
+  resumed at ``:1049-1052``);
+* the ``.ready.txt`` sentinel written next to a finished artifact
+  (``finetuner.py:1062``) and the downstream timeout-poll gate
+  (``online-inference/bloom-176b/bloom.py:79-90``,
+  ``online-inference/dalle-mini/downloader/download.py:31-33``);
+
+while replacing torch/HF-Trainer serialization with Orbax: async,
+sharding-aware save/restore that scales to multi-host meshes (SURVEY.md
+§5.4 TPU plan).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+# Matches the reference's "checkpoint-N" layout and Orbax's
+# step_prefix-generated "checkpoint_N" directories.
+_CKPT_RE = re.compile(r"^checkpoint[-_](\d+)$")
+READY_SENTINEL = ".ready.txt"
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest ``checkpoint-N`` subdirectory, or None."""
+    if not os.path.isdir(directory):
+        return None
+    best_step, best = -1, None
+    for entry in os.listdir(directory):
+        m = _CKPT_RE.match(entry)
+        if m and int(m.group(1)) > best_step:
+            best_step, best = int(m.group(1)), os.path.join(directory, entry)
+    return best
+
+
+def mark_ready(directory: str, text: str = "ready") -> None:
+    with open(os.path.join(directory, READY_SENTINEL), "w") as f:
+        f.write(text)
+
+
+def is_ready(directory: str) -> bool:
+    return os.path.exists(os.path.join(directory, READY_SENTINEL))
+
+
+def wait_ready(directory: str, timeout: float = 600.0,
+               poll: float = 5.0) -> bool:
+    """Poll for the ready sentinel (reference ``bloom.py:79-90``)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if is_ready(directory):
+            return True
+        time.sleep(poll)
+    return is_ready(directory)
+
+
+class Checkpointer:
+    """Async sharding-aware checkpoint manager over ``checkpoint-N`` dirs."""
+
+    def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
+                 async_save: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                step_prefix="checkpoint",
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore(self, state_template: Any,
+                step: Optional[int] = None) -> Any:
+        """Restore into the shardings/structure of ``state_template``
+        (pass the abstract state from ``jax.eval_shape`` + shardings, or a
+        concrete state to overwrite)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {self.directory}")
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(state_template))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

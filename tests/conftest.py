@@ -18,6 +18,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Some environments pin the default platform to a real accelerator
+# regardless of JAX_PLATFORMS (e.g. the axon TPU shim).  Tests must run on
+# the 8-device CPU simulation with full fp32 matmul precision, so force the
+# default device to CPU; meshes are built from jax.devices("cpu") anyway.
+if jax.default_backend() != "cpu":
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
 
 def cpu_devices(n=8):
     devs = jax.devices("cpu")

@@ -1,0 +1,69 @@
+"""Logits parity vs HuggingFace transformers (torch CPU) for every model
+family the framework imports — the strongest architecture-correctness test
+(golden-value tests per SURVEY.md §4)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from kubernetes_cloud_tpu.models.causal_lm import forward  # noqa: E402
+from kubernetes_cloud_tpu.weights.hf_import import (  # noqa: E402
+    config_from_hf,
+    import_state_dict,
+)
+
+
+def _parity(hf_model, arch, atol=2e-4):
+    hf_model.eval()
+    cfg = config_from_hf(hf_model.config)
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = import_state_dict(cfg, hf_model.state_dict(), arch)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, 24))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(forward(cfg, params, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, atol=atol, rtol=1e-3)
+
+
+def test_gpt_neox_parity():
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=True, hidden_act="gelu")
+    _parity(transformers.GPTNeoXForCausalLM(hf_cfg), "gpt_neox")
+
+
+def test_gpt_neox_serial_residual_parity():
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=64, rotary_pct=1.0,
+        use_parallel_residual=False, hidden_act="gelu")
+    _parity(transformers.GPTNeoXForCausalLM(hf_cfg), "gpt_neox")
+
+
+def test_gptj_parity():
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+        n_positions=64, n_inner=None)
+    _parity(transformers.GPTJForCausalLM(hf_cfg), "gptj")
+
+
+def test_bloom_parity():
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4)
+    _parity(transformers.BloomForCausalLM(hf_cfg), "bloom")
+
+
+def test_gpt2_parity():
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64)
+    _parity(transformers.GPT2LMHeadModel(hf_cfg), "gpt2")

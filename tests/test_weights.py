@@ -1,0 +1,116 @@
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubernetes_cloud_tpu.core import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.weights import (
+    Checkpointer,
+    latest_checkpoint,
+    load_pytree,
+    mark_ready,
+    read_index,
+    wait_ready,
+    write_pytree,
+)
+from kubernetes_cloud_tpu.weights.checkpoint import is_ready
+
+
+@pytest.fixture
+def tree():
+    rng = np.random.RandomState(0)
+    return {
+        "embed": {"wte": rng.randn(32, 16).astype(np.float32)},
+        "blocks": {
+            "attn": {"wqkv": rng.randn(2, 16, 12, 4).astype(np.float32)},
+            "scale": np.ones((2, 16), np.float32),
+        },
+        "step": np.int32(7),
+    }
+
+
+def test_roundtrip(tmp_path, tree):
+    path = str(tmp_path / "model.tensors")
+    write_pytree(path, tree, meta={"run": "r1"})
+    idx = read_index(path)
+    assert idx["meta"]["run"] == "r1"
+    assert idx["tensors"]["embed.wte"]["shape"] == [32, 16]
+    out = load_pytree(path)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 tree, out)
+
+
+def test_dtype_cast_on_load(tmp_path, tree):
+    path = str(tmp_path / "model.tensors")
+    write_pytree(path, {"w": tree["embed"]["wte"]})
+    out = load_pytree(path, dtype=jnp.bfloat16)
+    assert out["w"].dtype == jnp.bfloat16
+
+
+def test_sharded_load(tmp_path, tree, devices8):
+    mesh = build_mesh(MeshSpec(data=1, fsdp=4, model=2), devices=devices8)
+    path = str(tmp_path / "model.tensors")
+    write_pytree(path, tree)
+    shardings = {
+        "embed": {"wte": NamedSharding(mesh, P("model", "fsdp"))},
+        "blocks": {
+            "attn": {"wqkv": NamedSharding(mesh,
+                                           P(None, "fsdp", "model", None))},
+            "scale": None,
+        },
+        "step": None,
+    }
+    out = load_pytree(path, shardings)
+    assert out["embed"]["wte"].sharding.spec == P("model", "fsdp")
+    np.testing.assert_array_equal(np.asarray(out["embed"]["wte"]),
+                                  tree["embed"]["wte"])
+    np.testing.assert_array_equal(np.asarray(out["blocks"]["attn"]["wqkv"]),
+                                  tree["blocks"]["attn"]["wqkv"])
+
+
+def test_bad_magic(tmp_path):
+    path = str(tmp_path / "junk.tensors")
+    with open(path, "wb") as f:
+        f.write(b"NOTMAGIC" + b"\0" * 64)
+    with pytest.raises(ValueError, match="bad magic"):
+        read_index(path)
+
+
+def test_ready_sentinel(tmp_path):
+    d = str(tmp_path)
+    assert not is_ready(d)
+    assert not wait_ready(d, timeout=0.2, poll=0.05)
+    mark_ready(d)
+    assert wait_ready(d, timeout=0.2, poll=0.05)
+
+
+def test_latest_checkpoint_discovery(tmp_path):
+    assert latest_checkpoint(str(tmp_path / "missing")) is None
+    for n in (100, 500, 1000):
+        os.makedirs(tmp_path / f"checkpoint-{n}")
+    os.makedirs(tmp_path / "not-a-checkpoint")
+    assert latest_checkpoint(str(tmp_path)).endswith("checkpoint-1000")
+
+
+def test_checkpointer_save_restore(tmp_path, devices8):
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2), devices=devices8)
+    state = {
+        "params": {"w": jax.device_put(
+            jnp.arange(64.0).reshape(8, 8),
+            NamedSharding(mesh, P("fsdp", "model")))},
+        "step": jnp.int32(3),
+    }
+    ckpt = Checkpointer(str(tmp_path / "ckpts"), async_save=False)
+    assert ckpt.save(500, state)
+    ckpt.wait()
+    assert ckpt.latest_step() == 500
+    restored = ckpt.restore(jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "sharding") else x, state))
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.arange(64.0).reshape(8, 8))
+    assert restored["params"]["w"].sharding.spec == P("fsdp", "model")
+    ckpt.close()
