@@ -211,12 +211,15 @@ def _norm(cfg: CausalLMConfig, p: Params, x: jax.Array) -> jax.Array:
     return layer_norm(x, p["scale"], p["bias"], cfg.layernorm_eps)
 
 
-def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
-           rope: Optional[tuple[jax.Array, jax.Array]],
-           bias: Optional[jax.Array], mask: Optional[jax.Array]) -> jax.Array:
-    b, s, d = x.shape
-    h, hkv, dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+def _project_qkv(cfg: CausalLMConfig, p: Params, x: jax.Array, *,
+                 rope: Optional[tuple[jax.Array, jax.Array]],
+                 q_positions: Optional[jax.Array] = None):
+    """Block front half: pre-norm + fused QKV projection + rotary.
 
+    Shared between the training ``forward`` and the KV-cached decode path
+    (:mod:`kubernetes_cloud_tpu.models.generate`) so the two can never
+    diverge architecturally.  Returns (q, k, v, attn_in)."""
+    h, hkv = cfg.num_heads, cfg.kv_heads
     attn_in = _norm(cfg, p["ln1"], x)
     qkv = jnp.einsum("bsd,dnk->bsnk", attn_in,
                      p["attn"]["wqkv"].astype(cfg.dtype))
@@ -225,10 +228,17 @@ def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
     q, k, v = jnp.split(qkv, [h, h + hkv], axis=2)
     if rope is not None:
         cos, sin = rope
-        q = apply_rotary(q, cos, sin, interleaved=cfg.rope_interleaved)
-        k = apply_rotary(k, cos, sin, interleaved=cfg.rope_interleaved)
-    attn_out = attention(q, k, v, causal=True, bias=bias, mask=mask)
-    attn_out = jnp.einsum("bsnk,nkd->bsd", attn_out,
+        q = apply_rotary(q, cos, sin, positions=q_positions,
+                         interleaved=cfg.rope_interleaved)
+        k = apply_rotary(k, cos, sin, positions=q_positions,
+                         interleaved=cfg.rope_interleaved)
+    return q, k, v, attn_in
+
+
+def _finish_block(cfg: CausalLMConfig, p: Params, x: jax.Array,
+                  attn_vec: jax.Array, attn_in: jax.Array) -> jax.Array:
+    """Block back half: output projection + residual wiring + MLP."""
+    attn_out = jnp.einsum("bsnk,nkd->bsd", attn_vec,
                           p["attn"]["wo"].astype(cfg.dtype))
     if cfg.use_bias:
         attn_out = attn_out + p["attn"]["bo"].astype(cfg.dtype)
@@ -253,15 +263,46 @@ def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
     return x + mlp_out
 
 
+def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
+           rope: Optional[tuple[jax.Array, jax.Array]],
+           bias: Optional[jax.Array], mask: Optional[jax.Array]) -> jax.Array:
+    q, k, v, attn_in = _project_qkv(cfg, p, x, rope=rope)
+    attn_vec = attention(q, k, v, causal=True, bias=bias, mask=mask)
+    return _finish_block(cfg, p, x, attn_vec, attn_in)
+
+
+def _embed(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
+           positions: Optional[jax.Array] = None) -> jax.Array:
+    x = params["embed"]["wte"][input_ids].astype(cfg.dtype)
+    if cfg.pos_emb == "learned":
+        if positions is None:
+            x = x + params["embed"]["wpe"][: input_ids.shape[1]].astype(
+                cfg.dtype)
+        else:
+            x = x + params["embed"]["wpe"][positions].astype(cfg.dtype)
+    if cfg.embed_layernorm:
+        x = _norm(cfg, params["embed"]["ln"], x)
+    return x
+
+
+def _unembed(cfg: CausalLMConfig, params: Params, x: jax.Array) -> jax.Array:
+    x = _norm(cfg, params["final_ln"], x)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed"]["wte"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["lm_head"].astype(cfg.dtype))
+    if "lm_head_bias" in params:  # GPT-J's biased output projection
+        logits = logits + params["lm_head_bias"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
 def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
             attention_mask: Optional[jax.Array] = None) -> jax.Array:
     """Token ids [B, S] → logits [B, S, V] (float32)."""
     b, s = input_ids.shape
-    x = params["embed"]["wte"][input_ids].astype(cfg.dtype)
-    if cfg.pos_emb == "learned":
-        x = x + params["embed"]["wpe"][:s].astype(cfg.dtype)
-    if cfg.embed_layernorm:
-        x = _norm(cfg, params["embed"]["ln"], x)
+    x = _embed(cfg, params, input_ids)
 
     rope = None
     bias = None
@@ -285,17 +326,7 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
                      attention_mask), None
 
     x, _ = jax.lax.scan(body, x, params["blocks"])
-
-    x = _norm(cfg, params["final_ln"], x)
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("bsd,vd->bsv", x,
-                            params["embed"]["wte"].astype(cfg.dtype))
-    else:
-        logits = jnp.einsum("bsd,dv->bsv", x,
-                            params["lm_head"].astype(cfg.dtype))
-    if "lm_head_bias" in params:  # GPT-J's biased output projection
-        logits = logits + params["lm_head_bias"].astype(cfg.dtype)
-    return logits.astype(jnp.float32)
+    return _unembed(cfg, params, x)
 
 
 def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],

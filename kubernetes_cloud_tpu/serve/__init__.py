@@ -1,0 +1,6 @@
+from kubernetes_cloud_tpu.serve.model import Model  # noqa: F401
+from kubernetes_cloud_tpu.serve.server import ModelServer  # noqa: F401
+from kubernetes_cloud_tpu.serve.lm_service import (  # noqa: F401
+    ByteTokenizer,
+    CausalLMService,
+)

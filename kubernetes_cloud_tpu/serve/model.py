@@ -1,0 +1,60 @@
+"""Predictor base class — the ``kserve.Model`` contract without kserve.
+
+Mirrors the interface every reference predictor implements
+(``online-inference/stable-diffusion/service/service.py:163-258``,
+``online-inference/bloom-176b/model/bloom.py:40-90``,
+``online-inference/tensorizer-isvc/kserve/kserve_api.py:19-74``): a named
+model with ``load()`` flipping ``ready``, ``predict(payload)`` on the V1
+data plane, and per-request parameter overrides merged over env-var
+defaults (``service.py:216-226``: request keys are upper-cased and looked
+up against the option dict).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+
+class Model:
+    def __init__(self, name: str):
+        self.name = name
+        self.ready = False
+
+    def load(self) -> None:
+        self.ready = True
+
+    def predict(self, payload: Mapping[str, Any]) -> dict:
+        raise NotImplementedError
+
+    # -- option handling ---------------------------------------------------
+
+    #: subclasses: {"OPTION_NAME": default}; values are parsed from env vars
+    #: of the same name at construction (reference ``bloom.py:13-30``).
+    OPTIONS: dict[str, Any] = {}
+
+    def default_options(self) -> dict[str, Any]:
+        opts = {}
+        for key, default in self.OPTIONS.items():
+            raw = os.environ.get(key)
+            if raw is None:
+                opts[key] = default
+            elif isinstance(default, bool):
+                opts[key] = raw.strip().lower() in ("1", "true", "yes", "on")
+            elif isinstance(default, int):
+                opts[key] = int(raw)
+            elif isinstance(default, float):
+                opts[key] = float(raw)
+            else:
+                opts[key] = raw
+        return opts
+
+    def configure_request(self, payload: Mapping[str, Any]) -> dict[str, Any]:
+        """Merge request ``parameters`` over env defaults, upper-casing keys
+        (byte-compatible with the reference's protocol)."""
+        opts = self.default_options()
+        for key, value in (payload.get("parameters") or {}).items():
+            key = key.upper()
+            if key in opts:
+                opts[key] = value
+        return opts

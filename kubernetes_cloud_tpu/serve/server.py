@@ -1,0 +1,158 @@
+"""KServe V1 data-plane HTTP server on the standard library.
+
+Serves :class:`~kubernetes_cloud_tpu.serve.model.Model` instances behind
+the exact REST surface the reference's InferenceServices expose
+(``online-inference/tensorizer-isvc/README.md``; clients at
+``image-classifier/service/predict_url.sh``):
+
+* ``GET  /``                         liveness (Knative probe target)
+* ``GET  /v1/models``                model list
+* ``GET  /v1/models/<name>``         readiness
+* ``POST /v1/models/<name>:predict`` prediction
+* ``POST /completion``               FastAPI-compatible completion route
+  (``finetuner-workflow/finetuner/inference.py:80-96``) when the model
+  implements ``completion()``
+
+Concurrency: one lock per model — the reference's GPU services run with
+``containerConcurrency: 1`` (``stable-diffusion/03-inference-service.yaml:7``)
+and a single TPU program likewise shouldn't interleave requests; Knative
+provides scale-out.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterable
+
+from kubernetes_cloud_tpu.serve.model import Model
+
+log = logging.getLogger(__name__)
+
+
+class ModelServer:
+    def __init__(self, models: Iterable[Model], *, host: str = "0.0.0.0",
+                 port: int = 8080):
+        self.models = {m.name: m for m in models}
+        self.locks = {name: threading.Lock() for name in self.models}
+        self.host, self.port = host, port
+        self._httpd: ThreadingHTTPServer | None = None
+
+    def load_all(self) -> None:
+        for model in self.models.values():
+            if not model.ready:
+                model.load()
+
+    # -- request handling --------------------------------------------------
+
+    def handle(self, method: str, path: str, body: bytes) -> tuple[int, dict]:
+        if method == "GET":
+            if path in ("/", "/healthz"):
+                return 200, {"status": "alive"}
+            if path == "/v1/models":
+                return 200, {"models": sorted(self.models)}
+            if path.startswith("/v1/models/"):
+                name = path[len("/v1/models/"):]
+                model = self.models.get(name)
+                if model is None:
+                    return 404, {"error": f"model {name} not found"}
+                return 200, {"name": name, "ready": model.ready}
+            return 404, {"error": "not found"}
+
+        if method == "POST":
+            try:
+                payload = json.loads(body or b"{}")
+            except json.JSONDecodeError as e:
+                return 400, {"error": f"invalid JSON: {e}"}
+            if path.endswith(":predict") and path.startswith("/v1/models/"):
+                name = path[len("/v1/models/"):-len(":predict")]
+                return self._predict(name, payload)
+            if path == "/completion":
+                return self._completion(payload)
+            return 404, {"error": "not found"}
+
+        return 405, {"error": "method not allowed"}
+
+    def _predict(self, name: str, payload: dict) -> tuple[int, dict]:
+        model = self.models.get(name)
+        if model is None:
+            return 404, {"error": f"model {name} not found"}
+        if not model.ready:
+            return 503, {"error": f"model {name} is not ready"}
+        try:
+            with self.locks[name]:
+                return 200, model.predict(payload)
+        except ValueError as e:  # request validation problems
+            return 400, {"error": str(e)}
+        except Exception as e:  # surface as a 500, keep serving
+            log.exception("predict failed")
+            return 500, {"error": str(e)}
+
+    def _completion(self, payload: dict) -> tuple[int, dict]:
+        capable = [(n, m) for n, m in self.models.items()
+                   if getattr(m, "completion", None) is not None]
+        if not capable:
+            return 404, {"error": "no completion-capable model"}
+        for name, model in capable:
+            if not model.ready:
+                continue
+            try:
+                with self.locks[name]:
+                    return 200, model.completion(payload)
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            except Exception as e:
+                log.exception("completion failed")
+                return 500, {"error": str(e)}
+        return 503, {"error": "completion model is not ready"}
+
+    # -- http plumbing -----------------------------------------------------
+
+    def _make_handler(server):  # noqa: N805 - closure over the ModelServer
+        class Handler(BaseHTTPRequestHandler):
+            def _respond(self, method):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, obj = server.handle(method, self.path, body)
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._respond("GET")
+
+            def do_POST(self):
+                self._respond("POST")
+
+            def log_message(self, fmt, *args):
+                log.debug("%s " + fmt, self.client_address[0], *args)
+
+        return Handler
+
+    def _bind(self) -> ThreadingHTTPServer:
+        if self._httpd is not None:
+            raise RuntimeError("server already started")
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        log.info("serving on %s:%d", self.host, self.port)
+        return self._httpd
+
+    def start(self) -> None:
+        """Start serving in a background thread (returns immediately)."""
+        httpd = self._bind()
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def serve_forever(self) -> None:
+        self.load_all()
+        self._bind().serve_forever()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

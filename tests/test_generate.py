@@ -1,0 +1,112 @@
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.models import PRESETS, forward, init_params
+from kubernetes_cloud_tpu.models.generate import (
+    decode_step,
+    generate,
+    init_cache,
+    prefill,
+    sample_token,
+)
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+def test_prefill_matches_forward(params):
+    ids = jax.random.randint(jax.random.key(1), (2, 10), 0, CFG.vocab_size)
+    mask = jnp.ones_like(ids)
+    full = forward(CFG, params, ids)
+    last, _ = prefill(CFG, params, ids, mask, init_cache(CFG, 2, 16))
+    np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, -1]),
+                               atol=1e-5)
+
+
+def test_decode_matches_forward(params):
+    ids = jax.random.randint(jax.random.key(1), (2, 10), 0, CFG.vocab_size)
+    mask = jnp.ones_like(ids)
+    full = forward(CFG, params, ids)
+    _, cache = prefill(CFG, params, ids, mask, init_cache(CFG, 2, 16))
+    tok = full[:, -1].argmax(-1).astype(jnp.int32)
+    dec, cache = decode_step(CFG, params, tok, cache)
+    ext = forward(CFG, params, jnp.concatenate([ids, tok[:, None]], 1))
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(ext[:, -1]),
+                               atol=1e-4)
+    assert int(cache["length"][0]) == 11
+
+
+@pytest.mark.parametrize("variant", ["alibi", "learned"])
+def test_decode_matches_forward_other_positions(variant):
+    overrides = {
+        "alibi": dict(pos_emb="alibi", parallel_residual=False,
+                      embed_layernorm=True, tie_embeddings=True),
+        "learned": dict(pos_emb="learned", parallel_residual=False,
+                        tie_embeddings=True),
+    }[variant]
+    cfg = dataclasses.replace(CFG, **overrides)
+    p = init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+    mask = jnp.ones_like(ids)
+    full = forward(cfg, p, ids)
+    _, cache = prefill(cfg, p, ids, mask, init_cache(cfg, 2, 12))
+    tok = full[:, -1].argmax(-1).astype(jnp.int32)
+    dec, _ = decode_step(cfg, p, tok, cache)
+    ext = forward(cfg, p, jnp.concatenate([ids, tok[:, None]], 1))
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(ext[:, -1]),
+                               atol=1e-4)
+
+
+def test_greedy_generate_matches_iterated_forward(params):
+    ids = jax.random.randint(jax.random.key(1), (1, 6), 0, CFG.vocab_size)
+    out = generate(CFG, params, ids, max_new_tokens=4, temperature=0.0)
+    cur = ids
+    for _ in range(4):
+        nxt = forward(CFG, params, cur)[:, -1].argmax(-1).astype(jnp.int32)
+        cur = jnp.concatenate([cur, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+def test_generate_ragged_prompts(params):
+    ids = jax.random.randint(jax.random.key(1), (2, 10), 1, CFG.vocab_size)
+    mask = jnp.ones_like(ids).at[1, 6:].set(0)
+    out = generate(CFG, params, ids, mask, max_new_tokens=3,
+                   temperature=0.0, pad_token_id=0)
+    # row 1's completion starts right after its 6 real tokens
+    np.testing.assert_array_equal(np.asarray(out[1, :6]),
+                                  np.asarray(ids[1, :6]))
+    assert (np.asarray(out[1, 6:9]) != 0).all()
+
+
+def test_eos_stops_row(params):
+    ids = jax.random.randint(jax.random.key(1), (1, 4), 1, CFG.vocab_size)
+    # force eos to be whatever greedy emits first -> generation stops
+    first = generate(CFG, params, ids, max_new_tokens=1, temperature=0.0)
+    eos = int(first[0, 4])
+    out = generate(CFG, params, ids, max_new_tokens=5, temperature=0.0,
+                   eos_token_id=eos, pad_token_id=0)
+    np.testing.assert_array_equal(np.asarray(out[0, 5:]),
+                                  np.zeros(4, np.int32))
+
+
+def test_sample_token_top_k():
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]])
+    for seed in range(5):
+        tok = sample_token(logits, jax.random.key(seed), temperature=1.0,
+                           top_k=2, top_p=1.0)
+        assert int(tok[0]) in (2, 3)
+
+
+def test_sample_token_greedy():
+    logits = jnp.asarray([[0.0, 5.0, 2.0]])
+    tok = sample_token(logits, jax.random.key(0), temperature=0.0,
+                       top_k=0, top_p=1.0)
+    assert int(tok[0]) == 1

@@ -1,0 +1,90 @@
+"""Serving tests: real HTTP through a socket, mirroring the reference's
+smoke-test scripts (``image-classifier/service/predict_url.sh``,
+``tensorizer-isvc/README.md`` curl examples)."""
+
+import dataclasses
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.serve import ByteTokenizer, CausalLMService, ModelServer
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def server():
+    svc = CausalLMService(
+        "lm", CFG, params=init_params(CFG, jax.random.key(0)),
+        dtype=jnp.float32)
+    srv = ModelServer([svc], host="127.0.0.1", port=0)
+    srv.load_all()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _req(server, path, payload=None):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    if payload is None:
+        r = urllib.request.urlopen(url, timeout=30)
+    else:
+        r = urllib.request.urlopen(
+            urllib.request.Request(
+                url, json.dumps(payload).encode(),
+                {"Content-Type": "application/json"}),
+            timeout=120)
+    return json.loads(r.read())
+
+
+def test_liveness_and_model_list(server):
+    assert _req(server, "/")["status"] == "alive"
+    assert _req(server, "/v1/models") == {"models": ["lm"]}
+    assert _req(server, "/v1/models/lm") == {"name": "lm", "ready": True}
+
+
+def test_predict_v1(server):
+    out = _req(server, "/v1/models/lm:predict", {
+        "instances": ["hello world"],
+        "parameters": {"max_new_tokens": 4, "temperature": 0.0},
+    })
+    assert len(out["predictions"]) == 1
+    assert "generated_text" in out["predictions"][0]
+
+
+def test_predict_batch_and_param_override(server):
+    out = _req(server, "/v1/models/lm:predict", {
+        "instances": [{"text": "a"}, {"text": "bb"}],
+        "parameters": {"MAX_NEW_TOKENS": 2, "TEMPERATURE": 0.0,
+                       "ECHO_PROMPT": True},
+    })
+    preds = out["predictions"]
+    assert len(preds) == 2
+    assert preds[0]["generated_text"].startswith("a")
+    assert preds[1]["generated_text"].startswith("bb")
+
+
+def test_completion_route(server):
+    out = _req(server, "/completion",
+               {"prompt": "hi", "max_new_tokens": 3, "temperature": 0.0})
+    assert "completion" in out
+
+
+def test_errors(server):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(server, "/v1/models/nope:predict", {"instances": ["x"]})
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(server, "/v1/models/lm:predict", {"wrong": True})
+    assert e.value.code == 400
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    assert tok.decode(tok.encode("héllo ✓")) == "héllo ✓"
