@@ -1,0 +1,484 @@
+// dataset_tokenizer — streaming corpus -> packed uint16 token contexts.
+//
+// Native C++ replacement for the Go `gpt_bpe` dataset_tokenizer the
+// reference launches as a container step (invocation + flag semantics:
+// finetuner-workflow/finetune-workflow.yaml:188-191,441-454; flag docs
+// :39-81).  Emits the flat little-endian uint16 context-row format the
+// trainer mmaps (consumer spec: finetuner-workflow/finetuner/
+// finetuner.py:633-695), plus a JSON sidecar with the packing metadata.
+//
+// Tokenizers:
+//   --tokenizer byte   ids 0-255 are raw bytes (no vocab files needed)
+//   --tokenizer bpe    byte-level BPE from --vocab vocab.json and
+//                      --merges merges.txt (GPT-2 file formats)
+//
+// Packing semantics:
+//   * each input file is one document; documents are tokenized, an
+//     --eot-token is appended after each, and the stream is packed into
+//     rows of --context-size tokens;
+//   * if --boundary-token >= 0 and a row boundary would split a document,
+//     the row is cut at the document's last boundary token at row index
+//     >= --boundary-overlap, and the next row resumes right after that
+//     boundary (keeps contexts aligned to sentence/paragraph boundaries);
+//   * the final partial row is padded with --pad-token;
+//   * --sampling P keeps each document with probability P% (seeded);
+//   * --reorder none|shuffle|reverse orders documents before packing;
+//   * --sanitize collapses runs of whitespace to single spaces and strips
+//     non-newline control characters.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <filesystem>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <optional>
+#include <random>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace fs = std::filesystem;
+
+struct Args {
+  std::string input;
+  std::string output;
+  std::string tokenizer = "byte";
+  std::string vocab_path;
+  std::string merges_path;
+  long context_size = 2048;
+  long eot_token = 0;
+  long pad_token = 0;
+  long boundary_token = -1;
+  long boundary_overlap = 0;
+  double sampling = 100.0;
+  std::string reorder = "none";
+  unsigned seed = 42;
+  bool sanitize = false;
+};
+
+static void usage() {
+  std::cerr <<
+      "usage: dataset_tokenizer --input PATH --output OUT.tokens\n"
+      "  [--tokenizer byte|bpe] [--vocab vocab.json] [--merges merges.txt]\n"
+      "  [--context-size N] [--eot-token N] [--pad-token N]\n"
+      "  [--boundary-token N] [--boundary-overlap N]\n"
+      "  [--sampling PCT] [--reorder none|shuffle|reverse] [--seed N]\n"
+      "  [--sanitize]\n";
+}
+
+static bool parse_args(int argc, char** argv, Args* out) {
+  auto need = [&](int i) { return i + 1 < argc; };
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    // accept --dash-case and --underscore_case like the Python DashParser
+    std::replace(a.begin(), a.end(), '_', '-');
+    if (a == "--input" && need(i)) out->input = argv[++i];
+    else if (a == "--output" && need(i)) out->output = argv[++i];
+    else if (a == "--tokenizer" && need(i)) out->tokenizer = argv[++i];
+    else if (a == "--vocab" && need(i)) out->vocab_path = argv[++i];
+    else if (a == "--merges" && need(i)) out->merges_path = argv[++i];
+    else if (a == "--context-size" && need(i)) out->context_size = atol(argv[++i]);
+    else if (a == "--eot-token" && need(i)) out->eot_token = atol(argv[++i]);
+    else if (a == "--pad-token" && need(i)) out->pad_token = atol(argv[++i]);
+    else if (a == "--boundary-token" && need(i)) out->boundary_token = atol(argv[++i]);
+    else if (a == "--boundary-overlap" && need(i)) out->boundary_overlap = atol(argv[++i]);
+    else if (a == "--sampling" && need(i)) out->sampling = atof(argv[++i]);
+    else if (a == "--reorder" && need(i)) out->reorder = argv[++i];
+    else if (a == "--seed" && need(i)) out->seed = (unsigned)atol(argv[++i]);
+    else if (a == "--sanitize") out->sanitize = true;
+    else if (a == "--help" || a == "-h") { usage(); exit(0); }
+    else { std::cerr << "unknown arg: " << a << "\n"; return false; }
+  }
+  if (out->input.empty() || out->output.empty()) { usage(); return false; }
+  if (out->context_size <= 0 || out->context_size > 1 << 20) {
+    std::cerr << "bad --context-size\n"; return false;
+  }
+  if (out->boundary_overlap < 0 ||
+      out->boundary_overlap >= out->context_size) {
+    std::cerr << "--boundary-overlap must be in [0, context-size)\n";
+    return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------- tokenizers
+
+// Minimal JSON parser for the vocab.json shape {"tok": 123, ...} with
+// string escapes (incl. \uXXXX -> UTF-8).
+static std::optional<std::unordered_map<std::string, int>>
+load_vocab(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return std::nullopt;
+  std::stringstream ss; ss << f.rdbuf();
+  const std::string s = ss.str();
+  std::unordered_map<std::string, int> vocab;
+  size_t i = 0;
+  auto skip_ws = [&] { while (i < s.size() && isspace((unsigned char)s[i])) ++i; };
+  auto utf8_append = [](std::string* out, unsigned cp) {
+    if (cp < 0x80) { out->push_back((char)cp); }
+    else if (cp < 0x800) {
+      out->push_back((char)(0xC0 | (cp >> 6)));
+      out->push_back((char)(0x80 | (cp & 0x3F)));
+    } else {
+      out->push_back((char)(0xE0 | (cp >> 12)));
+      out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      out->push_back((char)(0x80 | (cp & 0x3F)));
+    }
+  };
+  skip_ws();
+  if (i >= s.size() || s[i] != '{') return std::nullopt;
+  ++i;
+  while (true) {
+    skip_ws();
+    if (i < s.size() && s[i] == '}') break;
+    if (i >= s.size() || s[i] != '"') return std::nullopt;
+    ++i;
+    std::string key;
+    while (i < s.size() && s[i] != '"') {
+      if (s[i] == '\\' && i + 1 < s.size()) {
+        char c = s[i + 1];
+        if (c == 'u' && i + 5 < s.size()) {
+          unsigned cp = (unsigned)strtoul(s.substr(i + 2, 4).c_str(), nullptr, 16);
+          utf8_append(&key, cp);
+          i += 6;
+          continue;
+        }
+        i += 2;
+        switch (c) {
+          case 'n': key.push_back('\n'); break;
+          case 't': key.push_back('\t'); break;
+          case 'r': key.push_back('\r'); break;
+          case 'b': key.push_back('\b'); break;
+          case 'f': key.push_back('\f'); break;
+          default: key.push_back(c);
+        }
+        continue;
+      }
+      key.push_back(s[i++]);
+    }
+    ++i;  // closing quote
+    skip_ws();
+    if (i >= s.size() || s[i] != ':') return std::nullopt;
+    ++i;
+    skip_ws();
+    size_t end = i;
+    while (end < s.size() && (isdigit((unsigned char)s[end]) || s[end] == '-')) ++end;
+    vocab[key] = atoi(s.substr(i, end - i).c_str());
+    i = end;
+    skip_ws();
+    if (i < s.size() && s[i] == ',') { ++i; continue; }
+    if (i < s.size() && s[i] == '}') break;
+  }
+  return vocab;
+}
+
+// GPT-2's byte -> printable-unicode-char remapping (bytes_to_unicode).
+static std::vector<std::string> byte_to_unicode_table() {
+  std::vector<int> bs;
+  for (int b = '!'; b <= '~'; ++b) bs.push_back(b);
+  for (int b = 0xA1; b <= 0xAC; ++b) bs.push_back(b);
+  for (int b = 0xAE; b <= 0xFF; ++b) bs.push_back(b);
+  std::vector<int> cs = bs;
+  int n = 0;
+  for (int b = 0; b < 256; ++b) {
+    if (std::find(bs.begin(), bs.end(), b) == bs.end()) {
+      bs.push_back(b);
+      cs.push_back(256 + n++);
+    }
+  }
+  std::vector<std::string> table(256);
+  for (size_t k = 0; k < bs.size(); ++k) {
+    std::string u;
+    unsigned cp = (unsigned)cs[k];
+    if (cp < 0x80) u.push_back((char)cp);
+    else if (cp < 0x800) {
+      u.push_back((char)(0xC0 | (cp >> 6)));
+      u.push_back((char)(0x80 | (cp & 0x3F)));
+    }
+    table[bs[k]] = u;
+  }
+  return table;
+}
+
+struct BPE {
+  std::unordered_map<std::string, int> vocab;
+  std::map<std::pair<std::string, std::string>, int> merge_rank;
+  std::vector<std::string> byte_table = byte_to_unicode_table();
+  std::unordered_map<std::string, std::vector<int>> cache;
+
+  bool load(const std::string& vocab_path, const std::string& merges_path) {
+    auto v = load_vocab(vocab_path);
+    if (!v) return false;
+    vocab = std::move(*v);
+    std::ifstream mf(merges_path);
+    if (!mf) return false;
+    std::string line;
+    int rank = 0;
+    while (std::getline(mf, line)) {
+      if (line.empty() || line[0] == '#') continue;
+      auto sp = line.find(' ');
+      if (sp == std::string::npos) continue;
+      merge_rank[{line.substr(0, sp), line.substr(sp + 1)}] = rank++;
+    }
+    return true;
+  }
+
+  // Pre-tokenization approximating the GPT-2 pattern
+  // ('s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|
+  //  \s+(?!\S)|\s+) for byte-oriented text; non-ASCII bytes are treated
+  // as letters (exact for ASCII corpora, see tests vs HF tokenizers).
+  static std::vector<std::string> pretokenize(const std::string& text) {
+    std::vector<std::string> words;
+    size_t i = 0;
+    const size_t n = text.size();
+    auto is_letter = [](unsigned char c) { return isalpha(c) || c >= 0x80; };
+    auto is_digit = [](unsigned char c) { return isdigit(c) != 0; };
+    auto is_space = [](unsigned char c) { return isspace(c) != 0; };
+    while (i < n) {
+      if (text[i] == '\'') {
+        static const char* conts[] = {"'re", "'ve", "'ll", "'s", "'t",
+                                      "'m", "'d"};
+        bool matched = false;
+        for (const char* c : conts) {
+          size_t len = strlen(c);
+          if (text.compare(i, len, c) == 0) {
+            words.push_back(text.substr(i, len));
+            i += len;
+            matched = true;
+            break;
+          }
+        }
+        if (matched) continue;
+      }
+      size_t j = i + (text[i] == ' ' ? 1 : 0);  // optional space prefix
+      if (j < n && is_letter(text[j])) {
+        size_t k = j;
+        while (k < n && is_letter(text[k])) ++k;
+        words.push_back(text.substr(i, k - i));
+        i = k;
+        continue;
+      }
+      if (j < n && is_digit(text[j])) {
+        size_t k = j;
+        while (k < n && is_digit(text[k])) ++k;
+        words.push_back(text.substr(i, k - i));
+        i = k;
+        continue;
+      }
+      if (j < n && !is_space(text[j])) {
+        size_t k = j;
+        while (k < n && !is_space(text[k]) && !is_letter(text[k]) &&
+               !is_digit(text[k]))
+          ++k;
+        words.push_back(text.substr(i, k - i));
+        i = k;
+        continue;
+      }
+      // whitespace run; a trailing single space attaches to the next word
+      size_t k = i;
+      while (k < n && is_space(text[k])) ++k;
+      size_t end = (k < n && text[k - 1] == ' ') ? k - 1 : k;
+      if (end > i) {
+        words.push_back(text.substr(i, end - i));
+        i = end;
+      } else {
+        ++i;  // lone space before a word: consumed as the prefix next loop
+      }
+    }
+    return words;
+  }
+
+  std::vector<int> encode_word(const std::string& word) {
+    auto it = cache.find(word);
+    if (it != cache.end()) return it->second;
+    // byte-remap then merge
+    std::vector<std::string> parts;
+    for (unsigned char c : word) parts.push_back(byte_table[c]);
+    while (parts.size() > 1) {
+      int best_rank = INT32_MAX;
+      size_t best_i = 0;
+      for (size_t k = 0; k + 1 < parts.size(); ++k) {
+        auto r = merge_rank.find({parts[k], parts[k + 1]});
+        if (r != merge_rank.end() && r->second < best_rank) {
+          best_rank = r->second;
+          best_i = k;
+        }
+      }
+      if (best_rank == INT32_MAX) break;
+      parts[best_i] = parts[best_i] + parts[best_i + 1];
+      parts.erase(parts.begin() + best_i + 1);
+    }
+    std::vector<int> ids;
+    for (auto& p : parts) {
+      auto v = vocab.find(p);
+      if (v != vocab.end()) ids.push_back(v->second);
+      // unknown pieces are dropped (GPT-2 byte-level BPE has full coverage,
+      // so this only happens with truncated vocab files)
+    }
+    cache[word] = ids;
+    return ids;
+  }
+
+  std::vector<int> encode(const std::string& text) {
+    std::vector<int> out;
+    for (auto& w : pretokenize(text)) {
+      auto ids = encode_word(w);
+      out.insert(out.end(), ids.begin(), ids.end());
+    }
+    return out;
+  }
+};
+
+// ------------------------------------------------------------------- helpers
+
+static std::string sanitize_text(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  bool in_ws = false;
+  for (unsigned char c : in) {
+    if (c == '\n') { out.push_back('\n'); in_ws = false; continue; }
+    if (isspace(c)) {
+      if (!in_ws) out.push_back(' ');
+      in_ws = true;
+      continue;
+    }
+    if (c < 0x20) continue;  // strip control chars
+    out.push_back((char)c);
+    in_ws = false;
+  }
+  return out;
+}
+
+int main(int argc, char** argv) {
+  Args args;
+  if (!parse_args(argc, argv, &args)) return 2;
+
+  // collect documents (sorted for determinism)
+  std::vector<fs::path> files;
+  fs::path in(args.input);
+  if (fs::is_directory(in)) {
+    for (auto& e : fs::recursive_directory_iterator(in))
+      if (e.is_regular_file()) files.push_back(e.path());
+    std::sort(files.begin(), files.end());
+  } else if (fs::is_regular_file(in)) {
+    files.push_back(in);
+  } else {
+    std::cerr << "no such input: " << args.input << "\n";
+    return 2;
+  }
+
+  std::mt19937 rng(args.seed);
+  if (args.reorder == "shuffle") std::shuffle(files.begin(), files.end(), rng);
+  else if (args.reorder == "reverse") std::reverse(files.begin(), files.end());
+  else if (args.reorder != "none") { std::cerr << "bad --reorder\n"; return 2; }
+
+  BPE bpe;
+  if (args.tokenizer == "bpe") {
+    if (!bpe.load(args.vocab_path, args.merges_path)) {
+      std::cerr << "failed to load vocab/merges\n";
+      return 2;
+    }
+  } else if (args.tokenizer != "byte") {
+    std::cerr << "bad --tokenizer\n";
+    return 2;
+  }
+
+  std::uniform_real_distribution<double> unif(0.0, 100.0);
+  const long C = args.context_size;
+  std::vector<uint16_t> row;
+  row.reserve(C);
+  std::ofstream out(args.output + ".tmp", std::ios::binary);
+  if (!out) { std::cerr << "cannot write " << args.output << "\n"; return 2; }
+  long n_rows = 0, n_docs = 0, n_tokens = 0, max_id = 0;
+
+  auto flush_row = [&](bool pad) {
+    if (row.empty()) return;
+    if (pad) while ((long)row.size() < C) row.push_back((uint16_t)args.pad_token);
+    if ((long)row.size() == C) {
+      out.write((const char*)row.data(), C * sizeof(uint16_t));
+      ++n_rows;
+      row.clear();
+    }
+  };
+
+  for (auto& path : files) {
+    if (args.sampling < 100.0 && unif(rng) >= args.sampling) continue;
+    std::ifstream f(path, std::ios::binary);
+    if (!f) continue;
+    std::stringstream ss;
+    ss << f.rdbuf();
+    std::string text = ss.str();
+    if (text.empty()) continue;
+    if (args.sanitize) text = sanitize_text(text);
+
+    std::vector<int> ids;
+    if (args.tokenizer == "byte") {
+      ids.reserve(text.size());
+      for (unsigned char c : text) ids.push_back(c);
+    } else {
+      ids = bpe.encode(text);
+    }
+    ids.push_back((int)args.eot_token);
+    ++n_docs;
+    n_tokens += (long)ids.size();
+
+    size_t i = 0;
+    while (i < ids.size()) {
+      long room = C - (long)row.size();
+      long take = std::min<long>(room, (long)(ids.size() - i));
+      for (long k = 0; k < take; ++k) {
+        int id = ids[i + k];
+        if (id > max_id) max_id = id;
+        row.push_back((uint16_t)std::min(id, 0xFFFF));
+      }
+      i += take;
+      if ((long)row.size() == C) {
+        bool doc_continues = i < ids.size();
+        if (doc_continues && args.boundary_token >= 0) {
+          // cut at the document's last boundary token at index
+          // >= boundary_overlap; resume after it
+          long cut = -1;
+          for (long k = C - 1; k >= args.boundary_overlap; --k) {
+            if (row[k] == (uint16_t)args.boundary_token) { cut = k; break; }
+          }
+          if (cut >= 0 && cut + 1 < C) {
+            long tail = C - (cut + 1);
+            i -= tail;  // tokens after the boundary go to the next row
+            row.resize(cut + 1);
+            flush_row(/*pad=*/true);
+            continue;
+          }
+        }
+        flush_row(/*pad=*/false);
+      }
+    }
+  }
+  flush_row(/*pad=*/true);
+  out.close();
+  fs::rename(args.output + ".tmp", args.output);
+
+  if (max_id > 0xFFFF) {
+    std::cerr << "warning: token ids exceeded uint16 range and were "
+                 "clamped; use a smaller vocab\n";
+  }
+
+  std::ofstream meta(args.output + ".json");
+  meta << "{\"context_size\": " << C
+       << ", \"rows\": " << n_rows
+       << ", \"documents\": " << n_docs
+       << ", \"tokens\": " << n_tokens
+       << ", \"eot_token\": " << args.eot_token
+       << ", \"pad_token\": " << args.pad_token
+       << ", \"boundary_token\": " << args.boundary_token
+       << ", \"boundary_overlap\": " << args.boundary_overlap
+       << ", \"dtype\": \"uint16\"}\n";
+
+  std::cout << "wrote " << n_rows << " contexts (" << n_tokens
+            << " tokens from " << n_docs << " documents) to "
+            << args.output << "\n";
+  return 0;
+}

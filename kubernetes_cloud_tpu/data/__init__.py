@@ -1,0 +1,8 @@
+from kubernetes_cloud_tpu.data.tokenized import (  # noqa: F401
+    TokenizedDataset,
+    sharded_batches,
+)
+from kubernetes_cloud_tpu.data.tokenizer_cli import (  # noqa: F401
+    build_tokenizer,
+    run_tokenizer,
+)

@@ -1,0 +1,137 @@
+"""Memory-mapped pre-tokenized dataset + per-host sharded batching.
+
+Consumer side of the ``dataset_tokenizer`` output, with the reference
+trainer's semantics (``finetuner-workflow/finetuner/finetuner.py:633-695``):
+a flat little-endian uint16 file of fixed-size context rows, mmap'd
+zero-copy, with the attention mask derived from trailing pad tokens
+(pad runs at the end of a row are masked out; pad ids appearing mid-row —
+e.g. when pad == eot — stay visible).
+
+Distribution replaces ``torch.utils.data.DistributedSampler``
+(``kubeflow/training-operator/resnet50/util.py:169-199``): each host reads
+only its row stripe and global arrays are assembled with
+``jax.make_array_from_process_local_data`` over the mesh's batch axes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+from kubernetes_cloud_tpu.parallel.sharding import batch_spec, logical_to_physical
+
+
+class TokenizedDataset:
+    def __init__(self, path: str, context_size: Optional[int] = None,
+                 *, pad_token: Optional[int] = None):
+        if context_size is None or pad_token is None:
+            sidecar = path + ".json"
+            if os.path.exists(sidecar):
+                with open(sidecar) as f:
+                    meta = json.load(f)
+                context_size = context_size or meta["context_size"]
+                pad_token = pad_token if pad_token is not None else (
+                    meta.get("pad_token"))
+        if context_size is None:
+            raise ValueError("context_size not given and no sidecar found")
+        nbytes = os.path.getsize(path)
+        row_bytes = context_size * 2
+        if nbytes % row_bytes:
+            raise ValueError(
+                f"{path}: {nbytes} bytes is not a whole number of "
+                f"{context_size}-token rows")
+        self.path = path
+        self.context_size = context_size
+        self.pad_token = pad_token
+        self.tokens = np.memmap(path, dtype=np.uint16, mode="r",
+                                shape=(nbytes // row_bytes, context_size))
+
+    def __len__(self) -> int:
+        return self.tokens.shape[0]
+
+    def __getitem__(self, idx) -> dict[str, np.ndarray]:
+        ids = np.asarray(self.tokens[idx], dtype=np.int32)
+        return {"input_ids": ids, "attention_mask": self.mask_for(ids)}
+
+    def mask_for(self, ids: np.ndarray) -> np.ndarray:
+        """1 for real tokens; trailing pad-token runs are 0."""
+        if self.pad_token is None:
+            return np.ones_like(ids, dtype=np.int32)
+        is_pad = ids == self.pad_token
+        # a position is masked iff it and everything after it is pad
+        trailing_pad = np.flip(
+            np.logical_and.accumulate(np.flip(is_pad, -1), axis=-1), -1)
+        return (~trailing_pad).astype(np.int32)
+
+    def split(self, train_ratio: float) -> tuple["Slice", "Slice"]:
+        """Deterministic train/val split by leading fraction (reference
+        ``--train_ratio`` flag semantics)."""
+        n_train = int(len(self) * train_ratio)
+        return Slice(self, 0, n_train), Slice(self, n_train, len(self))
+
+
+class Slice:
+    def __init__(self, ds: TokenizedDataset, start: int, stop: int):
+        self.ds, self.start, self.stop = ds, start, stop
+
+    def __len__(self) -> int:
+        return self.stop - self.start
+
+    def __getitem__(self, idx):
+        if isinstance(idx, (int, np.integer)):
+            if idx < 0 or idx >= len(self):
+                raise IndexError(idx)
+            return self.ds[self.start + int(idx)]
+        return self.ds[np.asarray(idx) + self.start]
+
+
+def sharded_batches(
+    dataset,
+    global_batch_size: int,
+    mesh,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epochs: Optional[int] = None,
+    drop_last: bool = True,
+) -> Iterator[dict[str, jax.Array]]:
+    """Yield globally-sharded batches from a per-host dataset stripe.
+
+    Each process loads rows ``i`` with ``i % process_count == process_index``
+    within the shuffled order, then the local arrays are joined into global
+    arrays sharded over the mesh batch axes.
+    """
+    n_proc = jax.process_count()
+    proc = jax.process_index()
+    if global_batch_size % n_proc:
+        raise ValueError("global batch must divide evenly across hosts")
+    local_bs = global_batch_size // n_proc
+    sharding = logical_to_physical(batch_spec(2), mesh)
+
+    rng = np.random.RandomState(seed)
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = np.arange(len(dataset))
+        if shuffle:
+            rng.shuffle(order)
+        order = order[proc::n_proc]
+        n_full = len(order) // local_bs
+        for b in range(n_full):
+            idx = order[b * local_bs:(b + 1) * local_bs]
+            rows = [dataset[int(i)] for i in idx]
+            local = {
+                k: np.stack([r[k] for r in rows]) for k in rows[0]
+            }
+            yield {
+                k: jax.make_array_from_process_local_data(
+                    sharding if v.ndim == 2 else
+                    logical_to_physical(batch_spec(v.ndim), mesh), v)
+                for k, v in local.items()
+            }
+        if not drop_last and len(order) % local_bs:
+            pass  # partial batches are dropped; parity with DistributedSampler
+        epoch += 1

@@ -1,0 +1,41 @@
+"""Build + invoke the native ``dataset_tokenizer`` CLI.
+
+The reference runs its Go tokenizer as a container step
+(``finetuner-workflow/finetune-workflow.yaml:423-479``); here the C++
+source ships in-tree (``csrc/dataset_tokenizer``) and is compiled on
+demand (image builds run ``make`` instead).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional, Sequence
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc", "dataset_tokenizer")
+
+
+def build_tokenizer(out_dir: Optional[str] = None, *,
+                    force: bool = False) -> str:
+    """Compile the CLI (cached); returns the binary path."""
+    src = os.path.join(_CSRC, "dataset_tokenizer.cpp")
+    if out_dir is None:
+        out_dir = os.path.join(_CSRC, "build")
+    os.makedirs(out_dir, exist_ok=True)
+    binary = os.path.join(out_dir, "dataset_tokenizer")
+    if not force and os.path.exists(binary) and (
+            os.path.getmtime(binary) >= os.path.getmtime(src)):
+        return binary
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-o", binary, src],
+        check=True, capture_output=True, text=True)
+    return binary
+
+
+def run_tokenizer(args: Sequence[str], *, binary: Optional[str] = None,
+                  check: bool = True) -> subprocess.CompletedProcess:
+    if binary is None:
+        binary = build_tokenizer()
+    return subprocess.run([binary, *args], check=check,
+                          capture_output=True, text=True)

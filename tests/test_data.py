@@ -1,0 +1,200 @@
+"""dataset_tokenizer CLI + TokenizedDataset tests.
+
+The C++ packer is exercised through its real CLI surface; BPE output is
+checked against the HuggingFace ``tokenizers`` implementation configured
+with the same vocab/merges (dataset-parity goal, SURVEY.md §7 hard part 4).
+"""
+
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.data import TokenizedDataset, build_tokenizer, run_tokenizer
+
+
+@pytest.fixture(scope="module")
+def binary():
+    return build_tokenizer()
+
+
+def write_docs(tmp_path, docs):
+    d = tmp_path / "docs"
+    d.mkdir(exist_ok=True)
+    for i, text in enumerate(docs):
+        (d / f"{i:03d}.txt").write_text(text)
+    return str(d)
+
+
+def test_byte_packing_exact(tmp_path, binary):
+    docs = ["abc", "defgh"]
+    out = str(tmp_path / "out.tokens")
+    run_tokenizer([
+        "--input", write_docs(tmp_path, docs), "--output", out,
+        "--tokenizer", "byte", "--context-size", "4",
+        "--eot-token", "0", "--pad-token", "1",
+    ], binary=binary)
+    tokens = np.fromfile(out, dtype=np.uint16).reshape(-1, 4)
+    # stream: a b c EOT | d e f g | h EOT pad pad
+    expect = np.array([
+        [97, 98, 99, 0],
+        [100, 101, 102, 103],
+        [104, 0, 1, 1],
+    ], np.uint16)
+    np.testing.assert_array_equal(tokens, expect)
+    meta = json.load(open(out + ".json"))
+    assert meta["rows"] == 3 and meta["documents"] == 2
+
+
+def test_boundary_cut(tmp_path, binary):
+    # newline (10) as boundary: a row that would split the doc is cut at
+    # the last newline and the remainder starts the next row
+    docs = ["ab\ncd\nefgh"]
+    out = str(tmp_path / "out.tokens")
+    run_tokenizer([
+        "--input", write_docs(tmp_path, docs), "--output", out,
+        "--tokenizer", "byte", "--context-size", "6",
+        "--eot-token", "0", "--pad-token", "1",
+        "--boundary-token", "10", "--boundary-overlap", "0",
+    ], binary=binary)
+    tokens = np.fromfile(out, dtype=np.uint16).reshape(-1, 6)
+    # row 0 cut after second newline: ab\ncd\n ; row 1: efgh EOT pad
+    expect = np.array([
+        [97, 98, 10, 99, 100, 10],
+        [101, 102, 103, 104, 0, 1],
+    ], np.uint16)
+    np.testing.assert_array_equal(tokens, expect)
+
+
+def test_sampling_and_reorder(tmp_path, binary):
+    docs = [f"doc{i}" for i in range(20)]
+    src = write_docs(tmp_path, docs)
+    out_all = str(tmp_path / "all.tokens")
+    out_half = str(tmp_path / "half.tokens")
+    run_tokenizer(["--input", src, "--output", out_all,
+                   "--tokenizer", "byte", "--context-size", "8",
+                   "--pad-token", "1"], binary=binary)
+    run_tokenizer(["--input", src, "--output", out_half,
+                   "--tokenizer", "byte", "--context-size", "8",
+                   "--pad-token", "1", "--sampling", "50",
+                   "--seed", "7"], binary=binary)
+    n_all = json.load(open(out_all + ".json"))["documents"]
+    n_half = json.load(open(out_half + ".json"))["documents"]
+    assert n_all == 20 and 3 <= n_half <= 17
+
+    out_shuf = str(tmp_path / "shuf.tokens")
+    run_tokenizer(["--input", src, "--output", out_shuf,
+                   "--tokenizer", "byte", "--context-size", "8",
+                   "--pad-token", "1", "--reorder", "shuffle",
+                   "--seed", "3"], binary=binary)
+    a = np.fromfile(out_all, np.uint16)
+    b = np.fromfile(out_shuf, np.uint16)
+    assert a.shape == b.shape and not np.array_equal(a, b)
+    assert np.array_equal(np.sort(a), np.sort(b))
+
+
+def test_sanitize(tmp_path, binary):
+    docs = ["a \t  b\x07c\n\nd"]
+    out = str(tmp_path / "san.tokens")
+    run_tokenizer(["--input", write_docs(tmp_path, docs), "--output", out,
+                   "--tokenizer", "byte", "--context-size", "16",
+                   "--eot-token", "0", "--pad-token", "0",
+                   "--sanitize"], binary=binary)
+    row = np.fromfile(out, np.uint16)
+    text = bytes(t for t in row.tolist() if t not in (0,)).decode()
+    assert text == "a bc\n\nd"
+
+
+def test_cli_errors(tmp_path, binary):
+    r = run_tokenizer(["--input", "/does/not/exist", "--output",
+                       str(tmp_path / "x.tokens"), "--context-size", "8"],
+                      binary=binary, check=False)
+    assert r.returncode != 0
+    r = run_tokenizer(["--nonsense"], binary=binary, check=False)
+    assert r.returncode != 0
+
+
+def test_bpe_matches_hf_tokenizers(tmp_path, binary):
+    tokenizers = pytest.importorskip("tokenizers")
+
+    # build a small BPE over ASCII from a corpus, then compare encodings
+    corpus = [
+        "the quick brown fox jumps over the lazy dog",
+        "hello world, hello tpu! it's running 123 tests.",
+        "pack my box with five dozen liquor jugs?",
+    ]
+    tok = tokenizers.Tokenizer(tokenizers.models.BPE(unk_token=None))
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.ByteLevel(
+        add_prefix_space=False)
+    tok.decoder = tokenizers.decoders.ByteLevel()
+    trainer = tokenizers.trainers.BpeTrainer(
+        vocab_size=400, special_tokens=["<|endoftext|>"],
+        initial_alphabet=tokenizers.pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(corpus, trainer)
+    vocab_path = str(tmp_path / "vocab.json")
+    merges_path = str(tmp_path / "merges.txt")
+    model_files = tok.model.save(str(tmp_path))
+    for f in model_files:
+        if f.endswith("vocab.json"):
+            os.replace(f, vocab_path)
+        elif f.endswith("merges.txt"):
+            os.replace(f, merges_path)
+
+    text = "the quick brown fox, it's 123 jugs over the lazy dog!"
+    expect = tok.encode(text).ids
+
+    doc_dir = tmp_path / "docs"
+    doc_dir.mkdir()
+    (doc_dir / "a.txt").write_text(text)
+    out = str(tmp_path / "bpe.tokens")
+    run_tokenizer([
+        "--input", str(doc_dir), "--output", out,
+        "--tokenizer", "bpe", "--vocab", vocab_path,
+        "--merges", merges_path, "--context-size", "64",
+        "--eot-token", "0", "--pad-token", "0",
+    ], binary=binary)
+    got = np.fromfile(out, np.uint16).tolist()
+    got = [t for t in got if t != 0]  # strip eot+pad (id 0)
+    expect = [t for t in expect if t != 0]
+    assert got == expect, f"\nexpect {expect}\ngot    {got}"
+
+
+def test_tokenized_dataset_and_masks(tmp_path, binary):
+    docs = ["abc", "defgh"]
+    out = str(tmp_path / "ds.tokens")
+    run_tokenizer(["--input", write_docs(tmp_path, docs), "--output", out,
+                   "--tokenizer", "byte", "--context-size", "4",
+                   "--eot-token", "0", "--pad-token", "1"], binary=binary)
+    ds = TokenizedDataset(out)  # reads sidecar
+    assert len(ds) == 3 and ds.context_size == 4
+    row = ds[2]
+    np.testing.assert_array_equal(row["input_ids"], [104, 0, 1, 1])
+    np.testing.assert_array_equal(row["attention_mask"], [1, 1, 0, 0])
+    # mid-row pad ids stay visible (pad == eot case)
+    row0 = ds[0]
+    np.testing.assert_array_equal(row0["attention_mask"], [1, 1, 1, 1])
+    train, val = ds.split(2 / 3)
+    assert len(train) == 2 and len(val) == 1
+    np.testing.assert_array_equal(val[0]["input_ids"], row["input_ids"])
+
+
+def test_sharded_batches(tmp_path, binary, devices8):
+    from kubernetes_cloud_tpu.core import MeshSpec, build_mesh
+    from kubernetes_cloud_tpu.data import sharded_batches
+
+    docs = [chr(ord("a") + i) * 7 for i in range(8)]
+    out = str(tmp_path / "sb.tokens")
+    run_tokenizer(["--input", write_docs(tmp_path, docs), "--output", out,
+                   "--tokenizer", "byte", "--context-size", "8",
+                   "--eot-token", "0", "--pad-token", "1"], binary=binary)
+    ds = TokenizedDataset(out)
+    mesh = build_mesh(MeshSpec(data=4, fsdp=2), devices=devices8)
+    it = sharded_batches(ds, 8, mesh, shuffle=True, seed=0, epochs=1)
+    batches = list(it)
+    assert len(batches) == 1
+    batch = batches[0]
+    assert batch["input_ids"].shape == (8, 8)
+    from jax.sharding import PartitionSpec as P
+    assert batch["input_ids"].sharding.spec[0] == ("data", "fsdp")
