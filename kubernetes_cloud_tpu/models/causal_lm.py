@@ -71,8 +71,16 @@ class CausalLMConfig:
     # GPT-J uses interleaved (rotate_every_two) rotary channel pairing;
     # NeoX/LLaMA use the half-split convention.
     rope_interleaved: bool = False
+    # Attention backend: "auto"/"xla"/"pallas" (single-device per shard) or
+    # "ring" — sequence-parallel ring attention over the ``seq`` mesh axis
+    # (requires passing ``mesh`` to forward/loss_fn; SURVEY.md §5.7).
+    attn_impl: str = "auto"
 
     def __post_init__(self):
+        if self.attn_impl not in ("auto", "xla", "pallas", "ring"):
+            raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
+        if self.attn_impl == "ring" and self.pos_emb == "alibi":
+            raise ValueError("ring attention does not support alibi bias yet")
         if self.pos_emb not in ("rope", "alibi", "learned"):
             raise ValueError(f"unknown pos_emb: {self.pos_emb!r}")
         if self.norm not in ("layernorm", "rmsnorm"):
@@ -265,9 +273,17 @@ def _finish_block(cfg: CausalLMConfig, p: Params, x: jax.Array,
 
 def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
            rope: Optional[tuple[jax.Array, jax.Array]],
-           bias: Optional[jax.Array], mask: Optional[jax.Array]) -> jax.Array:
+           bias: Optional[jax.Array], mask: Optional[jax.Array],
+           mesh=None) -> jax.Array:
     q, k, v, attn_in = _project_qkv(cfg, p, x, rope=rope)
-    attn_vec = attention(q, k, v, causal=True, bias=bias, mask=mask)
+    if cfg.attn_impl == "ring" and mesh is not None:
+        from kubernetes_cloud_tpu.ops.ring_attention import ring_attention
+
+        attn_vec = ring_attention(q, k, v, mesh, causal=True, kv_mask=mask)
+    else:
+        attn_vec = attention(q, k, v, causal=True, bias=bias, mask=mask,
+                             impl="auto" if cfg.attn_impl == "ring"
+                             else cfg.attn_impl)
     return _finish_block(cfg, p, x, attn_vec, attn_in)
 
 
@@ -299,10 +315,24 @@ def _unembed(cfg: CausalLMConfig, params: Params, x: jax.Array) -> jax.Array:
 
 
 def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
-            attention_mask: Optional[jax.Array] = None) -> jax.Array:
-    """Token ids [B, S] → logits [B, S, V] (float32)."""
+            attention_mask: Optional[jax.Array] = None,
+            mesh=None) -> jax.Array:
+    """Token ids [B, S] → logits [B, S, V] (float32).
+
+    ``mesh`` is only needed for ``attn_impl="ring"`` (sequence parallelism):
+    activations are constrained seq-sharded and attention runs as a
+    blockwise ring over the ``seq`` axis.
+    """
     b, s = input_ids.shape
     x = _embed(cfg, params, input_ids)
+    seq_parallel = cfg.attn_impl == "ring" and mesh is not None
+    if seq_parallel:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubernetes_cloud_tpu.core.mesh import AXIS_SEQ, BATCH_AXES
+
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(BATCH_AXES, AXIS_SEQ, None)))
 
     rope = None
     bias = None
@@ -317,20 +347,21 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
 
     block = _block
     if cfg.remat:
+        # cfg (0) and mesh (6) are static: hashable non-array metadata.
         block = jax.checkpoint(
-            _block, static_argnums=(0,),
+            _block, static_argnums=(0, 6),
             policy=jax.checkpoint_policies.nothing_saveable)
 
     def body(carry, layer_params):
         return block(cfg, layer_params, carry, rope, bias,
-                     attention_mask), None
+                     attention_mask, mesh), None
 
     x, _ = jax.lax.scan(body, x, params["blocks"])
     return _unembed(cfg, params, x)
 
 
 def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
-            ) -> tuple[jax.Array, dict[str, jax.Array]]:
+            mesh=None) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Next-token cross-entropy with attention-mask label masking.
 
     Matches the reference trainer's semantics (labels are the inputs,
@@ -342,7 +373,8 @@ def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
     # fast path / pallas dispatch eligible); the ones-mask is only for
     # label accounting.
     attn_mask = batch.get("attention_mask")
-    logits = forward(cfg, params, input_ids, attention_mask=attn_mask)
+    logits = forward(cfg, params, input_ids, attention_mask=attn_mask,
+                     mesh=mesh)
     mask = jnp.ones_like(input_ids) if attn_mask is None else attn_mask
     targets = input_ids[:, 1:]
     logits = logits[:, :-1]

@@ -1,0 +1,181 @@
+"""Ring attention: sequence-parallel attention over the ``seq`` mesh axis.
+
+The reference has **no** sequence/context parallelism — its max context is a
+fixed 2048 tokens and long documents are chunked offline by the Go tokenizer
+(``finetuner-workflow/finetune-workflow.yaml:66-81``; SURVEY.md §5.7).  This
+module is the designed-in capability the reference lacks: attention over
+sequences far larger than one chip's HBM, computed blockwise while K/V
+chunks rotate around the ICI ring.
+
+Mechanics (Liu et al., Ring Attention; blockwise online softmax):
+
+* The sequence dimension of Q, K, V is sharded over the ``seq`` mesh axis —
+  each device holds one contiguous chunk.
+* Each of the ``n = |seq|`` steps computes one (Q-chunk × K-chunk) block
+  with a numerically-stable online softmax (running max ``m``, normalizer
+  ``l``, accumulator ``o``), then passes its K/V chunk to the next device
+  with ``jax.lax.ppermute`` — the XLA collective that rides the ICI ring
+  (the NCCL send/recv analogue, but compiler-scheduled so the transfer
+  overlaps the block matmul).
+* After ``n`` steps every Q chunk has attended to every K/V chunk; the
+  final output is ``o / l``.
+
+Communication volume per device per step is one K/V chunk — constant in the
+number of devices — so sequence length scales linearly with ring size.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect
+
+# jax>=0.8 renamed check_rep -> check_vma; support both.
+_CHECK_KW = ("check_vma"
+             if "check_vma" in inspect.signature(_shard_map).parameters
+             else "check_rep")
+
+
+def shard_map(*args, **kwargs):
+    if "check_rep" in kwargs:
+        kwargs[_CHECK_KW] = kwargs.pop("check_rep")
+    return _shard_map(*args, **kwargs)
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubernetes_cloud_tpu.core.mesh import AXIS_SEQ, BATCH_AXES
+
+NEG_INF = -1e15
+_M_INIT = -1e30
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=2)
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = AXIS_SEQ,
+    causal: bool = True,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-device body; call inside ``shard_map``/``pjit`` with the sequence
+    dimension mapped over ``axis_name``.
+
+    q/k/v: local chunks ``[B, S/n, H, Dh]`` (GQA: ``Hkv <= H``).
+    kv_mask: local key-padding chunk ``[B, S/n]``, nonzero = attend (the
+    reference's padding-mask training semantics,
+    ``finetuner-workflow/finetuner/finetuner.py:475-493``).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n_chunks = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n_chunks) for j in range(n_chunks)]
+
+    b, sq, h, dh = q.shape
+    hkv = k.shape[2]
+    n_rep = h // hkv  # GQA: rotate compact [*, Hkv, *] chunks around the
+    # ring and expand per step, so ppermute traffic stays at the true KV
+    # size rather than h/hkv times it.
+    sk = k.shape[1]
+
+    qf = q.astype(jnp.float32)
+    q_pos = my_idx * sq + jax.lax.iota(jnp.int32, sq)
+
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, sk), jnp.int32)
+
+    def step_fn(s, carry):
+        o, m, l, k_c, v_c, mask_c = carry
+        # After s rotations along +1, device i holds chunk (i - s) mod n.
+        k_idx = (my_idx - s) % n_chunks
+        k_pos = k_idx * sk + jax.lax.iota(jnp.int32, sk)
+
+        # Note: with causal=True, blocks where k_idx > my_idx are fully
+        # masked and contribute nothing but are still computed — a
+        # deliberate simplicity trade-off (uniform loop body keeps XLA
+        # scheduling/overlap simple); striped chunk assignment to
+        # load-balance causal work is a future optimization.
+        k_e = _repeat_kv(k_c, n_rep)
+        v_e = _repeat_kv(v_c, n_rep)
+        logits = jnp.einsum(
+            "bqhd,bshd->bhqs", qf, k_e.astype(jnp.float32)) * scale
+        allow = (mask_c[:, None, None, :] != 0)
+        if causal:
+            allow = allow & (q_pos[None, None, :, None]
+                             >= k_pos[None, None, None, :])
+        logits = jnp.where(allow, logits, NEG_INF)
+
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        p = jnp.where(allow, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqs,bshd->bhqd", p, v_e.astype(jnp.float32))
+
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        mask_c = jax.lax.ppermute(mask_c, axis_name, perm)
+        return o_new, m_new, l_new, k_c, v_c, mask_c
+
+    o0 = jnp.zeros((b, h, sq, dh), jnp.float32)
+    m0 = jnp.full((b, h, sq), _M_INIT, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o, m, l, *_ = jax.lax.fori_loop(
+        0, n_chunks, step_fn, (o0, m0, l0, k, v, kv_mask))
+
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Global-view convenience wrapper: shard the sequence dim over ``seq``
+    (batch over ``("data", "fsdp")``, heads over ``model``) and run the ring.
+
+    Inputs are global ``[B, S, H, Dh]`` arrays; S must divide evenly by the
+    ``seq`` axis size.
+    """
+    qkv_spec = P(BATCH_AXES, AXIS_SEQ, "model", None)
+    mask_spec = P(BATCH_AXES, AXIS_SEQ)
+    has_mask = kv_mask is not None
+    if not has_mask:
+        kv_mask = jnp.ones(q.shape[:2], jnp.int32)
+
+    fn = functools.partial(
+        ring_attention_local, causal=causal, scale=scale)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_rep=False,
+    )
+    def mapped(q, k, v, kv_mask):
+        return fn(q, k, v, kv_mask=kv_mask)
+
+    return mapped(q, k, v, kv_mask)

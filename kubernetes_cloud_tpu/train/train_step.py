@@ -116,10 +116,25 @@ def make_train_step(
     model_cfg: CausalLMConfig,
     train_cfg: TrainConfig,
     loss: Callable = loss_fn,
+    mesh=None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the (unjitted) train step; callers jit with
-    ``donate_argnums=0`` so parameter/optimizer buffers are reused."""
+    ``donate_argnums=0`` so parameter/optimizer buffers are reused.
+
+    ``mesh`` is only required for mesh-aware losses (sequence-parallel ring
+    attention, ``attn_impl="ring"``); plain sharded training needs none —
+    XLA derives collectives from the argument shardings.
+    """
     optimizer = make_optimizer(train_cfg)
+    if getattr(model_cfg, "attn_impl", None) == "ring" and mesh is None:
+        raise ValueError(
+            "attn_impl='ring' (sequence parallelism) requires passing "
+            "mesh= to make_train_step; without it the model would silently "
+            "fall back to dense attention")
+    if mesh is not None:
+        import functools
+
+        loss = functools.partial(loss, mesh=mesh)
 
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         (l, metrics), grads = jax.value_and_grad(loss, argnums=1,

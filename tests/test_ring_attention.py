@@ -1,0 +1,75 @@
+"""Ring attention vs. dense attention on the CPU-simulated seq mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.ops.attention import attention
+from kubernetes_cloud_tpu.ops.ring_attention import ring_attention
+
+
+def _rand_qkv(rng, b=2, s=256, h=4, hkv=None, dh=16):
+    kq, kk, kv = jax.random.split(rng, 3)
+    hkv = hkv or h
+    q = jax.random.normal(kq, (b, s, h, dh), jnp.float32)
+    k = jax.random.normal(kk, (b, s, hkv, dh), jnp.float32)
+    v = jax.random.normal(kv, (b, s, hkv, dh), jnp.float32)
+    return q, k, v
+
+
+@pytest.fixture
+def seq_mesh(devices8):
+    return build_mesh(MeshSpec(data=1, seq=8), devices=devices8)
+
+
+def test_ring_matches_dense_causal(seq_mesh):
+    q, k, v = _rand_qkv(jax.random.key(0))
+    want = attention(q, k, v, causal=True, impl="xla")
+    got = ring_attention(q, k, v, seq_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_matches_dense_with_padding_mask(seq_mesh):
+    q, k, v = _rand_qkv(jax.random.key(1))
+    mask = jnp.ones((2, 256), jnp.int32).at[:, 200:].set(0)
+    want = attention(q, k, v, causal=True, mask=mask, impl="xla")
+    got = ring_attention(q, k, v, seq_mesh, causal=True, kv_mask=mask)
+    # Fully-masked key rows only; compare where queries attend to anything.
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_non_causal(seq_mesh):
+    q, k, v = _rand_qkv(jax.random.key(2))
+    want = attention(q, k, v, causal=False, impl="xla")
+    got = ring_attention(q, k, v, seq_mesh, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gqa(seq_mesh):
+    q, k, v = _rand_qkv(jax.random.key(3), h=8, hkv=2)
+    want = attention(q, k, v, causal=True, impl="xla")
+    got = ring_attention(q, k, v, seq_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_under_jit_grad(seq_mesh):
+    """Ring attention must be differentiable and jittable (training path)."""
+    q, k, v = _rand_qkv(jax.random.key(4), b=1, s=64, h=2, dh=8)
+
+    @jax.jit
+    def loss_ring(q, k, v):
+        return ring_attention(q, k, v, seq_mesh, causal=True).sum()
+
+    def loss_dense(q, k, v):
+        return attention(q, k, v, causal=True, impl="xla").sum()
+
+    g_ring = jax.grad(loss_ring)(q, k, v)
+    g_dense = jax.grad(loss_dense)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
+                               rtol=1e-4, atol=1e-4)
