@@ -324,6 +324,11 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
     blockwise ring over the ``seq`` axis.
     """
     b, s = input_ids.shape
+    if cfg.attn_impl == "ring" and mesh is None:
+        raise ValueError(
+            "attn_impl='ring' (sequence parallelism) requires mesh=; "
+            "without it attention would silently fall back to the dense "
+            "path and materialize full SxS logits")
     x = _embed(cfg, params, input_ids)
     seq_parallel = cfg.attn_impl == "ring" and mesh is not None
     if seq_parallel:
@@ -375,6 +380,14 @@ def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
     attn_mask = batch.get("attention_mask")
     logits = forward(cfg, params, input_ids, attention_mask=attn_mask,
                      mesh=mesh)
+    return next_token_xent(logits, input_ids, attn_mask)
+
+
+def next_token_xent(
+    logits: jax.Array, input_ids: jax.Array,
+    attn_mask: Optional[jax.Array] = None,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Shared next-token cross-entropy tail (dense and pipelined paths)."""
     mask = jnp.ones_like(input_ids) if attn_mask is None else attn_mask
     targets = input_ids[:, 1:]
     logits = logits[:, :-1]
@@ -383,8 +396,7 @@ def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     denom = jnp.maximum(tgt_mask.sum(), 1)
     loss = jnp.where(tgt_mask, nll, 0.0).sum() / denom
-    n_tokens = tgt_mask.sum()
-    return loss, {"loss": loss, "tokens": n_tokens}
+    return loss, {"loss": loss, "tokens": tgt_mask.sum()}
 
 
 def param_count(params: Params) -> int:

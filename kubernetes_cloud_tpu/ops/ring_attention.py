@@ -31,23 +31,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-import inspect
-
-# jax>=0.8 renamed check_rep -> check_vma; support both.
-_CHECK_KW = ("check_vma"
-             if "check_vma" in inspect.signature(_shard_map).parameters
-             else "check_rep")
-
-
-def shard_map(*args, **kwargs):
-    if "check_rep" in kwargs:
-        kwargs[_CHECK_KW] = kwargs.pop("check_rep")
-    return _shard_map(*args, **kwargs)
+from kubernetes_cloud_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubernetes_cloud_tpu.core.mesh import AXIS_SEQ, BATCH_AXES
@@ -99,8 +83,8 @@ def ring_attention_local(
     if kv_mask is None:
         kv_mask = jnp.ones((b, sk), jnp.int32)
 
-    def step_fn(s, carry):
-        o, m, l, k_c, v_c, mask_c = carry
+    def online_block(s, o, m, l, k_c, v_c, mask_c):
+        """Fold one (Q-chunk x K-chunk) block into the online softmax."""
         # After s rotations along +1, device i holds chunk (i - s) mod n.
         k_idx = (my_idx - s) % n_chunks
         k_pos = k_idx * sk + jax.lax.iota(jnp.int32, sk)
@@ -127,17 +111,25 @@ def ring_attention_local(
         l_new = l * alpha + p.sum(axis=-1)
         o_new = o * alpha[..., None] + jnp.einsum(
             "bhqs,bshd->bhqd", p, v_e.astype(jnp.float32))
+        return o_new, m_new, l_new
 
+    def step_fn(s, carry):
+        o, m, l, k_c, v_c, mask_c = carry
+        o, m, l = online_block(s, o, m, l, k_c, v_c, mask_c)
         k_c = jax.lax.ppermute(k_c, axis_name, perm)
         v_c = jax.lax.ppermute(v_c, axis_name, perm)
         mask_c = jax.lax.ppermute(mask_c, axis_name, perm)
-        return o_new, m_new, l_new, k_c, v_c, mask_c
+        return o, m, l, k_c, v_c, mask_c
 
     o0 = jnp.zeros((b, h, sq, dh), jnp.float32)
     m0 = jnp.full((b, h, sq), _M_INIT, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
-    o, m, l, *_ = jax.lax.fori_loop(
-        0, n_chunks, step_fn, (o0, m0, l0, k, v, kv_mask))
+    # n-1 rotating steps, then fold the final chunk without the dead
+    # rotation (its result would be discarded; XLA can't DCE collectives
+    # inside the loop).
+    o, m, l, k_l, v_l, mask_l = jax.lax.fori_loop(
+        0, n_chunks - 1, step_fn, (o0, m0, l0, k, v, kv_mask))
+    o, m, l = online_block(n_chunks - 1, o, m, l, k_l, v_l, mask_l)
 
     out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -1,0 +1,239 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``stage`` axis.
+
+The reference's pipeline engine is DeepSpeed's (GPT-NeoX ``pipe-parallel-
+size: 4``, ``kubeflow/training-operator/gpt-neox/04-finetune-workflow.yaml:201``)
+— a separate runtime that partitions ``nn.Module`` graphs, forks worker
+ranks and schedules P2P NCCL sends.  Here the whole schedule is one traced
+XLA program:
+
+* The stacked transformer blocks ``[L, ...]`` are reshaped to
+  ``[n_stages, L/n_stages, ...]`` and sharded over ``stage``.
+* ``shard_map`` maps *only* the ``stage`` axis (``axis_names={"stage"}``);
+  batch/model/fsdp axes stay XLA-managed inside the body, so pipeline
+  composes with FSDP and tensor parallelism instead of fighting them.
+* Each of ``n_micro + n_stages - 1`` ticks runs every stage on its current
+  microbatch, then hands activations to the next stage with a non-circular
+  ``ppermute`` — the XLA analogue of DeepSpeed's P2P sends, but visible to
+  the scheduler so transfer overlaps compute.
+* The classic GPipe bubble — ``(n_stages-1)/(n_micro+n_stages-1)`` idle
+  fraction — shrinks as microbatch count grows, exactly as in the
+  reference's engine.
+
+``stage`` is the outermost DCN-friendly mesh axis (core.mesh), so pipeline
+boundaries are where multi-slice DCN hops belong, with TP/FSDP riding ICI
+inside each slice — the TPU equivalent of the reference's
+NVLINK-intra-node / InfiniBand-inter-node split.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubernetes_cloud_tpu.core.mesh import AXIS_SEQ, AXIS_STAGE
+from kubernetes_cloud_tpu.models.causal_lm import (
+    CausalLMConfig,
+    Params,
+    _block,
+    _embed,
+    _unembed,
+    next_token_xent,
+)
+from kubernetes_cloud_tpu.ops.layers import alibi_slopes, rope_cache
+from kubernetes_cloud_tpu.utils.compat import shard_map
+
+
+def _split_stages(blocks: Params, n_stages: int) -> Params:
+    """[L, ...] block leaves → [n_stages, L/n_stages, ...]."""
+    return jax.tree.map(
+        lambda a: a.reshape(n_stages, a.shape[0] // n_stages, *a.shape[1:]),
+        blocks)
+
+
+def pipeline_forward(
+    cfg: CausalLMConfig,
+    params: Params,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array] = None,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jax.Array:
+    """Token ids [B, S] → logits [B, S, V], blocks pipelined over ``stage``.
+
+    Embedding and unembedding run outside the pipelined region (replicated
+    over ``stage``; still sharded over batch/model axes by XLA) — they are
+    cheap gathers/matmuls relative to the L-block trunk.
+    """
+    n_stages = mesh.shape[AXIS_STAGE]
+    if n_stages == 1:
+        raise ValueError("pipeline_forward needs a mesh with stage > 1")
+    if cfg.num_layers % n_stages:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by {n_stages} stages")
+    b, s = input_ids.shape
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by {n_microbatches} microbatches")
+    mb = b // n_microbatches
+
+    x = _embed(cfg, params, input_ids)
+    d = x.shape[-1]
+    # fp32 at the shard_map boundary and in the inter-stage carry: the
+    # transpose of replicated inputs / replicated outputs is a psum, and
+    # XLA CPU's AllReducePromotion pass aborts on bf16 all-reduce (jax
+    # 0.9).  fp32 boundary cotangents sidestep that and accumulate more
+    # accurately; stage bodies still compute in cfg.dtype.
+    x_micro = x.reshape(n_microbatches, mb, s, d).astype(jnp.float32)
+
+    rope = None
+    bias = jnp.zeros((1, 1, 1, 1), jnp.float32)
+    has_bias = False
+    if cfg.pos_emb == "rope":
+        rope = rope_cache(s, cfg.rotary_dim, cfg.rope_theta)
+    elif cfg.pos_emb == "alibi":
+        slopes = alibi_slopes(cfg.num_heads)
+        kpos = jnp.arange(s, dtype=jnp.float32)
+        bias = slopes[None, :, None, None] * kpos[None, None, None, :]
+        has_bias = True
+
+    if attention_mask is None:
+        mask_micro = jnp.ones((n_microbatches, mb, s), jnp.int32)
+    else:
+        mask_micro = attention_mask.reshape(n_microbatches, mb, s)
+
+    blocks = _split_stages(params["blocks"], n_stages)
+    rope_args = rope if rope is not None else (
+        jnp.zeros((s, 1), jnp.float32), jnp.zeros((s, 1), jnp.float32))
+
+    # Sequence parallelism composes with the pipeline: the seq axis is also
+    # manually mapped, activations/masks/rope tables are seq-sharded, and
+    # attention inside each stage runs as a K/V ring over ``seq``
+    # (ring_attention_local) while stage boundaries ppermute over ``stage``.
+    seq_parallel = mesh.shape["seq"] > 1
+    if seq_parallel and cfg.attn_impl != "ring":
+        raise ValueError(
+            "a mesh with seq > 1 requires attn_impl='ring' for the "
+            "pipelined path (dense attention would only see local chunks)")
+
+    use_ring = cfg.attn_impl == "ring"
+
+    def one_block(cfg, layer, carry, rope_l, bias_l, mask_mb, _unused):
+        if use_ring:
+            from kubernetes_cloud_tpu.models.causal_lm import (
+                _finish_block,
+                _project_qkv,
+            )
+            from kubernetes_cloud_tpu.ops.ring_attention import (
+                ring_attention_local,
+            )
+
+            q, kk, vv, attn_in = _project_qkv(cfg, layer, carry, rope=rope_l)
+            attn_vec = ring_attention_local(q, kk, vv, kv_mask=mask_mb,
+                                            causal=True)
+            return _finish_block(cfg, layer, carry, attn_vec, attn_in)
+        return _block(cfg, layer, carry, rope_l, bias_l, mask_mb, None)
+
+    block = one_block
+    if cfg.remat:
+        block = jax.checkpoint(
+            one_block, static_argnums=(0, 6),
+            policy=jax.checkpoint_policies.nothing_saveable)
+
+    def stage_fn(local_blocks, x_mb, mask_mb, rope_cos, rope_sin, bias_v):
+        rope_l = (rope_cos, rope_sin) if rope is not None else None
+        bias_l = bias_v if has_bias else None
+
+        def body(carry, layer):
+            return block(cfg, layer, carry, rope_l, bias_l, mask_mb,
+                         None), None
+
+        out, _ = lax.scan(body, x_mb.astype(cfg.dtype), local_blocks)
+        return out.astype(jnp.float32)
+
+    seq_dim = P(AXIS_SEQ) if seq_parallel else P(None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(AXIS_STAGE),                       # blocks: leading stage dim
+            P(None, None, *seq_dim, None),       # x_micro [M, mb, S, D]
+            P(None, None, *seq_dim),             # mask    [M, mb, S]
+            P(*seq_dim, None),                   # rope cos [S, rot]
+            P(*seq_dim, None),                   # rope sin [S, rot]
+            P(),                                 # alibi bias (no ring+alibi)
+        ),
+        out_specs=P(None, None, *seq_dim, None),
+        axis_names={AXIS_STAGE, AXIS_SEQ},
+        check_vma=False,
+    )
+    def run(blocks_sharded, x_micro, mask_micro, rope_cos, rope_sin, bias_v):
+        local_blocks = jax.tree.map(lambda a: a[0], blocks_sharded)
+        stage = lax.axis_index(AXIS_STAGE)
+        n = lax.psum(1, AXIS_STAGE)
+        n_micro = x_micro.shape[0]
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage s works on microbatch (t - s); clip for warmup/drain
+            # ticks (their results are never written back).
+            my_mb = jnp.clip(t - stage, 0, n_micro - 1)
+            feed = lax.dynamic_index_in_dim(x_micro, jnp.clip(t, 0, n_micro - 1),
+                                            0, keepdims=False)
+            inp = jnp.where(stage == 0, feed, state)
+            mask_mb = lax.dynamic_index_in_dim(mask_micro, my_mb, 0,
+                                               keepdims=False)
+            out = stage_fn(local_blocks, inp, mask_mb, rope_cos, rope_sin,
+                           bias_v)
+
+            out_idx = t - (n - 1)
+            idx_c = jnp.clip(out_idx, 0, n_micro - 1)
+            valid = (out_idx >= 0) & (out_idx < n_micro)
+            cur = lax.dynamic_index_in_dim(outputs, idx_c, 0, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, out, cur), idx_c, 0)
+
+            state = lax.ppermute(out, AXIS_STAGE, perm)
+            return (state, outputs), None
+
+        n_ticks = n_micro + n_stages - 1
+        state0 = jnp.zeros_like(x_micro[0])
+        out0 = jnp.zeros_like(x_micro)
+        (_, outputs), _ = lax.scan(tick, (state0, out0),
+                                   jnp.arange(n_ticks))
+        # Only the last stage holds real outputs; zero the rest and psum to
+        # replicate across the stage axis (fp32 throughout, see above).
+        outputs = jnp.where(stage == n - 1, outputs, 0)
+        return lax.psum(outputs, AXIS_STAGE)
+
+    y = run(blocks, x_micro, mask_micro, *rope_args, bias)
+    return _unembed(cfg, params, y.reshape(b, s, d).astype(cfg.dtype))
+
+
+def pipeline_loss_fn(
+    cfg: CausalLMConfig,
+    params: Params,
+    batch: dict[str, jax.Array],
+    mesh: Optional[Mesh] = None,
+    *,
+    n_microbatches: int = 4,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Drop-in for :func:`models.causal_lm.loss_fn` with a pipelined trunk.
+
+    Pass via ``make_train_step(cfg, tcfg, loss=functools.partial(
+    pipeline_loss_fn, n_microbatches=...), mesh=mesh)``.
+    """
+    if mesh is None:
+        raise ValueError("pipeline_loss_fn requires mesh=")
+    input_ids = batch["input_ids"]
+    attn_mask = batch.get("attention_mask")
+    logits = pipeline_forward(cfg, params, input_ids, attn_mask,
+                              mesh=mesh, n_microbatches=n_microbatches)
+    return next_token_xent(logits, input_ids, attn_mask)

@@ -1,0 +1,27 @@
+"""Version-compat shims for the jax API surface the framework uses."""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# jax>=0.8 renamed check_rep -> check_vma; jax 0.9 dropped check_rep.
+_PARAMS = inspect.signature(_shard_map).parameters
+_CHECK_KW = "check_vma" if "check_vma" in _PARAMS else "check_rep"
+_HAS_AXIS_NAMES = "axis_names" in _PARAMS
+
+
+def shard_map(*args, **kwargs):
+    """jax.shard_map accepting either check_rep= or check_vma=."""
+    for alias in ("check_rep", "check_vma"):
+        if alias in kwargs and alias != _CHECK_KW:
+            kwargs[_CHECK_KW] = kwargs.pop(alias)
+    if "axis_names" in kwargs and not _HAS_AXIS_NAMES:
+        raise NotImplementedError(
+            "this jax version's shard_map lacks axis_names= (partial-manual "
+            "mode); jax >= 0.8 is required for the pipeline-parallel path")
+    return _shard_map(*args, **kwargs)
