@@ -45,11 +45,15 @@ from jax.sharding import Mesh
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_STAGE = "stage"
+AXIS_EXPERT = "expert"
 AXIS_SEQ = "seq"
 AXIS_MODEL = "model"
 
 #: Mesh axis order, outermost (DCN-friendly) to innermost (ICI-adjacent).
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_STAGE, AXIS_SEQ, AXIS_MODEL)
+#: ``expert`` sits inside ``stage`` (all-to-all dispatch rides ICI) but
+#: outside ``seq``/``model`` (which need the tightest coupling).
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_STAGE, AXIS_EXPERT, AXIS_SEQ,
+             AXIS_MODEL)
 
 #: Axes over which the batch dimension is sharded.
 BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
@@ -70,6 +74,7 @@ class MeshSpec:
     data: int = -1
     fsdp: int = 1
     stage: int = 1
+    expert: int = 1
     seq: int = 1
     model: int = 1
     # Outer mesh over DCN (multi-slice). Product must equal num_slices.
@@ -78,7 +83,8 @@ class MeshSpec:
     dcn_stage: int = 1
 
     def ici_shape(self, n_devices: int) -> tuple[int, ...]:
-        sizes = [self.data, self.fsdp, self.stage, self.seq, self.model]
+        sizes = [self.data, self.fsdp, self.stage, self.expert, self.seq,
+                 self.model]
         n_fill = sizes.count(-1)
         if n_fill > 1:
             raise ValueError(f"at most one axis may be -1, got {sizes}")
@@ -96,7 +102,7 @@ class MeshSpec:
         return tuple(sizes)
 
     def dcn_shape(self) -> tuple[int, ...]:
-        return (self.dcn_data, self.dcn_fsdp, self.dcn_stage, 1, 1)
+        return (self.dcn_data, self.dcn_fsdp, self.dcn_stage, 1, 1, 1)
 
     @property
     def is_multislice(self) -> bool:

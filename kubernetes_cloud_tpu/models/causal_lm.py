@@ -75,10 +75,24 @@ class CausalLMConfig:
     # "ring" — sequence-parallel ring attention over the ``seq`` mesh axis
     # (requires passing ``mesh`` to forward/loss_fn; SURVEY.md §5.7).
     attn_impl: str = "auto"
+    # Mixture-of-experts FFN (0 = dense).  Experts shard over the
+    # ``expert`` mesh axis; the reference has no EP (SURVEY.md §2.3).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_group_size: int = 1024
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "xla", "pallas", "ring"):
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
+        if self.moe_experts:
+            if self.moe_experts < 0 or self.moe_top_k > self.moe_experts:
+                raise ValueError(
+                    f"moe_top_k={self.moe_top_k} must be <= "
+                    f"moe_experts={self.moe_experts} (and both positive)")
+            if self.moe_capacity_factor <= 0:
+                raise ValueError("moe_capacity_factor must be positive")
         if self.attn_impl == "ring" and self.pos_emb == "alibi":
             raise ValueError("ring attention does not support alibi bias yet")
         if self.pos_emb not in ("rope", "alibi", "learned"):
@@ -193,18 +207,27 @@ def init_params(cfg: CausalLMConfig, rng: jax.Array) -> Params:
             "wqkv": normal(keys[2], (l, d, h + 2 * hkv, dh)),
             "wo": normal(keys[3], (l, h, dh, d), wo_std),
         },
-        "mlp": {
+    }
+    if cfg.moe_experts:
+        ne = cfg.moe_experts
+        blocks["moe"] = {
+            "router": normal(keys[7], (l, d, ne)),
+            "wi": normal(keys[4], (l, ne, d, f)),
+            "wo": normal(keys[5], (l, ne, f, d), wo_std),
+        }
+    else:
+        blocks["mlp"] = {
             "wi": normal(keys[4], (l, d, f)),
             "wo": normal(keys[5], (l, f, d), wo_std),
-        },
-    }
+        }
     blocks["ln2"] = _norm_params(cfg, (l,))
     if cfg.use_bias:
         blocks["attn"]["bqkv"] = jnp.zeros((l, h + 2 * hkv, dh),
                                            cfg.param_dtype)
         blocks["attn"]["bo"] = jnp.zeros((l, d), cfg.param_dtype)
-        blocks["mlp"]["bi"] = jnp.zeros((l, f), cfg.param_dtype)
-        blocks["mlp"]["bo"] = jnp.zeros((l, d), cfg.param_dtype)
+        if not cfg.moe_experts:
+            blocks["mlp"]["bi"] = jnp.zeros((l, f), cfg.param_dtype)
+            blocks["mlp"]["bo"] = jnp.zeros((l, d), cfg.param_dtype)
 
     params: Params = {"embed": embed, "blocks": blocks,
                       "final_ln": _norm_params(cfg)}
@@ -244,8 +267,15 @@ def _project_qkv(cfg: CausalLMConfig, p: Params, x: jax.Array, *,
 
 
 def _finish_block(cfg: CausalLMConfig, p: Params, x: jax.Array,
-                  attn_vec: jax.Array, attn_in: jax.Array) -> jax.Array:
-    """Block back half: output projection + residual wiring + MLP."""
+                  attn_vec: jax.Array, attn_in: jax.Array,
+                  token_mask: Optional[jax.Array] = None,
+                  moe_no_drop: bool = False) -> tuple[jax.Array, jax.Array]:
+    """Block back half: output projection + residual wiring + MLP/MoE.
+
+    Returns ``(out, aux)`` where ``aux`` is the MoE load-balancing loss
+    (0.0 for dense blocks).  ``token_mask`` [B, S] keeps padding from
+    routing/claiming MoE capacity; ``moe_no_drop`` (decode path) raises
+    capacity so co-batched requests can't perturb each other's logits."""
     attn_out = jnp.einsum("bsnk,nkd->bsd", attn_vec,
                           p["attn"]["wo"].astype(cfg.dtype))
     if cfg.use_bias:
@@ -258,23 +288,40 @@ def _finish_block(cfg: CausalLMConfig, p: Params, x: jax.Array,
         x = x + attn_out
         mlp_in = _norm(cfg, p["ln2"], x)
 
-    hmid = jnp.einsum("bsd,df->bsf", mlp_in, p["mlp"]["wi"].astype(cfg.dtype))
-    if cfg.use_bias:
-        hmid = hmid + p["mlp"]["bi"].astype(cfg.dtype)
-    hmid = jax.nn.gelu(hmid, approximate=cfg.act == "gelu_tanh")
-    mlp_out = jnp.einsum("bsf,fd->bsd", hmid, p["mlp"]["wo"].astype(cfg.dtype))
-    if cfg.use_bias:
-        mlp_out = mlp_out + p["mlp"]["bo"].astype(cfg.dtype)
+    aux = jnp.zeros((), jnp.float32)
+    if "moe" in p:
+        from kubernetes_cloud_tpu.ops.moe import moe_ffn
+
+        if token_mask is not None and token_mask.ndim != 2:
+            # Full [B, 1, Sq, Sk] attention masks carry no per-token
+            # validity; only key-padding masks gate MoE routing.
+            token_mask = None
+
+        mlp_out, aux = moe_ffn(
+            mlp_in, p["moe"]["router"], p["moe"]["wi"], p["moe"]["wo"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            act=cfg.act, dtype=cfg.dtype, token_mask=token_mask,
+            group_size=cfg.moe_group_size, no_drop=moe_no_drop)
+    else:
+        hmid = jnp.einsum("bsd,df->bsf", mlp_in,
+                          p["mlp"]["wi"].astype(cfg.dtype))
+        if cfg.use_bias:
+            hmid = hmid + p["mlp"]["bi"].astype(cfg.dtype)
+        hmid = jax.nn.gelu(hmid, approximate=cfg.act == "gelu_tanh")
+        mlp_out = jnp.einsum("bsf,fd->bsd", hmid,
+                             p["mlp"]["wo"].astype(cfg.dtype))
+        if cfg.use_bias:
+            mlp_out = mlp_out + p["mlp"]["bo"].astype(cfg.dtype)
 
     if cfg.parallel_residual:
-        return x + attn_out + mlp_out
-    return x + mlp_out
+        return x + attn_out + mlp_out, aux
+    return x + mlp_out, aux
 
 
 def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
            rope: Optional[tuple[jax.Array, jax.Array]],
            bias: Optional[jax.Array], mask: Optional[jax.Array],
-           mesh=None) -> jax.Array:
+           mesh=None) -> tuple[jax.Array, jax.Array]:
     q, k, v, attn_in = _project_qkv(cfg, p, x, rope=rope)
     if cfg.attn_impl == "ring" and mesh is not None:
         from kubernetes_cloud_tpu.ops.ring_attention import ring_attention
@@ -284,7 +331,7 @@ def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
         attn_vec = attention(q, k, v, causal=True, bias=bias, mask=mask,
                              impl="auto" if cfg.attn_impl == "ring"
                              else cfg.attn_impl)
-    return _finish_block(cfg, p, x, attn_vec, attn_in)
+    return _finish_block(cfg, p, x, attn_vec, attn_in, token_mask=mask)
 
 
 def _embed(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
@@ -316,12 +363,13 @@ def _unembed(cfg: CausalLMConfig, params: Params, x: jax.Array) -> jax.Array:
 
 def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
             attention_mask: Optional[jax.Array] = None,
-            mesh=None) -> jax.Array:
+            mesh=None, with_aux: bool = False) -> jax.Array:
     """Token ids [B, S] → logits [B, S, V] (float32).
 
     ``mesh`` is only needed for ``attn_impl="ring"`` (sequence parallelism):
     activations are constrained seq-sharded and attention runs as a
-    blockwise ring over the ``seq`` axis.
+    blockwise ring over the ``seq`` axis.  ``with_aux=True`` also returns
+    the mean MoE load-balancing loss across layers.
     """
     b, s = input_ids.shape
     if cfg.attn_impl == "ring" and mesh is None:
@@ -358,11 +406,15 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
             policy=jax.checkpoint_policies.nothing_saveable)
 
     def body(carry, layer_params):
-        return block(cfg, layer_params, carry, rope, bias,
-                     attention_mask, mesh), None
+        out, aux = block(cfg, layer_params, carry, rope, bias,
+                         attention_mask, mesh)
+        return out, aux
 
-    x, _ = jax.lax.scan(body, x, params["blocks"])
-    return _unembed(cfg, params, x)
+    x, auxs = jax.lax.scan(body, x, params["blocks"])
+    logits = _unembed(cfg, params, x)
+    if with_aux:
+        return logits, auxs.mean()
+    return logits
 
 
 def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
@@ -378,6 +430,14 @@ def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
     # fast path / pallas dispatch eligible); the ones-mask is only for
     # label accounting.
     attn_mask = batch.get("attention_mask")
+    if cfg.moe_experts:
+        logits, aux = forward(cfg, params, input_ids,
+                              attention_mask=attn_mask, mesh=mesh,
+                              with_aux=True)
+        loss, metrics = next_token_xent(logits, input_ids, attn_mask)
+        loss = loss + cfg.moe_aux_weight * aux
+        metrics = dict(metrics, loss=loss, aux_loss=aux)
+        return loss, metrics
     logits = forward(cfg, params, input_ids, attention_mask=attn_mask,
                      mesh=mesh)
     return next_token_xent(logits, input_ids, attn_mask)

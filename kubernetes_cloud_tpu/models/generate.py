@@ -84,7 +84,8 @@ def prefill(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
             cfg, p, x, rope=rope, q_positions=positions)
         attn_vec = attention(q, k_new, v_new, causal=True, bias=bias,
                              mask=attention_mask, impl="xla")
-        x = _finish_block(cfg, p, x, attn_vec, attn_in)
+        x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
+                                token_mask=attention_mask, moe_no_drop=True)
         return x, (k_new, v_new)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
@@ -131,7 +132,8 @@ def decode_step(cfg: CausalLMConfig, params: Params, token: jax.Array,
         attn_vec = attention(q, ck.astype(cfg.dtype), cv.astype(cfg.dtype),
                              causal=False, bias=bias, mask=key_mask,
                              impl="xla")
-        x = _finish_block(cfg, p, x, attn_vec, attn_in)
+        x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
+                                moe_no_drop=True)
         return x, (ck, cv)
 
     x, (ks, vs) = jax.lax.scan(body, x,

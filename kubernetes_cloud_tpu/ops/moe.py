@@ -1,0 +1,113 @@
+"""Mixture-of-experts FFN with expert parallelism over the ``expert`` axis.
+
+The reference has **no** expert parallelism anywhere (SURVEY.md §2.3 lists
+EP as an explicit capability gap to design in).  This is the designed-in
+version: a token-choice top-k router with GShard/Switch-style capacity
+dispatch, experts sharded over the ``expert`` mesh axis.  The dispatch and
+combine einsums contract the token dimension (sharded over ``data``/
+``fsdp``) against the expert dimension (sharded over ``expert``), so XLA's
+SPMD partitioner emits the all-to-all exchanges that GPU MoE stacks
+hand-write — no manual collectives.
+
+Design points:
+
+* **Grouped dispatch** (GShard): tokens are split into groups of
+  ``group_size`` and capacity applies per group, so the dispatch/combine
+  tensors are ``[G, gs, E, C]`` with ``C ∝ gs/E`` — memory linear in
+  tokens, not quadratic.
+* **Padding-aware routing**: masked tokens claim no expert slots and
+  contribute no output, so logits for real tokens are independent of how
+  much padding shares the batch.
+* **``no_drop`` mode** for inference: capacity is raised to the group size
+  so no token is ever dropped — a sequence's logits can't depend on which
+  other requests happen to be co-batched (training keeps the drop trade
+  for static shapes + balance pressure).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_ffn(
+    x: jax.Array,
+    router_w: jax.Array,
+    wi: jax.Array,
+    wo: jax.Array,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    act: str = "gelu_tanh",
+    dtype=None,
+    token_mask: Optional[jax.Array] = None,
+    group_size: int = 1024,
+    no_drop: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """x [B, S, D], router_w [D, E], wi [E, D, F], wo [E, F, D] →
+    (y [B, S, D], aux_loss scalar).
+
+    ``token_mask`` [B, S]: nonzero = real token; masked positions neither
+    route nor consume capacity.  ``aux_loss`` is the Switch-Transformer
+    load-balancing loss ``E * Σ_e f_e · p_e`` over real tokens (~1.0 under
+    perfect balance).
+    """
+    b, s, d = x.shape
+    t = b * s
+    e = router_w.shape[-1]
+    cdtype = dtype or x.dtype
+    xt = x.reshape(t, d)
+
+    gs = t if (t <= group_size or t % group_size) else group_size
+    g = t // gs
+    capacity = gs if no_drop else min(
+        gs, int(math.ceil(capacity_factor * top_k * gs / e)))
+
+    # Router in fp32: small matmul, numerically load-bearing.
+    logits = xt.astype(jnp.float32) @ router_w.astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, idx = jax.lax.top_k(probs, top_k)  # [T, k]
+    gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, k, E]
+    if token_mask is not None:
+        tm = (token_mask.reshape(t) != 0).astype(jnp.float32)
+        onehot = onehot * tm[:, None, None]
+        gate = gate * tm[:, None]
+
+    # Per-group slot assignment.  Priority: choice rank first, then token
+    # order — cumsum over a [G, k*gs, E] layout.
+    oh_g = onehot.reshape(g, gs, top_k, e)
+    oh_flat = oh_g.transpose(0, 2, 1, 3).reshape(g, top_k * gs, e)
+    pos_flat = jnp.cumsum(oh_flat, axis=1) - oh_flat
+    pos = pos_flat.reshape(g, top_k, gs, e).transpose(0, 2, 1, 3)
+    pos_k = (pos * oh_g).sum(-1).astype(jnp.int32)  # [G, gs, k] expert slot
+    # one_hot is all-zero for pos_k >= capacity: that IS the drop.
+    slot = jax.nn.one_hot(pos_k, capacity, dtype=jnp.float32)
+    disp = oh_g[..., None] * slot[..., None, :]  # [G, gs, k, E, C]
+    dispatch = disp.sum(2)  # [G, gs, E, C] in {0, 1}
+    gate_g = gate.reshape(g, gs, top_k)
+    combine = (disp * gate_g[..., None, None]).sum(2)
+
+    x_g = xt.reshape(g, gs, d)
+    expert_in = jnp.einsum("gtec,gtd->gecd", dispatch.astype(cdtype),
+                           x_g.astype(cdtype))
+    h = jnp.einsum("gecd,edf->gecf", expert_in, wi.astype(cdtype))
+    h = jax.nn.gelu(h, approximate=act == "gelu_tanh")
+    out = jnp.einsum("gecf,efd->gecd", h, wo.astype(cdtype))
+    y = jnp.einsum("gtec,gecd->gtd", combine.astype(cdtype), out)
+
+    # Switch aux loss on top-1 assignment fractions over real tokens.
+    top1 = onehot[:, 0, :]
+    if token_mask is not None:
+        denom = jnp.maximum(tm.sum(), 1.0)
+        f_e = top1.sum(0) / denom
+        p_e = (probs * tm[:, None]).sum(0) / denom
+    else:
+        f_e = top1.mean(0)
+        p_e = probs.mean(0)
+    aux = e * jnp.sum(f_e * p_e)
+    return y.reshape(b, s, d).astype(x.dtype), aux

@@ -73,6 +73,10 @@ def pipeline_forward(
     n_stages = mesh.shape[AXIS_STAGE]
     if n_stages == 1:
         raise ValueError("pipeline_forward needs a mesh with stage > 1")
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "MoE aux-loss accumulation through the pipeline schedule is "
+            "not wired up yet; use the non-pipelined path for MoE models")
     if cfg.num_layers % n_stages:
         raise ValueError(
             f"num_layers={cfg.num_layers} not divisible by {n_stages} stages")
@@ -136,8 +140,10 @@ def pipeline_forward(
             q, kk, vv, attn_in = _project_qkv(cfg, layer, carry, rope=rope_l)
             attn_vec = ring_attention_local(q, kk, vv, kv_mask=mask_mb,
                                             causal=True)
-            return _finish_block(cfg, layer, carry, attn_vec, attn_in)
-        return _block(cfg, layer, carry, rope_l, bias_l, mask_mb, None)
+            out, _aux = _finish_block(cfg, layer, carry, attn_vec, attn_in)
+            return out
+        out, _aux = _block(cfg, layer, carry, rope_l, bias_l, mask_mb, None)
+        return out
 
     block = one_block
     if cfg.remat:

@@ -45,6 +45,10 @@ _RULES: dict[str, P] = {
     "mlp.wi": P("fsdp", "model"),
     "bi": P("model"),
     "mlp.wo": P("model", "fsdp"),
+    # MoE: experts over the expert axis, then Megatron-style within expert.
+    "moe.router": P("fsdp", None),
+    "moe.wi": P("expert", "fsdp", "model"),
+    "moe.wo": P("expert", "model", "fsdp"),
     "wte": P("model", "fsdp"),
     "wpe": P(None, "fsdp"),
     "lm_head": P("fsdp", "model"),

@@ -22,7 +22,8 @@ def test_fsdp_tp_mesh(devices8):
     mesh = build_mesh(MeshSpec(data=1, fsdp=4, model=2), devices=devices8)
     assert mesh.shape["fsdp"] == 4
     assert mesh.shape["model"] == 2
-    assert mesh.axis_names == ("data", "fsdp", "stage", "seq", "model")
+    assert mesh.axis_names == ("data", "fsdp", "stage", "expert", "seq",
+                               "model")
 
 
 def test_bad_spec_raises(devices8):
