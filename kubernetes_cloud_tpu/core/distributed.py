@@ -63,10 +63,33 @@ def maybe_initialize_distributed(
             process_id = env.get("JOB_COMPLETION_INDEX")
 
     if coordinator is None:
-        if env.get("TPU_WORKER_HOSTNAMES") or env.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        platforms = env.get("JAX_PLATFORMS", "")
+        on_tpu = not platforms or any(
+            p in platforms for p in ("tpu", "axon"))
+        if on_tpu and (env.get("TPU_WORKER_HOSTNAMES")
+                       or env.get("MEGASCALE_COORDINATOR_ADDRESS")):
             # GKE TPU slice: args are autodetected from the TPU metadata.
+            # (Skipped when JAX_PLATFORMS pins a non-TPU backend — e.g.
+            # CPU-simulated test meshes on a host that also has TPU env.)
             log.info("jax.distributed.initialize() via TPU autodetection")
-            jax.distributed.initialize()
+            try:
+                jax.distributed.initialize()
+            except ValueError as e:
+                # Autodetection found no usable TPU metadata (single-host
+                # dev shims export partial env); run single-process.
+                log.warning("TPU autodetection failed, single-process: %s",
+                            e)
+                return False
+            except RuntimeError as e:
+                # Only the backend-already-initialized error may be
+                # downgraded (library use after jax calls, or a single-host
+                # dev shim exporting TPU env).  Real rendezvous failures
+                # must crash so Kubernetes restarts the pod — proceeding
+                # single-process would silently corrupt the run.
+                if "must be called before" not in str(e):
+                    raise
+                log.warning("jax.distributed.initialize skipped: %s", e)
+                return False
             _INITIALIZED = True
             return True
         return False

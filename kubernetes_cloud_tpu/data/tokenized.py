@@ -98,6 +98,7 @@ def sharded_batches(
     seed: int = 0,
     epochs: Optional[int] = None,
     drop_last: bool = True,
+    skip_batches: int = 0,
 ) -> Iterator[dict[str, jax.Array]]:
     """Yield globally-sharded batches from a per-host dataset stripe.
 
@@ -120,7 +121,15 @@ def sharded_batches(
             rng.shuffle(order)
         order = order[proc::n_proc]
         n_full = len(order) // local_bs
-        for b in range(n_full):
+        if skip_batches >= n_full:
+            # Resume fast-forward: advance the (deterministic) shuffle
+            # stream without materializing device batches.
+            skip_batches -= n_full
+            epoch += 1
+            continue
+        start = skip_batches
+        skip_batches = 0
+        for b in range(start, n_full):
             idx = order[b * local_bs:(b + 1) * local_bs]
             rows = [dataset[int(i)] for i in idx]
             local = {

@@ -87,10 +87,11 @@ class CausalLMConfig:
         if self.attn_impl not in ("auto", "xla", "pallas", "ring"):
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
         if self.moe_experts:
-            if self.moe_experts < 0 or self.moe_top_k > self.moe_experts:
+            if (self.moe_experts < 0 or self.moe_top_k < 1
+                    or self.moe_top_k > self.moe_experts):
                 raise ValueError(
-                    f"moe_top_k={self.moe_top_k} must be <= "
-                    f"moe_experts={self.moe_experts} (and both positive)")
+                    f"moe_top_k={self.moe_top_k} must be in "
+                    f"[1, moe_experts={self.moe_experts}]")
             if self.moe_capacity_factor <= 0:
                 raise ValueError("moe_capacity_factor must be positive")
         if self.attn_impl == "ring" and self.pos_emb == "alibi":

@@ -1,0 +1,102 @@
+"""Standalone evaluator: load a (finetuned) model and sample prompts.
+
+Parity with the reference's ``finetuner-workflow/finetuner/evaluator.py``
+(#4 in SURVEY.md §2.1): prompts from a file or the CLI, the same sampling
+knobs as the finetuner's in-training sampler, device auto-selection (the
+reference picks CUDA/MPS/CPU, ``evaluator.py:11-15``; here jax picks
+TPU/CPU).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Optional, Sequence
+
+from kubernetes_cloud_tpu.utils.cli import DashParser, val
+
+
+def build_parser() -> DashParser:
+    parser = DashParser(description="TPU-native model evaluator")
+    parser.add_argument("--model", type=str, required=True,
+                        help="Model preset, checkpoint dir, or HF ID")
+    parser.add_argument("--prompt", type=str, action="append", default=None,
+                        help="Prompt text (repeatable)")
+    parser.add_argument("--prompt-file", type=str, default=None,
+                        help="File of prompts, one per line")
+    parser.add_argument("--prompt-tokens", type=val.non_negative(int),
+                        default=200, help="Tokens to sample per prompt")
+    parser.add_argument("--prompt-samples", type=val.positive(int),
+                        default=1, help="Samples per prompt")
+    parser.add_argument("--top-k", type=val.non_negative(int), default=50)
+    parser.add_argument("--top-p",
+                        type=val.at_most_1(val.non_negative(float)),
+                        default=0.95)
+    parser.add_argument("--temperature", type=val.positive(float),
+                        default=1.0)
+    parser.add_argument("--seed", type=val.at_most_32_bit(
+        val.non_negative(int)), default=42)
+    parser.add_argument("--cache", type=str, default="/tmp")
+    parser.add_argument("--log-level", type=str.upper, default="INFO")
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubernetes_cloud_tpu.models.generate import generate
+    from kubernetes_cloud_tpu.train.finetuner_cli import load_model
+    from kubernetes_cloud_tpu.train.trainer import read_prompts
+
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=args.log_level)
+    log = logging.getLogger("evaluator")
+
+    prompts = list(args.prompt or [])
+    if args.prompt_file:
+        prompts.extend(read_prompts(args.prompt_file))
+    if not prompts:
+        log.error("no prompts given (--prompt / --prompt-file)")
+        return 2
+
+    cfg, params = load_model(args.model, cache=args.cache)
+    if params is None:
+        from kubernetes_cloud_tpu.models.causal_lm import init_params
+
+        params = jax.jit(init_params, static_argnums=0)(
+            cfg, jax.random.key(args.seed))
+
+    try:
+        import transformers
+
+        tokenizer = transformers.AutoTokenizer.from_pretrained(
+            args.model, cache_dir=args.cache)
+    except Exception:
+        from kubernetes_cloud_tpu.serve.lm_service import ByteTokenizer
+
+        tokenizer = ByteTokenizer()
+
+    for prompt in prompts:
+        ids = jnp.asarray([tokenizer.encode(prompt)], jnp.int32)
+        ids = jnp.repeat(ids, args.prompt_samples, axis=0)
+        start = time.time()
+        out = generate(cfg, params, ids,
+                       max_new_tokens=args.prompt_tokens,
+                       temperature=args.temperature, top_k=args.top_k,
+                       top_p=args.top_p, rng=jax.random.key(args.seed))
+        jax.block_until_ready(out)
+        print("=============================")
+        print(f"PROMPT: {prompt}")
+        print(f"INFERENCE TIME: {time.time() - start:.2f}s")
+        for row in np.asarray(out):
+            text = tokenizer.decode([int(t) for t in row[ids.shape[1]:]])
+            print("-----------------------------")
+            print(f"RESPONSE: {text}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Metrics reporting: wandb when configured, JSONL file otherwise.
+
+wandb is the reference's metrics backbone (loss/lr/step + ``perf/*`` +
+generation tables, ``finetuner-workflow/finetuner/finetuner.py:523-533,
+615-629``); the metric names are kept byte-identical so dashboards and the
+driver's baseline comparisons carry over.  Without a WANDB_API_KEY the
+logger degrades to an append-only JSONL stream under the run's log dir —
+the operational artifact the reference lacks when wandb is unset.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Mapping, Optional, Sequence
+
+
+def _is_rank0() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+class MetricsLogger:
+    """Rank-0 metrics sink with the reference's wandb surface."""
+
+    def __init__(self, run_name: str, *, project: str = "huggingface",
+                 log_dir: str = "./logs", use_wandb: Optional[bool] = None,
+                 resume: bool = True):
+        self.run_name = run_name
+        self.enabled = _is_rank0()
+        self._wandb = None
+        self._fh = None
+        if not self.enabled:
+            return
+        if use_wandb is None:
+            use_wandb = bool(os.environ.get("WANDB_API_KEY"))
+        if use_wandb:
+            try:
+                import wandb
+
+                # Resume a crashed run of the same name, as the reference
+                # does by querying the API (``finetuner.py:362-393``);
+                # resume="allow" + deterministic id is the jax-side analogue.
+                self._wandb = wandb.init(
+                    project=project, name=run_name, id=run_name,
+                    resume="allow" if resume else "never")
+            except Exception:
+                self._wandb = None
+        if self._wandb is None:
+            os.makedirs(log_dir, exist_ok=True)
+            path = os.path.join(log_dir, f"{run_name}.metrics.jsonl")
+            self._fh = open(path, "a", buffering=1)
+
+    def log(self, metrics: Mapping[str, Any], step: Optional[int] = None,
+            commit: bool = True) -> None:
+        if not self.enabled:
+            return
+        if self._wandb is not None:
+            self._wandb.log(dict(metrics), step=step, commit=commit)
+            return
+        rec = {"ts": time.time(), "step": step, **{
+            k: (float(v) if hasattr(v, "__float__") else v)
+            for k, v in metrics.items()}}
+        self._fh.write(json.dumps(rec) + "\n")
+
+    def log_table(self, key: str, columns: Sequence[str],
+                  rows: Sequence[Sequence[Any]]) -> None:
+        """Generation-sample table (wandb.Table analogue)."""
+        if not self.enabled:
+            return
+        if self._wandb is not None:
+            import wandb
+
+            self._wandb.log({key: wandb.Table(data=list(rows),
+                                              columns=list(columns))},
+                            commit=False)
+            return
+        for row in rows:
+            self.log({"table": key, **dict(zip(columns, row))})
+
+    def close(self) -> None:
+        if self._wandb is not None:
+            self._wandb.finish()
+        if self._fh is not None:
+            self._fh.close()

@@ -112,6 +112,28 @@ def init_train_state(
     return jax.jit(init, out_shardings=shardings)()
 
 
+def train_state_from_params(
+    params: Any,
+    train_cfg: TrainConfig,
+    mesh,
+) -> TrainState:
+    """Build a sharded train state around existing (e.g. pretrained)
+    parameters without ever materializing a throwaway random init — the
+    Tensorizer/``no_init`` analogue (reference ``finetuner.py:801-830``)."""
+    from kubernetes_cloud_tpu.parallel.sharding import shard_params
+
+    optimizer = make_optimizer(train_cfg)
+    params = shard_params(params, mesh)
+
+    def init(p):
+        return {"params": p, "opt_state": optimizer.init(p),
+                "step": jax.numpy.zeros((), jax.numpy.int32)}
+
+    shapes = jax.eval_shape(init, params)
+    shardings = logical_to_physical(param_specs(shapes), mesh)
+    return jax.jit(init, out_shardings=shardings)(params)
+
+
 def make_train_step(
     model_cfg: CausalLMConfig,
     train_cfg: TrainConfig,
@@ -126,7 +148,11 @@ def make_train_step(
     XLA derives collectives from the argument shardings.
     """
     optimizer = make_optimizer(train_cfg)
-    if getattr(model_cfg, "attn_impl", None) == "ring" and mesh is None:
+    if (loss is loss_fn
+            and getattr(model_cfg, "attn_impl", None) == "ring"
+            and mesh is None):
+        # Custom losses manage their own mesh binding (e.g. Trainer passes
+        # a pre-bound partial); the guard protects the default path only.
         raise ValueError(
             "attn_impl='ring' (sequence parallelism) requires passing "
             "mesh= to make_train_step; without it the model would silently "

@@ -1,0 +1,319 @@
+"""Trainer with the reference finetuner's operational semantics, TPU-first.
+
+Replaces HF ``Trainer`` + DeepSpeed engine (reference
+``finetuner-workflow/finetuner/finetuner.py``) with a mesh-sharded jax
+loop.  Operational parity points, each cited to the reference behavior it
+mirrors:
+
+* checkpoint-N resume discovery (``finetuner.py:349-360,1049-1052``) —
+  newest step restored automatically unless ``resume=False``;
+* gradient accumulation with the DeepSpeed launcher's step semantics
+  (``--gradients``, GAS microsteps then one optimizer step);
+* ``perf/*`` metrics with byte-identical names and the same gas/opt
+  decomposition (``finetuner.py:509-533``): accumulation microsteps and
+  the optimizer step are separately-jitted programs, so their wall times
+  are the TPU analogues of ``on_substep_end``/``on_step_end``;
+* in-training prompt sampling every N steps reported as a generations
+  table (``ModelSampler``, ``finetuner.py:538-630``);
+* memory-based batch-size estimation (``estimate_batch_size``,
+  ``finetuner.py:447-466``) from device HBM stats;
+* final artifact layout ``results-<run>/final`` + ``.ready.txt`` sentinel
+  (``finetuner.py:1054-1062``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubernetes_cloud_tpu.core.memory import DeviceMemoryUsage
+from kubernetes_cloud_tpu.data.tokenized import sharded_batches
+from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig, loss_fn
+from kubernetes_cloud_tpu.models.generate import generate
+from kubernetes_cloud_tpu.train.metrics import MetricsLogger
+from kubernetes_cloud_tpu.train.train_step import (
+    TrainConfig,
+    init_train_state,
+    make_optimizer,
+)
+from kubernetes_cloud_tpu.weights.checkpoint import Checkpointer, mark_ready
+from kubernetes_cloud_tpu.weights.tensorstream import write_pytree
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """Run-level knobs, named after the reference's CLI flags."""
+
+    run_name: str
+    output_path: str = "./"
+    batch_size: int = 8          # global micro-batch (--bs)
+    gradients: int = 1           # accumulation steps (--gradients)
+    epochs: int = 1
+    save_steps: int = 500
+    resume: bool = True
+    shuffle: bool = True
+    seed: int = 42
+    logs: str = "./logs"
+    project_id: str = "huggingface"
+    # In-training sampling (--prompt-*)
+    prompt_file: Optional[str] = None
+    prompt_every: int = 0
+    prompt_tokens: int = 200
+    prompt_samples: int = 5
+    top_k: int = 50
+    top_p: float = 0.95
+    temperature: float = 1.0
+
+    @property
+    def run_dir(self) -> str:
+        return os.path.join(self.output_path, f"results-{self.run_name}")
+
+
+def estimate_batch_size(divisor: float = 1.0,
+                        device: Optional[jax.Device] = None) -> int:
+    """HBM-based batch autosizing (the reference's VRAM heuristic,
+    ``finetuner.py:447-466``): free bytes over bytes already used by the
+    materialized model/optimizer, scaled by ``divisor``."""
+    mem = DeviceMemoryUsage.now(device)
+    if mem.used and mem.limit and mem.used > 0:
+        free = mem.limit - mem.used
+        return max(1, math.ceil(free / (mem.used * divisor)))
+    return 1
+
+
+def read_prompts(path: str) -> list[str]:
+    with open(path) as fh:
+        return [line.rstrip("\n") for line in fh if line.strip()]
+
+
+class Trainer:
+    """Sharded training loop with resume, perf metrics and sampling."""
+
+    def __init__(
+        self,
+        model_cfg: CausalLMConfig,
+        train_cfg: TrainConfig,
+        trainer_cfg: TrainerConfig,
+        mesh,
+        dataset,
+        eval_dataset=None,
+        tokenizer=None,
+        loss: Callable = loss_fn,
+        initial_params=None,
+    ):
+        self.model_cfg = model_cfg
+        self.train_cfg = train_cfg
+        self.cfg = trainer_cfg
+        self.mesh = mesh
+        self.dataset = dataset
+        self.eval_dataset = eval_dataset
+        self.tokenizer = tokenizer
+
+        import functools
+        import inspect
+
+        accepts_mesh = "mesh" in inspect.signature(loss).parameters
+        if accepts_mesh and (model_cfg.attn_impl == "ring"
+                             or loss is not loss_fn):
+            loss = functools.partial(loss, mesh=mesh)
+        self._loss = loss
+        self._optimizer = make_optimizer(train_cfg)
+
+        # Separately-jitted accumulation / update programs so the perf/*
+        # gas-vs-opt decomposition survives (one fused step would hide it;
+        # when gradients == 1 we use the shared fused step and report
+        # opt_time = 0).
+        self._fused = trainer_cfg.gradients <= 1
+
+        def grad_micro(params, batch):
+            (l, metrics), grads = jax.value_and_grad(
+                self._loss, argnums=1, has_aux=True)(model_cfg, params,
+                                                     batch)
+            return grads, metrics
+
+        def accum(acc, grads):
+            return jax.tree.map(jnp.add, acc, grads)
+
+        def apply(state, grads, denom):
+            grads = jax.tree.map(lambda g: g / denom, grads)
+            grad_norm = optax.global_norm(grads)
+            updates, opt_state = self._optimizer.update(
+                grads, state["opt_state"], state["params"])
+            params = optax.apply_updates(state["params"], updates)
+            return {"params": params, "opt_state": opt_state,
+                    "step": state["step"] + 1}, grad_norm
+
+        self._grad_micro = jax.jit(grad_micro)
+        self._accum = jax.jit(accum, donate_argnums=0)
+        self._apply = jax.jit(apply, donate_argnums=(0, 1),
+                              static_argnums=2)
+        # gas == 1: the one shared step implementation (train_step.py).
+        from kubernetes_cloud_tpu.train.train_step import make_train_step
+
+        self._fused_step = jax.jit(
+            make_train_step(model_cfg, train_cfg, loss=self._loss),
+            donate_argnums=0)
+
+        if initial_params is not None:
+            from kubernetes_cloud_tpu.train.train_step import (
+                train_state_from_params,
+            )
+
+            self.state = train_state_from_params(initial_params, train_cfg,
+                                                 mesh)
+        else:
+            self.state = init_train_state(model_cfg, train_cfg,
+                                          jax.random.key(trainer_cfg.seed),
+                                          mesh)
+        ckpt_keep = 3
+        self.checkpointer = Checkpointer(self.cfg.run_dir,
+                                         max_to_keep=ckpt_keep)
+        self.metrics = MetricsLogger(
+            trainer_cfg.run_name, project=trainer_cfg.project_id,
+            log_dir=trainer_cfg.logs, resume=trainer_cfg.resume)
+
+    # -- checkpointing -----------------------------------------------------
+
+    def maybe_resume(self) -> int:
+        """Restore the newest ``checkpoint-N`` if present; returns step."""
+        if not self.cfg.resume:
+            return 0
+        latest = self.checkpointer.latest_step()
+        if latest is None:
+            return 0
+        self.state = self.checkpointer.restore(self.state, step=latest)
+        return int(latest)
+
+    def save_checkpoint(self, step: int, force: bool = False) -> None:
+        self.checkpointer.save(step, self.state, force=force)
+
+    def save_final(self) -> str:
+        """``results-<run>/final`` + tokenizer + ``.ready.txt``."""
+        final_dir = os.path.join(self.cfg.run_dir, "final")
+        os.makedirs(final_dir, exist_ok=True)
+        params_host = jax.device_get(self.state["params"])
+        write_pytree(os.path.join(final_dir, "model.tensors"), params_host,
+                     meta={"model_config": dataclasses.asdict(
+                         dataclasses.replace(self.model_cfg,
+                                             dtype=str(self.model_cfg.dtype),
+                                             param_dtype=str(
+                                                 self.model_cfg.param_dtype)))})
+        if self.tokenizer is not None and hasattr(self.tokenizer,
+                                                  "save_pretrained"):
+            self.tokenizer.save_pretrained(final_dir)
+        mark_ready(self.cfg.run_dir)
+        return final_dir
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_prompts(self, step: int, tokens_seen: int) -> None:
+        """ModelSampler parity: generate from the prompt file, print, and
+        log a generations table (``finetuner.py:574-630``)."""
+        if not (self.cfg.prompt_file and self.tokenizer):
+            return
+        rows = []
+        for prompt in read_prompts(self.cfg.prompt_file):
+            ids = jnp.asarray([self.tokenizer.encode(prompt)], jnp.int32)
+            ids = jnp.repeat(ids, max(1, self.cfg.prompt_samples), axis=0)
+            start = time.time()
+            out = generate(
+                self.model_cfg, self.state["params"], ids,
+                max_new_tokens=self.cfg.prompt_tokens,
+                temperature=self.cfg.temperature, top_k=self.cfg.top_k,
+                top_p=self.cfg.top_p, rng=jax.random.key(step))
+            jax.block_until_ready(out)
+            elapsed = time.time() - start
+            if jax.process_index() == 0:
+                print(f"\nSTEP {step}: PROMPT: {prompt}")
+                print(f"INFERENCE TIME: {elapsed:.2f}s")
+            for row in np.asarray(out):
+                text = self.tokenizer.decode(
+                    [int(t) for t in row[ids.shape[1]:]])
+                rows.append([self.cfg.run_name, step, tokens_seen, prompt,
+                             text])
+                if jax.process_index() == 0:
+                    print(f"RESPONSE: {text}")
+        self.metrics.log_table(
+            "Generations",
+            ["Run", "Step", "Contexts Trained", "Prompt", "Generated Text"],
+            rows)
+
+    # -- the loop ----------------------------------------------------------
+
+    def train(self) -> dict[str, Any]:
+        cfg = self.cfg
+        gas = max(1, cfg.gradients)
+        start_step = self.maybe_resume()
+        steps_per_epoch = max(
+            1, len(self.dataset) // (cfg.batch_size * gas))
+        total_steps = steps_per_epoch * cfg.epochs
+        world = jax.process_count()
+
+        batches = sharded_batches(
+            self.dataset, cfg.batch_size, self.mesh, shuffle=cfg.shuffle,
+            seed=cfg.seed, epochs=None,
+            skip_batches=start_step * gas)  # cheap resume fast-forward
+
+        step = start_step
+        last_metrics: dict[str, Any] = {}
+        while step < total_steps:
+            t0 = time.perf_counter()
+            if self._fused:
+                batch = next(batches)
+                self.state, metrics = self._fused_step(self.state, batch)
+                jax.block_until_ready(metrics["loss"])
+                t_gas = time.perf_counter() - t0
+                t_opt = 0.0
+            else:
+                grads = None
+                loss_acc = 0.0
+                for _ in range(gas):
+                    batch = next(batches)
+                    g, metrics = self._grad_micro(self.state["params"],
+                                                  batch)
+                    grads = g if grads is None else self._accum(grads, g)
+                    loss_acc += metrics["loss"]
+                jax.block_until_ready(loss_acc)
+                t_gas = time.perf_counter() - t0
+                self.state, grad_norm = self._apply(self.state, grads,
+                                                    float(gas))
+                jax.block_until_ready(self.state["step"])
+                t_opt = time.perf_counter() - t0 - t_gas
+                metrics = dict(metrics, loss=loss_acc / gas,
+                               grad_norm=grad_norm)
+            step += 1
+
+            step_time = t_gas + t_opt
+            rank_sps = cfg.batch_size * gas / world / step_time
+            tokens_seen = step * cfg.batch_size * gas
+            log = {
+                "train/loss": float(metrics["loss"]),
+                "train/epoch": step / steps_per_epoch,
+                "perf/opt_time": t_opt,
+                "perf/gas_time": t_gas,
+                "perf/total_time_per_step": step_time,
+                "perf/rank_samples_per_second": rank_sps,
+                "perf/world_samples_per_second": rank_sps * world,
+            }
+            self.metrics.log(log, step=step)
+            last_metrics = log
+
+            if cfg.save_steps and step % cfg.save_steps == 0:
+                self.save_checkpoint(step)
+            if cfg.prompt_every and step % cfg.prompt_every == 0:
+                self.sample_prompts(step, tokens_seen)
+
+        if self.checkpointer.latest_step() != step:
+            self.save_checkpoint(step, force=True)
+        self.checkpointer.wait()
+        final_dir = self.save_final()
+        self.metrics.close()
+        return {"steps": step, "final_dir": final_dir, **last_metrics}

@@ -30,8 +30,14 @@ def parse_bool(value: str) -> bool:
 
 
 class FuzzyBoolAction(argparse.Action):
-    """``--flag``, ``--flag true``, ``--flag=no`` all work
-    (reference ``utils.py:229-292``)."""
+    """``--flag``, ``--flag true``, ``--flag=no`` all work.
+
+    Matches the reference's inversion contract (``utils.py:229-292``):
+    "``True`` means the same as the flag being present" — a bare flag or a
+    truthy value sets ``not default``, a falsy value sets ``default``.
+    With ``default=True`` this gives ``store_false`` behavior, so
+    ``--no-resume`` (dest=resume, default=True) turns resume off and
+    ``--no-resume false`` keeps it on."""
 
     def __init__(self, option_strings, dest, nargs="?", default=False, **kwargs):
         kwargs.pop("type", None)
@@ -40,12 +46,13 @@ class FuzzyBoolAction(argparse.Action):
 
     def __call__(self, parser, namespace, values, option_string=None):
         if values is None:
-            result = True
+            truthy = True
         elif isinstance(values, bool):
-            result = values
+            truthy = values
         else:
-            result = parse_bool(values)
-        setattr(namespace, self.dest, result)
+            truthy = parse_bool(values)
+        setattr(namespace, self.dest,
+                (not self.default) if truthy else self.default)
 
 
 class DashParser(argparse.ArgumentParser):
@@ -74,18 +81,24 @@ class DashParser(argparse.ArgumentParser):
                                  default=default, help=help)
 
 
-def _positive(type_):
+def _positive(type_, special_val=None):
+    """> 0, with an optional escape value (e.g. -1 = autosize; reference
+    ``utils.py`` ``val.positive(int, special_val=-1)``)."""
     def check(value):
         v = type_(value)
+        if special_val is not None and v == special_val:
+            return v
         if v <= 0:
             raise argparse.ArgumentTypeError(f"must be > 0, got {v}")
         return v
     return check
 
 
-def _non_negative(type_):
+def _non_negative(type_, special_val=None):
     def check(value):
         v = type_(value)
+        if special_val is not None and v == special_val:
+            return v
         if v < 0:
             raise argparse.ArgumentTypeError(f"must be >= 0, got {v}")
         return v
@@ -125,3 +138,6 @@ validators = SimpleNamespace(
     extant_file=_extant_file,
     parse_bool=parse_bool,
 )
+
+#: Short alias matching the reference's ``import utils.validators as val``.
+val = validators

@@ -1,0 +1,89 @@
+"""Finetuner/evaluator CLI tests: reference flag parity + end-to-end run."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.train import evaluator_cli, finetuner_cli
+
+
+def test_reference_flags_parse(tmp_path):
+    ds = tmp_path / "d.tokens"
+    np.zeros((4, 8), np.uint16).tofile(str(ds))
+    argv = [
+        "--run-name", "r1", "--model", "test-tiny", "--dataset", str(ds),
+        # dash and underscore spellings both work (DashParser parity)
+        "--train_ratio", "0.8", "--warmup-ratio", "0.05",
+        "--bs", "-1", "--gradients", "4", "--zero-stage", "2",
+        "--no-resume", "--fp16", "true", "--no-shuffle", "false",
+        "--prompt-every", "10", "--top-k", "40", "--top-p", "0.9",
+        "--repetition-penalty", "1.2", "--local-rank", "0",
+        "--log-level", "debug",
+    ]
+    args = finetuner_cli.build_parser().parse_args(argv)
+    assert args.run_name == "r1"
+    assert args.train_ratio == 0.8
+    assert args.bs == -1
+    assert args.resume is False          # --no-resume flips dest
+    assert args.fp16 is True
+    assert args.shuffle is True          # --no-shuffle false => keep shuffle
+    assert args.zero_stage == 2
+    assert args.log_level == "DEBUG"
+
+
+def test_bad_flag_values_rejected(tmp_path):
+    ds = tmp_path / "d.tokens"
+    np.zeros((4, 8), np.uint16).tofile(str(ds))
+    base = ["--run-name", "r", "--model", "m", "--dataset", str(ds)]
+    parser = finetuner_cli.build_parser()
+    with pytest.raises(SystemExit):
+        parser.parse_args(base + ["--bs", "0"])
+    with pytest.raises(SystemExit):
+        parser.parse_args(base + ["--train-ratio", "1.5"])
+    with pytest.raises(SystemExit):
+        parser.parse_args(base + ["--dataset", "/does/not/exist"])
+
+
+def test_mine_ds_config(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps({
+        "optimizer": {"type": "AdamW", "params": {
+            "lr": 1e-4, "betas": [0.9, 0.95], "eps": 1e-6,
+            "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 2},
+    }))
+    mined = finetuner_cli._mine_ds_config(str(path))
+    assert mined == {"lr": 1e-4, "beta1": 0.9, "beta2": 0.95, "eps": 1e-6,
+                     "weight_decay": 0.1, "zero_stage": 2}
+    assert finetuner_cli._mine_ds_config("") == {}
+
+
+def test_finetuner_main_end_to_end(tmp_path):
+    rng = np.random.RandomState(1)
+    ds = tmp_path / "d.tokens"
+    rng.randint(2, 400, size=(64, 32)).astype(np.uint16).tofile(str(ds))
+    rc = finetuner_cli.main([
+        "--run-name", "cli-e2e", "--model", "test-tiny",
+        "--dataset", str(ds), "--context-size", "32",
+        "--mesh", "data=8", "--bs", "8", "--gradients", "1",
+        "--epochs", "1", "--save-steps", "0",
+        "--output-path", str(tmp_path), "--logs", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    run_dir = tmp_path / "results-cli-e2e"
+    assert (run_dir / "final" / "model.tensors").exists()
+    assert (run_dir / ".ready.txt").exists()
+
+
+def test_evaluator_main(tmp_path, capsys):
+    prompts = tmp_path / "p.txt"
+    prompts.write_text("hi\n")
+    rc = evaluator_cli.main([
+        "--model", "test-tiny", "--prompt-file", str(prompts),
+        "--prompt-tokens", "4", "--prompt-samples", "1",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "PROMPT: hi" in out and "RESPONSE:" in out
