@@ -1,0 +1,169 @@
+"""Image+caption datasets for diffusion finetuning.
+
+Behavioral parity with the reference's dataset module
+(``sd-finetuner-workflow/sd-finetuner/datasets.py``):
+
+* :class:`LocalBase` — pairs ``img.png``/``img.jpg`` with ``img.txt`` by
+  file stem (``datasets.py:145-233``), center-crop-resizes to the training
+  resolution, normalizes to [-1, 1], and applies unconditional-guidance
+  caption dropout with probability ``ucg`` (``datasets.py:181-183``).
+* :class:`DreamBoothDataset` — instance/class directory pairs for
+  prior-preservation training (``datasets.py:51-142``); generating missing
+  class images is the trainer's job (``:94-101``), the dataset only
+  reports ``missing_class_images``.
+* :class:`PromptDataset` — prompts for class-image generation
+  (``datasets.py:236-250``).
+
+Arrays are NHWC float32 — the TPU conv layout — not torchvision CHW.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Optional
+
+import numpy as np
+
+_IMG_EXTS = (".png", ".jpg", ".jpeg", ".webp", ".bmp")
+
+
+def load_image(path: str, size: int) -> np.ndarray:
+    """Load → center-crop → resize → [-1, 1] float32 NHWC (single image)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        crop = min(w, h)
+        left, top = (w - crop) // 2, (h - crop) // 2
+        im = im.crop((left, top, left + crop, top + crop))
+        im = im.resize((size, size), Image.BICUBIC)
+        arr = np.asarray(im, dtype=np.float32)
+    return arr / 127.5 - 1.0
+
+
+class LocalBase:
+    """File-stem-paired image/caption dataset with ucg dropout."""
+
+    def __init__(self, data_root: str, size: int = 512, ucg: float = 0.1,
+                 seed: Optional[int] = None):
+        self.size = size
+        self.ucg = ucg
+        self._rng = random.Random(seed)
+        self.examples: list[tuple[str, str]] = []
+        for name in sorted(os.listdir(data_root)):
+            stem, ext = os.path.splitext(name)
+            if ext.lower() not in _IMG_EXTS:
+                continue
+            txt = os.path.join(data_root, stem + ".txt")
+            caption = ""
+            if os.path.exists(txt):
+                with open(txt) as fh:
+                    caption = fh.read().strip()
+            self.examples.append((os.path.join(data_root, name), caption))
+        if not self.examples:
+            raise ValueError(f"no images under {data_root}")
+
+    def __len__(self) -> int:
+        return len(self.examples)
+
+    def __getitem__(self, idx: int) -> dict:
+        path, caption = self.examples[idx]
+        if self.ucg and self._rng.random() < self.ucg:
+            caption = ""  # unconditional-guidance dropout
+        return {"image": load_image(path, self.size), "caption": caption}
+
+
+class DreamBoothDataset:
+    """Instance/class pairs for prior-preservation finetuning.
+
+    ``__getitem__`` returns both an instance and a (cycled) class example;
+    the collate function stacks them [instance..., class...] so the trainer
+    can chunk the loss (``sd-finetuner/finetuner.py:513-525``).
+    """
+
+    def __init__(self, instance_data_root: str, instance_prompt: str,
+                 class_data_root: Optional[str] = None,
+                 class_prompt: Optional[str] = None, size: int = 512,
+                 num_class_images: int = 0):
+        self.size = size
+        self.instance_prompt = instance_prompt
+        self.class_prompt = class_prompt
+        self.instance_images = [
+            os.path.join(instance_data_root, n)
+            for n in sorted(os.listdir(instance_data_root))
+            if os.path.splitext(n)[1].lower() in _IMG_EXTS]
+        if not self.instance_images:
+            raise ValueError(f"no images under {instance_data_root}")
+        self.class_images: list[str] = []
+        self.num_class_images = num_class_images
+        if class_data_root:
+            os.makedirs(class_data_root, exist_ok=True)
+            self.class_data_root = class_data_root
+            self.class_images = [
+                os.path.join(class_data_root, n)
+                for n in sorted(os.listdir(class_data_root))
+                if os.path.splitext(n)[1].lower() in _IMG_EXTS]
+        else:
+            self.class_data_root = None
+
+    @property
+    def missing_class_images(self) -> int:
+        """How many class images the trainer must generate first
+        (reference auto-generates them, ``datasets.py:94-101``)."""
+        if self.class_data_root is None:
+            return 0
+        return max(0, self.num_class_images - len(self.class_images))
+
+    @property
+    def with_prior(self) -> bool:
+        return bool(self.class_data_root and self.class_images)
+
+    def __len__(self) -> int:
+        return max(len(self.instance_images),
+                   len(self.class_images) or 1)
+
+    def __getitem__(self, idx: int) -> dict:
+        out = {
+            "instance_image": load_image(
+                self.instance_images[idx % len(self.instance_images)],
+                self.size),
+            "instance_caption": self.instance_prompt,
+        }
+        if self.with_prior:
+            out["class_image"] = load_image(
+                self.class_images[idx % len(self.class_images)], self.size)
+            out["class_caption"] = self.class_prompt or ""
+        return out
+
+
+class PromptDataset:
+    """N copies of one prompt (for class-image generation jobs)."""
+
+    def __init__(self, prompt: str, num_samples: int):
+        self.prompt = prompt
+        self.num_samples = num_samples
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        return {"prompt": self.prompt, "index": idx}
+
+
+def collate_images(rows: list[dict]) -> dict:
+    """LocalBase batch → {"images" [B,H,W,3], "captions" list[str]}."""
+    return {"images": np.stack([r["image"] for r in rows]),
+            "captions": [r["caption"] for r in rows]}
+
+
+def collate_dreambooth(rows: list[dict]) -> dict:
+    """[instance..., class...] stacking so the prior-loss chunk split is a
+    fixed midpoint (reference collate + chunked loss)."""
+    images = [r["instance_image"] for r in rows]
+    captions = [r["instance_caption"] for r in rows]
+    if "class_image" in rows[0]:
+        images += [r["class_image"] for r in rows]
+        captions += [r["class_caption"] for r in rows]
+    return {"images": np.stack(images), "captions": captions}

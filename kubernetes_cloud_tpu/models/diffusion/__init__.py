@@ -1,0 +1,34 @@
+"""Stable-Diffusion-class latent diffusion models, TPU-first.
+
+Pure-pytree re-implementations of the three modules the reference
+finetunes and serves (CLIP text encoder / VAE / UNet —
+``sd-finetuner-workflow/sd-finetuner/finetuner.py:648-659``,
+``online-inference/stable-diffusion/``), plus the DDPM/DDIM noise
+schedule.  NHWC layout throughout (TPU conv-native), GroupNorm statistics
+in fp32, bulk compute in bfloat16.
+"""
+
+from kubernetes_cloud_tpu.models.diffusion.schedule import (  # noqa: F401
+    NoiseSchedule,
+    add_noise,
+    ddim_step,
+    make_schedule,
+    timestep_embedding,
+    velocity_target,
+)
+from kubernetes_cloud_tpu.models.diffusion.clip_text import (  # noqa: F401
+    CLIPTextConfig,
+    clip_encode,
+    clip_init,
+)
+from kubernetes_cloud_tpu.models.diffusion.vae import (  # noqa: F401
+    VAEConfig,
+    vae_decode,
+    vae_encode,
+    vae_init,
+)
+from kubernetes_cloud_tpu.models.diffusion.unet import (  # noqa: F401
+    UNetConfig,
+    unet_apply,
+    unet_init,
+)

@@ -1,0 +1,106 @@
+"""CLIP text encoder (the SD conditioning tower), pure-pytree.
+
+The reference loads ``CLIPTextModel`` from transformers and freezes it
+(``sd-finetuner-workflow/sd-finetuner/finetuner.py:648-663``); serving
+deserializes it as the ``encoder`` module (``online-inference/
+stable-diffusion/serializer/serialize.py:13-50``).  Architecture: causal
+transformer encoder with quick-GELU, learned positions, final LayerNorm;
+SD-1.x uses the ViT-L/14 text tower (hidden 768, 12 layers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_cloud_tpu.ops.attention import attention
+from kubernetes_cloud_tpu.ops.layers import layer_norm
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_length: int = 77
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return 4 * self.hidden_size
+
+
+def clip_init(cfg: CLIPTextConfig, rng: jax.Array) -> Params:
+    keys = jax.random.split(rng, 6)
+    d, l, f = cfg.hidden_size, cfg.num_layers, cfg.ffn_size
+
+    def normal(key, shape, s=0.02):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(
+            cfg.param_dtype)
+
+    def ln(prefix=()):
+        return {"scale": jnp.ones((*prefix, d), cfg.param_dtype),
+                "bias": jnp.zeros((*prefix, d), cfg.param_dtype)}
+
+    return {
+        "wte": normal(keys[0], (cfg.vocab_size, d)),
+        "wpe": normal(keys[1], (cfg.max_length, d)),
+        "blocks": {
+            "ln1": ln((l,)),
+            "ln2": ln((l,)),
+            "wqkv": normal(keys[2], (l, d, 3 * d)),
+            "bqkv": jnp.zeros((l, 3 * d), cfg.param_dtype),
+            "wo": normal(keys[3], (l, d, d)),
+            "bo": jnp.zeros((l, d), cfg.param_dtype),
+            "wi": normal(keys[4], (l, d, f)),
+            "bi": jnp.zeros((l, f), cfg.param_dtype),
+            "wout": normal(keys[5], (l, f, d)),
+            "bout": jnp.zeros((l, d), cfg.param_dtype),
+        },
+        "final_ln": ln(),
+    }
+
+
+def _quick_gelu(x: jax.Array) -> jax.Array:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def clip_encode(cfg: CLIPTextConfig, params: Params,
+                input_ids: jax.Array) -> jax.Array:
+    """Token ids [B, S] → last hidden states [B, S, D] (post final LN) —
+    the conditioning tensor SD's UNet cross-attends to."""
+    b, s = input_ids.shape
+    x = (params["wte"][input_ids]
+         + params["wpe"][:s][None]).astype(cfg.dtype)
+    h, dh = cfg.num_heads, cfg.head_dim
+
+    def body(carry, p):
+        x = carry
+        y = layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+        qkv = jnp.einsum("bsd,de->bse", y, p["wqkv"].astype(cfg.dtype))
+        qkv = qkv + p["bqkv"].astype(cfg.dtype)
+        q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, dh), 3, axis=2)
+        a = attention(q, k, v, causal=True, impl="xla")
+        a = a.reshape(b, s, -1)
+        a = jnp.einsum("bsd,de->bse", a, p["wo"].astype(cfg.dtype))
+        x = x + a + p["bo"].astype(cfg.dtype)
+        y = layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+        y = jnp.einsum("bsd,df->bsf", y, p["wi"].astype(cfg.dtype))
+        y = _quick_gelu(y + p["bi"].astype(cfg.dtype))
+        y = jnp.einsum("bsf,fd->bsd", y, p["wout"].astype(cfg.dtype))
+        return x + y + p["bout"].astype(cfg.dtype), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    return layer_norm(x, params["final_ln"]["scale"],
+                      params["final_ln"]["bias"])

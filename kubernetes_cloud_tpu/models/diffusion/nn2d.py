@@ -1,0 +1,146 @@
+"""2D building blocks shared by the VAE and UNet, NHWC / TPU-native.
+
+NHWC is the layout XLA's TPU conv emitter prefers (channels on the minor,
+lane-mapped dimension); GroupNorm statistics run in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+def conv_init(rng: jax.Array, kh: int, kw: int, cin: int, cout: int,
+              param_dtype=jnp.float32) -> Params:
+    fan_in = kh * kw * cin
+    scale = (1.0 / fan_in) ** 0.5
+    w = jax.random.uniform(rng, (kh, kw, cin, cout), jnp.float32,
+                           -scale, scale)
+    return {"kernel": w.astype(param_dtype),
+            "bias": jnp.zeros((cout,), param_dtype)}
+
+
+def conv2d(p: Params, x: jax.Array, *, stride: int = 1,
+           padding="SAME", dtype=None) -> jax.Array:
+    dtype = dtype or x.dtype
+    y = jax.lax.conv_general_dilated(
+        x.astype(dtype), p["kernel"].astype(dtype),
+        window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y + p["bias"].astype(dtype)
+
+
+def group_norm_init(ch: int, param_dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((ch,), param_dtype),
+            "bias": jnp.zeros((ch,), param_dtype)}
+
+
+def group_norm(p: Params, x: jax.Array, groups: int = 32,
+               eps: float = 1e-6) -> jax.Array:
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    x32 = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = x32.mean(axis=(1, 2, 4), keepdims=True)
+    var = jnp.square(x32 - mean).mean(axis=(1, 2, 4), keepdims=True)
+    y = ((x32 - mean) * jax.lax.rsqrt(var + eps)).reshape(b, h, w, c)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def linear_init(rng: jax.Array, din: int, dout: int,
+                param_dtype=jnp.float32, scale: Optional[float] = None,
+                bias: bool = True) -> Params:
+    if scale is None:
+        scale = (1.0 / din) ** 0.5
+    w = jax.random.uniform(rng, (din, dout), jnp.float32, -scale, scale)
+    p = {"w": w.astype(param_dtype)}
+    if bias:
+        p["b"] = jnp.zeros((dout,), param_dtype)
+    return p
+
+
+def linear(p: Params, x: jax.Array, dtype=None) -> jax.Array:
+    dtype = dtype or x.dtype
+    y = x.astype(dtype) @ p["w"].astype(dtype)
+    if "b" in p:
+        y = y + p["b"].astype(dtype)
+    return y
+
+
+def resnet_block_init(rng: jax.Array, cin: int, cout: int,
+                      temb_dim: Optional[int] = None,
+                      param_dtype=jnp.float32) -> Params:
+    k = jax.random.split(rng, 4)
+    p: Params = {
+        "norm1": group_norm_init(cin, param_dtype),
+        "conv1": conv_init(k[0], 3, 3, cin, cout, param_dtype),
+        "norm2": group_norm_init(cout, param_dtype),
+        "conv2": conv_init(k[1], 3, 3, cout, cout, param_dtype),
+    }
+    if temb_dim is not None:
+        p["temb"] = linear_init(k[2], temb_dim, cout, param_dtype)
+    if cin != cout:
+        p["shortcut"] = conv_init(k[3], 1, 1, cin, cout, param_dtype)
+    return p
+
+
+def resnet_block(p: Params, x: jax.Array,
+                 temb: Optional[jax.Array] = None,
+                 groups: int = 32) -> jax.Array:
+    h = jax.nn.silu(group_norm(p["norm1"], x, groups))
+    h = conv2d(p["conv1"], h)
+    if temb is not None and "temb" in p:
+        h = h + linear(p["temb"], jax.nn.silu(temb),
+                       dtype=h.dtype)[:, None, None, :]
+    h = jax.nn.silu(group_norm(p["norm2"], h, groups))
+    h = conv2d(p["conv2"], h)
+    if "shortcut" in p:
+        x = conv2d(p["shortcut"], x)
+    return x + h
+
+
+def self_attention_2d_init(rng: jax.Array, ch: int,
+                           param_dtype=jnp.float32) -> Params:
+    k = jax.random.split(rng, 5)
+    return {
+        "norm": group_norm_init(ch, param_dtype),
+        "q": linear_init(k[0], ch, ch, param_dtype),
+        "k": linear_init(k[1], ch, ch, param_dtype),
+        "v": linear_init(k[2], ch, ch, param_dtype),
+        "out": linear_init(k[3], ch, ch, param_dtype),
+    }
+
+
+def self_attention_2d(p: Params, x: jax.Array,
+                      groups: int = 32) -> jax.Array:
+    """Single-head self-attention over spatial positions (VAE mid block)."""
+    b, h, w, c = x.shape
+    y = group_norm(p["norm"], x, groups).reshape(b, h * w, c)
+    q, k, v = linear(p["q"], y), linear(p["k"], y), linear(p["v"], y)
+    logits = jnp.einsum("bqc,bkc->bqk", q, k).astype(jnp.float32)
+    probs = jax.nn.softmax(logits * (c ** -0.5), axis=-1).astype(y.dtype)
+    o = jnp.einsum("bqk,bkc->bqc", probs, v)
+    return x + linear(p["out"], o).reshape(b, h, w, c)
+
+
+def downsample_init(rng: jax.Array, ch: int, param_dtype=jnp.float32):
+    return {"conv": conv_init(rng, 3, 3, ch, ch, param_dtype)}
+
+
+def downsample(p: Params, x: jax.Array) -> jax.Array:
+    # SD uses asymmetric (0,1) padding for stride-2 downsampling convs.
+    x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+    return conv2d(p["conv"], x, stride=2, padding="VALID")
+
+
+def upsample_init(rng: jax.Array, ch: int, param_dtype=jnp.float32):
+    return {"conv": conv_init(rng, 3, 3, ch, ch, param_dtype)}
+
+
+def upsample(p: Params, x: jax.Array) -> jax.Array:
+    b, h, w, c = x.shape
+    x = jax.image.resize(x, (b, 2 * h, 2 * w, c), method="nearest")
+    return conv2d(p["conv"], x)

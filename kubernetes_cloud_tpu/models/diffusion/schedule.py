@@ -1,0 +1,109 @@
+"""DDPM/DDIM noise schedules for latent diffusion.
+
+Replaces the diffusers ``DDPMScheduler``/``DDIMScheduler`` objects the
+reference trains and serves with (``sd-finetuner/finetuner.py:467-530``
+``noise_scheduler.add_noise`` + v-prediction at ``:502-511``;
+``online-inference/stable-diffusion/service/service.py`` sampling loop)
+as plain arrays + pure functions, jit/scan-friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class NoiseSchedule:
+    num_train_timesteps: int = 1000
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+    schedule: str = "scaled_linear"  # SD's default; or "linear"
+
+    def __post_init__(self):
+        if self.schedule not in ("scaled_linear", "linear"):
+            raise ValueError(f"unknown beta schedule: {self.schedule!r}")
+
+
+def make_schedule(cfg: NoiseSchedule = NoiseSchedule()) -> dict[str, jax.Array]:
+    """Precompute betas / cumulative alphas (fp32)."""
+    if cfg.schedule == "scaled_linear":
+        betas = jnp.linspace(cfg.beta_start ** 0.5, cfg.beta_end ** 0.5,
+                             cfg.num_train_timesteps,
+                             dtype=jnp.float32) ** 2
+    else:
+        betas = jnp.linspace(cfg.beta_start, cfg.beta_end,
+                             cfg.num_train_timesteps, dtype=jnp.float32)
+    alphas_cumprod = jnp.cumprod(1.0 - betas)
+    return {"betas": betas, "alphas_cumprod": alphas_cumprod}
+
+
+def _gather(acp: jax.Array, t: jax.Array, ndim: int) -> tuple[jax.Array,
+                                                              jax.Array]:
+    """sqrt(acp[t]), sqrt(1-acp[t]) broadcast to rank ``ndim``."""
+    a = acp[t]
+    shape = (-1,) + (1,) * (ndim - 1)
+    return (jnp.sqrt(a).reshape(shape), jnp.sqrt(1.0 - a).reshape(shape))
+
+
+def add_noise(sched: dict, x0: jax.Array, noise: jax.Array,
+              t: jax.Array) -> jax.Array:
+    """Forward process q(x_t | x_0)."""
+    sa, sna = _gather(sched["alphas_cumprod"], t, x0.ndim)
+    return (sa * x0.astype(jnp.float32)
+            + sna * noise.astype(jnp.float32)).astype(x0.dtype)
+
+
+def velocity_target(sched: dict, x0: jax.Array, noise: jax.Array,
+                    t: jax.Array) -> jax.Array:
+    """v-prediction target (``get_velocity``; reference v-pred support at
+    ``sd-finetuner/finetuner.py:502-511``)."""
+    sa, sna = _gather(sched["alphas_cumprod"], t, x0.ndim)
+    return (sa * noise.astype(jnp.float32)
+            - sna * x0.astype(jnp.float32)).astype(x0.dtype)
+
+
+def pred_x0(sched: dict, model_out: jax.Array, sample: jax.Array,
+            t: jax.Array, prediction_type: str = "epsilon") -> jax.Array:
+    """Recover x0 from the model output under either parameterization."""
+    sa, sna = _gather(sched["alphas_cumprod"], t, sample.ndim)
+    sample = sample.astype(jnp.float32)
+    model_out = model_out.astype(jnp.float32)
+    if prediction_type == "epsilon":
+        return (sample - sna * model_out) / sa
+    if prediction_type == "v_prediction":
+        return sa * sample - sna * model_out
+    raise ValueError(f"unknown prediction_type: {prediction_type!r}")
+
+
+def ddim_step(sched: dict, model_out: jax.Array, sample: jax.Array,
+              t: jax.Array, t_prev: jax.Array,
+              prediction_type: str = "epsilon") -> jax.Array:
+    """One deterministic DDIM update x_t → x_{t_prev} (eta = 0).
+
+    ``t_prev < 0`` means "final step" (alpha_prev = 1).
+    """
+    x0 = pred_x0(sched, model_out, sample, t, prediction_type)
+    acp = sched["alphas_cumprod"]
+    a_prev = jnp.where(t_prev >= 0, acp[jnp.maximum(t_prev, 0)], 1.0)
+    shape = (-1,) + (1,) * (sample.ndim - 1)
+    sa_prev = jnp.sqrt(a_prev).reshape(shape)
+    sna_prev = jnp.sqrt(1.0 - a_prev).reshape(shape)
+    sa, sna = _gather(acp, t, sample.ndim)
+    eps = (sample.astype(jnp.float32) - sa * x0) / sna
+    return (sa_prev * x0 + sna_prev * eps).astype(sample.dtype)
+
+
+def timestep_embedding(t: jax.Array, dim: int,
+                       max_period: float = 10000.0) -> jax.Array:
+    """Sinusoidal timestep embedding [B] → [B, dim] (fp32)."""
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb

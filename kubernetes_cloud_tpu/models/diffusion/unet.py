@@ -1,0 +1,252 @@
+"""UNet2DCondition (the SD denoiser), pure-pytree, NHWC.
+
+The module the reference actually trains (VAE and CLIP are frozen,
+``sd-finetuner/finetuner.py:661-663``): a conditional UNet with timestep
+embeddings, cross-attention to the CLIP text states in every spatial
+transformer, skip connections between down and up paths.  SD-1.x
+topology: block channels (320, 640, 1280, 1280), 2 resnets per block,
+one transformer layer per attention block, 8 heads, cross-attn dim 768.
+
+Config-driven so tests run a tiny instance; attention uses the shared
+:mod:`ops.attention` (pallas-eligible on TPU for fused shapes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_cloud_tpu.models.diffusion.nn2d import (
+    conv2d,
+    conv_init,
+    downsample,
+    downsample_init,
+    group_norm,
+    group_norm_init,
+    linear,
+    linear_init,
+    resnet_block,
+    resnet_block_init,
+    upsample,
+    upsample_init,
+)
+from kubernetes_cloud_tpu.models.diffusion.schedule import timestep_embedding
+from kubernetes_cloud_tpu.ops.attention import attention
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: tuple = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    cross_attn_dim: int = 768
+    num_heads: int = 8
+    norm_groups: int = 32
+    # blocks with a spatial transformer (SD: all but the last down block /
+    # first up block)
+    attn_blocks: Optional[tuple] = None  # None => all but innermost
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def temb_dim(self) -> int:
+        return 4 * self.block_out_channels[0]
+
+    def has_attn(self, i: int) -> bool:
+        if self.attn_blocks is not None:
+            return i in self.attn_blocks
+        return i < len(self.block_out_channels) - 1
+
+
+def _xattn_init(rng: jax.Array, ch: int, ctx: int, heads: int) -> Params:
+    """One BasicTransformerBlock: self-attn, cross-attn, geglu FF."""
+    k = iter(jax.random.split(rng, 16))
+    inner = ch
+
+    def attn(kdim):
+        return {
+            "q": linear_init(next(k), ch, inner, bias=False),
+            "k": linear_init(next(k), kdim, inner, bias=False),
+            "v": linear_init(next(k), kdim, inner, bias=False),
+            "out": linear_init(next(k), inner, ch),
+        }
+
+    def ln():
+        return {"scale": jnp.ones((ch,), jnp.float32),
+                "bias": jnp.zeros((ch,), jnp.float32)}
+
+    return {
+        "norm1": ln(), "attn1": attn(ch),
+        "norm2": ln(), "attn2": attn(ctx),
+        "norm3": ln(),
+        "ff1": linear_init(next(k), ch, 8 * ch),   # geglu: 2 * 4ch
+        "ff2": linear_init(next(k), 4 * ch, ch),
+    }
+
+
+def _spatial_transformer_init(rng: jax.Array, ch: int, ctx: int,
+                              heads: int) -> Params:
+    k = iter(jax.random.split(rng, 4))
+    return {
+        "norm": group_norm_init(ch),
+        "proj_in": linear_init(next(k), ch, ch),
+        "block": _xattn_init(next(k), ch, ctx, heads),
+        "proj_out": linear_init(next(k), ch, ch),
+    }
+
+
+def _mh_attn(p: Params, x: jax.Array, ctx: jax.Array,
+             heads: int) -> jax.Array:
+    b, s, c = x.shape
+    dh = c // heads
+    q = linear(p["q"], x).reshape(b, s, heads, dh)
+    k = linear(p["k"], ctx).reshape(b, ctx.shape[1], heads, dh)
+    v = linear(p["v"], ctx).reshape(b, ctx.shape[1], heads, dh)
+    o = attention(q, k, v, causal=False, impl="xla")
+    return linear(p["out"], o.reshape(b, s, c))
+
+
+def _layer_norm(p: Params, x: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = jnp.square(x32 - mean).mean(-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _spatial_transformer(p: Params, x: jax.Array, ctx: jax.Array,
+                         heads: int, groups: int) -> jax.Array:
+    b, h, w, c = x.shape
+    y = group_norm(p["norm"], x, groups).reshape(b, h * w, c)
+    y = linear(p["proj_in"], y)
+    blk = p["block"]
+    y1 = _layer_norm(blk["norm1"], y)
+    y = y + _mh_attn(blk["attn1"], y1, y1, heads)
+    y = y + _mh_attn(blk["attn2"], _layer_norm(blk["norm2"], y), ctx,
+                     heads)
+    z = linear(blk["ff1"], _layer_norm(blk["norm3"], y))
+    z1, z2 = jnp.split(z, 2, axis=-1)
+    y = y + linear(blk["ff2"], z1 * jax.nn.gelu(z2))
+    y = linear(p["proj_out"], y)
+    return x + y.reshape(b, h, w, c)
+
+
+def unet_init(cfg: UNetConfig, rng: jax.Array) -> Params:
+    keys = iter(jax.random.split(rng, 256))
+    chans = cfg.block_out_channels
+    ch0 = chans[0]
+    temb = cfg.temb_dim
+
+    p: Params = {
+        "time_mlp1": linear_init(next(keys), ch0, temb),
+        "time_mlp2": linear_init(next(keys), temb, temb),
+        "conv_in": conv_init(next(keys), 3, 3, cfg.in_channels, ch0),
+    }
+
+    down = []
+    cin = ch0
+    for i, cout in enumerate(chans):
+        blk: Params = {"resnets": [], "attns": []}
+        for _ in range(cfg.layers_per_block):
+            blk["resnets"].append(
+                resnet_block_init(next(keys), cin, cout, temb))
+            cin = cout
+            if cfg.has_attn(i):
+                blk["attns"].append(_spatial_transformer_init(
+                    next(keys), cout, cfg.cross_attn_dim, cfg.num_heads))
+        if i < len(chans) - 1:
+            blk["down"] = downsample_init(next(keys), cout)
+        down.append(blk)
+    p["down"] = down
+
+    chN = chans[-1]
+    p["mid"] = {
+        "res1": resnet_block_init(next(keys), chN, chN, temb),
+        "attn": _spatial_transformer_init(next(keys), chN,
+                                          cfg.cross_attn_dim,
+                                          cfg.num_heads),
+        "res2": resnet_block_init(next(keys), chN, chN, temb),
+    }
+
+    # Up path: skip channels come off the down-path stack in reverse.
+    skip_chans = [ch0]
+    cin_d = ch0
+    for i, cout in enumerate(chans):
+        for _ in range(cfg.layers_per_block):
+            skip_chans.append(cout)
+            cin_d = cout
+        if i < len(chans) - 1:
+            skip_chans.append(cout)
+
+    up = []
+    cin = chN
+    rev = list(reversed(chans))
+    for i, cout in enumerate(rev):
+        blk = {"resnets": [], "attns": []}
+        attn_i = len(chans) - 1 - i
+        for _ in range(cfg.layers_per_block + 1):
+            skip = skip_chans.pop()
+            blk["resnets"].append(
+                resnet_block_init(next(keys), cin + skip, cout, temb))
+            cin = cout
+            if cfg.has_attn(attn_i):
+                blk["attns"].append(_spatial_transformer_init(
+                    next(keys), cout, cfg.cross_attn_dim, cfg.num_heads))
+        if i < len(chans) - 1:
+            blk["up"] = upsample_init(next(keys), cout)
+        up.append(blk)
+    p["up"] = up
+
+    p["norm_out"] = group_norm_init(ch0)
+    p["conv_out"] = conv_init(next(keys), 3, 3, ch0, cfg.out_channels)
+    return p
+
+
+def unet_apply(cfg: UNetConfig, params: Params, x: jax.Array,
+               t: jax.Array, ctx: jax.Array) -> jax.Array:
+    """(latents [B,h,w,C], timesteps [B], text states [B,S,ctx_dim]) →
+    predicted noise/velocity [B,h,w,C]."""
+    g = cfg.norm_groups
+    heads = cfg.num_heads
+    x = x.astype(cfg.dtype)
+    ctx = ctx.astype(cfg.dtype)
+
+    temb = timestep_embedding(t, cfg.block_out_channels[0])
+    temb = linear(params["time_mlp2"],
+                  jax.nn.silu(linear(params["time_mlp1"],
+                                     temb.astype(cfg.dtype))))
+
+    h = conv2d(params["conv_in"], x)
+    skips = [h]
+    for i, blk in enumerate(params["down"]):
+        attns = blk.get("attns") or []  # empty lists vanish in serialization
+        for j, r in enumerate(blk["resnets"]):
+            h = resnet_block(r, h, temb, groups=g)
+            if attns:
+                h = _spatial_transformer(attns[j], h, ctx, heads, g)
+            skips.append(h)
+        if "down" in blk:
+            h = downsample(blk["down"], h)
+            skips.append(h)
+
+    h = resnet_block(params["mid"]["res1"], h, temb, groups=g)
+    h = _spatial_transformer(params["mid"]["attn"], h, ctx, heads, g)
+    h = resnet_block(params["mid"]["res2"], h, temb, groups=g)
+
+    for i, blk in enumerate(params["up"]):
+        attns = blk.get("attns") or []
+        for j, r in enumerate(blk["resnets"]):
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = resnet_block(r, h, temb, groups=g)
+            if attns:
+                h = _spatial_transformer(attns[j], h, ctx, heads, g)
+        if "up" in blk:
+            h = upsample(blk["up"], h)
+
+    h = jax.nn.silu(group_norm(params["norm_out"], h, g))
+    return conv2d(params["conv_out"], h).astype(jnp.float32)

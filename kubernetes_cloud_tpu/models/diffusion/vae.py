@@ -1,0 +1,142 @@
+"""AutoencoderKL (the SD VAE), pure-pytree, NHWC.
+
+The reference freezes the VAE and uses only ``encode`` during finetuning
+(``sd-finetuner/finetuner.py:484-500`` latents = vae.encode(x).sample() *
+0.18215) and ``decode`` during serving (``online-inference/
+stable-diffusion/service/service.py`` pipeline).  Standard SD-1.x
+topology: conv_in → N down blocks (2 resnets each, stride-2 conv between)
+→ mid (resnet, self-attn, resnet) → moments; decoder mirrors with
+nearest-neighbor upsampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_cloud_tpu.models.diffusion.nn2d import (
+    conv2d,
+    conv_init,
+    downsample,
+    downsample_init,
+    group_norm,
+    group_norm_init,
+    resnet_block,
+    resnet_block_init,
+    self_attention_2d,
+    self_attention_2d_init,
+    upsample,
+    upsample_init,
+)
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: tuple = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_groups: int = 32
+    scaling_factor: float = 0.18215
+
+
+def vae_init(cfg: VAEConfig, rng: jax.Array) -> Params:
+    n_blocks = len(cfg.block_out_channels)
+    keys = iter(jax.random.split(rng, 64))
+    ch0 = cfg.block_out_channels[0]
+    chN = cfg.block_out_channels[-1]
+
+    enc: Params = {"conv_in": conv_init(next(keys), 3, 3, cfg.in_channels,
+                                        ch0)}
+    cin = ch0
+    down = []
+    for i, cout in enumerate(cfg.block_out_channels):
+        blk: Params = {"resnets": []}
+        for _ in range(cfg.layers_per_block):
+            blk["resnets"].append(resnet_block_init(next(keys), cin, cout))
+            cin = cout
+        if i < n_blocks - 1:
+            blk["down"] = downsample_init(next(keys), cout)
+        down.append(blk)
+    enc["down"] = down
+    enc["mid"] = {
+        "res1": resnet_block_init(next(keys), chN, chN),
+        "attn": self_attention_2d_init(next(keys), chN),
+        "res2": resnet_block_init(next(keys), chN, chN),
+    }
+    enc["norm_out"] = group_norm_init(chN)
+    enc["conv_out"] = conv_init(next(keys), 3, 3, chN,
+                                2 * cfg.latent_channels)
+
+    dec: Params = {"conv_in": conv_init(next(keys), 3, 3,
+                                        cfg.latent_channels, chN)}
+    dec["mid"] = {
+        "res1": resnet_block_init(next(keys), chN, chN),
+        "attn": self_attention_2d_init(next(keys), chN),
+        "res2": resnet_block_init(next(keys), chN, chN),
+    }
+    cin = chN
+    up = []
+    for i, cout in enumerate(reversed(cfg.block_out_channels)):
+        blk = {"resnets": []}
+        for _ in range(cfg.layers_per_block + 1):
+            blk["resnets"].append(resnet_block_init(next(keys), cin, cout))
+            cin = cout
+        if i < n_blocks - 1:
+            blk["up"] = upsample_init(next(keys), cout)
+        up.append(blk)
+    dec["up"] = up
+    dec["norm_out"] = group_norm_init(ch0)
+    dec["conv_out"] = conv_init(next(keys), 3, 3, ch0, cfg.in_channels)
+    return {"encoder": enc, "decoder": dec}
+
+
+def _encode_moments(cfg: VAEConfig, p: Params, x: jax.Array) -> jax.Array:
+    g = cfg.norm_groups
+    h = conv2d(p["conv_in"], x)
+    for blk in p["down"]:
+        for r in blk["resnets"]:
+            h = resnet_block(r, h, groups=g)
+        if "down" in blk:
+            h = downsample(blk["down"], h)
+    h = resnet_block(p["mid"]["res1"], h, groups=g)
+    h = self_attention_2d(p["mid"]["attn"], h, groups=g)
+    h = resnet_block(p["mid"]["res2"], h, groups=g)
+    h = jax.nn.silu(group_norm(p["norm_out"], h, g))
+    return conv2d(p["conv_out"], h)  # [B, h, w, 2*latent]
+
+
+def vae_encode(cfg: VAEConfig, params: Params, x: jax.Array,
+               rng: jax.Array) -> jax.Array:
+    """Image [B, H, W, 3] (in [-1, 1]) → scaled latent sample
+    [B, H/8, W/8, latent] — the reference's ``vae.encode(...).sample() *
+    scaling_factor``."""
+    moments = _encode_moments(cfg, params["encoder"], x)
+    mean, logvar = jnp.split(moments, 2, axis=-1)
+    logvar = jnp.clip(logvar.astype(jnp.float32), -30.0, 20.0)
+    std = jnp.exp(0.5 * logvar)
+    z = mean.astype(jnp.float32) + std * jax.random.normal(
+        rng, mean.shape, jnp.float32)
+    return (z * cfg.scaling_factor).astype(x.dtype)
+
+
+def vae_decode(cfg: VAEConfig, params: Params, z: jax.Array) -> jax.Array:
+    """Scaled latent → image [B, H, W, 3] in [-1, 1]."""
+    g = cfg.norm_groups
+    p = params["decoder"]
+    h = conv2d(p["conv_in"], z / cfg.scaling_factor)
+    h = resnet_block(p["mid"]["res1"], h, groups=g)
+    h = self_attention_2d(p["mid"]["attn"], h, groups=g)
+    h = resnet_block(p["mid"]["res2"], h, groups=g)
+    for blk in p["up"]:
+        for r in blk["resnets"]:
+            h = resnet_block(r, h, groups=g)
+        if "up" in blk:
+            h = upsample(blk["up"], h)
+    h = jax.nn.silu(group_norm(p["norm_out"], h, g))
+    return conv2d(p["conv_out"], h)

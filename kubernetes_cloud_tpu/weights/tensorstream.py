@@ -63,7 +63,20 @@ def _unflatten(flat: dict[str, Any]) -> Any:
         for part in parts[:-1]:
             node = node.setdefault(part, {})
         node[parts[-1]] = value
-    return root
+
+    def restore_lists(node):
+        """Dicts whose keys are exactly 0..n-1 were lists before _flatten;
+        rebuild them so round-tripped pytrees keep their structure."""
+        if not isinstance(node, dict):
+            return node
+        node = {k: restore_lists(v) for k, v in node.items()}
+        if node and all(k.isdigit() for k in node):
+            idx = sorted(node, key=int)
+            if [int(k) for k in idx] == list(range(len(idx))):
+                return [node[k] for k in idx]
+        return node
+
+    return restore_lists(root)
 
 
 def write_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:

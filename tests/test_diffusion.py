@@ -1,0 +1,229 @@
+"""Stable Diffusion family: models, datasets, trainer, serving."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.data.diffusion import (
+    DreamBoothDataset,
+    LocalBase,
+    PromptDataset,
+    collate_dreambooth,
+    collate_images,
+)
+from kubernetes_cloud_tpu.models.diffusion import (
+    CLIPTextConfig,
+    NoiseSchedule,
+    UNetConfig,
+    VAEConfig,
+    add_noise,
+    make_schedule,
+    unet_apply,
+    unet_init,
+    vae_decode,
+    vae_encode,
+    vae_init,
+)
+from kubernetes_cloud_tpu.models.diffusion.schedule import ddim_step, pred_x0
+from kubernetes_cloud_tpu.train.sd_trainer import (
+    SDTrainerConfig,
+    StableDiffusionTrainer,
+    ema_decay_schedule,
+    ema_update,
+)
+
+TINY_UNET = UNetConfig(block_out_channels=(16, 32), layers_per_block=1,
+                       cross_attn_dim=16, num_heads=2, norm_groups=8,
+                       dtype=jnp.float32)
+TINY_VAE = VAEConfig(block_out_channels=(16, 32), norm_groups=8,
+                     latent_channels=4)
+TINY_CLIP = CLIPTextConfig(vocab_size=128, hidden_size=16, num_layers=2,
+                           num_heads=2, max_length=8, dtype=jnp.float32)
+
+
+def _write_images(tmp_path, n=4, size=32, captions=True):
+    from PIL import Image
+
+    d = tmp_path / "imgs"
+    d.mkdir(exist_ok=True)
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        arr = rng.randint(0, 255, (size, size, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(d / f"img{i}.png")
+        if captions:
+            (d / f"img{i}.txt").write_text(f"a photo number {i}")
+    return str(d)
+
+
+# -- schedule ---------------------------------------------------------------
+
+def test_schedule_roundtrip():
+    sched = make_schedule(NoiseSchedule())
+    x0 = jax.random.normal(jax.random.key(0), (2, 4, 4, 4))
+    noise = jax.random.normal(jax.random.key(1), (2, 4, 4, 4))
+    t = jnp.array([100, 900])
+    xt = add_noise(sched, x0, noise, t)
+    rec = pred_x0(sched, noise, xt, t)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(x0), atol=2e-3)
+
+
+def test_ddim_denoises_with_oracle_eps():
+    """Stepping DDIM with the true noise recovers x0."""
+    sched = make_schedule(NoiseSchedule())
+    x0 = jax.random.normal(jax.random.key(2), (1, 4, 4, 4))
+    noise = jax.random.normal(jax.random.key(3), (1, 4, 4, 4))
+    t = jnp.array([500])
+    xt = add_noise(sched, x0, noise, t)
+    final = ddim_step(sched, noise, xt, t, jnp.array([-1]))
+    np.testing.assert_allclose(np.asarray(final), np.asarray(x0), atol=2e-3)
+
+
+# -- datasets ---------------------------------------------------------------
+
+def test_local_base_pairs_and_ucg(tmp_path):
+    root = _write_images(tmp_path)
+    ds = LocalBase(root, size=16, ucg=0.0)
+    assert len(ds) == 4
+    row = ds[1]
+    assert row["image"].shape == (16, 16, 3)
+    assert row["caption"] == "a photo number 1"
+    assert row["image"].min() >= -1.0 and row["image"].max() <= 1.0
+
+    ds_ucg = LocalBase(root, size=16, ucg=1.0, seed=0)
+    assert ds_ucg[0]["caption"] == ""  # always dropped at ucg=1
+
+    batch = collate_images([ds[i] for i in range(4)])
+    assert batch["images"].shape == (4, 16, 16, 3)
+    assert len(batch["captions"]) == 4
+
+
+def test_dreambooth_dataset(tmp_path):
+    inst = _write_images(tmp_path, n=2, captions=False)
+    cls_dir = tmp_path / "cls"
+    cls_dir.mkdir()
+    ds = DreamBoothDataset(inst, "a sks dog", str(cls_dir), "a dog",
+                           size=16, num_class_images=3)
+    assert ds.missing_class_images == 3
+    assert not ds.with_prior
+
+    from PIL import Image
+
+    for i in range(3):
+        Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(
+            cls_dir / f"c{i}.png")
+    ds = DreamBoothDataset(inst, "a sks dog", str(cls_dir), "a dog",
+                           size=16, num_class_images=3)
+    assert ds.with_prior and ds.missing_class_images == 0
+    batch = collate_dreambooth([ds[0], ds[1]])
+    # [instance x2; class x2]
+    assert batch["images"].shape == (4, 16, 16, 3)
+    assert batch["captions"][:2] == ["a sks dog"] * 2
+    assert batch["captions"][2:] == ["a dog"] * 2
+
+    pd = PromptDataset("a dog", 5)
+    assert len(pd) == 5 and pd[3] == {"prompt": "a dog", "index": 3}
+
+
+# -- EMA --------------------------------------------------------------------
+
+def test_ema_warmup_schedule():
+    assert float(ema_decay_schedule(jnp.asarray(0.0), 0.9999)) == pytest.approx(0.1)
+    assert float(ema_decay_schedule(jnp.asarray(1e7), 0.9999)) == pytest.approx(0.9999)
+    ema = {"w": jnp.ones((2,))}
+    cur = {"w": jnp.zeros((2,))}
+    out = ema_update(ema, cur, 0.9)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.9)
+
+
+# -- trainer ----------------------------------------------------------------
+
+def _trainer(tmp_path, dataset, collate, devices, **kw):
+    mesh = build_mesh(MeshSpec(data=2), devices=devices[:2])
+    defaults = dict(run_name="sd1", output_path=str(tmp_path), batch_size=2,
+                    lr=1e-4, epochs=1, save_steps=0, image_log_steps=0,
+                    resolution=32, use_ema=True,
+                    logs=str(tmp_path / "logs"))
+    defaults.update(kw)
+    return StableDiffusionTrainer(
+        SDTrainerConfig(**defaults), mesh, dataset, collate,
+        unet_cfg=TINY_UNET, vae_cfg=TINY_VAE, clip_cfg=TINY_CLIP)
+
+
+def test_sd_train_loop_and_checkpoint(tmp_path, devices8):
+    root = _write_images(tmp_path)
+    ds = LocalBase(root, size=32, ucg=0.5, seed=0)
+    trainer = _trainer(tmp_path, ds, collate_images, devices8)
+    result = trainer.train()
+    assert result["steps"] == 2  # 4 imgs / bs 2
+    assert np.isfinite(result["train/loss"])
+    final = result["final_dir"]
+    for mod in ("unet", "vae", "encoder"):
+        assert os.path.exists(os.path.join(final, f"{mod}.tensors"))
+    assert os.path.exists(os.path.join(final, ".ready.txt"))
+
+
+def test_sd_dreambooth_prior_loss(tmp_path, devices8):
+    inst = _write_images(tmp_path, n=2, captions=False)
+    cls_dir = tmp_path / "cls"
+    cls_dir.mkdir()
+    from PIL import Image
+
+    for i in range(2):
+        Image.fromarray(np.zeros((32, 32, 3), np.uint8)).save(
+            cls_dir / f"c{i}.png")
+    ds = DreamBoothDataset(inst, "a sks dog", str(cls_dir), "a dog",
+                           size=32, num_class_images=2)
+    trainer = _trainer(tmp_path, ds, collate_dreambooth, devices8,
+                       run_name="db1", prior_loss_weight=1.0, batch_size=1)
+    result = trainer.train()
+    assert "train/prior_loss" in result
+    assert np.isfinite(result["train/prior_loss"])
+
+
+def test_sd_v_prediction_changes_target(tmp_path, devices8):
+    root = _write_images(tmp_path)
+    ds = LocalBase(root, size=32, ucg=0.0, seed=0)
+    t_eps = _trainer(tmp_path, ds, collate_images, devices8,
+                     run_name="eps", use_ema=False)
+    t_v = _trainer(tmp_path, ds, collate_images, devices8,
+                   run_name="v", use_ema=False, v_prediction=True)
+    r_eps = t_eps.train()
+    r_v = t_v.train()
+    assert r_eps["train/loss"] != r_v["train/loss"]
+
+
+# -- serving ----------------------------------------------------------------
+
+def test_sd_service_roundtrip(tmp_path, devices8):
+    import base64
+
+    root = _write_images(tmp_path)
+    ds = LocalBase(root, size=32, ucg=0.0, seed=0)
+    trainer = _trainer(tmp_path, ds, collate_images, devices8,
+                       run_name="srv")
+    trainer.train()
+
+    from kubernetes_cloud_tpu.serve.sd_service import StableDiffusionService
+
+    svc = StableDiffusionService(
+        "sd", os.path.join(str(tmp_path), "results-srv", "final"))
+    svc.load()
+    assert svc.ready
+    out = svc.predict({
+        "prompt": "a test",
+        "parameters": {"height": 32, "width": 32,
+                       "num_inference_steps": 3, "seed": 7},
+    })
+    pred = out["predictions"][0]
+    assert pred["format"] == "png"
+    png = base64.b64decode(pred["image_b64"])
+    from PIL import Image
+    import io
+
+    img = Image.open(io.BytesIO(png))
+    assert img.size == (32, 32)
