@@ -1,0 +1,9 @@
+#!/bin/sh
+# Smoke test: classify an image by URL (reference:
+# image-classifier/service/predict_url.sh).
+SERVICE=${SERVICE:-image-classifier.default.example.com}
+URL=${1:-https://upload.wikimedia.org/wikipedia/commons/9/99/Brooks_Chase_Ranger_of_Jolly_Dogs_Jack_Russell.jpg}
+curl -s -H "Content-Type: application/json" \
+  "http://${SERVICE}/v1/models/classifier:predict" \
+  -d "{\"instances\": [{\"image_url\": \"${URL}\"}]}"
+echo

@@ -1,0 +1,10 @@
+#!/bin/sh
+# Smoke test: classify a base64-encoded local image (reference:
+# image-classifier/service/test_base64.sh).
+SERVICE=${SERVICE:-image-classifier.default.example.com}
+IMG=${1:?usage: test_base64.sh <image-file>}
+B64=$(base64 -w0 "$IMG" 2>/dev/null || base64 "$IMG")
+curl -s -H "Content-Type: application/json" \
+  "http://${SERVICE}/v1/models/classifier:predict" \
+  -d "{\"instances\": [{\"image_b64\": \"${B64}\"}]}"
+echo

@@ -1,0 +1,125 @@
+"""deploy/ manifest library sanity.
+
+The YAML surface is the L5/L6 public interface (SURVEY.md §1); these tests
+keep it loadable and structurally consistent: every file parses, every TPU
+workload pairs a google.com/tpu limit with gke-tpu nodeSelectors, and the
+flagship workflow keeps the reference's 1:1 parameter surface
+(finetuner-workflow/finetune-workflow.yaml:8-199).
+"""
+
+import pathlib
+import re
+
+import pytest
+import yaml
+
+DEPLOY = pathlib.Path(__file__).resolve().parent.parent / "deploy"
+YAMLS = sorted(DEPLOY.rglob("*.yaml"))
+
+
+def _docs(path):
+    # Argo template braces are valid YAML scalars; sprig expressions with
+    # `{{=...}}` inside quoted strings parse fine with safe_load.
+    return [d for d in yaml.safe_load_all(path.read_text()) if d is not None]
+
+
+def test_manifests_exist():
+    assert len(YAMLS) >= 15
+
+
+@pytest.mark.parametrize("path", YAMLS, ids=lambda p: str(p.relative_to(DEPLOY)))
+def test_manifest_parses(path):
+    docs = _docs(path)
+    assert docs, f"{path} has no documents"
+    for doc in docs:
+        assert "kind" in doc and "apiVersion" in doc
+
+
+def test_no_gpu_resources_anywhere():
+    """TPU-native means no nvidia.com/gpu or CUDA scheduling leftovers."""
+    for path in YAMLS:
+        text = "\n".join(
+            line for line in path.read_text().splitlines()
+            if not line.lstrip().startswith("#"))
+        assert "nvidia.com/gpu" not in text, path
+        assert "rdma/ib" not in text, path
+
+
+def test_tpu_workloads_pair_limits_with_selectors():
+    for path in YAMLS:
+        text = path.read_text()
+        if "google.com/tpu" in text:
+            assert "gke-tpu-accelerator" in text, (
+                f"{path}: TPU limit without accelerator nodeSelector")
+
+
+def test_finetune_workflow_parameter_surface():
+    wf = _docs(DEPLOY / "finetuner-workflow" / "finetune-workflow.yaml")[0]
+    params = {p["name"] for p in wf["spec"]["arguments"]["parameters"]}
+    # The reference's user-facing config surface (SURVEY.md §5.6) ports 1:1.
+    expected = {
+        "run_name", "pvc", "model", "dataset", "tensorizer_uri",
+        "retokenize", "sanitize", "tokenizer", "reorder", "no_shuffle",
+        "sampling", "eot_token", "pad_token", "boundary_token",
+        "boundary_index", "context", "prompt_file", "prompt_every",
+        "prompt_tokens", "prompt_samples", "top_k", "top_p", "temperature",
+        "repetition_penalty", "warmup_ratio", "batch_size", "force_fp16",
+        "batch_size_divisor", "random_seed", "learn_rate", "epochs",
+        "gradients", "zero_stage", "save_steps", "no_resume", "logs",
+        "wandb_key", "project_id", "run_inference", "inference_only",
+        "download_dataset",
+    }
+    missing = expected - params
+    assert not missing, f"missing workflow params: {sorted(missing)}"
+
+
+def test_finetune_workflow_step_dag():
+    wf = _docs(DEPLOY / "finetuner-workflow" / "finetune-workflow.yaml")[0]
+    main = next(t for t in wf["spec"]["templates"] if t["name"] == "main")
+    step_names = [s[0]["name"] for s in main["steps"]]
+    assert step_names == [
+        "check-model", "model-downloader", "dataset-downloader",
+        "tokenizer", "finetuner", "inference-service",
+    ]
+    # Every non-main template retries or is a resource apply
+    # (reference retryStrategy on all steps, SURVEY.md §5.3).
+    for t in wf["spec"]["templates"]:
+        if t["name"] in ("main", "model-inference-service"):
+            continue
+        assert "retryStrategy" in t, t["name"]
+
+
+def test_event_bindings_reference_their_templates():
+    for wf_dir, binding, template in [
+        ("sd-finetuner-workflow", "sd-finetune-workflow-event-binding.yaml",
+         "sd-finetune-template"),
+        ("sd-dreambooth-workflow", "db-workflow-event-binding.yaml",
+         "db-finetune-template"),
+    ]:
+        doc = _docs(DEPLOY / wf_dir / binding)[0]
+        assert doc["kind"] == "WorkflowEventBinding"
+        assert doc["spec"]["submit"]["workflowTemplateRef"]["name"] == template
+        tmpl_files = [p for p in (DEPLOY / wf_dir).glob("*.yaml")
+                      if p.name != binding]
+        names = {d["metadata"].get("name")
+                 for f in tmpl_files for d in _docs(f)}
+        assert template in names
+
+
+def test_jobsets_are_symmetric():
+    """JobSet workers: no launcher/worker asymmetry (SURVEY.md §7 hard part
+    5) — a single replicatedJob where every host runs the same command."""
+    for path in (DEPLOY / "jobset").glob("*jobset.yaml"):
+        for doc in _docs(path):
+            if doc["kind"] != "JobSet":
+                continue
+            jobs = doc["spec"]["replicatedJobs"]
+            assert len(jobs) == 1, f"{path}: expected symmetric single job"
+            spec = jobs[0]["template"]["spec"]
+            assert spec["parallelism"] == spec["completions"]
+
+
+def test_ready_sentinel_protocol_present():
+    text = (DEPLOY / "online-inference" / "bloom-176b" /
+            "01-download-job.yaml").read_text()
+    assert ".ready.txt" in text
