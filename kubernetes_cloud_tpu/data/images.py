@@ -1,0 +1,140 @@
+"""Image-classification input pipeline (ImageNet-folder layout).
+
+Replaces the reference's torchvision ``ImageFolder`` + ``DistributedSampler``
++ transform stack (``kubeflow/training-operator/resnet50/util.py:169-199``):
+
+* class-per-directory layout discovered the same way (sorted dir names →
+  label ids);
+* per-host sharding replaces ``DistributedSampler`` — each host reads only
+  ``files[process_index::process_count]`` and builds its slice of the
+  globally-sharded batch (under pjit the global batch is the concatenation);
+* transforms: resize-crop to ``image_size``, fp32 scale to [0,1], ImageNet
+  mean/std normalization, random horizontal flip in training.
+
+NumPy/PIL only — the decode happens on host CPU, the normalized batch is
+device_put as NHWC (TPU layout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+@dataclasses.dataclass
+class ImageFolderDataset:
+    """<root>/<class_name>/<image> layout, labels by sorted class name."""
+
+    root: str
+    image_size: int = 224
+    train: bool = True
+    seed: int = 0
+
+    def __post_init__(self):
+        classes = sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d)))
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {self.root}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: list[tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(self.root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(_EXTS):
+                    self.samples.append(
+                        (os.path.join(cdir, fn), self.class_to_idx[c]))
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def _load(self, path: str, rng: Optional[np.random.Generator]):
+        from PIL import Image
+
+        img = Image.open(path).convert("RGB")
+        s = self.image_size
+        if self.train and rng is not None:
+            # Random resized crop, cheap variant: resize short side to
+            # [s, 1.15*s], random crop, random hflip.
+            short = int(s * (1 + 0.15 * rng.random()))
+            img = _resize_short(img, short)
+            x0 = rng.integers(0, img.width - s + 1)
+            y0 = rng.integers(0, img.height - s + 1)
+            img = img.crop((x0, y0, x0 + s, y0 + s))
+            if rng.random() < 0.5:
+                img = img.transpose(Image.FLIP_LEFT_RIGHT)
+        else:
+            # Standard ImageNet eval: resize short side by 256/224, i.e.
+            # exactly 256 for the 224 crop, then center-crop.
+            img = _resize_short(img, int(round(s * 256 / 224)))
+            x0 = (img.width - s) // 2
+            y0 = (img.height - s) // 2
+            img = img.crop((x0, y0, x0 + s, y0 + s))
+        arr = np.asarray(img, np.float32) / 255.0
+        return (arr - IMAGENET_MEAN) / IMAGENET_STD
+
+    def batches(
+        self,
+        batch_size: int,
+        *,
+        epoch: int = 0,
+        process_index: int = 0,
+        process_count: int = 1,
+        drop_remainder: bool = True,
+    ) -> Iterator[dict]:
+        """Per-host shard of globally-shuffled batches.  ``batch_size`` is
+        the per-host size; shuffling is seeded by (seed, epoch) identically
+        on every host so the global permutation agrees (the
+        ``DistributedSampler.set_epoch`` contract)."""
+        order = np.arange(len(self.samples))
+        if self.train:
+            np.random.default_rng((self.seed, epoch)).shuffle(order)
+        # Strided shards differ in length by up to one sample; truncate to
+        # the common minimum so every host yields the SAME number of
+        # batches — unequal counts deadlock the SPMD program at the first
+        # collective.  (DistributedSampler pads with duplicates instead;
+        # truncation drops <process_count samples and stays exact.)
+        common = len(order) // process_count
+        local = order[process_index::process_count][:common]
+        rng = np.random.default_rng((self.seed, epoch, process_index))
+        n_full = len(local) // batch_size
+        end = n_full * batch_size if drop_remainder else len(local)
+        for i in range(0, end, batch_size):
+            idx = local[i:i + batch_size]
+            imgs = np.stack([
+                self._load(self.samples[j][0], rng if self.train else None)
+                for j in idx])
+            labels = np.array([self.samples[j][1] for j in idx], np.int32)
+            yield {"image": imgs, "label": labels}
+
+
+def _resize_short(img, short: int):
+    from PIL import Image
+
+    w, h = img.size
+    if w < h:
+        return img.resize((short, int(h * short / w)), Image.BILINEAR)
+    return img.resize((int(w * short / h), short), Image.BILINEAR)
+
+
+def synthetic_batches(batch_size: int, *, image_size: int = 224,
+                      num_classes: int = 1000, steps: int = 10,
+                      seed: int = 0) -> Iterator[dict]:
+    """Deterministic synthetic data for smoke tests and benchmarks: each
+    class has a distinct mean pixel value, so a working model can actually
+    learn the mapping (unlike pure noise)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        labels = rng.integers(0, num_classes, size=batch_size).astype(
+            np.int32)
+        base = (labels[:, None, None, None] / num_classes).astype(np.float32)
+        noise = rng.normal(0, 0.1, (batch_size, image_size, image_size, 3))
+        yield {"image": (base + noise).astype(np.float32), "label": labels}

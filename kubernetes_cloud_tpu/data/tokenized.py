@@ -120,7 +120,10 @@ def sharded_batches(
         if shuffle:
             rng.shuffle(order)
         order = order[proc::n_proc]
-        n_full = len(order) // local_bs
+        # Every host MUST emit the same batch count or the SPMD program
+        # deadlocks at the first collective; strided shards differ in length
+        # by one, so compute the count from the guaranteed-common minimum.
+        n_full = (len(dataset) // n_proc) // local_bs
         if skip_batches >= n_full:
             # Resume fast-forward: advance the (deterministic) shuffle
             # stream without materializing device batches.

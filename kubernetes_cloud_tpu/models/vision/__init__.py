@@ -1,0 +1,8 @@
+from kubernetes_cloud_tpu.models.vision.resnet import (  # noqa: F401
+    PRESETS,
+    ResNetConfig,
+    forward,
+    init_params,
+    loss_fn,
+    topk_accuracy,
+)

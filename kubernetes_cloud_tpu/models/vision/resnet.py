@@ -1,0 +1,293 @@
+"""ResNet image classifiers, TPU-first.
+
+Covers the reference's ResNet50 ImageNet trainers
+(``kubeflow/training-operator/resnet50/resnet50_pytorch.py``,
+``resnet50_horovod.py`` — the same torchvision model trained two ways) and
+the TF-2 Inception-class serving path (``online-inference/image-classifier``)
+as one configurable residual family (depths 18/34/50/101/152).
+
+Design (deliberately not a torch translation):
+
+* **NHWC layout.** TPUs tile convolutions onto the MXU in NHWC; torch's
+  NCHW would force layout transposes at every op.  Conv kernels are HWIO.
+* **Pure pytrees + functions**, like :mod:`..causal_lm`: ``init_params``
+  returns nested dicts, ``forward`` is pure.  BatchNorm running statistics
+  live in a separate ``batch_stats`` pytree threaded through ``forward``
+  (functional state, not module attributes).
+* **Global BatchNorm for free.** Under ``jit`` with the batch sharded over
+  the ``data`` axis, ``jnp.mean`` over the batch dim is the *global* mean —
+  XLA inserts the cross-replica reduction.  The reference's per-GPU-stats
+  DDP BatchNorm is strictly weaker; sync-BN is the default here.
+* **bf16 compute, fp32 statistics.** Convs/matmuls run in bfloat16 on the
+  MXU; BN statistics, softmax and loss run in float32 (the mixed-precision
+  discipline ``util.py:20-67`` gets from torch.cuda.amp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+# Per-depth (block type, blocks per stage).  Bottleneck blocks expand
+# channels 4x (torchvision's resnet.py layout, reproduced from the
+# architecture, not the code).
+_DEPTHS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64  # stem channels; stages run width * (1, 2, 4, 8)
+    bn_momentum: float = 0.9  # running-stat EMA decay (torch's 1 - 0.1)
+    bn_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.depth not in _DEPTHS:
+            raise ValueError(
+                f"depth must be one of {sorted(_DEPTHS)}, got {self.depth}")
+
+    @property
+    def block(self) -> str:
+        return _DEPTHS[self.depth][0]
+
+    @property
+    def stage_sizes(self) -> tuple[int, ...]:
+        return _DEPTHS[self.depth][1]
+
+    @property
+    def expansion(self) -> int:
+        return 4 if self.block == "bottleneck" else 1
+
+
+PRESETS = {
+    "resnet18": ResNetConfig(depth=18),
+    "resnet34": ResNetConfig(depth=34),
+    "resnet50": ResNetConfig(depth=50),
+    "resnet101": ResNetConfig(depth=101),
+    "resnet152": ResNetConfig(depth=152),
+    # CIFAR-scale config for tests and the CPU smoke path.
+    "resnet-tiny": ResNetConfig(depth=18, num_classes=10, width=8),
+}
+
+
+# --------------------------------------------------------------------------
+# init
+
+
+def _conv_init(rng, kh, kw, cin, cout, dtype):
+    # He/Kaiming normal (fan_out, relu), the standard ResNet init.
+    fan_out = kh * kw * cout
+    std = jnp.sqrt(2.0 / fan_out)
+    return (jax.random.normal(rng, (kh, kw, cin, cout)) * std).astype(dtype)
+
+
+def _bn_init(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_stats_init(c):
+    # Running stats are always fp32.
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def init_params(cfg: ResNetConfig, rng: jax.Array) -> tuple[Params, Params]:
+    """Returns ``(params, batch_stats)``."""
+    pd = cfg.param_dtype
+    n_convs = 2 + sum(cfg.stage_sizes) * (3 if cfg.block == "bottleneck"
+                                          else 2) + 4
+    rngs = iter(jax.random.split(rng, n_convs + 1))
+
+    params: Params = {}
+    stats: Params = {}
+    params["stem"] = {
+        "kernel": _conv_init(next(rngs), 7, 7, 3, cfg.width, pd),
+        "bn": _bn_init(cfg.width, pd),
+    }
+    stats["stem"] = {"bn": _bn_stats_init(cfg.width)}
+
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        planes = cfg.width * (2 ** s)
+        cout = planes * cfg.expansion
+        stage_p, stage_s = [], []
+        for b in range(n_blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            bp: Params = {}
+            bs: Params = {}
+            if cfg.block == "bottleneck":
+                shapes = [(1, 1, cin, planes), (3, 3, planes, planes),
+                          (1, 1, planes, cout)]
+            else:
+                shapes = [(3, 3, cin, planes), (3, 3, planes, cout)]
+            for i, (kh, kw, ci, co) in enumerate(shapes):
+                bp[f"conv{i}"] = {
+                    "kernel": _conv_init(next(rngs), kh, kw, ci, co, pd),
+                    "bn": _bn_init(co, pd),
+                }
+                bs[f"conv{i}"] = {"bn": _bn_stats_init(co)}
+            if stride != 1 or cin != cout:
+                bp["proj"] = {
+                    "kernel": _conv_init(next(rngs), 1, 1, cin, cout, pd),
+                    "bn": _bn_init(cout, pd),
+                }
+                bs["proj"] = {"bn": _bn_stats_init(cout)}
+            stage_p.append(bp)
+            stage_s.append(bs)
+            cin = cout
+        params[f"stage{s}"] = stage_p
+        stats[f"stage{s}"] = stage_s
+
+    head_std = 1.0 / jnp.sqrt(cin)
+    params["head"] = {
+        "w": (jax.random.uniform(next(rngs), (cin, cfg.num_classes),
+                                 minval=-1, maxval=1) * head_std).astype(pd),
+        "bias": jnp.zeros((cfg.num_classes,), pd),
+    }
+    return params, stats
+
+
+# --------------------------------------------------------------------------
+# forward
+
+
+def _conv(x, kernel, *, stride=1, dtype=jnp.bfloat16):
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype), kernel.astype(dtype),
+        window_strides=(stride, stride),
+        padding="SAME" if kernel.shape[0] > 1 else "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _batch_norm(x, p, s, *, train, momentum, eps):
+    """Functional BatchNorm.  Returns ``(y, new_stats)``; statistics in
+    fp32.  Under pjit with a data-sharded batch the reductions are global
+    (sync-BN)."""
+    xf = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        new_stats = {
+            "mean": momentum * s["mean"] + (1 - momentum) * mean,
+            "var": momentum * s["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_stats = s
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype), new_stats
+
+
+def _conv_bn(x, p, s, *, stride, relu, train, cfg):
+    y = _conv(x, p["kernel"], stride=stride, dtype=cfg.dtype)
+    y, ns = _batch_norm(y, p["bn"], s["bn"], train=train,
+                        momentum=cfg.bn_momentum, eps=cfg.bn_eps)
+    if relu:
+        y = jax.nn.relu(y)
+    return y, {"bn": ns}
+
+
+def _block(x, bp, bs, *, stride, cfg, train):
+    ns: Params = {}
+    if cfg.block == "bottleneck":
+        y, ns["conv0"] = _conv_bn(x, bp["conv0"], bs["conv0"], stride=1,
+                                  relu=True, train=train, cfg=cfg)
+        y, ns["conv1"] = _conv_bn(y, bp["conv1"], bs["conv1"], stride=stride,
+                                  relu=True, train=train, cfg=cfg)
+        y, ns["conv2"] = _conv_bn(y, bp["conv2"], bs["conv2"], stride=1,
+                                  relu=False, train=train, cfg=cfg)
+    else:
+        y, ns["conv0"] = _conv_bn(x, bp["conv0"], bs["conv0"], stride=stride,
+                                  relu=True, train=train, cfg=cfg)
+        y, ns["conv1"] = _conv_bn(y, bp["conv1"], bs["conv1"], stride=1,
+                                  relu=False, train=train, cfg=cfg)
+    if "proj" in bp:
+        shortcut, ns["proj"] = _conv_bn(x, bp["proj"], bs["proj"],
+                                        stride=stride, relu=False,
+                                        train=train, cfg=cfg)
+    else:
+        shortcut = x
+    return jax.nn.relu(y + shortcut), ns
+
+
+def forward(
+    cfg: ResNetConfig,
+    params: Params,
+    images: jax.Array,  # [B, H, W, 3], float
+    batch_stats: Params,
+    *,
+    train: bool = False,
+) -> tuple[jax.Array, Params]:
+    """Returns ``(logits[B, num_classes] fp32, new_batch_stats)``."""
+    new_stats: Params = {}
+    x = _conv(images, params["stem"]["kernel"], stride=2, dtype=cfg.dtype)
+    x, sbn = _batch_norm(x, params["stem"]["bn"], batch_stats["stem"]["bn"],
+                         train=train, momentum=cfg.bn_momentum,
+                         eps=cfg.bn_eps)
+    new_stats["stem"] = {"bn": sbn}
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+        "SAME")
+
+    for s in range(len(cfg.stage_sizes)):
+        stage_ns = []
+        for b, (bp, bs) in enumerate(zip(params[f"stage{s}"],
+                                         batch_stats[f"stage{s}"])):
+            stride = 2 if (s > 0 and b == 0) else 1
+            x, ns = _block(x, bp, bs, stride=stride, cfg=cfg, train=train)
+            stage_ns.append(ns)
+        new_stats[f"stage{s}"] = stage_ns
+
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global average pool
+    logits = x @ params["head"]["w"].astype(jnp.float32) + \
+        params["head"]["bias"].astype(jnp.float32)
+    return logits, new_stats
+
+
+# --------------------------------------------------------------------------
+# loss / metrics
+
+
+def loss_fn(cfg: ResNetConfig, params: Params, batch: dict,
+            batch_stats: Params) -> tuple[jax.Array, dict]:
+    """Cross-entropy with label smoothing off (reference parity:
+    ``util.py:70-108`` uses plain ``F.cross_entropy``)."""
+    logits, new_stats = forward(cfg, params, batch["image"], batch_stats,
+                                train=True)
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    loss = jnp.mean(nll)
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc, "batch_stats": new_stats}
+
+
+def topk_accuracy(logits: jax.Array, labels: jax.Array,
+                  ks: tuple[int, ...] = (1, 5)) -> dict:
+    """Top-k accuracies (reference ``util.py:150-166`` ``accuracy()``).
+    Each k is clamped to the class count (top-5 on a 2-class head is
+    top-2), keeping the metric defined for small-class configs."""
+    n_classes = logits.shape[-1]
+    maxk = min(max(ks), n_classes)
+    _, pred = jax.lax.top_k(logits, maxk)  # [B, maxk]
+    correct = pred == labels[:, None]
+    return {f"top{k}": jnp.mean(
+        jnp.any(correct[:, :min(k, n_classes)], axis=1).astype(jnp.float32))
+        for k in ks}

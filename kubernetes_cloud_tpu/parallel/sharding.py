@@ -139,11 +139,22 @@ def batch_spec(ndim: int = 2, *, seq_axis: Optional[int] = 1,
 
 
 def shard_batch(batch: Any, mesh: Mesh, *, shard_seq: bool = False) -> Any:
+    """Place per-host batch arrays onto the mesh's batch axes.
+
+    Single-host this is a plain sharded ``device_put``.  Multi-host, the
+    input is each process's *local shard* and the global batch is the
+    concatenation over processes (``jax.make_array_from_process_local_data``
+    — ``device_put`` would wrongly treat the local array as the global
+    value, silently shrinking the batch)."""
+
     def put(x):
         if not isinstance(x, jax.Array):
             x = np.asarray(x)
         sharding = logical_to_physical(
             batch_spec(x.ndim, shard_seq=shard_seq), mesh)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x))
         return jax.device_put(x, sharding)
 
     return jax.tree.map(put, batch)
