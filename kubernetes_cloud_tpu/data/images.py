@@ -72,12 +72,7 @@ class ImageFolderDataset:
             if rng.random() < 0.5:
                 img = img.transpose(Image.FLIP_LEFT_RIGHT)
         else:
-            # Standard ImageNet eval: resize short side by 256/224, i.e.
-            # exactly 256 for the 224 crop, then center-crop.
-            img = _resize_short(img, int(round(s * 256 / 224)))
-            x0 = (img.width - s) // 2
-            y0 = (img.height - s) // 2
-            img = img.crop((x0, y0, x0 + s, y0 + s))
+            return eval_transform(img, s)
         arr = np.asarray(img, np.float32) / 255.0
         return (arr - IMAGENET_MEAN) / IMAGENET_STD
 
@@ -123,6 +118,19 @@ def _resize_short(img, short: int):
     if w < h:
         return img.resize((short, int(h * short / w)), Image.BILINEAR)
     return img.resize((int(w * short / h), short), Image.BILINEAR)
+
+
+def eval_transform(img, size: int) -> np.ndarray:
+    """Standard ImageNet eval preprocessing: resize short side by 256/224
+    (exactly 256 for the 224 crop), center-crop, scale to [0,1], normalize.
+    Shared by the eval data path and the serving-side ImageTransformer so
+    train-time and serve-time preprocessing cannot drift."""
+    img = _resize_short(img, int(round(size * 256 / 224)))
+    x0 = (img.width - size) // 2
+    y0 = (img.height - size) // 2
+    img = img.crop((x0, y0, x0 + size, y0 + size))
+    arr = np.asarray(img, np.float32) / 255.0
+    return (arr - IMAGENET_MEAN) / IMAGENET_STD
 
 
 def synthetic_batches(batch_size: int, *, image_size: int = 224,

@@ -279,15 +279,21 @@ def loss_fn(cfg: ResNetConfig, params: Params, batch: dict,
     return loss, {"loss": loss, "accuracy": acc, "batch_stats": new_stats}
 
 
-def topk_accuracy(logits: jax.Array, labels: jax.Array,
-                  ks: tuple[int, ...] = (1, 5)) -> dict:
-    """Top-k accuracies (reference ``util.py:150-166`` ``accuracy()``).
+def topk_correct(logits: jax.Array, labels: jax.Array,
+                 ks: tuple[int, ...] = (1, 5)) -> dict:
+    """Per-example top-k hit indicators (float 0/1, shape [B]) per k.
     Each k is clamped to the class count (top-5 on a 2-class head is
     top-2), keeping the metric defined for small-class configs."""
     n_classes = logits.shape[-1]
     maxk = min(max(ks), n_classes)
     _, pred = jax.lax.top_k(logits, maxk)  # [B, maxk]
     correct = pred == labels[:, None]
-    return {f"top{k}": jnp.mean(
-        jnp.any(correct[:, :min(k, n_classes)], axis=1).astype(jnp.float32))
-        for k in ks}
+    return {f"top{k}": jnp.any(correct[:, :min(k, n_classes)],
+                               axis=1).astype(jnp.float32) for k in ks}
+
+
+def topk_accuracy(logits: jax.Array, labels: jax.Array,
+                  ks: tuple[int, ...] = (1, 5)) -> dict:
+    """Top-k accuracies (reference ``util.py:150-166`` ``accuracy()``)."""
+    return {k: jnp.mean(v)
+            for k, v in topk_correct(logits, labels, ks).items()}

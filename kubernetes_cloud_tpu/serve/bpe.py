@@ -1,0 +1,120 @@
+"""Byte-level BPE codec (GPT-2 family), dependency-free.
+
+The reference vendors a byte-pair encoder into its GPT-2 transformer
+sidecar (``online-inference/gpt-2/transformer/encoder.py``) so the
+pre/post-processing container needs no ML stack; this is the same
+capability implemented from the published GPT-2 BPE algorithm: a byte→
+unicode trampoline, greedy merge loop over ``merges.txt`` ranks, and a
+regex pre-tokenizer.  Loads the standard ``vocab.json`` + ``merges.txt``
+pair (what HF tokenizers write), so artifacts from the C++
+``dataset_tokenizer`` (``csrc/dataset_tokenizer``) and HF checkpoints both
+work.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from functools import lru_cache
+
+# GPT-2's pre-tokenization pattern.  Python's ``re`` lacks ``\p{L}``; the
+# translation: letters = ``[^\W\d_]`` (unicode \w minus digits minus the
+# underscore \w wrongly includes), "punctuation" = everything that is
+# neither whitespace nor letter nor digit — which INCLUDES '_', hence the
+# explicit ``|_`` in that class.  Round-trips byte-identically because
+# byte-level BPE encodes whatever the splitter yields.
+_PAT = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?(?:[^\s\w]|_)+"
+    r"|\s+(?!\S)|\s+",
+    re.UNICODE,
+)
+
+
+@lru_cache(maxsize=1)
+def bytes_to_unicode() -> dict[int, str]:
+    """The reversible byte→printable-unicode map BPE operates over."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+def _pairs(word: tuple[str, ...]) -> set[tuple[str, str]]:
+    return set(zip(word, word[1:]))
+
+
+class BPECodec:
+    def __init__(self, vocab: dict[str, int],
+                 merges: list[tuple[str, str]]):
+        self.encoder = dict(vocab)
+        self.decoder = {v: k for k, v in vocab.items()}
+        self.ranks = {m: i for i, m in enumerate(merges)}
+        self.byte_enc = bytes_to_unicode()
+        self.byte_dec = {v: k for k, v in self.byte_enc.items()}
+        self._cache: dict[str, tuple[str, ...]] = {}
+
+    @classmethod
+    def from_dir(cls, path: str) -> "BPECodec":
+        with open(os.path.join(path, "vocab.json")) as f:
+            vocab = json.load(f)
+        merges = []
+        with open(os.path.join(path, "merges.txt")) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                # Only the '#version' header is a comment; real merge rules
+                # can begin with '#' (e.g. "# #" building the '##' token).
+                if not line or line.startswith("#version"):
+                    continue
+                a, _, b = line.partition(" ")
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+    # -- core merge loop ---------------------------------------------------
+
+    def _bpe(self, token: str) -> tuple[str, ...]:
+        cached = self._cache.get(token)
+        if cached is not None:
+            return cached
+        word = tuple(token)
+        while len(word) > 1:
+            pairs = _pairs(word)
+            best = min(pairs,
+                       key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            a, b = best
+            merged: list[str] = []
+            i = 0
+            while i < len(word):
+                if (i < len(word) - 1 and word[i] == a
+                        and word[i + 1] == b):
+                    merged.append(a + b)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+        self._cache[token] = word
+        return word
+
+    # -- public API --------------------------------------------------------
+
+    def encode(self, text: str) -> list[int]:
+        ids: list[int] = []
+        for tok in _PAT.findall(text):
+            mapped = "".join(self.byte_enc[b] for b in tok.encode("utf-8"))
+            ids.extend(self.encoder[piece] for piece in self._bpe(mapped))
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        text = "".join(self.decoder[i] for i in ids)
+        data = bytes(self.byte_dec[c] for c in text)
+        return data.decode("utf-8", errors="replace")

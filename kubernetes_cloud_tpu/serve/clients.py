@@ -1,0 +1,131 @@
+"""Client programs for the serving data plane.
+
+The reference treats clients as integration tests (SURVEY.md §4): the
+FasterTransformer gRPC/HTTP client with its own BPE tokenizer
+(``online-inference/fastertransformer/client/example.py``) and the BASNet
+image→mask compositing client
+(``online-inference/custom-basnet/client/main.py:13-37``).  Equivalents
+here speak the V1 data plane of :mod:`kubernetes_cloud_tpu.serve.server`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import io
+import json
+import urllib.request
+from typing import Any, Optional
+
+
+def predict(url: str, payload: dict, *, timeout: float = 300.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# -------------------------------------------------------------------------
+# LM client (fastertransformer/client/example.py equivalent)
+
+
+def generate_text(
+    url: str,
+    prompt: str,
+    *,
+    codec=None,
+    max_tokens: int = 64,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    timeout: float = 300.0,
+) -> str:
+    """Client-side tokenization like the FT client: if ``codec`` is given
+    the prompt is BPE-encoded locally and token ids are decoded on return;
+    otherwise the server tokenizes."""
+    params = {"max_tokens": max_tokens, "temperature": temperature,
+              "top_k": top_k, "top_p": top_p}
+    if codec is not None:
+        payload = {"instances": [codec.encode(prompt)],
+                   "parameters": params}
+        out = predict(url, payload, timeout=timeout)
+        ids = out["predictions"][0]
+        if isinstance(ids, dict):
+            ids = ids.get("token_ids", ids.get("text"))
+        return codec.decode(ids) if isinstance(ids, list) else str(ids)
+    payload = {"instances": [prompt], "parameters": params}
+    out = predict(url, payload, timeout=timeout)
+    pred = out["predictions"][0]
+    return pred["text"] if isinstance(pred, dict) else pred
+
+
+# -------------------------------------------------------------------------
+# Segmentation-mask compositing client (custom-basnet/client/main.py)
+
+
+def cutout(url: str, image_path: str, out_path: str, *,
+           timeout: float = 300.0) -> str:
+    """POST an image to a mask predictor; composite mask as alpha to cut
+    the foreground out, write RGBA PNG.  Mask responses accepted as
+    ``{"predictions": [{"mask": {"b64": <png>}}]}`` or a nested float
+    list."""
+    import numpy as np
+    from PIL import Image
+
+    with open(image_path, "rb") as f:
+        raw = f.read()
+    payload = {"instances": [{"image_bytes": {
+        "b64": base64.b64encode(raw).decode()}}]}
+    resp = predict(url, payload, timeout=timeout)
+    pred = resp["predictions"][0]
+
+    img = Image.open(io.BytesIO(raw)).convert("RGBA")
+    if isinstance(pred, dict) and "mask" in pred:
+        mask_img = Image.open(io.BytesIO(
+            base64.b64decode(pred["mask"]["b64"]))).convert("L")
+    else:
+        arr = np.asarray(pred, np.float32)
+        if arr.max() <= 1.0:
+            arr = arr * 255.0
+        mask_img = Image.fromarray(arr.astype("uint8"), "L")
+    mask_img = mask_img.resize(img.size, Image.BILINEAR)
+    img.putalpha(mask_img)
+    img.save(out_path, "PNG")
+    return out_path
+
+
+def main(argv: Optional[list[str]] = None) -> Any:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("generate", help="text generation client")
+    g.add_argument("--url", required=True)
+    g.add_argument("--prompt", required=True)
+    g.add_argument("--codec-dir", default=None,
+                   help="vocab.json+merges.txt dir for client-side BPE")
+    g.add_argument("--max-tokens", type=int, default=64)
+    g.add_argument("--temperature", type=float, default=1.0)
+
+    c = sub.add_parser("cutout", help="mask + composite client")
+    c.add_argument("--url", required=True)
+    c.add_argument("--image", required=True)
+    c.add_argument("--out", required=True)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "generate":
+        codec = None
+        if args.codec_dir:
+            from kubernetes_cloud_tpu.serve.bpe import BPECodec
+
+            codec = BPECodec.from_dir(args.codec_dir)
+        text = generate_text(args.url, args.prompt, codec=codec,
+                             max_tokens=args.max_tokens,
+                             temperature=args.temperature)
+        print(text)
+        return text
+    return cutout(args.url, args.image, args.out)
+
+
+if __name__ == "__main__":
+    main()

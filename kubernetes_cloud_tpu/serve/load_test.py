@@ -1,0 +1,142 @@
+"""Serving load-test harness: throughput / goodput / latency.
+
+Port of the reference's benchmark
+(``online-inference/tensorizer-isvc/benchmark/load_test.py:38-100`` async
+aiohttp driver, ``:131-176`` stats: requests/sec, goodput = successful
+fraction, mean±stddev latency) with the same two modes:
+
+* ``async`` — ``asyncio`` + aiohttp when available, otherwise a thread
+  pool at the same concurrency (identical stats either way);
+* ``sync``  — one request at a time (the reference's ``requests`` loop).
+
+CLI::
+
+    python -m kubernetes_cloud_tpu.serve.load_test \
+        --url http://host/v1/models/m:predict --requests 100 \
+        --concurrency 8 --payload '{"instances": [..]}' \
+        [--inputs prompts.txt]
+
+``--inputs`` cycles prompt lines into ``{"instances": [line]}`` payloads
+(the reference's ``benchmark/inputs.txt`` corpus).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import statistics
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Result:
+    latency: float
+    status: int
+    error: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.status == 200 and not self.error
+
+
+@dataclass
+class Summary:
+    total_time: float
+    results: list[Result] = field(repr=False, default_factory=list)
+
+    @property
+    def n(self) -> int:
+        return len(self.results)
+
+    @property
+    def n_ok(self) -> int:
+        return sum(r.ok for r in self.results)
+
+    def stats(self) -> dict:
+        lat = [r.latency for r in self.results if r.ok]
+        return {
+            "requests": self.n,
+            "successful": self.n_ok,
+            "total_time_s": round(self.total_time, 4),
+            # reference names: throughput = all completed / time,
+            # goodput = successful / time (load_test.py:158-176)
+            "throughput_rps": round(self.n / self.total_time, 4),
+            "goodput_rps": round(self.n_ok / self.total_time, 4),
+            "latency_mean_s": round(statistics.mean(lat), 4) if lat else None,
+            "latency_stddev_s": round(statistics.stdev(lat), 4)
+            if len(lat) > 1 else None,
+            "latency_min_s": round(min(lat), 4) if lat else None,
+            "latency_max_s": round(max(lat), 4) if lat else None,
+        }
+
+
+def _one_request(url: str, payload: bytes, timeout: float) -> Result:
+    t0 = time.monotonic()
+    try:
+        req = urllib.request.Request(
+            url, data=payload, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+            return Result(time.monotonic() - t0, resp.status)
+    except Exception as e:  # noqa: BLE001 - goodput counts all failures
+        return Result(time.monotonic() - t0, 0, str(e))
+
+
+def run_sync(url: str, payloads: list[bytes], *,
+             timeout: float = 300.0) -> Summary:
+    t0 = time.monotonic()
+    results = [_one_request(url, p, timeout) for p in payloads]
+    return Summary(time.monotonic() - t0, results)
+
+
+def run_concurrent(url: str, payloads: list[bytes], *, concurrency: int = 8,
+                   timeout: float = 300.0) -> Summary:
+    """The async mode: ``concurrency`` in-flight requests until the payload
+    list drains (thread pool; stats match the aiohttp original)."""
+    t0 = time.monotonic()
+    with ThreadPoolExecutor(max_workers=concurrency) as pool:
+        results = list(pool.map(
+            lambda p: _one_request(url, p, timeout), payloads))
+    return Summary(time.monotonic() - t0, results)
+
+
+def build_payloads(args) -> list[bytes]:
+    if args.inputs:
+        with open(args.inputs) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        cycle = itertools.cycle(lines)
+        return [json.dumps({"instances": [next(cycle)]}).encode()
+                for _ in range(args.requests)]
+    return [args.payload.encode()] * args.requests
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", required=True)
+    ap.add_argument("--requests", type=int, default=100)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--mode", choices=("async", "sync"), default="async")
+    ap.add_argument("--payload", default='{"instances": ["hello"]}')
+    ap.add_argument("--inputs", default=None,
+                    help="file of prompt lines cycled into payloads")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    args = ap.parse_args(argv)
+
+    payloads = build_payloads(args)
+    if args.mode == "sync":
+        summary = run_sync(args.url, payloads, timeout=args.timeout)
+    else:
+        summary = run_concurrent(args.url, payloads,
+                                 concurrency=args.concurrency,
+                                 timeout=args.timeout)
+    stats = summary.stats()
+    print(json.dumps(stats))
+    return stats
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Sentiment predictor — the ``custom-sentiment`` service, JAX-native.
+
+The reference serves a fastai text learner behind a 25-line ``KFModel``
+(``online-inference/custom-sentiment/custom-predictor/model.py:6-30``):
+``load()`` reads an exported artifact off the PVC, ``predict()`` maps
+``instances`` strings to labeled scores.  Here the artifact is a hashed
+bag-of-words linear classifier — a pure-JAX pytree saved with
+:mod:`kubernetes_cloud_tpu.weights.tensorstream` — because the service
+contract (artifact on PVC → label + confidence per instance) is the
+capability, not fastai.  Training is included so the artifact is
+reproducible end-to-end on CPU in seconds.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import zlib
+from typing import Any, Iterable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_cloud_tpu.serve.model import Model
+
+_TOKEN = re.compile(r"[a-z0-9']+")
+N_BUCKETS = 1 << 16
+LABELS = ("negative", "positive")
+
+
+def featurize(text: str) -> np.ndarray:
+    """Hashed unigram+bigram counts, L2-normalized."""
+    toks = _TOKEN.findall(text.lower())
+    grams = toks + [f"{a}_{b}" for a, b in zip(toks, toks[1:])]
+    vec = np.zeros((N_BUCKETS,), np.float32)
+    for g in grams:
+        # crc32, NOT hash(): Python's hash is salted per process, which
+        # would scramble buckets between the training job and the serving
+        # pod loading the artifact.
+        vec[zlib.crc32(g.encode()) % N_BUCKETS] += 1.0
+    n = np.linalg.norm(vec)
+    return vec / n if n else vec
+
+
+def init_params(rng: jax.Array) -> dict:
+    return {"w": jnp.zeros((N_BUCKETS, len(LABELS)), jnp.float32),
+            "b": jnp.zeros((len(LABELS),), jnp.float32)}
+
+
+def train(texts: Iterable[str], labels: Iterable[int], *,
+          epochs: int = 20, lr: float = 1.0) -> dict:
+    """Full-batch logistic regression (the corpus is small by design)."""
+    x = jnp.asarray(np.stack([featurize(t) for t in texts]))
+    y = jnp.asarray(np.asarray(list(labels), np.int32))
+    params = init_params(jax.random.key(0))
+
+    @jax.jit
+    def step(params):
+        def loss(p):
+            logits = x @ p["w"] + p["b"]
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+        g = jax.grad(loss)(params)
+        return jax.tree.map(lambda p, gi: p - lr * gi, params, g)
+
+    for _ in range(epochs):
+        params = step(params)
+    return params
+
+
+class SentimentModel(Model):
+    """``{"instances": ["text", ...]}`` → label + probability each."""
+
+    def __init__(self, name: str = "sentiment",
+                 artifact_dir: str = "/mnt/model"):
+        super().__init__(name)
+        self.artifact_dir = artifact_dir
+        self.params: dict | None = None
+
+    def load(self) -> None:
+        from kubernetes_cloud_tpu.weights.tensorstream import load_pytree
+
+        path = os.path.join(self.artifact_dir, "sentiment.tensors")
+        self.params = load_pytree(path)
+        self.ready = True
+
+    def save(self, params: dict) -> str:
+        from kubernetes_cloud_tpu.weights.tensorstream import write_pytree
+
+        os.makedirs(self.artifact_dir, exist_ok=True)
+        path = os.path.join(self.artifact_dir, "sentiment.tensors")
+        write_pytree(path, params)
+        return path
+
+    def predict(self, payload: Mapping[str, Any]) -> dict:
+        texts = payload.get("instances")
+        if not isinstance(texts, list):
+            raise ValueError('payload needs {"instances": [text, ...]}')
+        x = jnp.asarray(np.stack([featurize(t) for t in texts]))
+        probs = jax.nn.softmax(x @ self.params["w"] + self.params["b"])
+        probs = np.asarray(probs)
+        out = []
+        for row in probs:
+            idx = int(np.argmax(row))
+            out.append({"label": LABELS[idx],
+                        "score": float(row[idx])})
+        return {"predictions": out}

@@ -1,0 +1,135 @@
+"""Transformer sidecars — KServe's pre/post-processing containers.
+
+The reference splits encode/decode out of the predictor into a separate
+"transformer" pod on the KServe data plane: the GPT-2 service BPE-encodes
+text before TF-Serving and decodes logits after
+(``online-inference/gpt-2/transformer/transformer.py:16-20``), and the
+image classifier turns b64/URL images into tensors and argmax outputs into
+ImageNet labels (``online-inference/image-classifier/transformer/
+transformer.py:25-48``).  Same split here: a transformer is itself a
+:class:`~kubernetes_cloud_tpu.serve.model.Model` served by
+:class:`~kubernetes_cloud_tpu.serve.server.ModelServer`, forwarding the
+transformed payload to ``predictor_host`` over the V1 protocol.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import urllib.request
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+from kubernetes_cloud_tpu.serve.model import Model
+
+
+class TransformerModel(Model):
+    """preprocess → POST predictor_host/v1/models/<name>:predict →
+    postprocess."""
+
+    def __init__(self, name: str, predictor_host: str, *,
+                 timeout: float = 300.0):
+        super().__init__(name)
+        self.predictor_host = predictor_host.rstrip("/")
+        self.timeout = timeout
+
+    def load(self) -> None:
+        self.ready = True
+
+    def preprocess(self, payload: Mapping[str, Any]) -> dict:
+        return dict(payload)
+
+    def postprocess(self, response: Mapping[str, Any]) -> dict:
+        return dict(response)
+
+    def _forward(self, payload: dict) -> dict:
+        from kubernetes_cloud_tpu.serve.clients import predict
+
+        host = (self.predictor_host if "://" in self.predictor_host
+                else f"http://{self.predictor_host}")
+        return predict(f"{host}/v1/models/{self.name}:predict", payload,
+                       timeout=self.timeout)
+
+    def predict(self, payload: Mapping[str, Any]) -> dict:
+        return self.postprocess(self._forward(self.preprocess(payload)))
+
+
+class TextBPETransformer(TransformerModel):
+    """GPT-2-style text sidecar: BPE-encode ``instances`` strings to token
+    ids, decode predicted token ids back to text (reference
+    ``gpt-2/transformer/transformer.py``)."""
+
+    def __init__(self, name: str, predictor_host: str, *,
+                 codec=None, codec_dir: Optional[str] = None, **kw):
+        super().__init__(name, predictor_host, **kw)
+        if codec is None:
+            from kubernetes_cloud_tpu.serve.bpe import BPECodec
+
+            if codec_dir is None:
+                raise ValueError("need codec or codec_dir")
+            codec = BPECodec.from_dir(codec_dir)
+        self.codec = codec
+
+    def preprocess(self, payload: Mapping[str, Any]) -> dict:
+        return {"instances": [self.codec.encode(t)
+                              for t in payload.get("instances", [])]}
+
+    def postprocess(self, response: Mapping[str, Any]) -> dict:
+        return {"predictions": [self.codec.decode(ids)
+                                for ids in response.get("predictions", [])]}
+
+
+#: ImageNet class-id → human label; loaded lazily from a JSON mapping file
+#: (the reference ships ``imagenet_classes.json`` in its transformer image).
+def load_class_map(path: str) -> dict[int, str]:
+    with open(path) as f:
+        raw = json.load(f)
+    if isinstance(raw, list):
+        return dict(enumerate(raw))
+    return {int(k): v for k, v in raw.items()}
+
+
+class ImageTransformer(TransformerModel):
+    """Image sidecar: accepts ``{"instances": [{"image_bytes": {"b64": ..}}
+    | {"image_url": ...}]}``, emits normalized NHWC tensors; postprocess
+    maps argmax (or the predictor's label ids) to class names (reference
+    ``image-classifier/transformer/transformer.py:25-48``)."""
+
+    def __init__(self, name: str, predictor_host: str, *,
+                 image_size: int = 224,
+                 class_map: Optional[dict[int, str]] = None, **kw):
+        super().__init__(name, predictor_host, **kw)
+        self.image_size = image_size
+        self.class_map = class_map or {}
+
+    def _decode_image(self, inst: Mapping[str, Any]) -> np.ndarray:
+        from PIL import Image
+
+        if "image_bytes" in inst:
+            data = base64.b64decode(inst["image_bytes"]["b64"])
+        elif "image_url" in inst:
+            with urllib.request.urlopen(inst["image_url"],
+                                        timeout=self.timeout) as r:
+                data = r.read()
+        else:
+            raise ValueError("instance needs image_bytes.b64 or image_url")
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        # Same transform the eval data path uses — serving preprocessing
+        # must not drift from training-side eval preprocessing.
+        from kubernetes_cloud_tpu.data.images import eval_transform
+
+        return eval_transform(img, self.image_size)
+
+    def preprocess(self, payload: Mapping[str, Any]) -> dict:
+        return {"instances": [self._decode_image(i).tolist()
+                              for i in payload.get("instances", [])]}
+
+    def postprocess(self, response: Mapping[str, Any]) -> dict:
+        out = []
+        for pred in response.get("predictions", []):
+            if isinstance(pred, list):  # raw logits → argmax
+                pred = int(np.argmax(np.asarray(pred)))
+            out.append(self.class_map.get(int(pred), str(pred)))
+        return {"predictions": out}

@@ -34,7 +34,7 @@ from kubernetes_cloud_tpu.models.vision.resnet import (
     ResNetConfig,
     forward,
     loss_fn,
-    topk_accuracy,
+    topk_correct,
 )
 from kubernetes_cloud_tpu.parallel.sharding import shard_batch
 
@@ -135,15 +135,8 @@ def make_eval_step(model_cfg: ResNetConfig, ks: tuple[int, ...] = (1, 5)):
                             state["batch_stats"], train=False)
         labels = batch["label"]
         valid = batch["valid"].astype(jnp.float32)
-        n_classes = logits.shape[-1]
-        maxk = min(max(ks), n_classes)
-        _, pred = jax.lax.top_k(logits, maxk)
-        correct = pred == labels[:, None]
-        out = {
-            f"top{k}": jnp.sum(
-                jnp.any(correct[:, :min(k, n_classes)], axis=1) * valid)
-            for k in ks
-        }
+        out = {k: jnp.sum(v * valid)
+               for k, v in topk_correct(logits, labels, ks).items()}
         logp = jax.nn.log_softmax(logits, -1)
         nll = -jnp.take_along_axis(logp, labels[:, None], -1)[:, 0]
         out["loss"] = jnp.sum(nll * valid)
@@ -160,20 +153,24 @@ def train_epoch(step_fn, state: VisionState, batches: Iterable[dict],
     t0 = time.monotonic()
     n_samples = 0
     n_batches = 0
-    running = 0.0
+    # Losses stay as device arrays until a log point: float() every step
+    # would block on the TPU result before the host starts preparing the
+    # next batch, serializing PIL decode with device compute.
+    losses: list = []
     for batch in batches:
         if mesh is not None:
             batch = shard_batch(batch, mesh)
         state, metrics = step_fn(state, batch)
         n_batches += 1
         n_samples += int(batch["label"].shape[0])
-        running += float(metrics["loss"])
+        losses.append(metrics["loss"])
         if log and n_batches % log_every == 0:
             dt = time.monotonic() - t0
-            log({"train/loss": running / n_batches,
+            log({"train/loss": sum(float(l) for l in losses) / n_batches,
                  "train/accuracy": float(metrics["accuracy"]),
                  "perf/world_samples_per_second": n_samples / dt,
                  "step": n_batches})
+    running = sum(float(l) for l in losses)
     return state, {"loss": running / max(n_batches, 1),
                    "samples_per_second":
                        n_samples / max(time.monotonic() - t0, 1e-9)}

@@ -1,0 +1,185 @@
+"""Transformer sidecars, BPE codec, load-test harness, custom predictors
+(reference ``online-inference/gpt-2``, ``image-classifier``,
+``custom-sentiment``, ``custom-basnet``, ``tensorizer-isvc/benchmark``)."""
+
+import base64
+import io
+import json
+
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.serve.bpe import BPECodec, bytes_to_unicode
+from kubernetes_cloud_tpu.serve.load_test import run_concurrent, run_sync
+from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+
+def make_codec(merges=()):
+    b2u = bytes_to_unicode()
+    vocab = {ch: i for i, ch in enumerate(sorted(b2u.values()))}
+    for a, b in merges:
+        vocab[a + b] = len(vocab)
+    return BPECodec(vocab, list(merges))
+
+
+class TestBPE:
+    def test_roundtrip_bytes_only(self):
+        codec = make_codec()
+        for text in ("hello world", "naïve café ☕", "  spaces\n\ttabs",
+                     "123 mixed UPPER'case", "snake_case_ids", "__dunder__",
+                     "# ## ### markdown", "a_b"):
+            assert codec.decode(codec.encode(text)) == text
+
+    def test_merges_reduce_length(self):
+        plain = make_codec()
+        merged = make_codec(merges=[("h", "e"), ("l", "l"), ("he", "ll")])
+        text = "hello hello"
+        ids_plain = plain.encode(text)
+        ids_merged = merged.encode(text)
+        assert len(ids_merged) < len(ids_plain)
+        assert merged.decode(ids_merged) == text
+
+    def test_from_dir(self, tmp_path):
+        b2u = bytes_to_unicode()
+        vocab = {ch: i for i, ch in enumerate(sorted(b2u.values()))}
+        vocab["he"] = len(vocab)
+        vocab["##"] = len(vocab)
+        (tmp_path / "vocab.json").write_text(json.dumps(vocab))
+        # merge rules whose first symbol is '#' are REAL rules, not
+        # comments; only the #version header is skipped
+        (tmp_path / "merges.txt").write_text("#version: 0.2\nh e\n# #\n")
+        codec = BPECodec.from_dir(str(tmp_path))
+        assert codec.decode(codec.encode("hey")) == "hey"
+        assert len(codec.encode("he")) == 1
+        assert len(codec.encode("##")) == 1
+
+
+class EchoPredictor(Model):
+    """Predictor standing in for the model container behind a sidecar."""
+
+    def predict(self, payload):
+        return {"predictions": payload.get("instances", [])}
+
+
+class ArgmaxPredictor(Model):
+    def predict(self, payload):
+        return {"predictions": [
+            [0.1, 0.9] if np.mean(inst) > 0 else [0.9, 0.1]
+            for inst in payload.get("instances", [])]}
+
+
+@pytest.fixture
+def echo_server():
+    server = ModelServer([EchoPredictor("echo")], host="127.0.0.1", port=0)
+    server.load_all()
+    server.start()
+    yield server
+    server.stop()
+
+
+class TestTransformerSidecar:
+    def test_text_bpe_roundtrip_through_predictor(self, echo_server):
+        from kubernetes_cloud_tpu.serve.transformer import TextBPETransformer
+
+        sidecar = TextBPETransformer(
+            "echo", f"127.0.0.1:{echo_server.port}", codec=make_codec())
+        sidecar.load()
+        out = sidecar.predict({"instances": ["hello world"]})
+        assert out == {"predictions": ["hello world"]}
+
+    def test_image_transformer_b64(self):
+        from PIL import Image
+
+        from kubernetes_cloud_tpu.serve.transformer import ImageTransformer
+
+        server = ModelServer([ArgmaxPredictor("cls")], host="127.0.0.1",
+                             port=0)
+        server.load_all()
+        server.start()
+        try:
+            sidecar = ImageTransformer(
+                "cls", f"127.0.0.1:{server.port}", image_size=16,
+                class_map={0: "dark", 1: "bright"})
+            sidecar.load()
+            buf = io.BytesIO()
+            Image.new("RGB", (32, 32), (255, 255, 255)).save(buf, "PNG")
+            payload = {"instances": [{"image_bytes": {
+                "b64": base64.b64encode(buf.getvalue()).decode()}}]}
+            out = sidecar.predict(payload)
+            assert out["predictions"] == ["bright"]  # white image > mean 0
+        finally:
+            server.stop()
+
+
+class TestLoadTest:
+    def test_sync_and_concurrent_stats(self, echo_server):
+        url = (f"http://127.0.0.1:{echo_server.port}"
+               f"/v1/models/echo:predict")
+        payloads = [json.dumps({"instances": [i]}).encode()
+                    for i in range(12)]
+        for summary in (run_sync(url, payloads),
+                        run_concurrent(url, payloads, concurrency=4)):
+            stats = summary.stats()
+            assert stats["requests"] == 12
+            assert stats["successful"] == 12
+            assert stats["goodput_rps"] == stats["throughput_rps"]
+            assert stats["latency_mean_s"] > 0
+
+    def test_goodput_counts_failures(self, echo_server):
+        url = (f"http://127.0.0.1:{echo_server.port}"
+               f"/v1/models/missing:predict")
+        stats = run_sync(url, [b"{}"] * 3).stats()
+        assert stats["successful"] == 0
+        assert stats["goodput_rps"] == 0
+
+
+class TestSentiment:
+    def test_train_save_load_predict(self, tmp_path):
+        from kubernetes_cloud_tpu.serve.sentiment import (
+            SentimentModel,
+            train,
+        )
+
+        texts = ["great movie loved it", "wonderful fantastic acting",
+                 "best film ever amazing", "terrible waste of time",
+                 "awful boring mess", "worst film ever hated it"]
+        labels = [1, 1, 1, 0, 0, 0]
+        params = train(texts, labels)
+        model = SentimentModel(artifact_dir=str(tmp_path))
+        model.save(params)
+        model.load()
+        out = model.predict(
+            {"instances": ["loved it wonderful", "boring terrible"]})
+        assert out["predictions"][0]["label"] == "positive"
+        assert out["predictions"][1]["label"] == "negative"
+        assert 0.5 < out["predictions"][0]["score"] <= 1.0
+
+
+class TestCutoutClient:
+    def test_composite_alpha(self, tmp_path, echo_server):
+        from PIL import Image
+
+        from kubernetes_cloud_tpu.serve.clients import cutout
+
+        class MaskPredictor(Model):
+            def predict(self, payload):
+                # constant half-transparent 8x8 mask as nested list
+                return {"predictions": [np.full((8, 8), 0.5).tolist()]}
+
+        server = ModelServer([MaskPredictor("basnet")], host="127.0.0.1",
+                             port=0)
+        server.load_all()
+        server.start()
+        try:
+            src = tmp_path / "in.png"
+            Image.new("RGB", (8, 8), (10, 200, 30)).save(src)
+            out = cutout(
+                f"http://127.0.0.1:{server.port}"
+                "/v1/models/basnet:predict",
+                str(src), str(tmp_path / "out.png"))
+            img = Image.open(out)
+            assert img.mode == "RGBA"
+            assert img.getpixel((4, 4))[3] == 127  # 0.5 * 255
+        finally:
+            server.stop()
