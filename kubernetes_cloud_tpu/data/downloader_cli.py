@@ -1,0 +1,168 @@
+"""Artifact downloader Jobs — model / dataset fetch onto the shared PVC.
+
+The reference runs Go binaries as workflow steps: ``model_downloader``
+(HF/diffusers snapshot → PVC, ``finetune-workflow.yaml:184-187,347-351``;
+``--type diffusers`` variant at ``sd-finetune-workflow-template.yaml:229-233``)
+and dataset fetchers (``smashwords-downloader``,
+``finetune-workflow.yaml:192-195``; plain wget steps in
+``gpt-neox/04-finetune-workflow.yaml:306-340``).  These are I/O-bound
+container steps, so Python is the right tool (SURVEY.md §2.2); the
+contract they must honor:
+
+* idempotent — rerunning over a populated dir is a no-op;
+* completion sentinel — ``.ready.txt`` written last, which downstream
+  steps / serving pods poll before touching the artifact
+  (``finetuner.py:1062``, ``bloom.py:79-90``);
+* destination layout — a flat directory consumable by ``from_pretrained``
+  -style loaders or the tokenizer step.
+
+Usage::
+
+    python -m kubernetes_cloud_tpu.data.downloader_cli model \
+        --model EleutherAI/pythia-410m --dest /mnt/pvc/model [--type hf]
+    python -m kubernetes_cloud_tpu.data.downloader_cli dataset \
+        --urls urls.txt --dest /mnt/pvc/dataset
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+READY_SENTINEL = ".ready.txt"
+
+
+def is_ready(dest: str) -> bool:
+    return os.path.exists(os.path.join(dest, READY_SENTINEL))
+
+
+def mark_ready(dest: str) -> None:
+    """Write the completion sentinel LAST (downstream pods poll for it)."""
+    with open(os.path.join(dest, READY_SENTINEL), "w") as f:
+        f.write(str(time.time()))
+
+
+def wait_ready(dest: str, *, timeout: float = 3600.0,
+               poll: float = 5.0) -> bool:
+    """Download-gate poll used by consumers (reference ``bloom.py:79-90``)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if is_ready(dest):
+            return True
+        time.sleep(poll)
+    return False
+
+
+def download_model(model: str, dest: str, *, model_type: str = "hf",
+                   revision: str | None = None,
+                   allow_patterns: list[str] | None = None) -> str:
+    """HF snapshot → flat dir on the PVC.  ``model_type='diffusers'``
+    keeps the pipeline subfolder layout (vae/ unet/ text_encoder/);
+    ``'hf'`` flattens a transformers checkpoint."""
+    if is_ready(dest):
+        print(f"{dest} already ready, skipping")
+        return dest
+    os.makedirs(dest, exist_ok=True)
+    if os.path.isdir(model):
+        # Local path (pre-mounted snapshot): copy is the download.
+        for entry in os.listdir(model):
+            src = os.path.join(model, entry)
+            dst = os.path.join(dest, entry)
+            if os.path.isdir(src):
+                shutil.copytree(src, dst, dirs_exist_ok=True)
+            else:
+                shutil.copy2(src, dst)
+    else:
+        from huggingface_hub import snapshot_download
+
+        patterns = allow_patterns
+        if patterns is None and model_type == "hf":
+            # skip alternate-format weights; JAX import reads safetensors
+            # or torch .bin, never both
+            patterns = ["*.json", "*.txt", "*.model", "*.safetensors",
+                        "tokenizer*", "*.bin"]
+        snapshot_download(model, revision=revision, local_dir=dest,
+                          allow_patterns=patterns)
+    mark_ready(dest)
+    return dest
+
+
+def download_dataset(urls: list[str], dest: str, *,
+                     retries: int = 3) -> str:
+    """Fetch a URL list into ``dest`` (the wget-step / demo-corpus
+    equivalent).  Retries per file mirror the workflow's retryStrategy
+    (``04-finetune-workflow.yaml:315-316``)."""
+    if is_ready(dest):
+        print(f"{dest} already ready, skipping")
+        return dest
+    os.makedirs(dest, exist_ok=True)
+    for url in urls:
+        name = os.path.basename(urllib.parse.urlparse(url).path) or "file"
+        out = os.path.join(dest, name)
+        if os.path.exists(out):
+            continue
+        last_err: Exception | None = None
+        for attempt in range(retries):
+            try:
+                tmp = out + ".tmp"
+                with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
+                    shutil.copyfileobj(r, f)
+                os.replace(tmp, out)
+                last_err = None
+                break
+            except Exception as e:  # noqa: BLE001 - retry any fetch error
+                last_err = e
+                time.sleep(2.0 * (attempt + 1))
+        if last_err is not None:
+            raise RuntimeError(f"failed to fetch {url}: {last_err}")
+    mark_ready(dest)
+    return dest
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("model")
+    m.add_argument("--model", required=True,
+                   help="HF repo id or local snapshot path")
+    m.add_argument("--dest", required=True)
+    m.add_argument("--type", dest="model_type", default="hf",
+                   choices=("hf", "diffusers"))
+    m.add_argument("--revision", default=None)
+
+    d = sub.add_parser("dataset")
+    d.add_argument("--urls", required=True,
+                   help="file with one URL per line, or a single URL")
+    d.add_argument("--dest", required=True)
+    d.add_argument("--retries", type=int, default=3)
+
+    w = sub.add_parser("wait")
+    w.add_argument("--dest", required=True)
+    w.add_argument("--timeout", type=float, default=3600.0)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "model":
+        download_model(args.model, args.dest, model_type=args.model_type,
+                       revision=args.revision)
+    elif args.cmd == "dataset":
+        if os.path.exists(args.urls):
+            with open(args.urls) as f:
+                urls = [ln.strip() for ln in f if ln.strip()]
+        else:
+            urls = [args.urls]
+        download_dataset(urls, args.dest, retries=args.retries)
+    else:
+        if not wait_ready(args.dest, timeout=args.timeout):
+            print(f"timed out waiting for {args.dest}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,17 +18,26 @@ import os
 import re
 from functools import lru_cache
 
-# GPT-2's pre-tokenization pattern.  Python's ``re`` lacks ``\p{L}``; the
-# translation: letters = ``[^\W\d_]`` (unicode \w minus digits minus the
-# underscore \w wrongly includes), "punctuation" = everything that is
-# neither whitespace nor letter nor digit — which INCLUDES '_', hence the
-# explicit ``|_`` in that class.  Round-trips byte-identically because
-# byte-level BPE encodes whatever the splitter yields.
-_PAT = re.compile(
-    r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?(?:[^\s\w]|_)+"
-    r"|\s+(?!\S)|\s+",
-    re.UNICODE,
-)
+# GPT-2's published pre-tokenization pattern, exactly, via the ``regex``
+# module's \p{L}/\p{N} classes (so token boundaries match HF artifacts for
+# all unicode letters/numerics, e.g. '²' is category-N, not a letter).
+# Fallback for stdlib-only environments: letters = ``[^\W\d_]``,
+# "punctuation" = everything neither whitespace nor letter nor digit —
+# including '_', hence the explicit ``|_``.  Both round-trip
+# byte-identically because byte-level BPE encodes whatever the splitter
+# yields; only boundary placement (and thus merge behavior) differs.
+try:
+    import regex as _regex
+
+    _PAT = _regex.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+"
+        r"|\s+(?!\S)|\s+")
+except ImportError:  # pragma: no cover - regex is in the baked image
+    _PAT = re.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?(?:[^\s\w]|_)+"
+        r"|\s+(?!\S)|\s+",
+        re.UNICODE,
+    )
 
 
 @lru_cache(maxsize=1)
@@ -68,7 +77,7 @@ class BPECodec:
         merges = []
         with open(os.path.join(path, "merges.txt")) as f:
             for line in f:
-                line = line.rstrip("\n")
+                line = line.rstrip("\r\n")  # tolerate CRLF merges.txt
                 # Only the '#version' header is a comment; real merge rules
                 # can begin with '#' (e.g. "# #" building the '##' token).
                 if not line or line.startswith("#version"):

@@ -5,8 +5,9 @@ Port of the reference's benchmark
 aiohttp driver, ``:131-176`` stats: requests/sec, goodput = successful
 fraction, mean±stddev latency) with the same two modes:
 
-* ``async`` — ``asyncio`` + aiohttp when available, otherwise a thread
-  pool at the same concurrency (identical stats either way);
+* ``async`` — ``concurrency`` requests in flight at once via a thread
+  pool (same concurrency semantics and stats as the aiohttp original,
+  no third-party dependency);
 * ``sync``  — one request at a time (the reference's ``requests`` loop).
 
 CLI::

@@ -102,17 +102,33 @@ class StableDiffusionService(Model):
               f"({nbytes / dt / 1e6:.1f} MB/s)")
         self.ready = True
 
-    def generate(self, prompt: str, *, height: int, width: int, steps: int,
-                 guidance_scale: float,
-                 seed: Optional[int] = None) -> np.ndarray:
+    def generate_batch(self, prompt: str, *, n_images: int, height: int,
+                       width: int, steps: int, guidance_scale: float,
+                       seed: Optional[int] = None,
+                       mesh=None) -> np.ndarray:
+        """Generate ``n_images`` candidates for one prompt in a single
+        device program.  With ``mesh`` the latent batch is sharded over the
+        ``data`` axis, so N local chips denoise N candidates concurrently —
+        the modern-sharding form of the reference DALL-E service's
+        ``replicate()`` + ``pmap`` + ``shard_prng_key`` generation
+        (``online-inference/dalle-mini/model/service.py:93-109,130-137``)."""
         tokens = jnp.asarray(self._tokenize([prompt, ""]), jnp.int32)
-        ctx = clip_encode(self.clip_cfg, self.clip_params, tokens)
+        ctx2 = clip_encode(self.clip_cfg, self.clip_params, tokens)
+        # [cond]*n then [uncond]*n for the CFG double-batch
+        ctx = jnp.concatenate([
+            jnp.repeat(ctx2[:1], n_images, axis=0),
+            jnp.repeat(ctx2[1:], n_images, axis=0),
+        ])
         factor = 2 ** (len(self.vae_cfg.block_out_channels) - 1)
         rng = jax.random.key(seed if seed not in (None, -1)
                              else int(time.time_ns() % (2 ** 31)))
         z = jax.random.normal(
-            rng, (1, height // factor, width // factor,
+            rng, (n_images, height // factor, width // factor,
                   self.vae_cfg.latent_channels), jnp.float32)
+        if mesh is not None:
+            from kubernetes_cloud_tpu.parallel.sharding import shard_batch
+
+            z = shard_batch(z, mesh)
         n_train = self.sched["betas"].shape[0]
         ts = jnp.linspace(n_train - 1, 0, steps).astype(jnp.int32)
         g = guidance_scale
@@ -124,16 +140,24 @@ class StableDiffusionService(Model):
                                                              steps - 1)], -1)
             zz = jnp.concatenate([z, z])
             out = unet_apply(self.unet_cfg, self.unet_params, zz,
-                             jnp.full((2,), t), ctx)
-            cond, uncond = out[:1], out[1:]
+                             jnp.full((2 * n_images,), t), ctx)
+            cond, uncond = out[:n_images], out[n_images:]
             guided = uncond + g * (cond - uncond)
-            return ddim_step(self.sched, guided, z, jnp.full((1,), t),
-                             jnp.full((1,), t_prev), pred_type)
+            return ddim_step(self.sched, guided, z,
+                             jnp.full((n_images,), t),
+                             jnp.full((n_images,), t_prev), pred_type)
 
         z = jax.lax.fori_loop(0, steps, body, z)
         img = vae_decode(self.vae_cfg, self.vae_params, z)
-        arr = np.asarray(img[0], np.float32)
+        arr = np.asarray(img, np.float32)
         return ((np.clip(arr, -1, 1) + 1) * 127.5).astype(np.uint8)
+
+    def generate(self, prompt: str, *, height: int, width: int, steps: int,
+                 guidance_scale: float,
+                 seed: Optional[int] = None) -> np.ndarray:
+        return self.generate_batch(
+            prompt, n_images=1, height=height, width=width, steps=steps,
+            guidance_scale=guidance_scale, seed=seed)[0]
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
         opts = self.configure_request(payload)

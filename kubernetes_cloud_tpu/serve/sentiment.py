@@ -98,6 +98,8 @@ class SentimentModel(Model):
         texts = payload.get("instances")
         if not isinstance(texts, list):
             raise ValueError('payload needs {"instances": [text, ...]}')
+        if not texts:
+            return {"predictions": []}
         x = jnp.asarray(np.stack([featurize(t) for t in texts]))
         probs = jax.nn.softmax(x @ self.params["w"] + self.params["b"])
         probs = np.asarray(probs)

@@ -155,22 +155,27 @@ def train_epoch(step_fn, state: VisionState, batches: Iterable[dict],
     n_batches = 0
     # Losses stay as device arrays until a log point: float() every step
     # would block on the TPU result before the host starts preparing the
-    # next batch, serializing PIL decode with device compute.
-    losses: list = []
+    # next batch, serializing PIL decode with device compute.  Pending
+    # scalars are drained into a host-side running sum at each log point
+    # (each converted exactly once — O(n) total syncs).
+    pending: list = []
+    running = 0.0
     for batch in batches:
         if mesh is not None:
             batch = shard_batch(batch, mesh)
         state, metrics = step_fn(state, batch)
         n_batches += 1
         n_samples += int(batch["label"].shape[0])
-        losses.append(metrics["loss"])
+        pending.append(metrics["loss"])
         if log and n_batches % log_every == 0:
+            running += sum(float(l) for l in pending)
+            pending.clear()
             dt = time.monotonic() - t0
-            log({"train/loss": sum(float(l) for l in losses) / n_batches,
+            log({"train/loss": running / n_batches,
                  "train/accuracy": float(metrics["accuracy"]),
                  "perf/world_samples_per_second": n_samples / dt,
                  "step": n_batches})
-    running = sum(float(l) for l in losses)
+    running += sum(float(l) for l in pending)
     return state, {"loss": running / max(n_batches, 1),
                    "samples_per_second":
                        n_samples / max(time.monotonic() - t0, 1e-9)}

@@ -1,0 +1,183 @@
+"""Downloader CLI, image-dataset builder, replicated txt2img service
+(reference §2.2 downloader binaries, ``spark/``, ``dalle-mini``)."""
+
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.data.downloader_cli import (
+    download_dataset,
+    download_model,
+    is_ready,
+    main as downloader_main,
+    wait_ready,
+)
+from kubernetes_cloud_tpu.data.image_dataset_builder import (
+    BuilderConfig,
+    build,
+    read_url_list,
+)
+
+
+def _write_png(path, size=48, color=(200, 30, 40)):
+    from PIL import Image
+
+    Image.new("RGB", (size, size), color).save(path)
+    return str(path)
+
+
+class TestDownloader:
+    def test_local_model_copy_and_sentinel(self, tmp_path):
+        src = tmp_path / "snapshot"
+        (src / "sub").mkdir(parents=True)
+        (src / "config.json").write_text("{}")
+        (src / "sub" / "w.bin").write_bytes(b"\x00" * 8)
+        dest = tmp_path / "dest"
+        download_model(str(src), str(dest))
+        assert (dest / "config.json").exists()
+        assert (dest / "sub" / "w.bin").exists()
+        assert is_ready(str(dest))
+        # idempotent rerun
+        download_model(str(src), str(dest))
+
+    def test_dataset_file_urls_and_retry_failure(self, tmp_path):
+        corpus = tmp_path / "c.txt"
+        corpus.write_text("hello corpus")
+        dest = tmp_path / "ds"
+        download_dataset([corpus.as_uri()], str(dest))
+        assert (dest / "c.txt").read_text() == "hello corpus"
+        assert is_ready(str(dest))
+
+        dest2 = tmp_path / "ds2"
+        with pytest.raises(RuntimeError):
+            download_dataset([(tmp_path / "missing.txt").as_uri()],
+                             str(dest2), retries=1)
+        assert not is_ready(str(dest2))
+
+    def test_wait_ready(self, tmp_path):
+        dest = tmp_path / "w"
+        dest.mkdir()
+        assert not wait_ready(str(dest), timeout=0.2, poll=0.05)
+        (dest / ".ready.txt").write_text("1")
+        assert wait_ready(str(dest), timeout=0.2, poll=0.05)
+
+    def test_cli_entry(self, tmp_path):
+        src = tmp_path / "m"
+        src.mkdir()
+        (src / "config.json").write_text("{}")
+        rc = downloader_main(["model", "--model", str(src),
+                              "--dest", str(tmp_path / "out")])
+        assert rc == 0
+        assert is_ready(str(tmp_path / "out"))
+
+
+class TestImageDatasetBuilder:
+    def _url_list(self, tmp_path, n=5, broken=1):
+        paths = [_write_png(tmp_path / f"img{i}.png",
+                            color=(i * 40 % 255, 10, 10))
+                 for i in range(n)]
+        paths += [str(tmp_path / "nope.png")] * broken
+        listfile = tmp_path / "urls.tsv"
+        listfile.write_text(
+            "url\tcaption\n"
+            + "".join(f"{p}\tcaption {i}\n" for i, p in enumerate(paths)))
+        return str(listfile), n, broken
+
+    def test_read_url_list(self, tmp_path):
+        listfile, n, broken = self._url_list(tmp_path)
+        rows = read_url_list(listfile)
+        assert len(rows) == n + broken
+        assert rows[0][1] == "caption 0"
+
+    def test_build_shards_and_stats(self, tmp_path):
+        listfile, n, broken = self._url_list(tmp_path)
+        out = tmp_path / "wds"
+        cfg = BuilderConfig(image_size=32, shard_size=3, workers=4)
+        stats = build(listfile, str(out), cfg)
+        assert stats["success"] == n
+        assert stats["failed"] == broken
+        assert stats["shards"] == 2  # 5 ok samples, 3 per shard
+
+        tars = sorted(f for f in os.listdir(out) if f.endswith(".tar"))
+        assert len(tars) == 2
+        with tarfile.open(out / tars[0]) as tf:
+            names = tf.getnames()
+            keys = {n.split(".")[0] for n in names}
+            for k in keys:
+                assert {f"{k}.jpg", f"{k}.txt", f"{k}.json"} <= set(names)
+            meta = json.loads(
+                tf.extractfile(f"{sorted(keys)[0]}.json").read())
+            assert meta["status"] == "success"
+            assert meta["width"] == 32
+        assert (out / "stats-000.json").exists()
+
+    def test_slicing_partitions_work(self, tmp_path):
+        listfile, n, broken = self._url_list(tmp_path, n=6, broken=0)
+        s0 = build(listfile, str(tmp_path / "s0"),
+                   BuilderConfig(image_size=16, workers=2),
+                   slice_index=0, slice_count=2)
+        s1 = build(listfile, str(tmp_path / "s1"),
+                   BuilderConfig(image_size=16, workers=2),
+                   slice_index=1, slice_count=2)
+        assert s0["total"] + s1["total"] == 6
+        assert s0["success"] + s1["success"] == 6
+
+
+class TestReplicatedService:
+    def test_multi_candidate_generation(self, tmp_path, devices8):
+        from tests.test_diffusion import (
+            TINY_CLIP,
+            TINY_UNET,
+            TINY_VAE,
+            _write_images,
+        )
+        from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+        from kubernetes_cloud_tpu.data.diffusion import (
+            LocalBase,
+            collate_images,
+        )
+        from kubernetes_cloud_tpu.train.sd_trainer import (
+            SDTrainerConfig,
+            StableDiffusionTrainer,
+        )
+
+        root = _write_images(tmp_path)
+        ds = LocalBase(root, size=32, ucg=0.0, seed=0)
+        mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+        trainer = StableDiffusionTrainer(
+            SDTrainerConfig(run_name="rep", output_path=str(tmp_path),
+                            batch_size=2, lr=1e-4, epochs=1, save_steps=0,
+                            image_log_steps=0, resolution=32, use_ema=False,
+                            logs=str(tmp_path / "logs")),
+            mesh, ds, collate_images,
+            unet_cfg=TINY_UNET, vae_cfg=TINY_VAE, clip_cfg=TINY_CLIP)
+        trainer.train()
+
+        from kubernetes_cloud_tpu.serve.replicated import (
+            ReplicatedTxt2ImgService,
+        )
+
+        svc = ReplicatedTxt2ImgService(
+            "dalle", os.path.join(str(tmp_path), "results-rep", "final"),
+            devices=devices8[:4])
+        svc.load()
+        assert svc.n_devices == 4
+        out = svc.predict({
+            "instances": [{"prompt": "four candidates"}],
+            "parameters": {"height": 32, "width": 32,
+                           "num_inference_steps": 2, "seed": 3},
+        })
+        assert len(out["predictions"]) == 4  # one per device by default
+
+        out3 = svc.predict({
+            "instances": [{"prompt": "trimmed"}],
+            "parameters": {"num_predictions": 3, "height": 32, "width": 32,
+                           "num_inference_steps": 2, "seed": 3},
+        })
+        assert len(out3["predictions"]) == 3
+        # candidates differ (independent latents)
+        imgs = {p["image_b64"] for p in out3["predictions"]}
+        assert len(imgs) == 3
