@@ -1,0 +1,132 @@
+#!/usr/bin/env bash
+# Workstation bootstrap for the TPU framework's GKE clusters.
+#
+# The reference ships a Windows PowerShell installer that fetches
+# kubectl/virtctl/helm and merges the downloaded kubeconfig
+# (getting-started/k8ctl_setup.ps1).  This is the equivalent for the
+# GKE-TPU stack: kubectl + helm + the gke-gcloud-auth-plugin, plus
+# kubeconfig merge for a named cluster.
+#
+# Usage:
+#   ./setup.sh install                 # install missing tools to ~/.local/bin
+#   ./setup.sh kubeconfig CLUSTER ZONE # merge GKE credentials
+#   ./setup.sh verify                  # print tool + cluster status
+set -euo pipefail
+
+BIN_DIR="${BIN_DIR:-$HOME/.local/bin}"
+KUBECTL_VERSION="${KUBECTL_VERSION:-stable}"
+HELM_VERSION="${HELM_VERSION:-v3.15.2}"
+
+say() { printf '>>> %s\n' "$*"; }
+
+arch() {
+  case "$(uname -m)" in
+    x86_64) echo amd64 ;;
+    aarch64 | arm64) echo arm64 ;;
+    *) echo "unsupported arch $(uname -m)" >&2; exit 1 ;;
+  esac
+}
+
+os() {
+  case "$(uname -s)" in
+    Linux) echo linux ;;
+    Darwin) echo darwin ;;
+    *) echo "unsupported OS $(uname -s)" >&2; exit 1 ;;
+  esac
+}
+
+install_kubectl() {
+  if command -v kubectl >/dev/null; then
+    say "kubectl already installed: $(command -v kubectl)"
+    return
+  fi
+  local ver="$KUBECTL_VERSION"
+  if [ "$ver" = stable ]; then
+    ver="$(curl -fsSL https://dl.k8s.io/release/stable.txt)"
+  fi
+  say "installing kubectl $ver -> $BIN_DIR"
+  mkdir -p "$BIN_DIR"
+  curl -fsSL "https://dl.k8s.io/release/${ver}/bin/$(os)/$(arch)/kubectl" \
+    -o "$BIN_DIR/kubectl"
+  chmod +x "$BIN_DIR/kubectl"
+}
+
+install_helm() {
+  if command -v helm >/dev/null; then
+    say "helm already installed: $(command -v helm)"
+    return
+  fi
+  say "installing helm $HELM_VERSION -> $BIN_DIR"
+  mkdir -p "$BIN_DIR"
+  local tmp
+  tmp="$(mktemp -d)"
+  curl -fsSL \
+    "https://get.helm.sh/helm-${HELM_VERSION}-$(os)-$(arch).tar.gz" |
+    tar -xz -C "$tmp"
+  mv "$tmp/$(os)-$(arch)/helm" "$BIN_DIR/helm"
+  rm -rf "$tmp"
+}
+
+install_gke_auth_plugin() {
+  if command -v gke-gcloud-auth-plugin >/dev/null; then
+    say "gke-gcloud-auth-plugin already installed"
+    return
+  fi
+  if command -v gcloud >/dev/null; then
+    say "installing gke-gcloud-auth-plugin via gcloud components"
+    gcloud components install gke-gcloud-auth-plugin --quiet
+  else
+    say "gcloud not found: install the Google Cloud SDK first" \
+        "(https://cloud.google.com/sdk/docs/install)"
+  fi
+}
+
+merge_kubeconfig() {
+  local cluster="$1" zone="$2"
+  command -v gcloud >/dev/null || {
+    echo "gcloud required for kubeconfig merge" >&2; exit 1; }
+  say "merging kubeconfig for cluster $cluster ($zone)"
+  gcloud container clusters get-credentials "$cluster" --zone "$zone"
+  kubectl config current-context
+}
+
+verify() {
+  for tool in kubectl helm gke-gcloud-auth-plugin gcloud; do
+    if command -v "$tool" >/dev/null; then
+      say "$tool: $(command -v "$tool")"
+    else
+      say "$tool: MISSING"
+    fi
+  done
+  if command -v kubectl >/dev/null && kubectl version --client >/dev/null 2>&1; then
+    say "kubectl client: $(kubectl version --client 2>/dev/null | head -1)"
+  fi
+  if kubectl get nodes >/dev/null 2>&1; then
+    say "cluster reachable; TPU nodepools:"
+    kubectl get nodes \
+      -L cloud.google.com/gke-tpu-accelerator,cloud.google.com/gke-tpu-topology \
+      2>/dev/null | head -20
+  else
+    say "no reachable cluster context (run: $0 kubeconfig CLUSTER ZONE)"
+  fi
+}
+
+case "${1:-}" in
+  install)
+    install_kubectl
+    install_helm
+    install_gke_auth_plugin
+    say "done; ensure $BIN_DIR is on PATH"
+    ;;
+  kubeconfig)
+    [ $# -eq 3 ] || { echo "usage: $0 kubeconfig CLUSTER ZONE" >&2; exit 1; }
+    merge_kubeconfig "$2" "$3"
+    ;;
+  verify)
+    verify
+    ;;
+  *)
+    echo "usage: $0 {install|kubeconfig CLUSTER ZONE|verify}" >&2
+    exit 1
+    ;;
+esac

@@ -1,0 +1,104 @@
+"""Numerical-safety checks and profiling hooks.
+
+The reference has no sanitizer story (SURVEY.md §5.2: safety is
+``containerConcurrency: 1`` + ``NCCL_DEBUG=INFO``) and no profiler
+(§5.1: hand-rolled step timers).  The TPU-native equivalents:
+
+* **checkify** — XLA-compatible runtime checks (NaN, OOB indexing,
+  div-by-zero) compiled *into* the jitted step; the debug-mode analogue
+  of CUDA's compute-sanitizer for a framework whose hot loop is one XLA
+  program.
+* **finite-loss guard** — cheap always-on divergence detection for
+  trainers (the fp16 loss-scale skip logic in the reference's DeepSpeed
+  config guards the same failure class, ``ds_config.json:2-9``).
+* **jax.profiler** — trace context manager + TensorBoard-compatible
+  trace server, replacing ``nvidia-smi`` dumps and wall-clock prints
+  (``finetuner.py:700-711``, ``resnet50_pytorch.py:127-140``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Callable
+
+import jax
+
+# -------------------------------------------------------------------------
+# checkify wrappers
+
+
+def checked(fn: Callable, *, errors=None, jit: bool = True) -> Callable:
+    """Wrap ``fn`` with checkify so NaN production, out-of-bounds gathers
+    and division errors raise instead of silently propagating.  The
+    checks compile into one XLA program (jitted here — the error value
+    must be inspected *outside* the jit boundary, so callers must not
+    re-wrap in ``jax.jit``; pass ``jit=False`` to manage staging and call
+    ``checkify.check_error`` themselves).
+
+    Debug-mode tool: adds overhead, so gate by env
+    (``KCT_DEBUG_CHECKS=1``) in production paths."""
+    from jax.experimental import checkify
+
+    if errors is None:
+        errors = (checkify.float_checks | checkify.index_checks
+                  | checkify.div_checks)
+    cfn = checkify.checkify(fn, errors=errors)
+    if not jit:
+        return cfn
+    jfn = jax.jit(cfn)
+
+    def wrapper(*args, **kwargs):
+        err, out = jfn(*args, **kwargs)
+        checkify.check_error(err)  # host-side raise, outside the program
+        return out
+
+    return wrapper
+
+
+def debug_checks_enabled() -> bool:
+    return os.environ.get("KCT_DEBUG_CHECKS", "").strip() in (
+        "1", "true", "yes", "on")
+
+
+def assert_tree_finite(tree: Any, name: str = "tree") -> None:
+    """Host-side finiteness sweep over a pytree (checkpoint-time guard)."""
+    import jax.numpy as jnp
+
+    bad = []
+
+    def visit(path, leaf):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(leaf))):
+                bad.append(jax.tree_util.keystr(path))
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    if bad:
+        raise FloatingPointError(
+            f"{name} contains non-finite values at: {', '.join(bad[:8])}"
+            + (" ..." if len(bad) > 8 else ""))
+
+
+# -------------------------------------------------------------------------
+# profiling
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a profiler trace viewable in TensorBoard / Perfetto:
+
+        with profile_trace("/tmp/trace"):
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_profiler_server(port: int = 9999) -> None:
+    """On-demand trace server (``jax.profiler.start_server``): connect
+    TensorBoard's profile plugin to ``<pod>:port`` while a job runs."""
+    jax.profiler.start_server(port)

@@ -27,6 +27,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import hashlib
 import os
 import shutil
 import sys
@@ -34,28 +35,14 @@ import time
 import urllib.parse
 import urllib.request
 
-READY_SENTINEL = ".ready.txt"
-
-
-def is_ready(dest: str) -> bool:
-    return os.path.exists(os.path.join(dest, READY_SENTINEL))
-
-
-def mark_ready(dest: str) -> None:
-    """Write the completion sentinel LAST (downstream pods poll for it)."""
-    with open(os.path.join(dest, READY_SENTINEL), "w") as f:
-        f.write(str(time.time()))
-
-
-def wait_ready(dest: str, *, timeout: float = 3600.0,
-               poll: float = 5.0) -> bool:
-    """Download-gate poll used by consumers (reference ``bloom.py:79-90``)."""
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if is_ready(dest):
-            return True
-        time.sleep(poll)
-    return False
+# One sentinel contract, one implementation — shared with the checkpoint
+# layer that serves/trainers already poll.
+from kubernetes_cloud_tpu.weights.checkpoint import (  # noqa: F401
+    READY_SENTINEL,
+    is_ready,
+    mark_ready,
+    wait_ready,
+)
 
 
 def download_model(model: str, dest: str, *, model_type: str = "hf",
@@ -101,8 +88,15 @@ def download_dataset(urls: list[str], dest: str, *,
         print(f"{dest} already ready, skipping")
         return dest
     os.makedirs(dest, exist_ok=True)
+    seen: dict[str, str] = {}
     for url in urls:
         name = os.path.basename(urllib.parse.urlparse(url).path) or "file"
+        if seen.setdefault(name, url) != url:
+            # Same basename from a different URL: disambiguate rather than
+            # silently skipping (which would mark a truncated corpus ready).
+            digest = hashlib.sha256(url.encode()).hexdigest()[:8]
+            stem, dot, ext = name.partition(".")
+            name = f"{stem}-{digest}{dot}{ext}"
         out = os.path.join(dest, name)
         if os.path.exists(out):
             continue
@@ -158,7 +152,7 @@ def main(argv=None) -> int:
             urls = [args.urls]
         download_dataset(urls, args.dest, retries=args.retries)
     else:
-        if not wait_ready(args.dest, timeout=args.timeout):
+        if not wait_ready(args.dest, args.timeout):
             print(f"timed out waiting for {args.dest}", file=sys.stderr)
             return 1
     return 0

@@ -87,7 +87,7 @@ def read_url_list(path: str, *, url_col: str = "url",
     rows: list[tuple[str, str]] = []
     with open(path, newline="") as f:
         sniff = csv.reader(f, delimiter=delim)
-        header = next(sniff, None)
+        header = next((row for row in sniff if row), None)  # skip blanks
         if header is None:
             return rows
         if url_col in header:

@@ -1,0 +1,2 @@
+from kubernetes_cloud_tpu.deploy.k8s_client import K8sClient  # noqa: F401
+from kubernetes_cloud_tpu.deploy.vsclient import VirtualServerClient  # noqa: F401

@@ -1,0 +1,102 @@
+"""Minimal Kubernetes API client, stdlib-only.
+
+The reference ships VirtualServer CRD clients in five languages
+(``virtual-server/examples/{curl,go,kubectl,nodejs,python}``); its Python
+client wraps the ``kubernetes`` package.  This framework's pods must not
+drag in a client stack for what is a handful of REST verbs, so the client
+is urllib against the API server with the standard credential sources:
+
+* in-cluster: ``/var/run/secrets/kubernetes.io/serviceaccount/{token,ca.crt}``
+  + ``KUBERNETES_SERVICE_HOST/PORT`` (what every reference Job/pod uses
+  implicitly through its serviceAccount);
+* explicit: ``api_server``/``token``/``ca_file`` kwargs (kubeconfig
+  values extracted by the caller).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"k8s api {status}: {body[:500]}")
+        self.status = status
+        self.body = body
+
+
+class K8sClient:
+    def __init__(self, api_server: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 insecure: bool = False):
+        if api_server is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in-cluster and no api_server given")
+            api_server = f"https://{host}:{port}"
+        self.api_server = api_server.rstrip("/")
+        if token is None and os.path.exists(f"{SA_DIR}/token"):
+            with open(f"{SA_DIR}/token") as f:
+                token = f.read().strip()
+        self.token = token
+        if ca_file is None and os.path.exists(f"{SA_DIR}/ca.crt"):
+            ca_file = f"{SA_DIR}/ca.crt"
+        if insecure:
+            self._ctx: Optional[ssl.SSLContext] = ssl._create_unverified_context()  # noqa: S323
+        elif self.api_server.startswith("https"):
+            self._ctx = ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ctx = None
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                content_type: str = "application/json") -> Any:
+        url = f"{self.api_server}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx) as resp:
+                raw = resp.read()
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+        return json.loads(raw) if raw else None
+
+    # -- typed helpers over CRD paths --------------------------------------
+
+    def crd_path(self, group: str, version: str, namespace: str,
+                 plural: str, name: Optional[str] = None,
+                 subresource: Optional[str] = None) -> str:
+        p = (f"/apis/{group}/{version}/namespaces/{namespace}/{plural}")
+        if name:
+            p += f"/{name}"
+        if subresource:
+            p += f"/{subresource}"
+        return p
+
+    def get(self, path: str) -> Any:
+        return self.request("GET", path)
+
+    def create(self, path: str, manifest: dict) -> Any:
+        return self.request("POST", path, manifest)
+
+    def delete(self, path: str) -> Any:
+        return self.request("DELETE", path)
+
+    def patch(self, path: str, body: dict) -> Any:
+        return self.request("PATCH", path, body,
+                            content_type="application/merge-patch+json")

@@ -1,0 +1,109 @@
+"""VirtualServer CRD client — create / wait-ready / get-IP / start / stop.
+
+Behavioral parity with the reference's Python client
+(``virtual-server/examples/python/vsclient.py:8-133``: CRUD + ready-wait
+on status conditions + IP extraction) and its KubeVirt start/stop wrapper
+(``kubevirtclient.py``: the ``virtualmachines/<name>/{start,stop}``
+subresource PUTs).  The CRD group/version match the reference's
+``virtualservers.coreweave.com/v1alpha1``
+(``virtual-server/examples/kubectl/virtual-server.yaml:1-2``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from kubernetes_cloud_tpu.deploy.k8s_client import ApiError, K8sClient
+
+GROUP = "virtualservers.coreweave.com"
+VERSION = "v1alpha1"
+PLURAL = "virtualservers"
+
+KUBEVIRT_GROUP = "subresources.kubevirt.io"
+KUBEVIRT_VERSION = "v1"
+
+
+class VirtualServerClient:
+    def __init__(self, client: K8sClient, namespace: str):
+        self.client = client
+        self.namespace = namespace
+
+    def _path(self, name: Optional[str] = None) -> str:
+        return self.client.crd_path(GROUP, VERSION, self.namespace, PLURAL,
+                                    name)
+
+    # -- CRUD (vsclient.py parity) -----------------------------------------
+
+    def create(self, manifest: dict) -> dict:
+        return self.client.create(self._path(), manifest)
+
+    def get(self, name: str) -> dict:
+        return self.client.get(self._path(name))
+
+    def delete(self, name: str) -> Any:
+        return self.client.delete(self._path(name))
+
+    def update(self, name: str, patch: dict) -> dict:
+        return self.client.patch(self._path(name), patch)
+
+    def list(self) -> list[dict]:
+        return self.client.get(self._path()).get("items", [])
+
+    # -- status helpers ----------------------------------------------------
+
+    @staticmethod
+    def _ready_condition(vs: dict) -> Optional[dict]:
+        for cond in (vs.get("status") or {}).get("conditions", []):
+            if cond.get("type") in ("Ready", "VirtualServerReady"):
+                return cond
+        return None
+
+    def is_ready(self, name: str) -> bool:
+        cond = self._ready_condition(self.get(name))
+        return bool(cond and cond.get("status") == "True")
+
+    def wait_ready(self, name: str, *, timeout: float = 600.0,
+                   poll: float = 5.0) -> dict:
+        """Poll until the Ready condition is True; returns the VS object
+        (reference ``vsclient.py`` ready loop)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            vs = self.get(name)
+            cond = self._ready_condition(vs)
+            if cond and cond.get("status") == "True":
+                return vs
+            if time.monotonic() > deadline:
+                reason = cond.get("reason") if cond else "no condition"
+                raise TimeoutError(
+                    f"VirtualServer {name} not ready after {timeout}s "
+                    f"({reason})")
+            time.sleep(poll)
+
+    def get_ip(self, name: str) -> Optional[str]:
+        status = self.get(name).get("status") or {}
+        net = status.get("network") or {}
+        return net.get("externalIP") or net.get("internalIP")
+
+    # -- power (kubevirtclient.py parity) ----------------------------------
+
+    def _vm_subresource(self, name: str, verb: str) -> Any:
+        path = self.client.crd_path(
+            KUBEVIRT_GROUP, KUBEVIRT_VERSION, self.namespace,
+            "virtualmachines", name, verb)
+        return self.client.request("PUT", path)
+
+    def start(self, name: str) -> Any:
+        return self._vm_subresource(name, "start")
+
+    def stop(self, name: str) -> Any:
+        return self._vm_subresource(name, "stop")
+
+    def exists(self, name: str) -> bool:
+        try:
+            self.get(name)
+            return True
+        except ApiError as e:
+            if e.status == 404:
+                return False
+            raise

@@ -15,8 +15,6 @@ Request protocol parity: ``{"instances": [{"prompt": ...}], "parameters":
 
 from __future__ import annotations
 
-import base64
-import io
 import time
 from typing import Any, Mapping
 
@@ -44,9 +42,13 @@ class ReplicatedTxt2ImgService(StableDiffusionService):
         self.n_devices = len(devices)
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
+        from kubernetes_cloud_tpu.serve.sd_service import (
+            extract_prompt,
+            png_predictions,
+        )
+
         opts = self.configure_request(payload)
-        prompt = payload.get("prompt") or (
-            payload.get("instances") or [{}])[0].get("prompt", "")
+        prompt = extract_prompt(payload)
         n = int(opts["NUM_PREDICTIONS"]) or self.n_devices
         # candidate batch must tile the data axis; round up like the
         # reference rounds to whole devices, then trim
@@ -58,16 +60,4 @@ class ReplicatedTxt2ImgService(StableDiffusionService):
             steps=int(opts["NUM_INFERENCE_STEPS"]),
             guidance_scale=float(opts["GUIDANCE_SCALE"]),
             seed=int(opts["SEED"]), mesh=self.mesh)[:n]
-        from PIL import Image
-
-        dt = time.time() - t0
-        preds = []
-        for img in imgs:
-            buf = io.BytesIO()
-            Image.fromarray(img).save(buf, format="PNG")
-            preds.append({
-                "image_b64": base64.b64encode(buf.getvalue()).decode(),
-                "format": "png",
-                "inference_time": dt,
-            })
-        return {"predictions": preds}
+        return {"predictions": png_predictions(imgs, time.time() - t0)}

@@ -37,6 +37,29 @@ from kubernetes_cloud_tpu.serve.model import Model
 from kubernetes_cloud_tpu.weights.tensorstream import load_pytree, read_index
 
 
+def extract_prompt(payload: Mapping[str, Any]) -> str:
+    """Request-protocol prompt extraction shared by all txt2img services."""
+    return payload.get("prompt") or (
+        payload.get("instances") or [{}])[0].get("prompt", "")
+
+
+def png_predictions(imgs, inference_time: float) -> list[dict]:
+    """Encode HWC uint8 images as the b64-PNG prediction records every
+    txt2img service returns."""
+    from PIL import Image
+
+    preds = []
+    for img in imgs:
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        preds.append({
+            "image_b64": base64.b64encode(buf.getvalue()).decode(),
+            "format": "png",
+            "inference_time": inference_time,
+        })
+    return preds
+
+
 def _cfg_from_meta(cls, meta: dict, **drop):
     fields = {f.name for f in dataclasses.fields(cls)}
     raw = {k: v for k, v in dict(meta).items() if k in fields}
@@ -161,22 +184,11 @@ class StableDiffusionService(Model):
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
         opts = self.configure_request(payload)
-        prompt = payload.get("prompt") or (
-            payload.get("instances") or [{}])[0].get("prompt", "")
+        prompt = extract_prompt(payload)
         t0 = time.time()
         img = self.generate(
             prompt, height=int(opts["HEIGHT"]), width=int(opts["WIDTH"]),
             steps=int(opts["NUM_INFERENCE_STEPS"]),
             guidance_scale=float(opts["GUIDANCE_SCALE"]),
             seed=int(opts["SEED"]))
-        from PIL import Image
-
-        buf = io.BytesIO()
-        Image.fromarray(img).save(buf, format="PNG")
-        return {
-            "predictions": [{
-                "image_b64": base64.b64encode(buf.getvalue()).decode(),
-                "format": "png",
-                "inference_time": time.time() - t0,
-            }]
-        }
+        return {"predictions": png_predictions([img], time.time() - t0)}

@@ -193,13 +193,31 @@ class Trainer:
         return int(latest)
 
     def save_checkpoint(self, step: int, force: bool = False) -> None:
+        from kubernetes_cloud_tpu.core.debug import (
+            assert_tree_finite,
+            debug_checks_enabled,
+        )
+
+        if debug_checks_enabled():
+            # Never persist a diverged state (KCT_DEBUG_CHECKS=1): a NaN
+            # checkpoint silently poisons every resume after it.
+            assert_tree_finite(self.state["params"], "params")
         self.checkpointer.save(step, self.state, force=force)
 
     def save_final(self) -> str:
         """``results-<run>/final`` + tokenizer + ``.ready.txt``."""
+        from kubernetes_cloud_tpu.core.debug import (
+            assert_tree_finite,
+            debug_checks_enabled,
+        )
+
         final_dir = os.path.join(self.cfg.run_dir, "final")
         os.makedirs(final_dir, exist_ok=True)
         params_host = jax.device_get(self.state["params"])
+        if debug_checks_enabled():
+            # Same never-publish-NaN guard as save_checkpoint: final/ is
+            # the artifact serving actually loads.
+            assert_tree_finite(params_host, "final params")
         write_pytree(os.path.join(final_dir, "model.tensors"), params_host,
                      meta={"model_config": dataclasses.asdict(
                          dataclasses.replace(self.model_cfg,

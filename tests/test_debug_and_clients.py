@@ -1,0 +1,156 @@
+"""Debug checks (checkify/finite guards), profiler hooks, k8s/VirtualServer
+clients (reference ``virtual-server/examples/python``)."""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_cloud_tpu.core.debug import (
+    assert_tree_finite,
+    checked,
+    profile_trace,
+)
+from kubernetes_cloud_tpu.deploy.k8s_client import ApiError, K8sClient
+from kubernetes_cloud_tpu.deploy.vsclient import VirtualServerClient
+
+
+class TestChecked:
+    def test_nan_raises(self):
+        def f(x):
+            return jnp.log(x)
+
+        cf = checked(f)  # checked() jits internally
+        cf(jnp.ones(3))  # fine
+        with pytest.raises(Exception, match="nan"):
+            cf(-jnp.ones(3))
+
+    def test_oob_raises(self):
+        def f(x, i):
+            return x[i]
+
+        cf = checked(f)
+        assert float(cf(jnp.arange(4.0), 2)) == 2.0
+        with pytest.raises(Exception):
+            cf(jnp.arange(4.0), 17)
+
+    def test_assert_tree_finite(self):
+        ok = {"a": jnp.ones(3), "b": {"c": jnp.zeros(2)}}
+        assert_tree_finite(ok)
+        bad = {"a": jnp.ones(3), "b": {"c": jnp.array([1.0, jnp.nan])}}
+        with pytest.raises(FloatingPointError, match="b.*c"):
+            assert_tree_finite(bad, "state")
+
+    def test_profile_trace_writes(self, tmp_path):
+        with profile_trace(str(tmp_path)):
+            jax.block_until_ready(jnp.ones(8) * 2)
+        # trace directory materialized with at least one event file
+        found = any(f for _, _, fs in os.walk(tmp_path) for f in fs)
+        assert found
+
+
+# -------------------------------------------------------------------------
+# mock API server for the k8s client
+
+
+class _MockK8s(ThreadingHTTPServer):
+    def __init__(self):
+        self.store: dict[str, dict] = {}
+        self.power: list[tuple[str, str]] = []
+        super().__init__(("127.0.0.1", 0), _Handler)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def _reply(self, status, obj):
+        data = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        store = self.server.store
+        if self.path.endswith("/virtualservers"):
+            self._reply(200, {"items": list(store.values())})
+        elif self.path in store:
+            self._reply(200, store[self.path])
+        else:
+            self._reply(404, {"message": "not found"})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        manifest = json.loads(self.rfile.read(n))
+        name = manifest["metadata"]["name"]
+        key = f"{self.path}/{name}"
+        # simulate the controller: ready with an IP on creation
+        manifest["status"] = {
+            "conditions": [{"type": "VirtualServerReady",
+                            "status": "True", "reason": "Running"}],
+            "network": {"internalIP": "10.0.0.7"},
+        }
+        self.server.store[key] = manifest
+        self._reply(201, manifest)
+
+    def do_DELETE(self):
+        if self.server.store.pop(self.path, None) is not None:
+            self._reply(200, {"status": "Success"})
+        else:
+            self._reply(404, {"message": "not found"})
+
+    def do_PUT(self):
+        parts = self.path.rsplit("/", 2)
+        self.server.power.append((parts[-2], parts[-1]))
+        self._reply(202, {"status": "ok"})
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+@pytest.fixture
+def mock_k8s():
+    server = _MockK8s()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server
+    server.shutdown()
+
+
+class TestVirtualServerClient:
+    def _client(self, server):
+        port = server.server_address[1]
+        k8s = K8sClient(api_server=f"http://127.0.0.1:{port}", token="t")
+        return VirtualServerClient(k8s, namespace="tenant-test")
+
+    def test_crud_ready_ip(self, mock_k8s):
+        vs = self._client(mock_k8s)
+        manifest = {
+            "apiVersion": "virtualservers.coreweave.com/v1alpha1",
+            "kind": "VirtualServer",
+            "metadata": {"name": "vs-test"},
+            "spec": {"region": "ORD1"},
+        }
+        assert not vs.exists("vs-test")
+        vs.create(manifest)
+        assert vs.exists("vs-test")
+        ready = vs.wait_ready("vs-test", timeout=2, poll=0.05)
+        assert ready["status"]["conditions"][0]["status"] == "True"
+        assert vs.get_ip("vs-test") == "10.0.0.7"
+        assert [v["metadata"]["name"] for v in vs.list()] == ["vs-test"]
+        vs.delete("vs-test")
+        assert not vs.exists("vs-test")
+
+    def test_power_subresources(self, mock_k8s):
+        vs = self._client(mock_k8s)
+        vs.start("vm-1")
+        vs.stop("vm-1")
+        assert mock_k8s.power == [("vm-1", "start"), ("vm-1", "stop")]
+
+    def test_api_error_status(self, mock_k8s):
+        vs = self._client(mock_k8s)
+        with pytest.raises(ApiError) as ei:
+            vs.get("missing")
+        assert ei.value.status == 404
