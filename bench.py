@@ -35,7 +35,10 @@ BENCH_STEPS = 10
 def main() -> None:
     import dataclasses
 
-    model_cfg = dataclasses.replace(PRESETS["pythia-410m"], remat=True)
+    # attn_out remat policy: saving each block's attention output beats
+    # full recompute by ~4% at this shape (backward never re-runs attn).
+    model_cfg = dataclasses.replace(PRESETS["pythia-410m"], remat=True,
+                                    remat_policy="attn_out")
     train_cfg = TrainConfig(warmup_steps=10, total_steps=1000)
     mesh = build_mesh(MeshSpec())
     state = init_train_state(model_cfg, train_cfg, jax.random.key(0), mesh)

@@ -1,0 +1,163 @@
+// batch_reader — native mmap token-dataset reader with threaded gather.
+//
+// The training hot path reads shuffled rows out of the flat uint16
+// context file (format producer: csrc/dataset_tokenizer; consumer
+// semantics: finetuner-workflow/finetuner/finetuner.py:633-695 — the
+// reference does this per-row in Python through numpy's mmap).  This
+// library does the per-batch work natively and GIL-free:
+//
+//   * mmap + MADV_RANDOM on open (shuffled access pattern);
+//   * br_prefetch: MADV_WILLNEED on the next batch's rows so page-ins
+//     overlap device compute;
+//   * br_gather: N worker threads copy rows, widen uint16 -> int32 and
+//     derive the trailing-pad attention mask in one pass.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread batch_reader.cpp \
+//        -o libbatch_reader.so
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Reader {
+  int fd = -1;
+  const uint16_t* data = nullptr;
+  size_t nbytes = 0;
+  int64_t context_size = 0;
+  int64_t num_rows = 0;
+};
+
+long page_size() {
+  static long ps = sysconf(_SC_PAGESIZE);
+  return ps;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* br_open(const char* path, int64_t context_size) {
+  if (context_size <= 0) return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0 ||
+      st.st_size % (context_size * 2) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  if (map == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  madvise(map, st.st_size, MADV_RANDOM);
+  auto* r = new Reader;
+  r->fd = fd;
+  r->data = static_cast<const uint16_t*>(map);
+  r->nbytes = st.st_size;
+  r->context_size = context_size;
+  r->num_rows = st.st_size / (context_size * 2);
+  return r;
+}
+
+int64_t br_num_rows(const void* h) {
+  return h ? static_cast<const Reader*>(h)->num_rows : -1;
+}
+
+// Copy rows[0..n) into out_ids[n, context_size] (int32) and, when
+// pad_token >= 0, write the trailing-pad attention mask into
+// out_mask[n, context_size] (int32; may be null).  Returns 0 on success,
+// -1 on a bad row index.
+int br_gather(const void* h, const int64_t* rows, int64_t n,
+              int32_t* out_ids, int32_t* out_mask, int32_t pad_token,
+              int n_threads) {
+  const auto* r = static_cast<const Reader*>(h);
+  if (!r) return -1;
+  const int64_t c = r->context_size;
+  std::atomic<bool> ok(true);
+
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const int64_t row = rows[i];
+      if (row < 0 || row >= r->num_rows) {
+        ok.store(false, std::memory_order_relaxed);
+        return;
+      }
+      const uint16_t* src = r->data + row * c;
+      int32_t* dst = out_ids + i * c;
+      for (int64_t j = 0; j < c; ++j) dst[j] = src[j];
+      if (out_mask != nullptr) {
+        int32_t* m = out_mask + i * c;
+        if (pad_token < 0) {
+          for (int64_t j = 0; j < c; ++j) m[j] = 1;
+        } else {
+          // trailing pad run is masked; mid-row pads stay visible
+          int64_t last_real = -1;
+          for (int64_t j = c - 1; j >= 0; --j) {
+            if (src[j] != static_cast<uint16_t>(pad_token)) {
+              last_real = j;
+              break;
+            }
+          }
+          for (int64_t j = 0; j < c; ++j) m[j] = j <= last_real ? 1 : 0;
+        }
+      }
+    }
+  };
+
+  int nt = std::max(1, std::min<int>(n_threads, n));
+  if (nt == 1) {
+    work(0, n);
+  } else {
+    std::vector<std::thread> threads;
+    const int64_t chunk = (n + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      int64_t lo = t * chunk;
+      int64_t hi = std::min<int64_t>(n, lo + chunk);
+      if (lo >= hi) break;
+      threads.emplace_back(work, lo, hi);
+    }
+    for (auto& th : threads) th.join();
+  }
+  return ok.load() ? 0 : -1;
+}
+
+// Advise the kernel to page in the given rows (next batch) while the
+// device crunches the current one.
+void br_prefetch(const void* h, const int64_t* rows, int64_t n) {
+  const auto* r = static_cast<const Reader*>(h);
+  if (!r) return;
+  const long ps = page_size();
+  const int64_t row_bytes = r->context_size * 2;
+  for (int64_t i = 0; i < n; ++i) {
+    if (rows[i] < 0 || rows[i] >= r->num_rows) continue;
+    auto addr = reinterpret_cast<uintptr_t>(r->data) + rows[i] * row_bytes;
+    uintptr_t aligned = addr & ~static_cast<uintptr_t>(ps - 1);
+    size_t len = (addr - aligned) + row_bytes;
+    madvise(reinterpret_cast<void*>(aligned), len, MADV_WILLNEED);
+  }
+}
+
+void br_close(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  if (!r) return;
+  munmap(const_cast<uint16_t*>(r->data), r->nbytes);
+  ::close(r->fd);
+  delete r;
+}
+
+}  // extern "C"

@@ -62,14 +62,24 @@ def debug_checks_enabled() -> bool:
 
 
 def assert_tree_finite(tree: Any, name: str = "tree") -> None:
-    """Host-side finiteness sweep over a pytree (checkpoint-time guard)."""
+    """Finiteness sweep over a pytree (checkpoint-time guard).
+
+    The reduction runs under jit so it also works on globally-sharded
+    multi-host arrays (eager ops on non-fully-addressable arrays raise;
+    a jitted all-reduce yields a replicated scalar every host can read).
+    """
     import jax.numpy as jnp
+
+    @jax.jit
+    def _finite(leaf):
+        return jnp.all(jnp.isfinite(leaf))
 
     bad = []
 
     def visit(path, leaf):
-        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
-            if not bool(jnp.all(jnp.isfinite(leaf))):
+        arr = leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            if not bool(_finite(arr)):
                 bad.append(jax.tree_util.keystr(path))
 
     jax.tree_util.tree_map_with_path(visit, tree)

@@ -49,6 +49,18 @@ class TokenizedDataset:
         self.pad_token = pad_token
         self.tokens = np.memmap(path, dtype=np.uint16, mode="r",
                                 shape=(nbytes // row_bytes, context_size))
+        # Native threaded gather+mask (csrc/batch_reader) when the
+        # toolchain is present; the numpy mmap stays as the fallback and
+        # the per-row __getitem__ path.
+        self._native = None
+        from kubernetes_cloud_tpu.data import native_reader
+
+        if native_reader.available():
+            try:
+                self._native = native_reader.NativeTokenReader(
+                    path, context_size, pad_token)
+            except Exception:  # noqa: BLE001 - any native failure =>
+                self._native = None  # python fallback, never a crash
 
     def __len__(self) -> int:
         return self.tokens.shape[0]
@@ -66,6 +78,17 @@ class TokenizedDataset:
         trailing_pad = np.flip(
             np.logical_and.accumulate(np.flip(is_pad, -1), axis=-1), -1)
         return (~trailing_pad).astype(np.int32)
+
+    def gather(self, rows: np.ndarray) -> dict[str, np.ndarray]:
+        """Batch gather: native (threaded, GIL-free) when available."""
+        if self._native is not None:
+            return self._native.gather(rows)
+        ids = np.asarray(self.tokens[np.asarray(rows)], dtype=np.int32)
+        return {"input_ids": ids, "attention_mask": self.mask_for(ids)}
+
+    def prefetch(self, rows: np.ndarray) -> None:
+        if self._native is not None:
+            self._native.prefetch(rows)
 
     def split(self, train_ratio: float) -> tuple["Slice", "Slice"]:
         """Deterministic train/val split by leading fraction (reference
@@ -87,6 +110,15 @@ class Slice:
                 raise IndexError(idx)
             return self.ds[self.start + int(idx)]
         return self.ds[np.asarray(idx) + self.start]
+
+    def gather(self, rows: np.ndarray) -> dict[str, np.ndarray]:
+        rows = np.asarray(rows)
+        if ((rows < 0) | (rows >= len(self))).any():
+            raise IndexError("slice row index out of range")
+        return self.ds.gather(rows + self.start)
+
+    def prefetch(self, rows: np.ndarray) -> None:
+        self.ds.prefetch(np.asarray(rows) + self.start)
 
 
 def sharded_batches(
@@ -132,12 +164,20 @@ def sharded_batches(
             continue
         start = skip_batches
         skip_batches = 0
+        gather = getattr(dataset, "gather", None)
+        prefetch = getattr(dataset, "prefetch", None)
         for b in range(start, n_full):
             idx = order[b * local_bs:(b + 1) * local_bs]
-            rows = [dataset[int(i)] for i in idx]
-            local = {
-                k: np.stack([r[k] for r in rows]) for k in rows[0]
-            }
+            if gather is not None:
+                local = gather(idx)
+                if prefetch is not None and b + 1 < n_full:
+                    # overlap the next batch's page-ins with device compute
+                    prefetch(order[(b + 1) * local_bs:(b + 2) * local_bs])
+            else:
+                rows = [dataset[int(i)] for i in idx]
+                local = {
+                    k: np.stack([r[k] for r in rows]) for k in rows[0]
+                }
             yield {
                 k: jax.make_array_from_process_local_data(
                     sharding if v.ndim == 2 else

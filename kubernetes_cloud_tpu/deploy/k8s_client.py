@@ -36,7 +36,9 @@ class K8sClient:
     def __init__(self, api_server: Optional[str] = None,
                  token: Optional[str] = None,
                  ca_file: Optional[str] = None,
-                 insecure: bool = False):
+                 insecure: bool = False,
+                 timeout: float = 30.0):
+        self.timeout = timeout
         if api_server is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
@@ -70,7 +72,10 @@ class K8sClient:
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
-            with urllib.request.urlopen(req, context=self._ctx) as resp:
+            # Bounded: a hung apiserver connection must not stall
+            # wait_ready loops past their own deadlines.
+            with urllib.request.urlopen(req, context=self._ctx,
+                                        timeout=self.timeout) as resp:
                 raw = resp.read()
         except urllib.error.HTTPError as e:
             raise ApiError(e.code, e.read().decode(errors="replace")) from e

@@ -68,6 +68,11 @@ class CausalLMConfig:
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False  # rematerialize each block in the backward pass
+    # Remat policy: "nothing" = full recompute (min memory); "attn_out" =
+    # save each block's attention output so the backward pass never
+    # re-runs attention — the right pairing for the flash kernel, whose
+    # custom-vjp backward already does its own internal recompute.
+    remat_policy: str = "nothing"
     # GPT-J uses interleaved (rotate_every_two) rotary channel pairing;
     # NeoX/LLaMA use the half-split convention.
     rope_interleaved: bool = False
@@ -86,6 +91,8 @@ class CausalLMConfig:
     def __post_init__(self):
         if self.attn_impl not in ("auto", "xla", "pallas", "ring"):
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
+        if self.remat_policy not in ("nothing", "attn_out"):
+            raise ValueError(f"unknown remat_policy: {self.remat_policy!r}")
         if self.moe_experts:
             if (self.moe_experts < 0 or self.moe_top_k < 1
                     or self.moe_top_k > self.moe_experts):
@@ -332,6 +339,9 @@ def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
         attn_vec = attention(q, k, v, causal=True, bias=bias, mask=mask,
                              impl="auto" if cfg.attn_impl == "ring"
                              else cfg.attn_impl)
+    from jax.ad_checkpoint import checkpoint_name
+
+    attn_vec = checkpoint_name(attn_vec, "attn_out")
     return _finish_block(cfg, p, x, attn_vec, attn_in, token_mask=mask)
 
 
@@ -401,10 +411,12 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
 
     block = _block
     if cfg.remat:
+        policy = (jax.checkpoint_policies.save_only_these_names("attn_out")
+                  if cfg.remat_policy == "attn_out"
+                  else jax.checkpoint_policies.nothing_saveable)
         # cfg (0) and mesh (6) are static: hashable non-array metadata.
         block = jax.checkpoint(
-            _block, static_argnums=(0, 6),
-            policy=jax.checkpoint_policies.nothing_saveable)
+            _block, static_argnums=(0, 6), policy=policy)
 
     def body(carry, layer_params):
         out, aux = block(cfg, layer_params, carry, rope, bias,

@@ -88,7 +88,7 @@ def attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if impl == "auto":
-        impl = _pick_impl(q, bias, mask)
+        impl = _pick_impl(q, k, bias, mask)
     if impl == "pallas":
         from kubernetes_cloud_tpu.ops import flash_attention
 
@@ -98,16 +98,13 @@ def attention(
     return _mha_xla(q, k, v, causal=causal, bias=bias, mask=mask, scale=scale)
 
 
-def _pick_impl(q, bias, mask) -> str:
-    try:
-        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    except RuntimeError:
-        on_tpu = False
-    if not on_tpu:
-        return "xla"
-    dh = q.shape[-1]
-    if q.shape[1] % 128 or dh % 128 or bias is not None or mask is not None:
-        return "xla"
+def _pick_impl(q, k, bias, mask) -> str:
     from kubernetes_cloud_tpu.ops import flash_attention
 
-    return "pallas" if flash_attention.available() else "xla"
+    if not flash_attention.available():
+        return "xla"
+    if not flash_attention.supports(q, k, bias):
+        return "xla"
+    if mask is not None and mask.ndim != 2:
+        return "xla"  # full [B,1,Sq,Sk] masks stay on the einsum path
+    return "pallas"

@@ -1,0 +1,81 @@
+"""Native C++ batch reader: bit-parity with the Python mmap path and the
+ctypes surface (``csrc/batch_reader``)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.data import native_reader
+from kubernetes_cloud_tpu.data.tokenized import TokenizedDataset
+
+CONTEXT = 64
+PAD = 7
+
+
+@pytest.fixture(scope="module")
+def token_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tokens") / "data.tokens"
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 500, size=(32, CONTEXT)).astype(np.uint16)
+    # rows with trailing pad runs and one mid-row pad
+    rows[3, -10:] = PAD
+    rows[5, -1:] = PAD
+    rows[9, 20] = PAD  # mid-row pad must stay visible
+    rows[9, -4:] = PAD
+    rows.tofile(path)
+    return str(path)
+
+
+def test_available_and_build():
+    assert native_reader.available()  # g++ is in the image
+
+
+def test_parity_with_python_path(token_file):
+    ds = TokenizedDataset(token_file, CONTEXT, pad_token=PAD)
+    assert ds._native is not None
+    idx = np.array([3, 5, 9, 0, 31])
+    native = ds.gather(idx)
+    ids_py = np.asarray(ds.tokens[idx], np.int32)
+    mask_py = ds.mask_for(ids_py)
+    np.testing.assert_array_equal(native["input_ids"], ids_py)
+    np.testing.assert_array_equal(native["attention_mask"], mask_py)
+    # spot-check mask semantics
+    assert native["attention_mask"][0, -10:].sum() == 0  # trailing run
+    assert native["attention_mask"][2, 20] == 1  # mid-row pad visible
+    assert native["attention_mask"][2, -4:].sum() == 0
+
+
+def test_no_pad_token_all_ones(token_file):
+    r = native_reader.NativeTokenReader(token_file, CONTEXT, None)
+    out = r.gather(np.arange(4))
+    assert out["attention_mask"].min() == 1
+    r.close()
+
+
+def test_oob_row_raises(token_file):
+    r = native_reader.NativeTokenReader(token_file, CONTEXT, PAD)
+    with pytest.raises(IndexError):
+        r.gather(np.array([0, 99]))
+    r.close()
+
+
+def test_prefetch_noop_safe(token_file):
+    r = native_reader.NativeTokenReader(token_file, CONTEXT, PAD)
+    r.prefetch(np.array([0, 5, 31, 100]))  # oob rows silently skipped
+    r.close()
+
+
+def test_bad_file_rejected(tmp_path):
+    bad = tmp_path / "bad.tokens"
+    bad.write_bytes(b"\x01\x02\x03")  # not a whole number of rows
+    with pytest.raises(OSError):
+        native_reader.NativeTokenReader(str(bad), CONTEXT, PAD)
+
+
+def test_slice_gather_offsets(token_file):
+    ds = TokenizedDataset(token_file, CONTEXT, pad_token=PAD)
+    lo, hi = ds.split(0.5)
+    got = hi.gather(np.array([0, 1]))
+    want = ds.gather(np.array([16, 17]))
+    np.testing.assert_array_equal(got["input_ids"], want["input_ids"])
+    with pytest.raises(IndexError):
+        hi.gather(np.array([16]))
