@@ -1,0 +1,232 @@
+"""Dynamic request batching — the Triton scheduler capability.
+
+The reference serves GPT-J/NeoX through Triton's C++ dynamic batcher +
+the FasterTransformer backend, configured by ``config.pbtxt``
+(``online-inference/fastertransformer/download-weights-job-gptj.yml``:
+``max_batch_size``, ``dynamic_batching``, per-model instance groups).
+The TPU equivalent: requests queue on the HTTP threads; a single
+dispatcher thread drains the queue, coalesces up to ``max_batch_size``
+instances (waiting at most ``max_queue_delay_us`` for stragglers — same
+knob names as config.pbtxt), runs ONE batched device program, and
+scatters results back to the waiting requests.
+
+Why this shape on TPU: one XLA program at batch N is far cheaper than N
+programs at batch 1 (the MXU is depth-loaded), and a single dispatcher
+matches the one-program-at-a-time device semantics that
+``containerConcurrency``-style locks otherwise enforce.
+
+Config file parity: :func:`load_model_config` reads the same fields from
+a JSON rendering of config.pbtxt (``model_config.json``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import queue
+import threading
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+from kubernetes_cloud_tpu.serve.model import Model
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherConfig:
+    """config.pbtxt-equivalent knobs (names kept)."""
+
+    max_batch_size: int = 8
+    max_queue_delay_us: int = 5000  # dynamic_batching.max_queue_delay_...
+    max_queue_size: int = 256
+
+    def __post_init__(self):
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+
+
+def load_model_config(model_dir: str) -> BatcherConfig:
+    """Read ``model_config.json`` (the config.pbtxt analogue) if present."""
+    path = os.path.join(model_dir, "model_config.json")
+    if not os.path.exists(path):
+        return BatcherConfig()
+    with open(path) as f:
+        raw = json.load(f)
+    dyn = raw.get("dynamic_batching") or {}
+    return BatcherConfig(
+        max_batch_size=int(raw.get("max_batch_size", 8)),
+        max_queue_delay_us=int(dyn.get("max_queue_delay_microseconds",
+                                       5000)),
+        max_queue_size=int(dyn.get("max_queue_size", 256)),
+    )
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the request queue is at max_queue_size.  Mapped to
+    HTTP 503 by the server so clients/autoscalers can retry, unlike a
+    real fault's 500."""
+
+
+class _Pending:
+    __slots__ = ("instances", "params", "event", "result", "error",
+                 "claimed")
+
+    def __init__(self, instances: Sequence[Any], params: Mapping[str, Any]):
+        self.instances = list(instances)
+        self.params = dict(params)
+        self.event = threading.Event()
+        self.result: Optional[list] = None
+        self.error: Optional[Exception] = None
+        #: set by the dispatcher when dequeued — a claimed request's batch
+        #: WILL complete (and set event), even across stop()
+        self.claimed = False
+
+
+class BatchingModel(Model):
+    """Wrap a ``predict_batch(instances, params) -> list`` callable (or an
+    inner Model) with dynamic batching.  Serve it with
+    :class:`~kubernetes_cloud_tpu.serve.server.ModelServer` like any other
+    model; the ``self_batching`` class attribute below makes the server
+    skip its per-model request lock automatically (the dispatcher thread
+    serializes device access itself — a lock would prevent requests from
+    ever being concurrent enough to coalesce)."""
+
+    #: ModelServer checks this attribute to skip its per-model lock.
+    self_batching = True
+
+    def __init__(self, name: str, inner: Model | Callable,
+                 cfg: BatcherConfig = BatcherConfig()):
+        super().__init__(name)
+        self.cfg = cfg
+        self.inner = inner
+        self._queue: "queue.Queue[_Pending]" = queue.Queue(
+            maxsize=cfg.max_queue_size)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # batching telemetry (the Triton metrics a load test reads)
+        self.stats = {"requests": 0, "batches": 0, "batched_instances": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def load(self) -> None:
+        if isinstance(self.inner, Model) and not self.inner.ready:
+            self.inner.load()
+        self._stop.clear()  # support stop() -> load() restart
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        daemon=True,
+                                        name=f"batcher-{self.name}")
+        self._thread.start()
+        self.ready = True
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.ready = False
+
+    # -- request side ------------------------------------------------------
+
+    def predict(self, payload: Mapping[str, Any]) -> dict:
+        instances = payload.get("instances")
+        if not isinstance(instances, list) or not instances:
+            raise ValueError(
+                'payload needs a non-empty {"instances": [...]}')
+        if len(instances) > self.cfg.max_batch_size:
+            raise ValueError(
+                f"request carries {len(instances)} instances > "
+                f"max_batch_size {self.cfg.max_batch_size}")
+        if self._stop.is_set() or not self.ready:
+            raise RuntimeError("batcher stopped")
+        pending = _Pending(instances, payload.get("parameters") or {})
+        try:
+            self._queue.put_nowait(pending)
+        except queue.Full:
+            raise QueueFullError("request queue full") from None
+        # Bounded wait re-checking for shutdown: a request enqueued in the
+        # race window after the dispatcher's final drain must not hang.
+        # A CLAIMED request's batch is already executing and will finish
+        # (its event always gets set), so only unclaimed waiters bail.
+        while not pending.event.wait(timeout=0.5):
+            if (self._stop.is_set() and not pending.claimed
+                    and not pending.event.is_set()):
+                raise RuntimeError("batcher stopped")
+        if pending.error is not None:
+            raise pending.error
+        return {"predictions": pending.result}
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _run_inner(self, instances: list, params: Mapping[str, Any]) -> list:
+        if isinstance(self.inner, Model):
+            out = self.inner.predict(
+                {"instances": instances, "parameters": dict(params)})
+            return list(out["predictions"])
+        return list(self.inner(instances, params))
+
+    def _dispatch_loop(self) -> None:
+        delay_s = self.cfg.max_queue_delay_us / 1e6
+        held: Optional[_Pending] = None  # request that didn't fit/merge
+        while not self._stop.is_set():
+            if held is not None:
+                first, held = held, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+            first.claimed = True
+            batch = [first]
+            total = len(first.instances)
+            # coalesce: wait up to max_queue_delay for stragglers, while
+            # respecting max_batch_size and only merging compatible
+            # (same-parameters) requests — Triton's batching rule.
+            deadline = delay_s
+            while total < self.cfg.max_batch_size:
+                try:
+                    nxt = self._queue.get(timeout=deadline)
+                except queue.Empty:
+                    break
+                nxt.claimed = True
+                if (nxt.params != first.params
+                        or total + len(nxt.instances)
+                        > self.cfg.max_batch_size):
+                    held = nxt  # seeds the next batch
+                    break
+                batch.append(nxt)
+                total += len(nxt.instances)
+                deadline = 0  # drain whatever is already queued
+            self._execute(batch)
+        # drain on shutdown: fail pending requests rather than hang them
+        leftovers = [held] if held is not None else []
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for p in leftovers:
+            p.error = RuntimeError("batcher stopped")
+            p.event.set()
+
+    def _execute(self, batch: list[_Pending]) -> None:
+        instances = [x for p in batch for x in p.instances]
+        self.stats["requests"] += len(batch)
+        self.stats["batches"] += 1
+        self.stats["batched_instances"] += len(instances)
+        try:
+            results = self._run_inner(instances, batch[0].params)
+            if len(results) != len(instances):
+                raise RuntimeError(
+                    f"inner model returned {len(results)} predictions "
+                    f"for {len(instances)} instances")
+            i = 0
+            for p in batch:
+                p.result = results[i:i + len(p.instances)]
+                i += len(p.instances)
+        except Exception as e:  # noqa: BLE001 - propagate per request
+            for p in batch:
+                p.error = e
+        finally:
+            for p in batch:
+                p.event.set()

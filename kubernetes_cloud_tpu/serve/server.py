@@ -16,7 +16,9 @@ the exact REST surface the reference's InferenceServices expose
 Concurrency: one lock per model — the reference's GPU services run with
 ``containerConcurrency: 1`` (``stable-diffusion/03-inference-service.yaml:7``)
 and a single TPU program likewise shouldn't interleave requests; Knative
-provides scale-out.
+provides scale-out.  Models that set ``self_batching = True`` (the
+dynamic batcher, :mod:`kubernetes_cloud_tpu.serve.batcher`) bypass the
+lock: they coalesce concurrent requests themselves.
 """
 
 from __future__ import annotations
@@ -81,11 +83,19 @@ class ModelServer:
             return 404, {"error": f"model {name} not found"}
         if not model.ready:
             return 503, {"error": f"model {name} is not ready"}
+        from kubernetes_cloud_tpu.serve.batcher import QueueFullError
+
         try:
+            if getattr(model, "self_batching", False):
+                # dynamic batchers coalesce concurrent requests; the
+                # per-model lock would serialize them and defeat batching
+                return 200, model.predict(payload)
             with self.locks[name]:
                 return 200, model.predict(payload)
         except ValueError as e:  # request validation problems
             return 400, {"error": str(e)}
+        except QueueFullError as e:  # backpressure: retriable overload
+            return 503, {"error": str(e)}
         except Exception as e:  # surface as a 500, keep serving
             log.exception("predict failed")
             return 500, {"error": str(e)}

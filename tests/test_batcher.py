@@ -1,0 +1,193 @@
+"""Dynamic batching front-end (reference: Triton's dynamic_batching for
+FasterTransformer, ``online-inference/fastertransformer``)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_cloud_tpu.serve.batcher import (
+    BatcherConfig,
+    BatchingModel,
+    load_model_config,
+)
+from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+
+class RecordingModel(Model):
+    """Echoes instances; records batch sizes and per-call parameters."""
+
+    def __init__(self, name="inner", delay=0.0):
+        super().__init__(name)
+        self.batch_sizes = []
+        self.call_params = []
+        self.delay = delay
+
+    def predict(self, payload):
+        insts = payload["instances"]
+        self.batch_sizes.append(len(insts))
+        self.call_params.append(dict(payload.get("parameters") or {}))
+        if self.delay:
+            time.sleep(self.delay)
+        return {"predictions": [f"out:{x}" for x in insts]}
+
+
+def make(cfg=None, **inner_kw):
+    inner = RecordingModel(**inner_kw)
+    m = BatchingModel("lm", inner, cfg or BatcherConfig(
+        max_batch_size=4, max_queue_delay_us=20_000))
+    m.load()
+    return m, inner
+
+
+def test_single_request_roundtrip():
+    m, inner = make()
+    try:
+        out = m.predict({"instances": ["a", "b"]})
+        assert out == {"predictions": ["out:a", "out:b"]}
+        assert inner.batch_sizes == [2]
+    finally:
+        m.stop()
+
+
+def test_concurrent_requests_coalesce():
+    m, inner = make(delay=0.01)
+    try:
+        results = {}
+
+        def call(i):
+            results[i] = m.predict({"instances": [f"r{i}"]})
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(8):
+            assert results[i]["predictions"] == [f"out:r{i}"]
+        # 8 single-instance requests must have been served in fewer than
+        # 8 device calls (coalescing happened)
+        assert m.stats["batches"] < 8
+        assert m.stats["batched_instances"] == 8
+        assert max(inner.batch_sizes) > 1
+    finally:
+        m.stop()
+
+
+def test_different_params_not_merged():
+    m, inner = make(delay=0.01)
+    try:
+        outs = {}
+        temps = {i: 0.1 * (i % 2) for i in range(4)}
+
+        def call(i):
+            outs[i] = m.predict({"instances": [f"p{i}"],
+                                 "parameters": {"temperature": temps[i]}})
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(4):
+            assert outs[i]["predictions"] == [f"out:p{i}"]
+        # requests with different temperatures must never share one inner
+        # call: each executed batch carries exactly one parameter set, and
+        # both parameter sets actually executed
+        seen = {p["temperature"] for p in inner.call_params}
+        assert seen == {0.0, 0.1}
+        assert len(inner.call_params) >= 2
+    finally:
+        m.stop()
+
+
+def test_stop_then_load_restarts():
+    m, inner = make()
+    m.stop()
+    m.load()
+    try:
+        assert m.predict({"instances": ["again"]}) == {
+            "predictions": ["out:again"]}
+    finally:
+        m.stop()
+
+
+def test_oversize_request_rejected():
+    m, _ = make()
+    try:
+        with pytest.raises(ValueError, match="max_batch_size"):
+            m.predict({"instances": list("abcde")})
+    finally:
+        m.stop()
+
+
+def test_inner_error_propagates_per_request():
+    class Exploding(Model):
+        def predict(self, payload):
+            raise RuntimeError("device on fire")
+
+    m = BatchingModel("boom", Exploding("x"))
+    m.load()
+    try:
+        with pytest.raises(RuntimeError, match="device on fire"):
+            m.predict({"instances": ["a"]})
+        assert m.ready  # one failed batch must not kill the dispatcher
+        with pytest.raises(RuntimeError, match="device on fire"):
+            m.predict({"instances": ["b"]})
+    finally:
+        m.stop()
+
+
+def test_stop_fails_pending():
+    m, _ = make()
+    m.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        m.predict({"instances": ["late"]})
+
+
+def test_model_config_file(tmp_path):
+    cfg_file = tmp_path / "model_config.json"
+    cfg_file.write_text(json.dumps({
+        "max_batch_size": 16,
+        "dynamic_batching": {"max_queue_delay_microseconds": 1234,
+                             "max_queue_size": 99},
+    }))
+    cfg = load_model_config(str(tmp_path))
+    assert cfg.max_batch_size == 16
+    assert cfg.max_queue_delay_us == 1234
+    assert cfg.max_queue_size == 99
+    assert load_model_config("/nonexistent") == BatcherConfig()
+
+
+def test_served_through_http_concurrently():
+    m, inner = make(delay=0.01)
+    server = ModelServer([m], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        results = []
+
+        def call(i):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/models/lm:predict",
+                data=json.dumps({"instances": [f"h{i}"]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                results.append(json.loads(r.read()))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 6
+        # HTTP threads fed one dispatcher: batching must have occurred
+        assert max(inner.batch_sizes) > 1
+    finally:
+        server.stop()
+        m.stop()
