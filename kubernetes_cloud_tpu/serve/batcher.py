@@ -45,6 +45,10 @@ class BatcherConfig:
     def __post_init__(self):
         if self.max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
+        if self.max_queue_delay_us < 0:
+            raise ValueError("max_queue_delay_us must be >= 0")
+        if self.max_queue_size < 1:
+            raise ValueError("max_queue_size must be >= 1")
 
 
 def load_model_config(model_dir: str) -> BatcherConfig:
@@ -105,16 +109,34 @@ class BatchingModel(Model):
             maxsize=cfg.max_queue_size)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._held: Optional[_Pending] = None  # didn't fit/merge last batch
         # batching telemetry (the Triton metrics a load test reads)
         self.stats = {"requests": 0, "batches": 0, "batched_instances": 0}
 
     # -- lifecycle ---------------------------------------------------------
 
     def load(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            if self._stop.is_set():
+                # a previous stop() timed out mid-batch; two dispatchers
+                # would race the queue and the device
+                raise RuntimeError(
+                    "previous dispatcher still running; call stop() again")
+            self.ready = True  # already loaded and dispatching
+            return
         if isinstance(self.inner, Model) and not self.inner.ready:
             self.inner.load()
         self._stop.clear()  # support stop() -> load() restart
-        self._thread = threading.Thread(target=self._dispatch_loop,
+        # Requests enqueued in the stop/restart race window are stale:
+        # their callers already received "batcher stopped".
+        while True:
+            try:
+                stale = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            stale.error = RuntimeError("batcher restarted")
+            stale.event.set()
+        self._thread = threading.Thread(target=self._safe_dispatch_loop,
                                         daemon=True,
                                         name=f"batcher-{self.name}")
         self._thread.start()
@@ -165,41 +187,53 @@ class BatchingModel(Model):
             return list(out["predictions"])
         return list(self.inner(instances, params))
 
-    def _dispatch_loop(self) -> None:
-        delay_s = self.cfg.max_queue_delay_us / 1e6
-        held: Optional[_Pending] = None  # request that didn't fit/merge
+    def _safe_dispatch_loop(self) -> None:
+        # The dispatcher must never die silently: a dead dispatcher with
+        # ready=True hangs every request.  Unexpected loop errors fail the
+        # in-flight work and the loop resumes.
         while not self._stop.is_set():
-            if held is not None:
-                first, held = held, None
-            else:
-                try:
-                    first = self._queue.get(timeout=0.05)
-                except queue.Empty:
-                    continue
-            first.claimed = True
-            batch = [first]
-            total = len(first.instances)
-            # coalesce: wait up to max_queue_delay for stragglers, while
-            # respecting max_batch_size and only merging compatible
-            # (same-parameters) requests — Triton's batching rule.
-            deadline = delay_s
-            while total < self.cfg.max_batch_size:
-                try:
-                    nxt = self._queue.get(timeout=deadline)
-                except queue.Empty:
-                    break
-                nxt.claimed = True
-                if (nxt.params != first.params
-                        or total + len(nxt.instances)
-                        > self.cfg.max_batch_size):
-                    held = nxt  # seeds the next batch
-                    break
-                batch.append(nxt)
-                total += len(nxt.instances)
-                deadline = 0  # drain whatever is already queued
-            self._execute(batch)
-        # drain on shutdown: fail pending requests rather than hang them
-        leftovers = [held] if held is not None else []
+            try:
+                self._dispatch_once()
+            except Exception:  # noqa: BLE001
+                log.exception("batcher dispatch error; continuing")
+        self._drain_on_stop()
+
+    def _dispatch_once(self) -> None:
+        delay_s = self.cfg.max_queue_delay_us / 1e6
+        if self._held is not None:
+            first, self._held = self._held, None
+        else:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                return
+        first.claimed = True
+        batch = [first]
+        total = len(first.instances)
+        # coalesce: wait up to max_queue_delay for stragglers, while
+        # respecting max_batch_size and only merging compatible
+        # (same-parameters) requests — Triton's batching rule.
+        deadline = delay_s
+        while total < self.cfg.max_batch_size:
+            try:
+                nxt = self._queue.get(timeout=deadline)
+            except queue.Empty:
+                break
+            nxt.claimed = True
+            if (nxt.params != first.params
+                    or total + len(nxt.instances)
+                    > self.cfg.max_batch_size):
+                self._held = nxt  # seeds the next batch
+                break
+            batch.append(nxt)
+            total += len(nxt.instances)
+            deadline = 0  # drain whatever is already queued
+        self._execute(batch)
+
+    def _drain_on_stop(self) -> None:
+        # fail pending requests rather than hang them
+        leftovers = [self._held] if self._held is not None else []
+        self._held = None
         while True:
             try:
                 leftovers.append(self._queue.get_nowait())
@@ -225,6 +259,11 @@ class BatchingModel(Model):
                 p.result = results[i:i + len(p.instances)]
                 i += len(p.instances)
         except Exception as e:  # noqa: BLE001 - propagate per request
+            # Wrap ValueError: by the time a batch executes, every payload
+            # already passed request validation, so an inner ValueError is
+            # a server-side fault (500), not a client error (400).
+            if isinstance(e, ValueError):
+                e = RuntimeError(f"batch execution failed: {e}")
             for p in batch:
                 p.error = e
         finally:

