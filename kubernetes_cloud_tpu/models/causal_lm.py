@@ -73,6 +73,12 @@ class CausalLMConfig:
     # re-runs attention — the right pairing for the flash kernel, whose
     # custom-vjp backward already does its own internal recompute.
     remat_policy: str = "nothing"
+    # Cross-entropy chunking: 0 computes the full [B, S, V] fp32 logits
+    # tensor at once (6 GiB at B=32, S=1024, V=50k — the largest single
+    # allocation in training); >0 scans the loss over sequence chunks of
+    # this many positions, rematerializing each chunk's logits in the
+    # backward pass.  Must divide the sequence length.
+    loss_chunk_size: int = 0
     # GPT-J uses interleaved (rotate_every_two) rotary channel pairing;
     # NeoX/LLaMA use the half-split convention.
     rope_interleaved: bool = False
@@ -93,6 +99,9 @@ class CausalLMConfig:
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
         if self.remat_policy not in ("nothing", "attn_out"):
             raise ValueError(f"unknown remat_policy: {self.remat_policy!r}")
+        if self.loss_chunk_size < 0:
+            raise ValueError(
+                f"loss_chunk_size must be >= 0, got {self.loss_chunk_size}")
         if self.moe_experts:
             if (self.moe_experts < 0 or self.moe_top_k < 1
                     or self.moe_top_k > self.moe_experts):
@@ -374,13 +383,16 @@ def _unembed(cfg: CausalLMConfig, params: Params, x: jax.Array) -> jax.Array:
 
 def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
             attention_mask: Optional[jax.Array] = None,
-            mesh=None, with_aux: bool = False) -> jax.Array:
+            mesh=None, with_aux: bool = False,
+            return_hidden: bool = False) -> jax.Array:
     """Token ids [B, S] → logits [B, S, V] (float32).
 
     ``mesh`` is only needed for ``attn_impl="ring"`` (sequence parallelism):
     activations are constrained seq-sharded and attention runs as a
     blockwise ring over the ``seq`` axis.  ``with_aux=True`` also returns
-    the mean MoE load-balancing loss across layers.
+    the mean MoE load-balancing loss across layers.  ``return_hidden=True``
+    returns the pre-final-norm hidden states (and the aux loss) instead of
+    logits — the chunked-loss path unembeds per chunk itself.
     """
     b, s = input_ids.shape
     if cfg.attn_impl == "ring" and mesh is None:
@@ -424,6 +436,8 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
         return out, aux
 
     x, auxs = jax.lax.scan(body, x, params["blocks"])
+    if return_hidden:
+        return x, auxs.mean()
     logits = _unembed(cfg, params, x)
     if with_aux:
         return logits, auxs.mean()
@@ -443,6 +457,17 @@ def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
     # fast path / pallas dispatch eligible); the ones-mask is only for
     # label accounting.
     attn_mask = batch.get("attention_mask")
+    if cfg.loss_chunk_size:
+        hidden, aux = forward(cfg, params, input_ids,
+                              attention_mask=attn_mask, mesh=mesh,
+                              return_hidden=True)
+        loss, metrics = chunked_next_token_xent(
+            cfg, params, hidden, input_ids, attn_mask,
+            cfg.loss_chunk_size)
+        if cfg.moe_experts:
+            loss = loss + cfg.moe_aux_weight * aux
+            metrics = dict(metrics, loss=loss, aux_loss=aux)
+        return loss, metrics
     if cfg.moe_experts:
         logits, aux = forward(cfg, params, input_ids,
                               attention_mask=attn_mask, mesh=mesh,
@@ -456,19 +481,78 @@ def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
     return next_token_xent(logits, input_ids, attn_mask)
 
 
+def shift_targets(
+    input_ids: jax.Array, attn_mask: Optional[jax.Array],
+) -> tuple[jax.Array, jax.Array]:
+    """Next-token label accounting, shared by every loss path (reference
+    semantics ``finetuner.py:469-493``): ``targets[i] = input_ids[i+1]``,
+    a position contributes iff it AND its target are unmasked, and the
+    final position (no target) is masked.  Returned padded to the full
+    sequence length so chunked/pipelined shapes stay uniform."""
+    b = input_ids.shape[0]
+    mask = (jnp.ones_like(input_ids) if attn_mask is None else attn_mask)
+    targets = jnp.concatenate(
+        [input_ids[:, 1:], jnp.zeros((b, 1), input_ids.dtype)], axis=1)
+    tgt_mask = jnp.concatenate(
+        [(mask[:, 1:] != 0) & (mask[:, :-1] != 0),
+         jnp.zeros((b, 1), bool)], axis=1)
+    return targets, tgt_mask
+
+
+def chunked_next_token_xent(
+    cfg: CausalLMConfig, params: Params, hidden: jax.Array,
+    input_ids: jax.Array, attn_mask: Optional[jax.Array],
+    chunk: int,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Next-token CE without ever materializing [B, S, V] logits.
+
+    The sequence is scanned in chunks of ``chunk`` positions; each chunk
+    unembeds (final norm + lm_head) and reduces to masked nll sums, with
+    ``jax.checkpoint`` so the backward pass recomputes each chunk's
+    logits instead of storing them.  Peak loss memory drops from
+    O(B*S*V) to O(B*chunk*V).  Numerics identical to the dense path
+    (same fp32 log_softmax per position).
+    """
+    b, s = input_ids.shape
+    if s % chunk:
+        raise ValueError(f"loss_chunk_size {chunk} must divide seq {s}")
+    targets, tgt_mask = shift_targets(input_ids, attn_mask)
+
+    n_chunks = s // chunk
+    h = hidden.reshape(b, n_chunks, chunk, -1).swapaxes(0, 1)
+    t = targets.reshape(b, n_chunks, chunk).swapaxes(0, 1)
+    m = tgt_mask.reshape(b, n_chunks, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_nll(hc, tc, mc):
+        logits = _unembed(cfg, params, hc)  # [B, chunk, V] fp32
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        return jnp.where(mc, nll, 0.0).sum()
+
+    def body(acc, xs):
+        hc, tc, mc = xs
+        return acc + chunk_nll(hc, tc, mc), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h, t, m))
+    denom = jnp.maximum(tgt_mask.sum(), 1)
+    loss = total / denom
+    return loss, {"loss": loss, "tokens": tgt_mask.sum()}
+
+
 def next_token_xent(
     logits: jax.Array, input_ids: jax.Array,
     attn_mask: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Shared next-token cross-entropy tail (dense and pipelined paths)."""
-    mask = jnp.ones_like(input_ids) if attn_mask is None else attn_mask
-    targets = input_ids[:, 1:]
-    logits = logits[:, :-1]
-    tgt_mask = (mask[:, 1:] != 0) & (mask[:, :-1] != 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    targets, tgt_mask = shift_targets(input_ids, attn_mask)
+    # the final position is masked by shift_targets; drop it before the
+    # softmax so the dense path does no wasted vocab work on it
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, targets[:, :-1, None], axis=-1)[..., 0]
     denom = jnp.maximum(tgt_mask.sum(), 1)
-    loss = jnp.where(tgt_mask, nll, 0.0).sum() / denom
+    loss = jnp.where(tgt_mask[:, :-1], nll, 0.0).sum() / denom
     return loss, {"loss": loss, "tokens": tgt_mask.sum()}
 
 

@@ -63,12 +63,14 @@ def pipeline_forward(
     *,
     mesh: Mesh,
     n_microbatches: int,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Token ids [B, S] → logits [B, S, V], blocks pipelined over ``stage``.
 
     Embedding and unembedding run outside the pipelined region (replicated
     over ``stage``; still sharded over batch/model axes by XLA) — they are
     cheap gathers/matmuls relative to the L-block trunk.
+    ``return_hidden=True`` skips the unembed (the chunked-loss path).
     """
     n_stages = mesh.shape[AXIS_STAGE]
     if n_stages == 1:
@@ -220,7 +222,10 @@ def pipeline_forward(
         return lax.psum(outputs, AXIS_STAGE)
 
     y = run(blocks, x_micro, mask_micro, *rope_args, bias)
-    return _unembed(cfg, params, y.reshape(b, s, d).astype(cfg.dtype))
+    hidden = y.reshape(b, s, d).astype(cfg.dtype)
+    if return_hidden:
+        return hidden
+    return _unembed(cfg, params, hidden)
 
 
 def pipeline_loss_fn(
@@ -240,6 +245,16 @@ def pipeline_loss_fn(
         raise ValueError("pipeline_loss_fn requires mesh=")
     input_ids = batch["input_ids"]
     attn_mask = batch.get("attention_mask")
+    if cfg.loss_chunk_size:
+        from kubernetes_cloud_tpu.models.causal_lm import (
+            chunked_next_token_xent,
+        )
+
+        hidden = pipeline_forward(cfg, params, input_ids, attn_mask,
+                                  mesh=mesh, n_microbatches=n_microbatches,
+                                  return_hidden=True)
+        return chunked_next_token_xent(cfg, params, hidden, input_ids,
+                                       attn_mask, cfg.loss_chunk_size)
     logits = pipeline_forward(cfg, params, input_ids, attn_mask,
                               mesh=mesh, n_microbatches=n_microbatches)
     return next_token_xent(logits, input_ids, attn_mask)

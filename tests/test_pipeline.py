@@ -121,3 +121,31 @@ def test_pipeline_rejects_bad_shapes(devices8):
     with pytest.raises(ValueError, match="microbatch"):
         pipeline_forward(cfg, params, jnp.ones((3, 8), jnp.int32),
                          mesh=mesh, n_microbatches=2)
+
+
+def test_pipeline_chunked_loss_matches_dense(devices8):
+    """loss_chunk_size must take effect through the pipelined path too."""
+    import dataclasses
+    import functools
+
+    from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
+    from kubernetes_cloud_tpu.parallel.sharding import shard_params
+
+    mesh = build_mesh(MeshSpec(stage=2, data=2), devices=devices8[:4])
+    cfg = PRESETS["test-tiny"]
+    params = init_params(cfg, jax.random.key(0))
+    params = shard_params(params, mesh)
+    ids = jax.random.randint(jax.random.key(1), (4, 32), 0,
+                             cfg.vocab_size, dtype=jnp.int32)
+    batch = shard_batch({"input_ids": ids,
+                         "attention_mask": jnp.ones((4, 32), jnp.int32)},
+                        mesh)
+    dense = jax.jit(functools.partial(
+        pipeline_loss_fn, cfg, mesh=mesh, n_microbatches=2))(
+        params, batch)[0]
+    ccfg = dataclasses.replace(cfg, loss_chunk_size=8)
+    chunked = jax.jit(functools.partial(
+        pipeline_loss_fn, ccfg, mesh=mesh, n_microbatches=2))(
+        params, batch)[0]
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(dense),
+                               rtol=1e-5)
