@@ -464,21 +464,19 @@ def loss_fn(cfg: CausalLMConfig, params: Params, batch: dict[str, jax.Array],
         loss, metrics = chunked_next_token_xent(
             cfg, params, hidden, input_ids, attn_mask,
             cfg.loss_chunk_size)
-        if cfg.moe_experts:
-            loss = loss + cfg.moe_aux_weight * aux
-            metrics = dict(metrics, loss=loss, aux_loss=aux)
-        return loss, metrics
-    if cfg.moe_experts:
+    elif cfg.moe_experts:
         logits, aux = forward(cfg, params, input_ids,
                               attention_mask=attn_mask, mesh=mesh,
                               with_aux=True)
         loss, metrics = next_token_xent(logits, input_ids, attn_mask)
+    else:
+        logits = forward(cfg, params, input_ids, attention_mask=attn_mask,
+                         mesh=mesh)
+        return next_token_xent(logits, input_ids, attn_mask)
+    if cfg.moe_experts:  # shared aux-loss combination for both paths above
         loss = loss + cfg.moe_aux_weight * aux
         metrics = dict(metrics, loss=loss, aux_loss=aux)
-        return loss, metrics
-    logits = forward(cfg, params, input_ids, attention_mask=attn_mask,
-                     mesh=mesh)
-    return next_token_xent(logits, input_ids, attn_mask)
+    return loss, metrics
 
 
 def shift_targets(

@@ -42,6 +42,7 @@ from kubernetes_cloud_tpu.models.causal_lm import (
     _block,
     _embed,
     _unembed,
+    chunked_next_token_xent,
     next_token_xent,
 )
 from kubernetes_cloud_tpu.ops.layers import alibi_slopes, rope_cache
@@ -246,10 +247,6 @@ def pipeline_loss_fn(
     input_ids = batch["input_ids"]
     attn_mask = batch.get("attention_mask")
     if cfg.loss_chunk_size:
-        from kubernetes_cloud_tpu.models.causal_lm import (
-            chunked_next_token_xent,
-        )
-
         hidden = pipeline_forward(cfg, params, input_ids, attn_mask,
                                   mesh=mesh, n_microbatches=n_microbatches,
                                   return_hidden=True)
