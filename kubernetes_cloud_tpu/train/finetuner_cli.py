@@ -323,9 +323,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         bs = max(n_batch, est - est % n_batch)
         trainer.cfg.batch_size = bs
         log.info("estimated global batch size: %d", bs)
+    trainer.install_preemption_handler()  # SIGTERM => checkpoint + exit
     result = trainer.train()
     log.info("done: %s", result)
-    return 0
+    return 0 if not result.get("preempted") else 143  # 128+SIGTERM
 
 
 if __name__ == "__main__":

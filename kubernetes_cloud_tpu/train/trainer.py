@@ -179,6 +179,7 @@ class Trainer:
         self.metrics = MetricsLogger(
             trainer_cfg.run_name, project=trainer_cfg.project_id,
             log_dir=trainer_cfg.logs, resume=trainer_cfg.resume)
+        self._preempted = False
 
     # -- checkpointing -----------------------------------------------------
 
@@ -266,6 +267,33 @@ class Trainer:
 
     # -- the loop ----------------------------------------------------------
 
+    def install_preemption_handler(self) -> None:
+        """Catch SIGTERM (GKE node preemption / pod eviction sends it with
+        a grace period before SIGKILL) and checkpoint at the next step
+        boundary, then exit the loop cleanly.  The reference's only
+        preemption story is Argo step retry from the last periodic save
+        (SURVEY.md §5.3); this loses at most the in-flight step."""
+        import signal
+
+        def on_term(signum, frame):
+            self._preempted = True
+
+        signal.signal(signal.SIGTERM, on_term)
+
+    def _preemption_agreed(self) -> bool:
+        """All hosts must agree before the collective checkpoint save, or
+        a SIGTERM that straddles a step boundary deadlocks the slice (one
+        host in the orbax save barrier, the rest running step N+1).  The
+        per-step allgather is a few bytes over DCN."""
+        if jax.process_count() == 1:
+            return self._preempted
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(self._preempted))
+        return bool(np.any(flags))
+
     def train(self) -> dict[str, Any]:
         cfg = self.cfg
         gas = max(1, cfg.gradients)
@@ -324,6 +352,20 @@ class Trainer:
             self.metrics.log(log, step=step)
             last_metrics = log
 
+            # Preemption check comes FIRST: the SIGTERM grace period must
+            # not be burned on periodic saves or prompt sampling.
+            if self._preemption_agreed():
+                # Persist progress inside the grace period and leave; the
+                # replacement pod resumes from this step.  Guarded like
+                # the final save — orbax refuses to overwrite a step that
+                # a periodic save already wrote.
+                if self.checkpointer.latest_step() != step:
+                    self.save_checkpoint(step, force=True)
+                self.checkpointer.wait()
+                self.metrics.close()
+                if jax.process_index() == 0:
+                    print(f"preempted at step {step}; checkpoint saved")
+                return {"steps": step, "preempted": True, **last_metrics}
             if cfg.save_steps and step % cfg.save_steps == 0:
                 self.save_checkpoint(step)
             if cfg.prompt_every and step % cfg.prompt_every == 0:

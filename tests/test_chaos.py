@@ -122,3 +122,82 @@ def test_kill_and_resume(tmp_path):
             if "step" in rec:
                 steps_logged.append(rec["step"])
     assert max(steps_logged) == 24
+
+
+WORKER_SIGTERM = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.data.tokenized import TokenizedDataset
+from kubernetes_cloud_tpu.models.causal_lm import PRESETS
+from kubernetes_cloud_tpu.train.train_step import TrainConfig
+from kubernetes_cloud_tpu.train.trainer import Trainer, TrainerConfig
+import jax
+
+class SlowDataset(TokenizedDataset):
+    def gather(self, rows):
+        time.sleep({slow!r})
+        return super().gather(rows)
+
+mesh = build_mesh(MeshSpec(data=2), devices=jax.devices("cpu")[:2])
+ds = SlowDataset({data!r}, context_size=32)
+trainer = Trainer(
+    PRESETS["test-tiny"], TrainConfig(warmup_steps=2, total_steps=24),
+    TrainerConfig(run_name="term", output_path={out!r}, batch_size=4,
+                  gradients=2, epochs=3, save_steps=100,
+                  logs={logs!r}, prompt_every=0),
+    mesh, ds)
+trainer.install_preemption_handler()
+print("READY", flush=True)
+result = trainer.train()
+print("RESULT", result.get("preempted"), result["steps"], flush=True)
+"""
+
+
+def test_sigterm_graceful_checkpoint(tmp_path):
+    """SIGTERM mid-run: the trainer checkpoints at the step boundary and
+    exits cleanly; a resume completes from there (GKE preemption path —
+    save_steps=100 means the ONLY checkpoint comes from the handler)."""
+    data = str(tmp_path / "data.tokens")
+    np.random.RandomState(0).randint(
+        2, 500, size=(64, 32)).astype(np.uint16).tofile(data)
+    script = tmp_path / "w.py"
+    script.write_text(WORKER_SIGTERM.format(
+        repo=REPO, data=data, out=str(tmp_path),
+        logs=str(tmp_path / "logs"), slow=0.4))
+    run_dir = tmp_path / "results-term"
+
+    p = subprocess.Popen([sys.executable, str(script)], env=_env(),
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+    try:
+        # wait until the handler is installed and some steps are running,
+        # then deliver SIGTERM
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = p.stdout.readline()
+            if "READY" in line:
+                break
+            if not line and p.poll() is not None:
+                break  # worker died before READY; fail fast below
+        time.sleep(6)  # a few throttled steps
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=300)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert "RESULT True" in out, out
+    ckpts = [d for d in os.listdir(run_dir) if d.startswith("checkpoint")]
+    assert ckpts, out  # handler saved despite save_steps=100
+    assert not (run_dir / ".ready.txt").exists()  # run was NOT complete
+
+    # resume: same config minus throttle completes to 24
+    script2 = tmp_path / "w2.py"
+    script2.write_text(WORKER_SIGTERM.format(
+        repo=REPO, data=data, out=str(tmp_path),
+        logs=str(tmp_path / "logs"), slow=0.0))
+    out2 = subprocess.run([sys.executable, str(script2)], env=_env(),
+                          capture_output=True, text=True, timeout=600)
+    assert "RESULT None 24" in out2.stdout, out2.stdout + out2.stderr
+    assert (run_dir / ".ready.txt").exists()
