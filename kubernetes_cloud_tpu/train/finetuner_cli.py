@@ -324,7 +324,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         trainer.cfg.batch_size = bs
         log.info("estimated global batch size: %d", bs)
     trainer.install_preemption_handler()  # SIGTERM => checkpoint + exit
-    result = trainer.train()
+    try:
+        result = trainer.train()
+    finally:
+        trainer.restore_signal_handler()  # don't leak into embedding hosts
     log.info("done: %s", result)
     return 0 if not result.get("preempted") else 143  # 128+SIGTERM
 

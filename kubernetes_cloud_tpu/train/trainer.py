@@ -24,6 +24,7 @@ mirrors:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 import os
 import time
@@ -46,6 +47,8 @@ from kubernetes_cloud_tpu.train.train_step import (
 )
 from kubernetes_cloud_tpu.weights.checkpoint import Checkpointer, mark_ready
 from kubernetes_cloud_tpu.weights.tensorstream import write_pytree
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -180,6 +183,7 @@ class Trainer:
             trainer_cfg.run_name, project=trainer_cfg.project_id,
             log_dir=trainer_cfg.logs, resume=trainer_cfg.resume)
         self._preempted = False
+        self._handler_installed = False
 
     # -- checkpointing -----------------------------------------------------
 
@@ -272,19 +276,42 @@ class Trainer:
         a grace period before SIGKILL) and checkpoint at the next step
         boundary, then exit the loop cleanly.  The reference's only
         preemption story is Argo step retry from the last periodic save
-        (SURVEY.md §5.3); this loses at most the in-flight step."""
+        (SURVEY.md §5.3); this loses at most the in-flight step.
+
+        Pair with :meth:`restore_signal_handler` (try/finally) when
+        calling programmatically — the CLI does — so the process's
+        previous SIGTERM disposition isn't leaked."""
         import signal
 
         def on_term(signum, frame):
             self._preempted = True
 
-        signal.signal(signal.SIGTERM, on_term)
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, on_term)
+        except ValueError:
+            # signal.signal only works on the main thread; a worker-thread
+            # caller simply runs without graceful preemption.
+            log.warning("not on main thread; preemption handler skipped")
+            return
+        self._handler_installed = True
+
+    def restore_signal_handler(self) -> None:
+        import signal
+
+        if getattr(self, "_prev_sigterm", None) is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+        self._handler_installed = False
 
     def _preemption_agreed(self) -> bool:
         """All hosts must agree before the collective checkpoint save, or
         a SIGTERM that straddles a step boundary deadlocks the slice (one
         host in the orbax save barrier, the rest running step N+1).  The
-        per-step allgather is a few bytes over DCN."""
+        per-step allgather is a few bytes over DCN — and only paid when
+        the handler is installed (identical on every host, since every
+        host runs the same program)."""
+        if not self._handler_installed:
+            return False
         if jax.process_count() == 1:
             return self._preempted
         import numpy as np

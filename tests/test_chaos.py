@@ -1,12 +1,11 @@
-"""Preemption chaos test — SIGKILL a training process mid-run, resume in
-a fresh process, verify the run completes from the checkpoint.
+"""Preemption chaos tests — kill a training process mid-run (SIGKILL and
+graceful SIGTERM), resume in a fresh process, verify completion.
 
 SURVEY.md §5.3: the reference has no preemption handling beyond Argo
 step retries and a launcher-restart hack
 (``gpt-neox/04-finetune-workflow.yaml:420-425``); GKE TPU slices are
-preemptible, so kill-resume is a first-class test here.  The "worker"
-runs in a subprocess on the CPU-simulated mesh and is killed hard (no
-atexit, no graceful save) after its first periodic checkpoint appears.
+preemptible, so kill-resume is a first-class test here.  Workers run in
+subprocesses on the CPU-simulated mesh.
 """
 
 import json
@@ -21,6 +20,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+#: one worker template serves both the hard-kill and graceful scenarios
 WORKER = """
 import os, sys, time
 sys.path.insert(0, {repo!r})
@@ -33,7 +33,7 @@ from kubernetes_cloud_tpu.train.trainer import Trainer, TrainerConfig
 import jax
 
 class SlowDataset(TokenizedDataset):
-    # throttles the input pipeline so the kill lands mid-run
+    # throttles the input pipeline so the signal lands mid-run
     def gather(self, rows):
         time.sleep({slow!r})
         return super().gather(rows)
@@ -42,12 +42,15 @@ mesh = build_mesh(MeshSpec(data=2), devices=jax.devices("cpu")[:2])
 ds = SlowDataset({data!r}, context_size=32)
 trainer = Trainer(
     PRESETS["test-tiny"], TrainConfig(warmup_steps=2, total_steps=24),
-    TrainerConfig(run_name="chaos", output_path={out!r}, batch_size=4,
-                  gradients=2, epochs=3, save_steps=2,
+    TrainerConfig(run_name={run_name!r}, output_path={out!r}, batch_size=4,
+                  gradients=2, epochs=3, save_steps={save_steps},
                   logs={logs!r}, prompt_every=0),
     mesh, ds)
+if {graceful!r}:
+    trainer.install_preemption_handler()
+    print("READY", flush=True)
 result = trainer.train()
-print("DONE", result["steps"], flush=True)
+print("RESULT", result.get("preempted"), result["steps"], flush=True)
 """
 
 
@@ -60,21 +63,23 @@ def _env():
     return env
 
 
-def _write_worker(tmp_path, slow: float) -> str:
+def _write_worker(tmp_path, slow, *, name, run_name, save_steps, graceful):
     data = str(tmp_path / "data.tokens")
     if not os.path.exists(data):
         np.random.RandomState(0).randint(
             2, 500, size=(64, 32)).astype(np.uint16).tofile(data)
-    script = tmp_path / "worker.py"
+    script = tmp_path / name
     script.write_text(WORKER.format(
         repo=REPO, data=data, out=str(tmp_path),
-        logs=str(tmp_path / "logs"), slow=slow))
+        logs=str(tmp_path / "logs"), slow=slow,
+        run_name=run_name, save_steps=save_steps, graceful=graceful))
     return str(script)
 
 
 def test_kill_and_resume(tmp_path):
     run_dir = tmp_path / "results-chaos"
-    script = _write_worker(tmp_path, slow=0.5)
+    script = _write_worker(tmp_path, 0.5, name="w1.py", run_name="chaos",
+                           save_steps=2, graceful=False)
 
     # phase 1: start training, SIGKILL once the first checkpoint lands
     p = subprocess.Popen([sys.executable, script], env=_env(),
@@ -104,15 +109,16 @@ def test_kill_and_resume(tmp_path):
     assert not (run_dir / ".ready.txt").exists()
 
     # phase 2: fresh process resumes and completes
-    script2 = _write_worker(tmp_path, slow=0.0)
+    script2 = _write_worker(tmp_path, 0.0, name="w2.py", run_name="chaos",
+                            save_steps=2, graceful=False)
     out = subprocess.run([sys.executable, script2], env=_env(),
                          capture_output=True, text=True, timeout=600)
-    assert "DONE 24" in out.stdout, out.stdout + out.stderr
+    assert "RESULT None 24" in out.stdout, out.stdout + out.stderr
     assert (run_dir / ".ready.txt").exists()
     assert (run_dir / "final" / "model.tensors").exists()
 
     # the resumed run started from the checkpoint, not step 0: its metrics
-    # stream must not contain step numbers at/below the checkpoint step
+    # stream must reach exactly the final step
     logs = list((tmp_path / "logs").glob("*.jsonl"))
     assert logs
     steps_logged = []
@@ -124,63 +130,33 @@ def test_kill_and_resume(tmp_path):
     assert max(steps_logged) == 24
 
 
-WORKER_SIGTERM = """
-import os, sys, time
-sys.path.insert(0, {repo!r})
-import numpy as np
-from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
-from kubernetes_cloud_tpu.data.tokenized import TokenizedDataset
-from kubernetes_cloud_tpu.models.causal_lm import PRESETS
-from kubernetes_cloud_tpu.train.train_step import TrainConfig
-from kubernetes_cloud_tpu.train.trainer import Trainer, TrainerConfig
-import jax
-
-class SlowDataset(TokenizedDataset):
-    def gather(self, rows):
-        time.sleep({slow!r})
-        return super().gather(rows)
-
-mesh = build_mesh(MeshSpec(data=2), devices=jax.devices("cpu")[:2])
-ds = SlowDataset({data!r}, context_size=32)
-trainer = Trainer(
-    PRESETS["test-tiny"], TrainConfig(warmup_steps=2, total_steps=24),
-    TrainerConfig(run_name="term", output_path={out!r}, batch_size=4,
-                  gradients=2, epochs=3, save_steps=100,
-                  logs={logs!r}, prompt_every=0),
-    mesh, ds)
-trainer.install_preemption_handler()
-print("READY", flush=True)
-result = trainer.train()
-print("RESULT", result.get("preempted"), result["steps"], flush=True)
-"""
-
-
 def test_sigterm_graceful_checkpoint(tmp_path):
     """SIGTERM mid-run: the trainer checkpoints at the step boundary and
     exits cleanly; a resume completes from there (GKE preemption path —
     save_steps=100 means the ONLY checkpoint comes from the handler)."""
-    data = str(tmp_path / "data.tokens")
-    np.random.RandomState(0).randint(
-        2, 500, size=(64, 32)).astype(np.uint16).tofile(data)
-    script = tmp_path / "w.py"
-    script.write_text(WORKER_SIGTERM.format(
-        repo=REPO, data=data, out=str(tmp_path),
-        logs=str(tmp_path / "logs"), slow=0.4))
+    script = _write_worker(tmp_path, 0.4, name="w.py", run_name="term",
+                           save_steps=100, graceful=True)
     run_dir = tmp_path / "results-term"
 
-    p = subprocess.Popen([sys.executable, str(script)], env=_env(),
+    p = subprocess.Popen([sys.executable, script], env=_env(),
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                          text=True)
     try:
-        # wait until the handler is installed and some steps are running,
-        # then deliver SIGTERM
+        # wait (without blocking reads) until the handler is installed and
+        # a few throttled steps ran, then deliver SIGTERM
+        import selectors
+
+        sel = selectors.DefaultSelector()
+        sel.register(p.stdout, selectors.EVENT_READ)
         deadline = time.monotonic() + 300
-        while time.monotonic() < deadline:
-            line = p.stdout.readline()
-            if "READY" in line:
-                break
-            if not line and p.poll() is not None:
+        ready = False
+        while time.monotonic() < deadline and not ready:
+            if p.poll() is not None:
                 break  # worker died before READY; fail fast below
+            if sel.select(timeout=1.0):
+                line = p.stdout.readline()
+                ready = "READY" in line
+        assert ready, "worker never reached READY"
         time.sleep(6)  # a few throttled steps
         p.send_signal(signal.SIGTERM)
         out, _ = p.communicate(timeout=300)
@@ -193,11 +169,9 @@ def test_sigterm_graceful_checkpoint(tmp_path):
     assert not (run_dir / ".ready.txt").exists()  # run was NOT complete
 
     # resume: same config minus throttle completes to 24
-    script2 = tmp_path / "w2.py"
-    script2.write_text(WORKER_SIGTERM.format(
-        repo=REPO, data=data, out=str(tmp_path),
-        logs=str(tmp_path / "logs"), slow=0.0))
-    out2 = subprocess.run([sys.executable, str(script2)], env=_env(),
+    script2 = _write_worker(tmp_path, 0.0, name="w2.py", run_name="term",
+                            save_steps=100, graceful=True)
+    out2 = subprocess.run([sys.executable, script2], env=_env(),
                           capture_output=True, text=True, timeout=600)
     assert "RESULT None 24" in out2.stdout, out2.stdout + out2.stderr
     assert (run_dir / ".ready.txt").exists()
