@@ -1,0 +1,224 @@
+// http_server — native HTTP/1.1 serving front-end.
+//
+// The reference's serving cores are C++ (TF-Serving for the SavedModel
+// services, Triton for FasterTransformer); the Python layer only defines
+// the model.  Same split here: this library owns sockets, connection
+// concurrency, HTTP parsing and keep-alive in native threads, and calls
+// up into the embedding runtime through a single C callback per request
+// (ctypes serializes callback entry on the GIL, which matches the
+// one-device-program-at-a-time serving model; all I/O with slow clients
+// happens in native threads that never hold the GIL).
+//
+// C ABI (for ctypes; no pybind11 in the image):
+//   handle = hs_start(port, backlog, n_threads, handler)
+//   hs_port(handle)            actual bound port (0 => ephemeral)
+//   hs_stop(handle)
+// handler signature:
+//   void handler(const char* method, const char* path,
+//                const char* body, long body_len, void* resp);
+// the handler MUST call exactly once:
+//   hs_respond(resp, status, content_type, body, body_len)
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -pthread http_server.cpp \
+//        -o libhttp_server.so
+
+#include <atomic>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+using Handler = void (*)(const char*, const char*, const char*, long,
+                         void*);
+
+struct Response {
+  int status = 500;
+  std::string content_type = "application/json";
+  std::string body = "{\"error\": \"handler did not respond\"}";
+  bool responded = false;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  Handler handler = nullptr;
+  std::atomic<bool> stopping{false};
+  std::vector<std::thread> workers;
+};
+
+const char* reason(int status) {
+  switch (status) {
+    case 200: return "OK";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 503: return "Service Unavailable";
+    default: return status < 500 ? "Client Error" : "Internal Server Error";
+  }
+}
+
+// Read until the full header + Content-Length body is in `buf`.
+// Returns false on EOF/error/oversize.
+bool read_request(int fd, std::string& buf, size_t& header_end,
+                  size_t& content_len) {
+  constexpr size_t kMax = 64u << 20;  // 64 MiB request cap
+  char tmp[16384];
+  header_end = std::string::npos;
+  content_len = 0;
+  while (true) {
+    if (header_end == std::string::npos) {
+      size_t pos = buf.find("\r\n\r\n");
+      if (pos != std::string::npos) {
+        header_end = pos + 4;
+        // parse Content-Length (case-insensitive)
+        for (size_t i = 0; i + 15 < header_end;) {
+          size_t eol = buf.find("\r\n", i);
+          if (eol == std::string::npos || eol > header_end) break;
+          if (eol - i > 15 &&
+              strncasecmp(buf.c_str() + i, "content-length:", 15) == 0) {
+            content_len = strtoul(buf.c_str() + i + 15, nullptr, 10);
+          }
+          i = eol + 2;
+        }
+        if (content_len > kMax) return false;
+      }
+    }
+    if (header_end != std::string::npos &&
+        buf.size() >= header_end + content_len) {
+      return true;
+    }
+    ssize_t n = recv(fd, tmp, sizeof(tmp), 0);
+    if (n <= 0) return false;
+    if (buf.size() + n > kMax) return false;
+    buf.append(tmp, n);
+  }
+}
+
+bool write_all(int fd, const char* data, size_t len) {
+  while (len > 0) {
+    ssize_t n = send(fd, data, len, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    data += n;
+    len -= n;
+  }
+  return true;
+}
+
+void serve_connection(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::string buf;
+  while (!s->stopping.load(std::memory_order_relaxed)) {
+    size_t header_end, content_len;
+    if (!read_request(fd, buf, header_end, content_len)) break;
+
+    // request line: METHOD SP PATH SP VERSION
+    size_t sp1 = buf.find(' ');
+    size_t sp2 = sp1 == std::string::npos ? std::string::npos
+                                          : buf.find(' ', sp1 + 1);
+    std::string method = sp1 == std::string::npos ? "" : buf.substr(0, sp1);
+    std::string path = sp2 == std::string::npos
+                           ? "/"
+                           : buf.substr(sp1 + 1, sp2 - sp1 - 1);
+    bool keep_alive =
+        buf.find("HTTP/1.1") != std::string::npos &&
+        buf.substr(0, header_end).find("Connection: close") ==
+            std::string::npos;
+
+    Response resp;
+    if (s->handler) {
+      s->handler(method.c_str(), path.c_str(), buf.c_str() + header_end,
+                 static_cast<long>(content_len), &resp);
+    }
+    char head[256];
+    int hn = snprintf(head, sizeof(head),
+                      "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                      "Content-Length: %zu\r\nConnection: %s\r\n\r\n",
+                      resp.status, reason(resp.status),
+                      resp.content_type.c_str(), resp.body.size(),
+                      keep_alive ? "keep-alive" : "close");
+    if (!write_all(fd, head, hn) ||
+        !write_all(fd, resp.body.data(), resp.body.size())) {
+      break;
+    }
+    buf.erase(0, header_end + content_len);
+    if (!keep_alive) break;
+  }
+  close(fd);
+}
+
+void accept_loop(Server* s) {
+  while (!s->stopping.load(std::memory_order_relaxed)) {
+    int fd = accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stopping.load(std::memory_order_relaxed)) return;
+      continue;
+    }
+    // thread-per-connection: connections are few and long-lived behind
+    // Knative; native threads block on slow clients, not the GIL
+    std::thread(serve_connection, s, fd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void hs_respond(void* resp_ptr, int status, const char* content_type,
+                const char* body, long body_len) {
+  auto* r = static_cast<Response*>(resp_ptr);
+  r->status = status;
+  if (content_type) r->content_type = content_type;
+  r->body.assign(body ? body : "", body ? body_len : 0);
+  r->responded = true;
+}
+
+void* hs_start(int port, int backlog, Handler handler) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, backlog > 0 ? backlog : 128) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+
+  auto* s = new Server;
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->handler = handler;
+  s->workers.emplace_back(accept_loop, s);
+  return s;
+}
+
+int hs_port(const void* h) {
+  return h ? static_cast<const Server*>(h)->port : -1;
+}
+
+void hs_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  if (!s) return;
+  s->stopping.store(true);
+  shutdown(s->listen_fd, SHUT_RDWR);
+  close(s->listen_fd);
+  for (auto& t : s->workers) t.join();
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,144 @@
+"""Native HTTP serving front-end (``csrc/http_server``) for ModelServer.
+
+The reference's serving data planes are C++ cores (TF-Serving for the
+SavedModel services, ``gpt-s3-inferenceservice.yaml:14-16``; Triton for
+FasterTransformer, ``ft-inference-service-gptj.yml:15-17``) with the
+model logic layered on top.  :class:`NativeModelServer` gives
+:class:`~kubernetes_cloud_tpu.serve.server.ModelServer` the same split:
+sockets, connection concurrency, HTTP parsing and keep-alive live in
+C++ threads that never touch the GIL; each parsed request enters Python
+once through a ctypes callback into the exact same ``handle()`` routing
+the stdlib server uses — so both front-ends serve identical APIs and
+the pure-Python ``ModelServer`` remains the no-toolchain fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import subprocess
+from typing import Iterable, Optional
+
+from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+log = logging.getLogger(__name__)
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc", "http_server")
+
+_HANDLER = ctypes.CFUNCTYPE(
+    None, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.c_long, ctypes.c_void_p)
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def build_library(out_dir: Optional[str] = None, *,
+                  force: bool = False) -> str:
+    src = os.path.join(_CSRC, "http_server.cpp")
+    if out_dir is None:
+        out_dir = os.path.join(_CSRC, "build")
+    os.makedirs(out_dir, exist_ok=True)
+    lib = os.path.join(out_dir, "libhttp_server.so")
+    if not force and os.path.exists(lib) and (
+            os.path.getmtime(lib) >= os.path.getmtime(src)):
+        return lib
+    tmp = f"{lib}.tmp.{os.getpid()}"  # atomic vs concurrent builders
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+         src, "-o", tmp],
+        check=True, capture_output=True, text=True)
+    os.replace(tmp, lib)
+    return lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        lib = ctypes.CDLL(build_library())
+    except Exception:  # noqa: BLE001 - no toolchain => python fallback
+        _lib_failed = True
+        return None
+    lib.hs_start.restype = ctypes.c_void_p
+    lib.hs_start.argtypes = [ctypes.c_int, ctypes.c_int, _HANDLER]
+    lib.hs_port.restype = ctypes.c_int
+    lib.hs_port.argtypes = [ctypes.c_void_p]
+    lib.hs_stop.restype = None
+    lib.hs_stop.argtypes = [ctypes.c_void_p]
+    lib.hs_respond.restype = None
+    lib.hs_respond.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_long]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeModelServer(ModelServer):
+    """ModelServer with the C++ front-end instead of http.server."""
+
+    def __init__(self, models: Iterable[Model], *, host: str = "0.0.0.0",
+                 port: int = 8080):
+        super().__init__(models, host=host, port=port)
+        self._native = None
+        self._cb = None  # keep the callback object alive (ctypes rule)
+
+    def _make_callback(self):
+        lib = _load()
+
+        @_HANDLER
+        def on_request(method, path, body, body_len, resp):
+            try:
+                status, obj = self.handle(
+                    method.decode(), path.decode(),
+                    ctypes.string_at(body, body_len) if body_len else b"")
+                data = json.dumps(obj).encode()
+            except Exception as e:  # noqa: BLE001 - never unwind into C
+                log.exception("native handler failure")
+                status, data = 500, json.dumps({"error": str(e)}).encode()
+            lib.hs_respond(resp, status, b"application/json", data,
+                           len(data))
+
+        return on_request
+
+    def start(self) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native http front-end unavailable (no C++ toolchain); "
+                "use ModelServer")
+        if self._native is not None:
+            raise RuntimeError("server already started")
+        self._cb = self._make_callback()
+        self._native = lib.hs_start(self.port, 128, self._cb)
+        if not self._native:
+            raise OSError(f"failed to bind port {self.port}")
+        self.port = int(lib.hs_port(self._native))
+        log.info("native front-end serving on :%d", self.port)
+
+    def serve_forever(self) -> None:
+        import time
+
+        self.load_all()
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._native is not None:
+            _load().hs_stop(self._native)
+            self._native = None

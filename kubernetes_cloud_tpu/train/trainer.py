@@ -298,9 +298,15 @@ class Trainer:
     def restore_signal_handler(self) -> None:
         import signal
 
-        if getattr(self, "_prev_sigterm", None) is not None:
-            signal.signal(signal.SIGTERM, self._prev_sigterm)
-            self._prev_sigterm = None
+        if not self._handler_installed:
+            return
+        prev = getattr(self, "_prev_sigterm", None)
+        # prev is None when the prior handler was installed from C code —
+        # Python cannot reinstate it, so fall back to the default
+        # disposition rather than leaving our (now-inert) handler active.
+        signal.signal(signal.SIGTERM,
+                      prev if prev is not None else signal.SIG_DFL)
+        self._prev_sigterm = None
         self._handler_installed = False
 
     def _preemption_agreed(self) -> bool:

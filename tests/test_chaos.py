@@ -142,24 +142,36 @@ def test_sigterm_graceful_checkpoint(tmp_path):
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                          text=True)
     try:
-        # wait (without blocking reads) until the handler is installed and
-        # a few throttled steps ran, then deliver SIGTERM
-        import selectors
+        # wait until the handler is installed and a few throttled steps
+        # ran, then deliver SIGTERM.  A pump thread owns the buffered
+        # stream (selectors on the raw fd would race Python's buffer).
+        import queue as queue_mod
+        import threading
 
-        sel = selectors.DefaultSelector()
-        sel.register(p.stdout, selectors.EVENT_READ)
+        lines: "queue_mod.Queue[str]" = queue_mod.Queue()
+        captured: list[str] = []
+
+        def pump():
+            for line in p.stdout:
+                captured.append(line)
+                lines.put(line)
+
+        pump_thread = threading.Thread(target=pump, daemon=True)
+        pump_thread.start()
         deadline = time.monotonic() + 300
         ready = False
         while time.monotonic() < deadline and not ready:
-            if p.poll() is not None:
-                break  # worker died before READY; fail fast below
-            if sel.select(timeout=1.0):
-                line = p.stdout.readline()
-                ready = "READY" in line
+            try:
+                ready = "READY" in lines.get(timeout=1.0)
+            except queue_mod.Empty:
+                if p.poll() is not None:
+                    break  # worker died before READY; fail fast below
         assert ready, "worker never reached READY"
         time.sleep(6)  # a few throttled steps
         p.send_signal(signal.SIGTERM)
-        out, _ = p.communicate(timeout=300)
+        p.wait(timeout=300)
+        pump_thread.join(timeout=30)
+        out = "".join(captured)
     finally:
         if p.poll() is None:
             p.kill()

@@ -116,10 +116,16 @@ def test_two_process_training(tmp_path):
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
 
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"rank failed:\n{out}\n{err}"
-        outs.append(out)
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"rank failed:\n{out}\n{err}"
+            outs.append(out)
+    finally:
+        # never leak a sibling worker blocked in rendezvous
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
     import json
 

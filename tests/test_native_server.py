@@ -1,0 +1,105 @@
+"""Native C++ HTTP front-end (csrc/http_server) — same API as the
+stdlib ModelServer, served by native threads."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_cloud_tpu.serve import native_server
+from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.native_server import NativeModelServer
+
+
+class Echo(Model):
+    def predict(self, payload):
+        return {"predictions": payload.get("instances", [])}
+
+    def completion(self, payload):
+        return {"completion": payload.get("prompt", "") + "!"}
+
+
+@pytest.fixture
+def server():
+    assert native_server.available()  # g++ is in the image
+    s = NativeModelServer([Echo("echo")], host="127.0.0.1", port=0)
+    s.load_all()
+    s.start()
+    yield s
+    s.stop()
+
+
+def _req(port, path, payload=None, method=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_v1_surface_parity(server):
+    assert _req(server.port, "/") == (200, {"status": "alive"})
+    assert _req(server.port, "/v1/models") == (200, {"models": ["echo"]})
+    code, body = _req(server.port, "/v1/models/echo:predict",
+                      {"instances": ["a", "b"]})
+    assert (code, body) == (200, {"predictions": ["a", "b"]})
+    assert _req(server.port, "/completion", {"prompt": "hi"}) \
+        == (200, {"completion": "hi!"})
+    assert _req(server.port, "/v1/models/nope:predict", {})[0] == 404
+    assert _req(server.port, "/nope")[0] == 404
+
+
+def test_keep_alive_and_concurrency(server):
+    # many sequential requests over fresh and reused connections, plus
+    # parallel clients — exercises the native read/parse/keepalive loop
+    results = []
+
+    def burst(n):
+        for i in range(n):
+            results.append(_req(server.port, "/v1/models/echo:predict",
+                                {"instances": [i]}))
+
+    threads = [threading.Thread(target=burst, args=(10,)) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 40
+    assert all(code == 200 for code, _ in results)
+
+
+def test_large_body_roundtrip(server):
+    blob = "x" * (2 << 20)  # 2 MiB body through the native parser
+    code, body = _req(server.port, "/v1/models/echo:predict",
+                      {"instances": [blob]})
+    assert code == 200
+    assert body["predictions"][0] == blob
+
+
+def test_bad_json_is_400(server):
+    url = f"http://127.0.0.1:{server.port}/v1/models/echo:predict"
+    req = urllib.request.Request(
+        url, data=b"{not json", headers={"Content-Type":
+                                         "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 400
+
+
+def test_restartable(server):
+    port = server.port
+    server.stop()
+    with pytest.raises(Exception):
+        _req(port, "/")
+    server.start()  # rebinds (possibly a new ephemeral port)
+    assert _req(server.port, "/")[0] == 200
