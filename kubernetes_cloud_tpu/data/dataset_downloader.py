@@ -1,0 +1,41 @@
+"""Dataset-downloader container entrypoint (workflow step
+``deploy/finetuner-workflow/finetune-workflow.yaml`` dataset-downloader;
+the reference's demo-corpus fetcher, ``finetune-workflow.yaml:192-195``).
+
+``--urls`` takes a URL-list file or single URL; ``--output`` is the PVC
+destination (implementation in
+:mod:`kubernetes_cloud_tpu.data.downloader_cli`)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+from kubernetes_cloud_tpu.data.downloader_cli import download_dataset
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--output", required=True, help="destination dir")
+    ap.add_argument("--urls", default=None,
+                    help="URL-list file or single URL; default: the "
+                         "DATASET_URLS env")
+    ap.add_argument("--retries", type=int, default=3)
+    args = ap.parse_args(argv)
+    source = args.urls or os.environ.get("DATASET_URLS")
+    if not source:
+        raise SystemExit("need --urls or DATASET_URLS")
+    if os.path.exists(source):
+        with open(source) as f:
+            urls = [ln.strip() for ln in f if ln.strip()]
+    else:
+        urls = [source]
+    download_dataset(urls, args.output, retries=args.retries)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

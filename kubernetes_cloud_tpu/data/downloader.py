@@ -1,0 +1,41 @@
+"""Model-downloader container entrypoint (workflow step
+``deploy/finetuner-workflow/finetune-workflow.yaml`` model-downloader;
+``deploy/online-inference/stable-diffusion/02-model-download-job.yaml``).
+
+Flag surface mirrors the reference's Go ``model_downloader``
+(``finetune-workflow.yaml:184-187,347-351``); implementation in
+:mod:`kubernetes_cloud_tpu.data.downloader_cli`.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+from kubernetes_cloud_tpu.data.downloader_cli import download_model
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True,
+                    help="HF repo id or local snapshot path")
+    ap.add_argument("--dest", required=True)
+    ap.add_argument("--type", dest="model_type", default="hf",
+                    choices=("hf", "diffusers"))
+    ap.add_argument("--revision", default=None)
+    ap.add_argument("--tokenizer-only", default="false",
+                    help="fetch only tokenizer/config files")
+    args = ap.parse_args(argv)
+    tokenizer_only = str(args.tokenizer_only).strip().lower() in (
+        "1", "true", "yes", "on")
+    patterns = (["*.json", "*.txt", "*.model", "tokenizer*", "vocab*",
+                 "merges*"] if tokenizer_only else None)
+    download_model(args.model, args.dest, model_type=args.model_type,
+                   revision=args.revision, allow_patterns=patterns)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Shared serving-container bootstrap.
+
+Every InferenceService container in ``deploy/`` boots through this:
+parse the common flags, honor the ``.ready.txt`` download gate
+(reference ``bloom.py:79-90``), pick the native C++ front-end when the
+toolchain is present (stdlib fallback otherwise), and serve forever on
+``--port`` / ``$PORT`` (KServe's injected port).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Iterable, Optional
+
+from kubernetes_cloud_tpu.serve.model import Model
+
+log = logging.getLogger(__name__)
+
+
+def add_common_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--model-name", default=None,
+                    help="name on the V1 data plane")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("PORT", "8080")))
+    ap.add_argument("--ready-file", default=None,
+                    help="wait for this sentinel before loading")
+    ap.add_argument("--ready-timeout", type=float, default=3600.0)
+    ap.add_argument("--frontend", choices=("auto", "native", "python"),
+                    default="auto")
+
+
+def wait_for_artifact(args) -> None:
+    if not args.ready_file:
+        return
+    from kubernetes_cloud_tpu.weights.checkpoint import wait_ready
+
+    directory = os.path.dirname(args.ready_file) or "."
+    log.info("waiting for %s", args.ready_file)
+    if not wait_ready(directory, args.ready_timeout):
+        raise TimeoutError(f"artifact never became ready: {args.ready_file}")
+
+
+def make_server(models: Iterable[Model], args):
+    from kubernetes_cloud_tpu.serve import native_server
+    from kubernetes_cloud_tpu.serve.server import ModelServer
+
+    use_native = args.frontend == "native" or (
+        args.frontend == "auto" and native_server.available())
+    cls = native_server.NativeModelServer if use_native else ModelServer
+    log.info("front-end: %s", cls.__name__)
+    return cls(models, port=args.port)
+
+
+def serve(models: Iterable[Model], args) -> None:  # pragma: no cover - loop
+    server = make_server(models, args)
+    server.serve_forever()

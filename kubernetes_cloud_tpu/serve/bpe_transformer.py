@@ -1,0 +1,39 @@
+"""Text BPE encode/decode sidecar container
+(``deploy/online-inference/gpt-2/gpt-s3-inferenceservice.yaml``
+transformer; logic in
+:class:`kubernetes_cloud_tpu.serve.transformer.TextBPETransformer`)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Optional
+
+from kubernetes_cloud_tpu.serve import boot
+from kubernetes_cloud_tpu.serve.transformer import TextBPETransformer
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--predictor-host",
+                    default=os.environ.get("PREDICTOR_HOST",
+                                           "127.0.0.1:8081"))
+    ap.add_argument("--codec-dir",
+                    default=os.environ.get("CODEC_DIR", "/mnt/models"),
+                    help="dir with vocab.json + merges.txt")
+    boot.add_common_args(ap)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    boot.wait_for_artifact(args)  # vocab/merges may still be downloading
+    svc = TextBPETransformer(args.model_name or "gpt2",
+                             args.predictor_host,
+                             codec_dir=args.codec_dir)
+    boot.serve([svc], args)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Image-classifier predictor service
+(``deploy/online-inference/image-classifier/classifier-inferenceservice
+.yaml``).  The reference serves a TF SavedModel through TF-Serving with a
+transformer sidecar doing image decode and label mapping
+(``online-inference/image-classifier/``); here the predictor is the
+ResNet family on TPU and the sidecar is
+:mod:`kubernetes_cloud_tpu.serve.classifier_transformer`.
+
+Request: ``{"instances": [[H][W][3] float array, ...]}`` (what the
+sidecar emits) → ``{"predictions": [[logits...], ...]}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+from typing import Any, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_cloud_tpu.serve import boot
+from kubernetes_cloud_tpu.serve.model import Model
+
+log = logging.getLogger(__name__)
+
+
+class VisionClassifierService(Model):
+    def __init__(self, name: str, model_dir: str):
+        super().__init__(name)
+        self.model_dir = model_dir
+
+    def load(self) -> None:
+        import dataclasses
+
+        from kubernetes_cloud_tpu.models.vision.resnet import ResNetConfig
+        from kubernetes_cloud_tpu.weights.tensorstream import (
+            load_pytree,
+            read_index,
+        )
+
+        path = (self.model_dir if self.model_dir.endswith(".tensors")
+                else os.path.join(self.model_dir, "model.tensors"))
+        t0 = time.perf_counter()
+        meta = read_index(path)["meta"]
+        raw = dict(meta.get("resnet_config", {}))
+        fields = {f.name for f in dataclasses.fields(ResNetConfig)}
+        raw = {k: v for k, v in raw.items()
+               if k in fields and k not in ("dtype", "param_dtype")}
+        self.cfg = ResNetConfig(**raw)
+        tree = load_pytree(path)
+        self.params = tree["params"]
+        self.batch_stats = tree["batch_stats"]
+        self._forward = jax.jit(self._logits)
+        log.info("loaded %s in %.2fs", path, time.perf_counter() - t0)
+        self.ready = True
+
+    def _logits(self, images):
+        from kubernetes_cloud_tpu.models.vision.resnet import forward
+
+        logits, _ = forward(self.cfg, self.params, images,
+                            self.batch_stats, train=False)
+        return logits
+
+    def predict(self, payload: Mapping[str, Any]) -> dict:
+        instances = payload.get("instances")
+        if not isinstance(instances, list) or not instances:
+            raise ValueError('payload needs {"instances": [image, ...]}')
+        batch = jnp.asarray(np.asarray(instances, np.float32))
+        if batch.ndim != 4 or batch.shape[-1] != 3:
+            raise ValueError(
+                f"instances must be [N, H, W, 3] images, got {batch.shape}")
+        logits = np.asarray(self._forward(batch))
+        return {"predictions": logits.tolist()}
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True,
+                    help=".tensors file or dir containing model.tensors")
+    boot.add_common_args(ap)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    boot.wait_for_artifact(args)
+    svc = VisionClassifierService(args.model_name or "classifier",
+                                  args.model)
+    boot.serve([svc], args)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""Image pre/post-processing sidecar container
+(``deploy/online-inference/image-classifier/classifier-inferenceservice
+.yaml`` transformer; logic in
+:class:`kubernetes_cloud_tpu.serve.transformer.ImageTransformer`)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Optional
+
+from kubernetes_cloud_tpu.serve import boot
+from kubernetes_cloud_tpu.serve.transformer import (
+    ImageTransformer,
+    load_class_map,
+)
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--predictor-host",
+                    default=os.environ.get("PREDICTOR_HOST",
+                                           "127.0.0.1:8081"),
+                    help="host:port of the predictor container")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--class-map", default=None,
+                    help="JSON list/dict mapping class ids to labels")
+    boot.add_common_args(ap)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    boot.wait_for_artifact(args)  # class-map file may come from the PVC
+    class_map = load_class_map(args.class_map) if args.class_map else None
+    svc = ImageTransformer(args.model_name or "classifier",
+                           args.predictor_host,
+                           image_size=args.image_size,
+                           class_map=class_map)
+    boot.serve([svc], args)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

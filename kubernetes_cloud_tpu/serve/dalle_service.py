@@ -1,0 +1,21 @@
+"""Container entrypoint for the replicated multi-candidate txt2img
+service (``deploy/online-inference/dalle-mini/02-inference-service.yaml``;
+capability parity with the reference's DALL-E Mini JAX service —
+see :mod:`kubernetes_cloud_tpu.serve.replicated`)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubernetes_cloud_tpu.serve.replicated import ReplicatedTxt2ImgService
+from kubernetes_cloud_tpu.serve.sd_service import main as _sd_main
+
+
+def main(argv: Optional[list] = None) -> int:
+    return _sd_main(argv, service_cls=ReplicatedTxt2ImgService)
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from typing import Any, Mapping, Optional, Sequence
 
@@ -192,3 +193,105 @@ class CausalLMService(Model):
                 opts[target] = payload[key]
         text = self.generate_texts([prompt], opts)[0]
         return {"completion": text}
+
+
+# --------------------------------------------------------------------------
+# container entrypoint (deploy/online-inference/*/; deploy/finetuner-workflow
+# model-inference-service template)
+
+
+def _resolve_weights(model_arg: str) -> str:
+    """``--model`` accepts a ``.tensors`` file or a directory holding
+    ``model.tensors`` (the trainer's ``final/`` layout)."""
+    if os.path.isdir(model_arg):
+        return os.path.join(model_arg, "model.tensors")
+    return model_arg
+
+
+def _config_from_artifact(path: str, preset: Optional[str]) -> CausalLMConfig:
+    if preset:
+        from kubernetes_cloud_tpu.models.causal_lm import PRESETS
+
+        return PRESETS[preset]
+    from kubernetes_cloud_tpu.weights.tensorstream import read_index
+
+    meta = read_index(path)["meta"].get("model_config")
+    if not meta:
+        raise ValueError(
+            f"{path} carries no model_config metadata; pass --preset")
+    meta = {k: v for k, v in meta.items()
+            if k not in ("dtype", "param_dtype")}
+    return CausalLMConfig(**meta)
+
+
+def _tokenizer_for(model_dir: str):
+    try:  # HF tokenizer files beside the weights, if any
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(model_dir)
+    except Exception:  # noqa: BLE001 - offline/no files => byte-level
+        return ByteTokenizer()
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    from kubernetes_cloud_tpu.serve import boot
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True,
+                    help=".tensors file or dir containing model.tensors")
+    ap.add_argument("--preset", default=None,
+                    help="architecture preset overriding artifact metadata")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel ways (model mesh axis)")
+    ap.add_argument("--max-batch-size", type=int, default=0,
+                    help=">0 wraps the service in the dynamic batcher")
+    ap.add_argument("--max-seq-len", type=int, default=0)
+    ap.add_argument("--config", default=None,
+                    help="model_config.json for batcher knobs")
+    boot.add_common_args(ap)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    boot.wait_for_artifact(args)
+
+    weights = _resolve_weights(args.model)
+    cfg = _config_from_artifact(weights, args.preset)
+    if args.max_seq_len:
+        cfg = dataclasses.replace(cfg, max_seq_len=args.max_seq_len)
+    mesh = None
+    if args.tp > 1:
+        from kubernetes_cloud_tpu.core.distributed import (
+            maybe_initialize_distributed,
+        )
+        from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+
+        maybe_initialize_distributed()
+        mesh = build_mesh(MeshSpec(model=args.tp, fsdp=-1))
+
+    model_dir = (args.model if os.path.isdir(args.model)
+                 else os.path.dirname(args.model))
+    svc: Any = CausalLMService(
+        args.model_name or "model", cfg,
+        tokenizer=_tokenizer_for(model_dir), weights_path=weights,
+        mesh=mesh)
+    if args.max_batch_size > 0 or args.config:
+        from kubernetes_cloud_tpu.serve.batcher import (
+            BatchingModel,
+            load_model_config,
+        )
+
+        bcfg = load_model_config(os.path.dirname(args.config)
+                                 if args.config else model_dir)
+        if args.max_batch_size > 0:
+            bcfg = dataclasses.replace(bcfg,
+                                       max_batch_size=args.max_batch_size)
+        svc = BatchingModel(svc.name, svc, bcfg)
+    boot.serve([svc], args)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

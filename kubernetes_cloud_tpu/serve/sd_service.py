@@ -192,3 +192,33 @@ class StableDiffusionService(Model):
             guidance_scale=float(opts["GUIDANCE_SCALE"]),
             seed=int(opts["SEED"]))
         return {"predictions": png_predictions([img], time.time() - t0)}
+
+
+def main(argv: Optional[list] = None, service_cls=None) -> int:
+    """Container entrypoint (``deploy/online-inference/stable-diffusion/
+    03-inference-service.yaml``; also reused by dalle_service)."""
+    import argparse
+    import logging
+
+    from kubernetes_cloud_tpu.serve import boot
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True,
+                    help="dir with encoder/vae/unet .tensors module split")
+    ap.add_argument("--vqgan", default=None,
+                    help="accepted for layout parity; the module split "
+                         "carries the image decoder (vae.tensors)")
+    boot.add_common_args(ap)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    boot.wait_for_artifact(args)
+    cls = service_cls or StableDiffusionService
+    svc = cls(args.model_name or "stable-diffusion", args.model)
+    boot.serve([svc], args)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""Container entrypoint for the sentiment predictor
+(``deploy/online-inference/custom-predictors/custom-sentiment-isvc.yaml``;
+see :mod:`kubernetes_cloud_tpu.serve.sentiment`)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Optional
+
+from kubernetes_cloud_tpu.serve import boot
+from kubernetes_cloud_tpu.serve.sentiment import SentimentModel
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True,
+                    help="dir containing sentiment.tensors")
+    boot.add_common_args(ap)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    boot.wait_for_artifact(args)
+    svc = SentimentModel(args.model_name or "sentiment",
+                         artifact_dir=args.model)
+    boot.serve([svc], args)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""Stable Diffusion / DreamBooth finetuner container entrypoint
+(workflow steps ``deploy/sd-finetuner-workflow/sd-finetune-workflow-
+template.yaml`` and ``deploy/sd-dreambooth-workflow/db-workflow-
+template.yaml``).
+
+Flag surface follows the reference SD finetuner's argparse
+(``sd-finetuner-workflow/sd-finetuner/finetuner.py:45-258``), with the
+GPU-era knobs accepted and mapped or neutralized for TPU:
+
+* ``--use_8bit_adam`` — bitsandbytes is CUDA-only; on TPU the optimizer
+  runs in fp32 with bf16 compute (accepted, logged, ignored);
+* ``--gradient_checkpointing`` — accepted (rematerialization is governed
+  by the UNet config; the flag logs its mapping);
+* ``--lr_scheduler``/``--lr_warmup_steps`` — warmup honored; named
+  schedules beyond constant-with-warmup log a note.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import os
+import sys
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def _bool(v) -> bool:
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--run_name", "--run-name", required=True)
+    ap.add_argument("--model", required=True,
+                    help="dir with the encoder/vae/unet module split; "
+                         "a missing dir trains from scratch (dev mode)")
+    ap.add_argument("--dataset", default=None,
+                    help="img+caption folder (LocalBase pairing)")
+    # dreambooth (db-workflow-template.yaml)
+    ap.add_argument("--instance_dataset", default=None)
+    ap.add_argument("--instance_prompt", default=None)
+    ap.add_argument("--class_dataset", default=None)
+    ap.add_argument("--class_prompt", default=None)
+    ap.add_argument("--num_class_images", type=int, default=100)
+    # None (not 0.0) so an explicit --prior_loss_weight 0 stays 0 —
+    # disabling prior preservation is a legitimate DreamBooth setting
+    ap.add_argument("--prior_loss_weight", type=float, default=None)
+    # optimization
+    ap.add_argument("--lr", type=float, default=5e-6)
+    ap.add_argument("--lr_scheduler", default="constant_with_warmup")
+    ap.add_argument("--lr_warmup_steps", type=int, default=0)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch_size", type=int, default=4)
+    ap.add_argument("--use_ema", type=_bool, default=True)
+    ap.add_argument("--gradient_checkpointing", type=_bool, default=False)
+    ap.add_argument("--use_8bit_adam", type=_bool, default=False)
+    ap.add_argument("--adam_beta1", type=float, default=0.9)
+    ap.add_argument("--adam_beta2", type=float, default=0.999)
+    ap.add_argument("--adam_weight_decay", type=float, default=1e-2)
+    ap.add_argument("--adam_epsilon", type=float, default=1e-8)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--save_steps", type=int, default=500)
+    # data
+    ap.add_argument("--resolution", type=int, default=512)
+    ap.add_argument("--resize", type=_bool, default=True)
+    ap.add_argument("--center_crop", type=_bool, default=True)
+    ap.add_argument("--resize_interp", default="lanczos")
+    ap.add_argument("--shuffle", type=_bool, default=True)
+    ap.add_argument("--ucg", type=float, default=0.1)
+    # logging
+    ap.add_argument("--image_log_steps", type=int, default=0)
+    ap.add_argument("--image_log_amount", type=int, default=4)
+    ap.add_argument("--project_id", default="huggingface")
+    ap.add_argument("--output_path", "--output-path", default="./")
+    return ap
+
+
+def load_module_split(model_dir: str):
+    """Load encoder/vae/unet params + configs from the serializer layout
+    (what the model downloader + serializer publish)."""
+    from kubernetes_cloud_tpu.models.diffusion import (
+        CLIPTextConfig,
+        NoiseSchedule,
+        UNetConfig,
+        VAEConfig,
+    )
+    from kubernetes_cloud_tpu.serve.sd_service import _cfg_from_meta
+    from kubernetes_cloud_tpu.weights.tensorstream import (
+        load_pytree,
+        read_index,
+    )
+
+    unet_path = os.path.join(model_dir, "unet.tensors")
+    meta = read_index(unet_path)["meta"]
+    out = {
+        "unet_cfg": _cfg_from_meta(UNetConfig, meta.get("config", {})),
+        "schedule_cfg": _cfg_from_meta(NoiseSchedule,
+                                       meta.get("schedule", {})),
+        "v_prediction": bool(meta.get("v_prediction", False)),
+        "unet_params": load_pytree(unet_path),
+    }
+    vae_path = os.path.join(model_dir, "vae.tensors")
+    out["vae_cfg"] = _cfg_from_meta(
+        VAEConfig, read_index(vae_path)["meta"].get("config", {}))
+    out["vae_params"] = load_pytree(vae_path)
+    enc_path = os.path.join(model_dir, "encoder.tensors")
+    out["clip_cfg"] = _cfg_from_meta(
+        CLIPTextConfig, read_index(enc_path)["meta"].get("config", {}))
+    out["clip_params"] = load_pytree(enc_path)
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    if args.use_8bit_adam:
+        log.info("--use_8bit_adam: bitsandbytes is CUDA-only; TPU runs "
+                 "fp32 optimizer state with bf16 compute")
+    if args.gradient_checkpointing:
+        log.info("--gradient_checkpointing: rematerialization is part of "
+                 "the UNet remat policy on TPU")
+    if args.lr_scheduler not in ("constant", "constant_with_warmup"):
+        log.info("--lr_scheduler=%s: TPU trainer uses constant-with-"
+                 "warmup (warmup_steps=%d)", args.lr_scheduler,
+                 args.lr_warmup_steps)
+
+    from kubernetes_cloud_tpu.core.distributed import (
+        maybe_initialize_distributed,
+    )
+
+    maybe_initialize_distributed()
+
+    import jax
+
+    from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+    from kubernetes_cloud_tpu.data.diffusion import (
+        DreamBoothDataset,
+        LocalBase,
+        collate_dreambooth,
+        collate_images,
+    )
+    from kubernetes_cloud_tpu.train.sd_trainer import (
+        SDTrainerConfig,
+        StableDiffusionTrainer,
+    )
+
+    dreambooth = bool(args.instance_dataset)
+    if dreambooth:
+        if not args.instance_prompt:
+            raise SystemExit("--instance_prompt required with "
+                             "--instance_dataset (reference parity: "
+                             "finetuner.py:246-258)")
+        dataset = DreamBoothDataset(
+            args.instance_dataset, args.instance_prompt,
+            args.class_dataset, args.class_prompt,
+            size=args.resolution, num_class_images=args.num_class_images)
+        collate = collate_dreambooth
+        prior_w = (1.0 if args.prior_loss_weight is None
+                   else args.prior_loss_weight)
+    else:
+        if not args.dataset:
+            raise SystemExit("need --dataset (or --instance_dataset)")
+        dataset = LocalBase(args.dataset, size=args.resolution,
+                            ucg=args.ucg, seed=args.seed)
+        collate = collate_images
+        prior_w = 0.0
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    cfg = SDTrainerConfig(
+        run_name=args.run_name, output_path=args.output_path,
+        batch_size=args.batch_size, lr=args.lr, epochs=args.epochs,
+        save_steps=args.save_steps, image_log_steps=args.image_log_steps,
+        ucg=args.ucg, use_ema=args.use_ema,
+        prior_loss_weight=prior_w, resolution=args.resolution,
+        seed=args.seed, warmup_steps=args.lr_warmup_steps,
+        logs=os.path.join(args.output_path, "logs"),
+        project_id=args.project_id)
+
+    modules = {}
+    if os.path.exists(os.path.join(args.model, "unet.tensors")):
+        loaded = load_module_split(args.model)
+        modules = {
+            "unet_cfg": loaded["unet_cfg"],
+            "vae_cfg": loaded["vae_cfg"],
+            "clip_cfg": loaded["clip_cfg"],
+            "unet_params": loaded["unet_params"],
+            "vae_params": loaded["vae_params"],
+            "clip_params": loaded["clip_params"],
+            "schedule_cfg": loaded["schedule_cfg"],
+        }
+        if loaded["v_prediction"]:
+            cfg = dataclasses.replace(cfg, v_prediction=True)
+    else:
+        log.warning("%s has no module split; training from random init "
+                    "(dev mode)", args.model)
+
+    trainer = StableDiffusionTrainer(cfg, mesh, dataset, collate,
+                                     **modules)
+    result = trainer.train()
+    log.info("done: %s", {k: v for k, v in result.items()
+                          if not hasattr(v, "shape")})
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    sys.exit(main())

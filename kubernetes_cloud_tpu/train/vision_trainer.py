@@ -146,6 +146,33 @@ def make_eval_step(model_cfg: ResNetConfig, ks: tuple[int, ...] = (1, 5)):
     return step
 
 
+def save_classifier(final_dir: str, model_cfg: ResNetConfig,
+                    state: VisionState) -> str:
+    """Write the servable artifact: params + batch stats with the config
+    in metadata, plus the ``.ready.txt`` sentinel — what
+    :mod:`kubernetes_cloud_tpu.serve.classifier_service` loads."""
+    import dataclasses
+    import os
+
+    import jax as _jax
+
+    from kubernetes_cloud_tpu.weights.checkpoint import mark_ready
+    from kubernetes_cloud_tpu.weights.tensorstream import write_pytree
+
+    os.makedirs(final_dir, exist_ok=True)
+    tree = {
+        "params": _jax.device_get(state["params"]),
+        "batch_stats": _jax.device_get(state["batch_stats"]),
+    }
+    meta_cfg = dataclasses.asdict(dataclasses.replace(
+        model_cfg, dtype=str(model_cfg.dtype),
+        param_dtype=str(model_cfg.param_dtype)))
+    write_pytree(os.path.join(final_dir, "model.tensors"), tree,
+                 meta={"resnet_config": meta_cfg})
+    mark_ready(final_dir)
+    return final_dir
+
+
 def train_epoch(step_fn, state: VisionState, batches: Iterable[dict],
                 mesh=None, log_every: int = 10,
                 log: Optional[Callable[[dict], None]] = None):

@@ -1,0 +1,76 @@
+"""ImageNet download Job (``deploy/jobset/imagenet-download-job.yaml``).
+
+The reference fetches ImageNet from Kaggle with the kaggle CLI inside a
+Job (``kubeflow/training-operator/resnet50/k8s``); this entrypoint does
+the same when the kaggle CLI + ``KAGGLE_USERNAME``/``KAGGLE_KEY`` secret
+env are present, and otherwise falls back to a plain URL-list fetch
+(``--urls``) through the framework downloader — either way ending with
+the ``.ready.txt`` sentinel the trainer Job gates on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+from kubernetes_cloud_tpu.data.downloader_cli import (
+    download_dataset,
+    is_ready,
+    mark_ready,
+)
+
+log = logging.getLogger(__name__)
+
+KAGGLE_DATASET = "imagenet-object-localization-challenge"
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--urls", default=None,
+                    help="URL-list fallback when kaggle is unavailable")
+    ap.add_argument("--competition", default=KAGGLE_DATASET)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    if is_ready(args.output):
+        log.info("%s already ready", args.output)
+        return 0
+    os.makedirs(args.output, exist_ok=True)
+
+    kaggle = shutil.which("kaggle")
+    if kaggle and os.environ.get("KAGGLE_USERNAME") \
+            and os.environ.get("KAGGLE_KEY"):
+        log.info("downloading %s via kaggle CLI", args.competition)
+        subprocess.run(
+            [kaggle, "competitions", "download", "-c", args.competition,
+             "-p", args.output], check=True)
+        # extract before marking ready: the trainer expects the
+        # ImageFolder layout, not archives
+        for entry in sorted(os.listdir(args.output)):
+            if entry.endswith(".zip"):
+                path = os.path.join(args.output, entry)
+                log.info("extracting %s", entry)
+                shutil.unpack_archive(path, args.output)
+                os.remove(path)
+        mark_ready(args.output)
+        return 0
+
+    if args.urls:
+        with open(args.urls) as f:
+            urls = [ln.strip() for ln in f if ln.strip()]
+        download_dataset(urls, args.output)
+        return 0
+
+    raise SystemExit(
+        "no kaggle CLI/credentials and no --urls fallback given")
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

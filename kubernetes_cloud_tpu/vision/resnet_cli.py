@@ -1,0 +1,150 @@
+"""ResNet ImageNet trainer container entrypoint
+(``deploy/jobset/resnet50-imagenet-jobset.yaml``).
+
+The reference trains resnet50 two ways — torchrun DDP under PyTorchJob
+and Horovod under MPIJob (``kubeflow/training-operator/resnet50/``);
+here both collapse into one SPMD program launched identically on every
+JobSet worker: batch axis sharded over the mesh, gradient allreduce
+emitted by XLA, sync-BN for free.  Flag names follow the manifest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def _bool(v: str) -> bool:
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data-dir", required=True,
+                    help="ImageNet-folder layout: <root>/{train,val}/<cls>/")
+    ap.add_argument("--epochs", type=int, default=90)
+    ap.add_argument("--batch-size", type=int, default=256,
+                    help="global batch, split over the data axis")
+    ap.add_argument("--base-lr", type=float, default=0.1)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--weight-decay", type=float, default=1e-4)
+    ap.add_argument("--label-smoothing", type=float, default=0.0,
+                    help="accepted for manifest parity (smoothing off "
+                         "matches the reference recipe)")
+    ap.add_argument("--bf16", type=_bool, default=True)
+    ap.add_argument("--eval-every", type=int, default=1)
+    ap.add_argument("--checkpoint-dir", default="./checkpoints")
+    ap.add_argument("--depth", type=int, default=50)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=0,
+                    help="0 = infer from the train folder")
+    ap.add_argument("--steps-per-epoch", type=int, default=0,
+                    help="0 = full epoch; >0 truncates (smoke runs)")
+    return ap
+
+
+def main(argv: Optional[list] = None) -> int:
+    import dataclasses
+
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from kubernetes_cloud_tpu.core.distributed import (
+        is_primary,
+        maybe_initialize_distributed,
+    )
+
+    maybe_initialize_distributed()
+
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+    from kubernetes_cloud_tpu.data.images import ImageFolderDataset
+    from kubernetes_cloud_tpu.models.vision.resnet import ResNetConfig
+    from kubernetes_cloud_tpu.train.vision_trainer import (
+        VisionTrainConfig,
+        evaluate,
+        init_vision_state,
+        make_eval_step,
+        make_vision_train_step,
+        save_classifier,
+        train_epoch,
+    )
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    world = jax.process_count()
+    # n_data counts GLOBAL batch shards (build_mesh spans all processes'
+    # devices), so it is also the lr linear-scaling factor — do not
+    # multiply by world again.
+    n_data = mesh.shape["data"] * mesh.shape["fsdp"]
+    if args.batch_size % n_data or args.batch_size % world:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} must divide both the "
+            f"{n_data} batch shards and {world} hosts")
+    local_bs = args.batch_size // world
+
+    train_ds = ImageFolderDataset(os.path.join(args.data_dir, "train"),
+                                  image_size=args.image_size, train=True)
+    val_dir = os.path.join(args.data_dir, "val")
+    val_ds = (ImageFolderDataset(val_dir, image_size=args.image_size,
+                                 train=False)
+              if os.path.isdir(val_dir) else None)
+    n_classes = args.num_classes or len(train_ds.class_to_idx)
+
+    model_cfg = ResNetConfig(
+        depth=args.depth, num_classes=n_classes,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    steps_per_epoch = (args.steps_per_epoch
+                       or max(1, (len(train_ds) // world) // local_bs))
+    # lr x data-parallel size: the reference's linear scaling rule
+    # (resnet50_pytorch.py:103-106) — expressed via world_scale
+    tcfg = VisionTrainConfig(
+        learning_rate=args.base_lr, momentum=args.momentum,
+        weight_decay=args.weight_decay, epochs=args.epochs,
+        steps_per_epoch=steps_per_epoch, world_scale=n_data)
+    state = init_vision_state(model_cfg, tcfg, jax.random.key(0), mesh)
+    step = jax.jit(make_vision_train_step(model_cfg, tcfg),
+                   donate_argnums=0)
+    eval_step = jax.jit(make_eval_step(model_cfg))
+
+    for epoch in range(args.epochs):
+        batches = train_ds.batches(
+            local_bs, epoch=epoch, process_index=jax.process_index(),
+            process_count=world)
+        if args.steps_per_epoch:
+            batches = itertools.islice(batches, args.steps_per_epoch)
+        state, summary = train_epoch(step, state, batches, mesh=mesh)
+        if is_primary():
+            log.info("epoch %d: loss=%.4f %.1f samples/s", epoch,
+                     summary["loss"], summary["samples_per_second"])
+        if val_ds is not None and (epoch + 1) % max(args.eval_every,
+                                                   1) == 0:
+            metrics = evaluate(
+                eval_step, state,
+                val_ds.batches(local_bs, epoch=0,
+                               process_index=jax.process_index(),
+                               process_count=world,
+                               drop_remainder=False),
+                mesh=mesh)
+            if is_primary():
+                log.info("epoch %d eval: top1=%.4f top5=%.4f", epoch,
+                         metrics.get("top1", 0), metrics.get("top5", 0))
+
+    if is_primary():
+        final = save_classifier(
+            os.path.join(args.checkpoint_dir, "final"), model_cfg, state)
+        log.info("saved %s", final)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""SD serializer Job — split a trained pipeline into servable
+``encoder/vae/unet`` tensors (workflow step
+``deploy/sd-finetuner-workflow/sd-finetune-workflow-template.yaml``;
+reference ``online-inference/stable-diffusion/serializer/serialize.py``).
+
+The SD trainer's ``final/`` already writes the module split; this step
+republishes it at the serving path (``--dest``) with a fresh
+``.ready.txt``, so serving never races a partially-written training
+artifact — the same artifact-handoff role the reference's serializer
+Job plays between accelerate training and the tensorized ISVC.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+from typing import Optional
+
+from kubernetes_cloud_tpu.weights.checkpoint import mark_ready, wait_ready
+
+MODULES = ("encoder", "vae", "unet")
+
+
+def serialize(model_dir: str, dest: str, *, timeout: float = 0.0) -> str:
+    """Copy the module split from a run dir (or its ``final/``) to the
+    serving destination; waits on the source sentinel when asked.
+
+    The trainer writes its sentinel inside ``final/``
+    (``sd_trainer.save_checkpoint``), so the wait polls BOTH candidate
+    layouts and the source directory is chosen only after the sentinel
+    appears — never mid-write."""
+    import time
+
+    candidates = (os.path.join(model_dir, "final"), model_dir)
+    if timeout > 0:
+        deadline = time.monotonic() + timeout
+        while not any(wait_ready(c, 0.0, poll=1.0) for c in candidates):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no ready sentinel under {model_dir} "
+                    f"after {timeout}s")
+            time.sleep(2.0)
+    src = next((c for c in candidates
+                if os.path.exists(os.path.join(c, "unet.tensors"))),
+               model_dir)
+    missing = [m for m in MODULES
+               if not os.path.exists(os.path.join(src, f"{m}.tensors"))]
+    if missing:
+        raise FileNotFoundError(
+            f"{src} lacks {missing}; expected the SD trainer's module "
+            "split (encoder/vae/unet .tensors)")
+    os.makedirs(dest, exist_ok=True)
+    for m in MODULES:
+        shutil.copy2(os.path.join(src, f"{m}.tensors"),
+                     os.path.join(dest, f"{m}.tensors"))
+    mark_ready(dest)
+    return dest
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True,
+                    help="trained run dir (or its final/)")
+    ap.add_argument("--dest", required=True)
+    ap.add_argument("--wait-timeout", type=float, default=0.0)
+    args = ap.parse_args(argv)
+    serialize(args.model, args.dest, timeout=args.wait_timeout)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

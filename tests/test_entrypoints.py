@@ -1,0 +1,234 @@
+"""Every container command in deploy/ must resolve to a real module with
+a ``main``; plus functional smoke tests for the new entrypoints."""
+
+import importlib
+import json
+import os
+import re
+
+import jax
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(REPO, "deploy")
+
+
+def manifest_commands() -> set[str]:
+    mods = set()
+    pat = re.compile(r'"-m",\s*"(kubernetes_cloud_tpu\.[\w.]+)"')
+    for root, _, files in os.walk(DEPLOY):
+        for fn in files:
+            if fn.endswith((".yaml", ".yml")):
+                mods.update(pat.findall(open(os.path.join(root, fn)).read()))
+    return mods
+
+
+def test_all_manifest_commands_resolve():
+    mods = manifest_commands()
+    assert mods, "no commands found under deploy/"
+    missing = []
+    for mod in sorted(mods):
+        try:
+            m = importlib.import_module(mod)
+        except Exception as e:  # noqa: BLE001
+            missing.append(f"{mod}: import failed: {e}")
+            continue
+        if not hasattr(m, "main"):
+            missing.append(f"{mod}: no main()")
+    assert not missing, "\n".join(missing)
+
+
+# -------------------------------------------------------------------------
+# functional smokes
+
+
+def test_downloader_entrypoints(tmp_path):
+    from kubernetes_cloud_tpu.data import dataset_downloader, downloader
+
+    src = tmp_path / "snap"
+    src.mkdir()
+    (src / "config.json").write_text("{}")
+    (src / "tokenizer.json").write_text("{}")
+    (src / "model.safetensors").write_bytes(b"\0" * 4)
+    assert downloader.main(["--model", str(src),
+                            "--dest", str(tmp_path / "m")]) == 0
+    assert (tmp_path / "m" / ".ready.txt").exists()
+
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("text")
+    assert dataset_downloader.main(
+        ["--output", str(tmp_path / "d"), "--urls", corpus.as_uri()]) == 0
+    assert (tmp_path / "d" / "c.txt").exists()
+
+
+def test_sd_serialize_entrypoint(tmp_path, devices8):
+    from tests.test_diffusion import (
+        TINY_CLIP,
+        TINY_UNET,
+        TINY_VAE,
+        _write_images,
+    )
+    from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+    from kubernetes_cloud_tpu.data.diffusion import LocalBase, collate_images
+    from kubernetes_cloud_tpu.train.sd_trainer import (
+        SDTrainerConfig,
+        StableDiffusionTrainer,
+    )
+    from kubernetes_cloud_tpu.weights import sd_serialize
+
+    root = _write_images(tmp_path)
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    trainer = StableDiffusionTrainer(
+        SDTrainerConfig(run_name="ser", output_path=str(tmp_path),
+                        batch_size=2, lr=1e-4, epochs=1, save_steps=0,
+                        image_log_steps=0, resolution=32, use_ema=False,
+                        logs=str(tmp_path / "logs")),
+        mesh, LocalBase(root, size=32, ucg=0.0, seed=0), collate_images,
+        unet_cfg=TINY_UNET, vae_cfg=TINY_VAE, clip_cfg=TINY_CLIP)
+    trainer.train()
+
+    dest = tmp_path / "serving"
+    rc = sd_serialize.main(["--model",
+                            str(tmp_path / "results-ser"),
+                            "--dest", str(dest)])
+    assert rc == 0
+    for mod in ("encoder", "vae", "unet"):
+        assert (dest / f"{mod}.tensors").exists()
+    assert (dest / ".ready.txt").exists()
+
+
+def test_classifier_service_roundtrip(tmp_path, devices8):
+    import dataclasses
+
+    from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+    from kubernetes_cloud_tpu.data.images import synthetic_batches
+    from kubernetes_cloud_tpu.models.vision.resnet import PRESETS
+    from kubernetes_cloud_tpu.serve.classifier_service import (
+        VisionClassifierService,
+    )
+    from kubernetes_cloud_tpu.train.vision_trainer import (
+        VisionTrainConfig,
+        init_vision_state,
+        make_vision_train_step,
+        save_classifier,
+        train_epoch,
+    )
+
+    cfg = PRESETS["resnet-tiny"]
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    tcfg = VisionTrainConfig(learning_rate=0.01, steps_per_epoch=4)
+    state = init_vision_state(cfg, tcfg, jax.random.key(0), mesh)
+    step = jax.jit(make_vision_train_step(cfg, tcfg), donate_argnums=0)
+    state, _ = train_epoch(
+        step, state,
+        synthetic_batches(8, image_size=32, num_classes=cfg.num_classes,
+                          steps=4),
+        mesh=mesh)
+    final = save_classifier(str(tmp_path / "final"), cfg, state)
+
+    svc = VisionClassifierService("classifier", final)
+    svc.load()
+    assert svc.ready
+    imgs = np.zeros((2, 32, 32, 3), np.float32)
+    out = svc.predict({"instances": imgs.tolist()})
+    assert len(out["predictions"]) == 2
+    assert len(out["predictions"][0]) == cfg.num_classes
+    with pytest.raises(ValueError):
+        svc.predict({"instances": [[1, 2, 3]]})
+
+
+def test_sd_finetuner_cli_end_to_end(tmp_path, devices8):
+    """CLI resumes from a published module split (the downloader/
+    serializer layout) and finetunes it — the workflow's trainer step."""
+    from tests.test_diffusion import (
+        TINY_CLIP,
+        TINY_UNET,
+        TINY_VAE,
+        _write_images,
+    )
+    from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+    from kubernetes_cloud_tpu.data.diffusion import LocalBase, collate_images
+    from kubernetes_cloud_tpu.train import sd_finetuner_cli
+    from kubernetes_cloud_tpu.train.sd_trainer import (
+        SDTrainerConfig,
+        StableDiffusionTrainer,
+    )
+
+    root = _write_images(tmp_path)
+    # publish a tiny pretrained module split
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    pre = StableDiffusionTrainer(
+        SDTrainerConfig(run_name="pre", output_path=str(tmp_path),
+                        batch_size=2, lr=1e-4, epochs=1, save_steps=0,
+                        image_log_steps=0, resolution=32, use_ema=False,
+                        logs=str(tmp_path / "logs")),
+        mesh, LocalBase(root, size=32, ucg=0.0, seed=0), collate_images,
+        unet_cfg=TINY_UNET, vae_cfg=TINY_VAE, clip_cfg=TINY_CLIP)
+    pre.train()
+
+    rc = sd_finetuner_cli.main([
+        "--run_name", "sdcli",
+        "--model", str(tmp_path / "results-pre" / "final"),
+        "--dataset", root, "--resolution", "32", "--batch_size", "2",
+        "--epochs", "1", "--save_steps", "0", "--image_log_steps", "0",
+        "--use_ema", "false", "--lr", "1e-4", "--use_8bit_adam", "true",
+        "--gradient_checkpointing", "true", "--lr_scheduler", "cosine",
+        "--output_path", str(tmp_path),
+    ])
+    assert rc == 0
+    run = tmp_path / "results-sdcli"
+    assert (run / "final" / "unet.tensors").exists()
+    assert (run / "final" / ".ready.txt").exists()
+
+
+def test_lm_service_main_builds_and_serves(tmp_path, devices8):
+    """--model dir with trainer-final layout boots the full service."""
+    import urllib.request
+
+    from kubernetes_cloud_tpu.models.causal_lm import (
+        PRESETS,
+        init_params,
+    )
+    from kubernetes_cloud_tpu.serve import boot, lm_service
+    from kubernetes_cloud_tpu.weights.tensorstream import write_pytree
+    import dataclasses
+
+    cfg = PRESETS["test-tiny"]
+    params = init_params(cfg, jax.random.key(0))
+    final = tmp_path / "final"
+    final.mkdir()
+    meta_cfg = dataclasses.asdict(dataclasses.replace(
+        cfg, dtype=str(cfg.dtype), param_dtype=str(cfg.param_dtype)))
+    write_pytree(str(final / "model.tensors"), jax.device_get(params),
+                 meta={"model_config": meta_cfg})
+
+    # build the service exactly as main() does, then serve via boot
+    weights = lm_service._resolve_weights(str(final))
+    loaded_cfg = lm_service._config_from_artifact(weights, None)
+    assert loaded_cfg.vocab_size == cfg.vocab_size
+    svc = lm_service.CausalLMService(
+        "m", dataclasses.replace(loaded_cfg), weights_path=weights)
+
+    class A:  # minimal args namespace for boot
+        model_name = "m"
+        port = 0
+        ready_file = None
+        ready_timeout = 1.0
+        frontend = "python"
+
+    server = boot.make_server([svc], A)
+    server.load_all()
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/models/m:predict",
+            data=json.dumps({"instances": ["ab"],
+                             "parameters": {"max_new_tokens": 4,
+                                            "temperature": 0.0}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert "generated_text" in out["predictions"][0]
+    finally:
+        server.stop()
