@@ -201,8 +201,17 @@ class CausalLMService(Model):
 
 
 def _resolve_weights(model_arg: str) -> str:
-    """``--model`` accepts a ``.tensors`` file or a directory holding
-    ``model.tensors`` (the trainer's ``final/`` layout)."""
+    """``--model`` accepts a ``.tensors`` file/object, a local directory
+    holding ``model.tensors`` (the trainer's ``final/`` layout), or a
+    remote prefix (``gs://bucket/model`` → ``.../model.tensors``) —
+    remote objects stream by byte range, no local copy."""
+    from kubernetes_cloud_tpu.weights.tensorstream import is_remote
+
+    if is_remote(model_arg):
+        model_arg = model_arg.rstrip("/")  # before the suffix test
+        if not model_arg.endswith(".tensors"):
+            return model_arg + "/model.tensors"
+        return model_arg
     if os.path.isdir(model_arg):
         return os.path.join(model_arg, "model.tensors")
     return model_arg

@@ -41,6 +41,16 @@ import numpy as np
 MAGIC = b"KCTS0001"
 ALIGN = 512
 
+#: URI schemes routed through fsspec range reads instead of mmap —
+#: serving cold-starts stream weights straight from object storage into
+#: device memory (the reference streams Tensorizer files from S3/HTTP,
+#: ``stream_io.CURLStreamFile``; here the bucket is GCS).
+REMOTE_SCHEMES = ("gs://", "s3://", "http://", "https://", "memory://")
+
+
+def is_remote(path: str) -> bool:
+    return path.startswith(REMOTE_SCHEMES)
+
 
 def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
     flat: dict[str, np.ndarray] = {}
@@ -118,16 +128,60 @@ def write_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
     os.replace(tmp, path)
 
 
-def read_index(path: str) -> dict:
-    with open(path, "rb") as f:
-        magic = f.read(8)
-        if magic != MAGIC:
-            raise ValueError(f"{path}: bad magic {magic!r}")
-        header_len = int.from_bytes(f.read(8), "little")
-        header = json.loads(f.read(header_len))
+def _open_stream(path: str):
+    """Binary reader for a local path or a remote URI (fsspec)."""
+    if is_remote(path):
+        import fsspec
+
+        return fsspec.open(path, "rb").open()
+    return open(path, "rb")
+
+
+def _read_index_from(f, label: str = "<stream>") -> dict:
+    magic = f.read(8)
+    if magic != MAGIC:
+        raise ValueError(f"{label}: bad magic {magic!r}")
+    header_len = int.from_bytes(f.read(8), "little")
+    header = json.loads(f.read(header_len))
     data_start = (16 + header_len + ALIGN - 1) // ALIGN * ALIGN
     header["data_start"] = data_start
     return header
+
+
+def read_index(path: str) -> dict:
+    with _open_stream(path) as f:
+        return _read_index_from(f, path)
+
+
+def _target_dtype(src_dtype, dtype):
+    # dtype casting applies to floating leaves only; integer tensors
+    # (token ids, step counters) keep their dtype.
+    cast = dtype is not None and jnp.issubdtype(src_dtype, jnp.floating)
+    return jnp.dtype(dtype) if cast else src_dtype
+
+
+def _place_leaf(arr: np.ndarray, sharding, target_dtype):
+    """Shared cast + (sharded) device placement for both source paths.
+
+    The source ``arr`` may view borrowed memory (an mmap about to close,
+    a bytes buffer): ``materialize`` guarantees an owned copy, which jax
+    zero-copies on CPU backends."""
+
+    def materialize(view: np.ndarray) -> np.ndarray:
+        if target_dtype != view.dtype:
+            return view.astype(target_dtype)  # astype already copies
+        return np.array(view, copy=True)
+
+    if sharding is None:
+        return jnp.asarray(materialize(arr))
+    dev_indices = sharding.addressable_devices_indices_map(arr.shape)
+    shards = [
+        jax.device_put(materialize(arr[idx] if idx is not None else arr),
+                       device)
+        for device, idx in dev_indices.items()
+    ]
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, sharding, shards)
 
 
 def _leaf_from_mmap(mm, data_start: int, info: dict, sharding, dtype):
@@ -135,29 +189,21 @@ def _leaf_from_mmap(mm, data_start: int, info: dict, sharding, dtype):
     src_dtype = jnp.dtype(info["dtype"])
     arr = np.ndarray(shape, src_dtype,
                      buffer=mm, offset=data_start + info["offset"])
-    # dtype casting applies to floating leaves only; integer tensors
-    # (token ids, step counters) keep their dtype.
-    cast = dtype is not None and jnp.issubdtype(src_dtype, jnp.floating)
-    target_dtype = jnp.dtype(dtype) if cast else src_dtype
+    return _place_leaf(arr, sharding, _target_dtype(src_dtype, dtype))
 
-    def materialize(view: np.ndarray) -> np.ndarray:
-        # Copy out of the mmap: jax zero-copies aligned host buffers on CPU
-        # backends, and the mmap is unmapped when the load returns.  astype
-        # with a real cast already copies; force one otherwise.
-        if target_dtype != view.dtype:
-            return view.astype(target_dtype)
-        return np.array(view, copy=True)
 
-    if sharding is None:
-        return jnp.asarray(materialize(arr))
-    # Stream only the byte ranges each addressable device needs.
-    dev_indices = sharding.addressable_devices_indices_map(shape)
-    shards = [
-        jax.device_put(materialize(arr[idx] if idx is not None else arr),
-                       device)
-        for device, idx in dev_indices.items()
-    ]
-    return jax.make_array_from_single_device_arrays(shape, sharding, shards)
+def _leaf_from_stream(f, data_start: int, info: dict, sharding, dtype):
+    """Remote path: stream exactly this tensor's byte range (seek+read —
+    a ranged GET under fsspec/GCS) and place it, per-shard when sharded.
+    One tensor is resident on host at a time, so a sharded model larger
+    than host RAM still loads; per-shard sub-ranges within a tensor are
+    a future refinement."""
+    shape = tuple(info["shape"])
+    src_dtype = jnp.dtype(info["dtype"])
+    f.seek(data_start + info["offset"])
+    raw = f.read(info["nbytes"])
+    arr = np.frombuffer(raw, src_dtype).reshape(shape)
+    return _place_leaf(arr, sharding, _target_dtype(src_dtype, dtype))
 
 
 def load_pytree(
@@ -171,11 +217,28 @@ def load_pytree(
     ``shardings``: optional pytree of ``NamedSharding`` (same structure,
     missing/None leaves → unsharded host load).  ``dtype``: optional cast
     applied per-shard during the load (e.g. serve a fp32 checkpoint as
-    bf16 without materializing fp32 on device).
+    bf16 without materializing fp32 on device).  ``path`` may be a remote
+    URI (``gs://``, ``s3://``, ``http(s)://``): tensors stream by byte
+    range straight into (sharded) device memory — the serving cold-start
+    path, no local copy of the artifact.
     """
+    flat_shardings = _flatten(shardings) if shardings is not None else {}
+
+    if is_remote(path):
+        # One remote open serves header and tensor reads (connection and
+        # auth setup on GCS is not free on the cold-start path).
+        with _open_stream(path) as f:
+            header = _read_index_from(f, path)
+            data_start = header["data_start"]
+            flat = {}
+            for name, info in header["tensors"].items():
+                flat[name] = _leaf_from_stream(
+                    f, data_start, info, flat_shardings.get(name), dtype)
+            jax.block_until_ready(list(flat.values()))
+        return _unflatten(flat)
+
     header = read_index(path)
     data_start = header["data_start"]
-    flat_shardings = _flatten(shardings) if shardings is not None else {}
 
     with open(path, "rb") as f:
         mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
