@@ -42,8 +42,11 @@ class VisionClassifierService(Model):
             read_index,
         )
 
-        path = (self.model_dir if self.model_dir.endswith(".tensors")
-                else os.path.join(self.model_dir, "model.tensors"))
+        from kubernetes_cloud_tpu.weights.tensorstream import (
+            resolve_artifact,
+        )
+
+        path = resolve_artifact(self.model_dir)
         t0 = time.perf_counter()
         meta = read_index(path)["meta"]
         raw = dict(meta.get("resnet_config", {}))

@@ -76,6 +76,7 @@ class CausalLMService(Model):
         tokenizer=None,
         params: Any = None,
         weights_path: Optional[str] = None,
+        weights_index: Optional[dict] = None,
         mesh=None,
         dtype=jnp.bfloat16,
     ):
@@ -84,6 +85,8 @@ class CausalLMService(Model):
         self.tokenizer = tokenizer or ByteTokenizer()
         self.params = params
         self.weights_path = weights_path
+        # pre-read header (saves a remote round-trip on cold start)
+        self.weights_index = weights_index
         self.mesh = mesh
         self.dtype = dtype
         # jit per (shape-bucket, sampling-config); cached by jax across
@@ -106,7 +109,8 @@ class CausalLMService(Model):
                 shardings = logical_to_physical(param_specs(shapes),
                                                 self.mesh)
             self.params = load_pytree(self.weights_path, shardings,
-                                      dtype=self.dtype)
+                                      dtype=self.dtype,
+                                      index=self.weights_index)
         elif self.mesh is not None:
             shardings = logical_to_physical(param_specs(self.params),
                                             self.mesh)
@@ -205,32 +209,31 @@ def _resolve_weights(model_arg: str) -> str:
     holding ``model.tensors`` (the trainer's ``final/`` layout), or a
     remote prefix (``gs://bucket/model`` → ``.../model.tensors``) —
     remote objects stream by byte range, no local copy."""
-    from kubernetes_cloud_tpu.weights.tensorstream import is_remote
+    from kubernetes_cloud_tpu.weights.tensorstream import resolve_artifact
 
-    if is_remote(model_arg):
-        model_arg = model_arg.rstrip("/")  # before the suffix test
-        if not model_arg.endswith(".tensors"):
-            return model_arg + "/model.tensors"
-        return model_arg
-    if os.path.isdir(model_arg):
-        return os.path.join(model_arg, "model.tensors")
-    return model_arg
+    return resolve_artifact(model_arg)
 
 
-def _config_from_artifact(path: str, preset: Optional[str]) -> CausalLMConfig:
+def _config_from_index(index: dict, path: str,
+                       preset: Optional[str]) -> CausalLMConfig:
     if preset:
         from kubernetes_cloud_tpu.models.causal_lm import PRESETS
 
         return PRESETS[preset]
-    from kubernetes_cloud_tpu.weights.tensorstream import read_index
-
-    meta = read_index(path)["meta"].get("model_config")
+    meta = index["meta"].get("model_config")
     if not meta:
         raise ValueError(
             f"{path} carries no model_config metadata; pass --preset")
     meta = {k: v for k, v in meta.items()
             if k not in ("dtype", "param_dtype")}
     return CausalLMConfig(**meta)
+
+
+def _config_from_artifact(path: str, preset: Optional[str]) -> CausalLMConfig:
+    from kubernetes_cloud_tpu.weights.tensorstream import read_index
+
+    return _config_from_index(read_index(path) if not preset else {},
+                              path, preset)
 
 
 def _tokenizer_for(model_dir: str):
@@ -264,8 +267,11 @@ def main(argv: Optional[list] = None) -> int:
     logging.basicConfig(level=logging.INFO)
     boot.wait_for_artifact(args)
 
+    from kubernetes_cloud_tpu.weights.tensorstream import read_index
+
     weights = _resolve_weights(args.model)
-    cfg = _config_from_artifact(weights, args.preset)
+    index = read_index(weights)  # one header fetch serves config + load
+    cfg = _config_from_index(index, weights, args.preset)
     if args.max_seq_len:
         cfg = dataclasses.replace(cfg, max_seq_len=args.max_seq_len)
     mesh = None
@@ -283,7 +289,7 @@ def main(argv: Optional[list] = None) -> int:
     svc: Any = CausalLMService(
         args.model_name or "model", cfg,
         tokenizer=_tokenizer_for(model_dir), weights_path=weights,
-        mesh=mesh)
+        weights_index=index, mesh=mesh)
     if args.max_batch_size > 0 or args.config:
         from kubernetes_cloud_tpu.serve.batcher import (
             BatchingModel,

@@ -52,6 +52,26 @@ def is_remote(path: str) -> bool:
     return path.startswith(REMOTE_SCHEMES)
 
 
+def resolve_artifact(path: str, default_name: str = "model.tensors") -> str:
+    """Resolve a ``--model`` argument to the ``.tensors`` object: accepts
+    a file/object path directly, a local directory holding
+    ``default_name``, or a remote prefix (``gs://bucket/m`` →
+    ``gs://bucket/m/model.tensors``).  URL query strings survive
+    (presigned HTTP URLs)."""
+    if is_remote(path):
+        import urllib.parse
+
+        parts = urllib.parse.urlsplit(path)
+        clean = parts.path.rstrip("/")
+        if clean.endswith(".tensors"):
+            return path
+        return urllib.parse.urlunsplit(parts._replace(
+            path=clean + "/" + default_name))
+    if os.path.isdir(path):
+        return os.path.join(path, default_name)
+    return path
+
+
 def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
     flat: dict[str, np.ndarray] = {}
     if isinstance(tree, Mapping):
@@ -211,6 +231,7 @@ def load_pytree(
     shardings: Any = None,
     *,
     dtype: Any = None,
+    index: Optional[dict] = None,
 ) -> Any:
     """Load a serialized pytree.
 
@@ -220,7 +241,9 @@ def load_pytree(
     bf16 without materializing fp32 on device).  ``path`` may be a remote
     URI (``gs://``, ``s3://``, ``http(s)://``): tensors stream by byte
     range straight into (sharded) device memory — the serving cold-start
-    path, no local copy of the artifact.
+    path, no local copy of the artifact.  ``index``: a pre-read
+    :func:`read_index` result, so callers that already fetched the header
+    (for config metadata) don't pay a second remote round-trip.
     """
     flat_shardings = _flatten(shardings) if shardings is not None else {}
 
@@ -228,7 +251,11 @@ def load_pytree(
         # One remote open serves header and tensor reads (connection and
         # auth setup on GCS is not free on the cold-start path).
         with _open_stream(path) as f:
-            header = _read_index_from(f, path)
+            if index is not None:
+                header = index
+                f.seek(0)
+            else:
+                header = _read_index_from(f, path)
             data_start = header["data_start"]
             flat = {}
             for name, info in header["tensors"].items():
@@ -237,7 +264,7 @@ def load_pytree(
             jax.block_until_ready(list(flat.values()))
         return _unflatten(flat)
 
-    header = read_index(path)
+    header = index if index is not None else read_index(path)
     data_start = header["data_start"]
 
     with open(path, "rb") as f:

@@ -232,3 +232,26 @@ def test_lm_service_main_builds_and_serves(tmp_path, devices8):
         assert "generated_text" in out["predictions"][0]
     finally:
         server.stop()
+
+
+def test_compile_cache_flag(tmp_path):
+    import argparse
+
+    from kubernetes_cloud_tpu.serve import boot
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        ap = argparse.ArgumentParser()
+        boot.add_common_args(ap)
+        args = ap.parse_args(["--compile-cache", str(tmp_path / "cache")])
+        boot.enable_compile_cache(args)  # must not raise
+        assert jax.config.jax_compilation_cache_dir == str(
+            tmp_path / "cache")
+        args2 = ap.parse_args(["--compile-cache", ""])
+        boot.enable_compile_cache(args2)  # disabled path
+    finally:
+        # global jax config must not leak into later tests
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
