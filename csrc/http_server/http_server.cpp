@@ -10,7 +10,7 @@
 // happens in native threads that never hold the GIL).
 //
 // C ABI (for ctypes; no pybind11 in the image):
-//   handle = hs_start(port, backlog, n_threads, handler)
+//   handle = hs_start(port, backlog, handler)
 //   hs_port(handle)            actual bound port (0 => ephemeral)
 //   hs_stop(handle)
 // handler signature:
@@ -23,10 +23,12 @@
 //        -o libhttp_server.so
 
 #include <atomic>
+#include <cctype>
 #include <cstring>
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include <arpa/inet.h>
@@ -52,7 +54,14 @@ struct Server {
   int port = 0;
   Handler handler = nullptr;
   std::atomic<bool> stopping{false};
-  std::vector<std::thread> workers;
+  std::thread accept_thread;
+  // Open connections are tracked (not detached) so hs_stop can shut
+  // down their fds and join every thread before the Server is freed —
+  // a detached keep-alive thread would otherwise dereference a dangling
+  // Server* (and possibly call into Python) after shutdown.
+  std::mutex mu;
+  std::unordered_map<int, std::thread> conns;   // fd -> serving thread
+  std::vector<std::thread> finished;            // exited, awaiting join
 };
 
 const char* reason(int status) {
@@ -129,10 +138,30 @@ void serve_connection(Server* s, int fd) {
     std::string path = sp2 == std::string::npos
                            ? "/"
                            : buf.substr(sp1 + 1, sp2 - sp1 - 1);
-    bool keep_alive =
-        buf.find("HTTP/1.1") != std::string::npos &&
-        buf.substr(0, header_end).find("Connection: close") ==
-            std::string::npos;
+    // HTTP version is the third request-line token exactly (a body or
+    // path containing "HTTP/1.1" must not flip the decision), and the
+    // Connection header is matched case-insensitively in the headers.
+    size_t line_end = buf.find("\r\n");
+    bool keep_alive = false;
+    if (line_end != std::string::npos && sp2 != std::string::npos &&
+        sp2 < line_end) {
+      keep_alive = buf.compare(sp2 + 1, line_end - sp2 - 1,
+                               "HTTP/1.1") == 0;
+    }
+    for (size_t i = line_end == std::string::npos ? header_end
+                                                  : line_end + 2;
+         i + 11 < header_end;) {
+      size_t eol = buf.find("\r\n", i);
+      if (eol == std::string::npos || eol > header_end) break;
+      if (strncasecmp(buf.c_str() + i, "connection:", 11) == 0) {
+        std::string val = buf.substr(i + 11, eol - i - 11);
+        for (auto& c : val) c = static_cast<char>(tolower(c));
+        if (val.find("close") != std::string::npos) keep_alive = false;
+        else if (val.find("keep-alive") != std::string::npos)
+          keep_alive = true;  // HTTP/1.0 opt-in
+      }
+      i = eol + 2;
+    }
 
     Response resp;
     if (s->handler) {
@@ -153,7 +182,24 @@ void serve_connection(Server* s, int fd) {
     buf.erase(0, header_end + content_len);
     if (!keep_alive) break;
   }
-  close(fd);
+  // Deregister and close under the lock: hs_stop also touches conn fds
+  // under s->mu, so the fd can't be shut down concurrently with (or
+  // after) its close here, and a recycled fd number can't be hit.
+  // Earlier-exited threads are reaped here too (never self — self is
+  // pushed after the swap), so an idle server holds at most one exited
+  // thread's resources, not a whole burst's.
+  std::vector<std::thread> reap;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    close(fd);
+    auto it = s->conns.find(fd);
+    if (it != s->conns.end()) {
+      reap.swap(s->finished);
+      s->finished.push_back(std::move(it->second));
+      s->conns.erase(it);
+    }
+  }
+  for (auto& t : reap) t.join();
 }
 
 void accept_loop(Server* s) {
@@ -164,8 +210,16 @@ void accept_loop(Server* s) {
       continue;
     }
     // thread-per-connection: connections are few and long-lived behind
-    // Knative; native threads block on slow clients, not the GIL
-    std::thread(serve_connection, s, fd).detach();
+    // Knative; native threads block on slow clients, not the GIL.
+    std::vector<std::thread> reap;
+    {
+      std::lock_guard<std::mutex> lk(s->mu);
+      // Register under the lock: the new thread's exit path takes s->mu
+      // before looking itself up, so it cannot race its own insertion.
+      s->conns.emplace(fd, std::thread(serve_connection, s, fd));
+      reap.swap(s->finished);
+    }
+    for (auto& t : reap) t.join();
   }
 }
 
@@ -203,7 +257,7 @@ void* hs_start(int port, int backlog, Handler handler) {
   s->listen_fd = fd;
   s->port = ntohs(addr.sin_port);
   s->handler = handler;
-  s->workers.emplace_back(accept_loop, s);
+  s->accept_thread = std::thread(accept_loop, s);
   return s;
 }
 
@@ -217,7 +271,21 @@ void hs_stop(void* h) {
   s->stopping.store(true);
   shutdown(s->listen_fd, SHUT_RDWR);
   close(s->listen_fd);
-  for (auto& t : s->workers) t.join();
+  s->accept_thread.join();  // no further registrations after this
+  std::vector<std::thread> pending;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (auto& kv : s->conns) {
+      shutdown(kv.first, SHUT_RDWR);  // wake any blocked recv/send
+      pending.push_back(std::move(kv.second));
+    }
+    s->conns.clear();
+    for (auto& t : s->finished) pending.push_back(std::move(t));
+    s->finished.clear();
+  }
+  // Every connection thread exits (closing its own fd) before the
+  // Server — and with it the Python-side handler — goes away.
+  for (auto& t : pending) t.join();
   delete s;
 }
 

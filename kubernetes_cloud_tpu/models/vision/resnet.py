@@ -182,9 +182,13 @@ def _batch_norm(x, p, s, *, train, momentum, eps):
     if train:
         mean = jnp.mean(xf, axis=(0, 1, 2))
         var = jnp.var(xf, axis=(0, 1, 2))
+        # Running stats fold in the *unbiased* variance (n/(n-1)), like
+        # torch BatchNorm; normalization itself uses the biased estimate.
+        n = xf.shape[0] * xf.shape[1] * xf.shape[2]
+        unbiased = var * (n / max(n - 1, 1))
         new_stats = {
             "mean": momentum * s["mean"] + (1 - momentum) * mean,
-            "var": momentum * s["var"] + (1 - momentum) * var,
+            "var": momentum * s["var"] + (1 - momentum) * unbiased,
         }
     else:
         mean, var = s["mean"], s["var"]

@@ -29,8 +29,12 @@ log = logging.getLogger(__name__)
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "csrc", "http_server")
 
+# body is POINTER(c_char), NOT c_char_p: c_char_p would convert to a
+# NUL-terminated bytes copy, so string_at on a body with embedded NULs
+# would read past the truncated copy (out-of-bounds) instead of the real
+# C buffer.
 _HANDLER = ctypes.CFUNCTYPE(
-    None, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    None, ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char),
     ctypes.c_long, ctypes.c_void_p)
 
 _lib: Optional[ctypes.CDLL] = None

@@ -96,6 +96,81 @@ def test_bad_json_is_400(server):
     assert code == 400
 
 
+def test_nul_bytes_in_body(server):
+    # A body with embedded NULs must be read to its full Content-Length
+    # from the real C buffer (the ctypes handler declares the body as
+    # POINTER(c_char); a c_char_p declaration would NUL-truncate and
+    # string_at would read out of bounds).  The NUL-truncated prefix here
+    # is *valid* JSON, so a truncating server would answer 200; reading
+    # the full body yields invalid JSON => 400, and the process survives.
+    url = f"http://127.0.0.1:{server.port}/v1/models/echo:predict"
+    data = b'{"instances": [1]}' + b"\x00" * 4096
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 400
+    # server still healthy afterwards
+    assert _req(server.port, "/")[0] == 200
+
+
+def _raw_roundtrip(port, request: bytes):
+    import socket
+
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.sendall(request)
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        head, _, rest = data.partition(b"\r\n\r\n")
+        clen = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                clen = int(line.split(b":", 1)[1])
+        while len(rest) < clen:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            rest += chunk
+        # after a full response, a closing server sends EOF promptly
+        s.settimeout(5)
+        try:
+            closed = s.recv(1) == b""
+        except TimeoutError:
+            closed = False
+        return head, closed
+
+
+def test_keep_alive_from_request_line_only(server):
+    # HTTP/1.0 request whose *body* contains "HTTP/1.1": the version must
+    # be parsed from the request line only, so the connection closes.
+    body = b'{"instances": ["HTTP/1.1"]}'
+    req = (b"POST /v1/models/echo:predict HTTP/1.0\r\n"
+           b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+    head, closed = _raw_roundtrip(server.port, req)
+    assert b"200" in head.split(b"\r\n")[0]
+    assert b"Connection: close" in head
+    assert closed
+
+
+def test_connection_close_case_insensitive(server):
+    body = b'{"instances": [1]}'
+    req = (b"POST /v1/models/echo:predict HTTP/1.1\r\n"
+           b"cOnNeCtIoN: ClOsE\r\n"
+           b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+    head, closed = _raw_roundtrip(server.port, req)
+    assert b"200" in head.split(b"\r\n")[0]
+    assert b"Connection: close" in head
+    assert closed
+
+
 def test_restartable(server):
     port = server.port
     server.stop()
