@@ -64,6 +64,7 @@ def pipeline_forward(
     *,
     mesh: Mesh,
     n_microbatches: int,
+    with_aux: bool = False,
     return_hidden: bool = False,
 ) -> jax.Array:
     """Token ids [B, S] → logits [B, S, V], blocks pipelined over ``stage``.
@@ -71,15 +72,15 @@ def pipeline_forward(
     Embedding and unembedding run outside the pipelined region (replicated
     over ``stage``; still sharded over batch/model axes by XLA) — they are
     cheap gathers/matmuls relative to the L-block trunk.
-    ``return_hidden=True`` skips the unembed (the chunked-loss path).
+    Mirrors :func:`models.causal_lm.forward`'s return protocol:
+    ``return_hidden=True`` skips the unembed and returns ``(hidden, aux)``
+    (the chunked-loss path); ``with_aux=True`` returns ``(logits, aux)``
+    where ``aux`` is the mean MoE load-balancing loss accumulated through
+    the microbatch schedule (zero for dense models).
     """
     n_stages = mesh.shape[AXIS_STAGE]
     if n_stages == 1:
         raise ValueError("pipeline_forward needs a mesh with stage > 1")
-    if cfg.moe_experts:
-        raise NotImplementedError(
-            "MoE aux-loss accumulation through the pipeline schedule is "
-            "not wired up yet; use the non-pipelined path for MoE models")
     if cfg.num_layers % n_stages:
         raise ValueError(
             f"num_layers={cfg.num_layers} not divisible by {n_stages} stages")
@@ -143,10 +144,9 @@ def pipeline_forward(
             q, kk, vv, attn_in = _project_qkv(cfg, layer, carry, rope=rope_l)
             attn_vec = ring_attention_local(q, kk, vv, kv_mask=mask_mb,
                                             causal=True)
-            out, _aux = _finish_block(cfg, layer, carry, attn_vec, attn_in)
-            return out
-        out, _aux = _block(cfg, layer, carry, rope_l, bias_l, mask_mb, None)
-        return out
+            return _finish_block(cfg, layer, carry, attn_vec, attn_in,
+                                 token_mask=mask_mb)
+        return _block(cfg, layer, carry, rope_l, bias_l, mask_mb, None)
 
     block = one_block
     if cfg.remat:
@@ -159,11 +159,15 @@ def pipeline_forward(
         bias_l = bias_v if has_bias else None
 
         def body(carry, layer):
-            return block(cfg, layer, carry, rope_l, bias_l, mask_mb,
-                         None), None
+            out, aux = block(cfg, layer, carry, rope_l, bias_l, mask_mb,
+                             None)
+            return out, aux
 
-        out, _ = lax.scan(body, x_mb.astype(cfg.dtype), local_blocks)
-        return out.astype(jnp.float32)
+        out, auxs = lax.scan(body, x_mb.astype(cfg.dtype), local_blocks)
+        # Mean MoE load-balance loss over this stage's local layers (zeros
+        # for dense models; the scan always threads it so the schedule is
+        # one code path).
+        return out.astype(jnp.float32), auxs.mean().astype(jnp.float32)
 
     seq_dim = P(AXIS_SEQ) if seq_parallel else P(None)
 
@@ -178,7 +182,7 @@ def pipeline_forward(
             P(*seq_dim, None),                   # rope sin [S, rot]
             P(),                                 # alibi bias (no ring+alibi)
         ),
-        out_specs=P(None, None, *seq_dim, None),
+        out_specs=(P(None, None, *seq_dim, None), P()),
         axis_names={AXIS_STAGE, AXIS_SEQ},
         check_vma=False,
     )
@@ -190,7 +194,7 @@ def pipeline_forward(
         perm = [(i, i + 1) for i in range(n_stages - 1)]
 
         def tick(carry, t):
-            state, outputs = carry
+            state, outputs, aux_acc = carry
             # Stage s works on microbatch (t - s); clip for warmup/drain
             # ticks (their results are never written back).
             my_mb = jnp.clip(t - stage, 0, n_micro - 1)
@@ -199,8 +203,13 @@ def pipeline_forward(
             inp = jnp.where(stage == 0, feed, state)
             mask_mb = lax.dynamic_index_in_dim(mask_micro, my_mb, 0,
                                                keepdims=False)
-            out = stage_fn(local_blocks, inp, mask_mb, rope_cos, rope_sin,
-                           bias_v)
+            out, aux_mb = stage_fn(local_blocks, inp, mask_mb, rope_cos,
+                                   rope_sin, bias_v)
+            # Stage s computes real work only while microbatch (t - s) is in
+            # range; warmup/drain ticks run on garbage activations and must
+            # not pollute the MoE aux-loss accumulator.
+            computing = (t >= stage) & (t - stage < n_micro)
+            aux_acc = aux_acc + jnp.where(computing, aux_mb, 0.0)
 
             out_idx = t - (n - 1)
             idx_c = jnp.clip(out_idx, 0, n_micro - 1)
@@ -210,23 +219,32 @@ def pipeline_forward(
                 outputs, jnp.where(valid, out, cur), idx_c, 0)
 
             state = lax.ppermute(out, AXIS_STAGE, perm)
-            return (state, outputs), None
+            return (state, outputs, aux_acc), None
 
         n_ticks = n_micro + n_stages - 1
         state0 = jnp.zeros_like(x_micro[0])
         out0 = jnp.zeros_like(x_micro)
-        (_, outputs), _ = lax.scan(tick, (state0, out0),
-                                   jnp.arange(n_ticks))
+        aux0 = jnp.zeros((), jnp.float32)
+        (_, outputs, aux_acc), _ = lax.scan(tick, (state0, out0, aux0),
+                                            jnp.arange(n_ticks))
         # Only the last stage holds real outputs; zero the rest and psum to
         # replicate across the stage axis (fp32 throughout, see above).
         outputs = jnp.where(stage == n - 1, outputs, 0)
-        return lax.psum(outputs, AXIS_STAGE)
+        # Each stage accumulated n_micro per-microbatch layer-mean aux
+        # values; psum/n_stages averages over stages (= over all layers),
+        # /n_micro over microbatches, pmean over seq shards.
+        aux = lax.psum(aux_acc, AXIS_STAGE) / (n * n_micro)
+        aux = lax.pmean(aux, AXIS_SEQ)
+        return lax.psum(outputs, AXIS_STAGE), aux
 
-    y = run(blocks, x_micro, mask_micro, *rope_args, bias)
+    y, aux = run(blocks, x_micro, mask_micro, *rope_args, bias)
     hidden = y.reshape(b, s, d).astype(cfg.dtype)
     if return_hidden:
-        return hidden
-    return _unembed(cfg, params, hidden)
+        return hidden, aux
+    logits = _unembed(cfg, params, hidden)
+    if with_aux:
+        return logits, aux
+    return logits
 
 
 def pipeline_loss_fn(
@@ -247,11 +265,22 @@ def pipeline_loss_fn(
     input_ids = batch["input_ids"]
     attn_mask = batch.get("attention_mask")
     if cfg.loss_chunk_size:
-        hidden = pipeline_forward(cfg, params, input_ids, attn_mask,
-                                  mesh=mesh, n_microbatches=n_microbatches,
-                                  return_hidden=True)
-        return chunked_next_token_xent(cfg, params, hidden, input_ids,
-                                       attn_mask, cfg.loss_chunk_size)
-    logits = pipeline_forward(cfg, params, input_ids, attn_mask,
-                              mesh=mesh, n_microbatches=n_microbatches)
-    return next_token_xent(logits, input_ids, attn_mask)
+        hidden, aux = pipeline_forward(
+            cfg, params, input_ids, attn_mask, mesh=mesh,
+            n_microbatches=n_microbatches, return_hidden=True)
+        loss, metrics = chunked_next_token_xent(cfg, params, hidden,
+                                                input_ids, attn_mask,
+                                                cfg.loss_chunk_size)
+    elif cfg.moe_experts:
+        logits, aux = pipeline_forward(
+            cfg, params, input_ids, attn_mask, mesh=mesh,
+            n_microbatches=n_microbatches, with_aux=True)
+        loss, metrics = next_token_xent(logits, input_ids, attn_mask)
+    else:
+        logits = pipeline_forward(cfg, params, input_ids, attn_mask,
+                                  mesh=mesh, n_microbatches=n_microbatches)
+        return next_token_xent(logits, input_ids, attn_mask)
+    if cfg.moe_experts:  # mirror loss_fn's shared aux combination
+        loss = loss + cfg.moe_aux_weight * aux
+        metrics = dict(metrics, loss=loss, aux_loss=aux)
+    return loss, metrics
