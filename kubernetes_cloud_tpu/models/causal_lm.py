@@ -345,7 +345,12 @@ def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
 
         attn_vec = ring_attention(q, k, v, mesh, causal=True, kv_mask=mask)
     else:
-        attn_vec = attention(q, k, v, causal=True, bias=bias, mask=mask,
+        # ``bias`` rank disambiguates: [H] = ALiBi slopes (computed
+        # in-kernel on the pallas path), higher rank = materialized bias.
+        slopes = bias if bias is not None and bias.ndim == 1 else None
+        attn_vec = attention(q, k, v, causal=True,
+                             bias=None if slopes is not None else bias,
+                             alibi_slopes=slopes, mask=mask,
                              impl="auto" if cfg.attn_impl == "ring"
                              else cfg.attn_impl)
     from jax.ad_checkpoint import checkpoint_name
@@ -415,11 +420,10 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
     if cfg.pos_emb == "rope":
         rope = rope_cache(s, cfg.rotary_dim, cfg.rope_theta)
     elif cfg.pos_emb == "alibi":
-        slopes = alibi_slopes(cfg.num_heads)
-        kpos = jnp.arange(s, dtype=jnp.float32)
-        # [1, H, 1, S]: per-key distance bias; combined with the causal mask
-        # this is exactly ALiBi's -slope * (i - j).
-        bias = (slopes[None, :, None, None] * kpos[None, None, None, :])
+        # Per-head slopes only; the per-key bias ``slope * k_pos`` (ALiBi's
+        # -slope*(i-j) under the causal mask, by softmax shift-invariance)
+        # is materialized by the XLA path or computed in-kernel by pallas.
+        bias = alibi_slopes(cfg.num_heads)
 
     block = _block
     if cfg.remat:

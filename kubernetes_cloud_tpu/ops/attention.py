@@ -77,10 +77,14 @@ def attention(
     mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     impl: str = "auto",
+    alibi_slopes: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Scaled dot-product attention over [B, S, H, Dh] tensors.
 
-    ``bias``: additive [B or 1, H, Sq, Sk] bias (ALiBi).
+    ``bias``: additive [B or 1, H, Sq, Sk] bias tensor (XLA path only).
+    ``alibi_slopes``: per-head [H] ALiBi slopes — the structured form of
+    the per-key bias ``slope_h * k_pos``; the pallas path computes it
+    in-kernel, the XLA path materializes it here.
     ``mask``: [B, Sk] key padding mask or full [B, 1, Sq, Sk] mask, nonzero
     = attend (the reference trains with exactly this padding-mask semantics,
     ``finetuner-workflow/finetuner/finetuner.py:475-493``).
@@ -88,22 +92,27 @@ def attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if impl == "auto":
-        impl = _pick_impl(q, k, bias, mask)
+        impl = _pick_impl(q, k, bias, mask, alibi_slopes)
     if impl == "pallas":
         from kubernetes_cloud_tpu.ops import flash_attention
 
         return flash_attention.flash_attention(
-            q, k, v, causal=causal, bias=bias, mask=mask, scale=scale
+            q, k, v, causal=causal, bias=bias, mask=mask, scale=scale,
+            alibi_slopes=alibi_slopes,
         )
+    if alibi_slopes is not None:
+        kpos = jnp.arange(k.shape[1], dtype=jnp.float32)
+        alibi = alibi_slopes[None, :, None, None] * kpos[None, None, None, :]
+        bias = alibi if bias is None else bias + alibi
     return _mha_xla(q, k, v, causal=causal, bias=bias, mask=mask, scale=scale)
 
 
-def _pick_impl(q, k, bias, mask) -> str:
+def _pick_impl(q, k, bias, mask, alibi_slopes=None) -> str:
     from kubernetes_cloud_tpu.ops import flash_attention
 
     if not flash_attention.available():
         return "xla"
-    if not flash_attention.supports(q, k, bias):
+    if not flash_attention.supports(q, k, bias, alibi_slopes):
         return "xla"
     if mask is not None and mask.ndim != 2:
         return "xla"  # full [B,1,Sq,Sk] masks stay on the einsum path

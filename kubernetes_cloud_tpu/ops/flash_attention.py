@@ -10,21 +10,26 @@ DeepSpeed-Inference injection, ``bloom-176b-deepspeed/Dockerfile:1-15``).
 
 Mapping notes:
 
-* layout: kernel wants [B, H, S, D]; we transpose in/out.
+* layout: kernels want [B, H, S, D]; we transpose in/out.
 * padding masks ([B, Sk], nonzero = attend) become kernel segment ids —
   real tokens segment 1, pads segment 0, so cross-segment attention is
   masked inside the kernel without an [Sq, Sk] mask tensor.
-* ALiBi bias is passed through as the kernel's additive ``ab`` term.
-* GQA repeats KV heads up to the query head count before the call
-  (the kernel is MHA-only); correctness-preserving, costs KV bandwidth.
+* **MHA, no bias** dispatches to the stock kernel (battle-tested tiling).
+* **GQA and/or ALiBi** dispatch to this framework's own grouped kernel
+  (:mod:`kubernetes_cloud_tpu.ops.flash_kernel`): KV heads stay
+  unrepeated in HBM and the ALiBi bias is computed in-kernel from
+  per-head slopes instead of streaming an [Sq, Sk] tensor.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from kubernetes_cloud_tpu.ops import flash_kernel
 
 try:  # pragma: no cover - exercised on TPU only
     from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -41,7 +46,14 @@ except Exception:  # noqa: BLE001 - any import failure => no kernel
 _BLOCK = 128
 
 
+def _interpret() -> bool:
+    """Test hook: run the Pallas kernels in interpreter mode on CPU."""
+    return os.environ.get("KCT_FLASH_INTERPRET") == "1"
+
+
 def available() -> bool:
+    if _interpret():
+        return True
     if not _KERNEL:
         return False
     try:
@@ -58,19 +70,26 @@ _MIN_SEQ = 2048
 
 
 def supports(q: jax.Array, k: jax.Array,
-             bias: Optional[jax.Array] = None) -> bool:
-    """Shape eligibility: both sequence lengths divisible by the 4*128
-    block _block_sizes picks, equal (self-attention; the Sq=1 decode path
-    stays on the XLA impl, whose single-query einsum is already a plain
-    matmul), and long enough that the kernel beats XLA's fused attention
-    end-to-end.  Bias-carrying attention (ALiBi) stays on XLA: the kernel
-    would materialize the [B,H,Sq,Sk] ``ab`` tensor plus a same-sized,
-    discarded dab gradient — exactly the memory the kernel exists to
-    avoid."""
+             bias: Optional[jax.Array] = None,
+             alibi_slopes: Optional[jax.Array] = None) -> bool:
+    """Shape eligibility: equal sequence lengths (self-attention; the Sq=1
+    decode path stays on the XLA impl, whose single-query einsum is
+    already a plain matmul), block-aligned, and long enough that a kernel
+    beats XLA's fused attention end-to-end.  ALiBi arrives as per-head
+    ``alibi_slopes`` and runs on the grouped kernel; arbitrary
+    materialized ``bias`` tensors stay on XLA (streaming [B,H,Sq,Sk]
+    through HBM plus a discarded dab cotangent is exactly the traffic a
+    fused kernel exists to avoid)."""
     if bias is not None:
         return False
     sq, sk = q.shape[1], k.shape[1]
-    return sq == sk and sq % (4 * _BLOCK) == 0 and sq >= _MIN_SEQ
+    if not (sq == sk and sq >= _MIN_SEQ):
+        return False
+    h, hkv, dh = q.shape[2], k.shape[2], q.shape[3]
+    if h != hkv or alibi_slopes is not None:  # grouped-kernel path
+        return flash_kernel.supported(sq, sk, dh, h, hkv,
+                                      dtype_bytes=q.dtype.itemsize)
+    return sq % (4 * _BLOCK) == 0
 
 
 def _block_sizes(sq: int, sk: int) -> "BlockSizes":
@@ -100,13 +119,26 @@ def flash_attention(
     bias: Optional[jax.Array],
     mask: Optional[jax.Array],
     scale: float,
+    alibi_slopes: Optional[jax.Array] = None,
 ) -> jax.Array:
     b, sq, h, dh = q.shape
     hkv = k.shape[2]
-    if hkv != h:  # GQA -> MHA for the kernel
-        rep = h // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    if mask is not None and mask.ndim != 2:
+        raise ValueError(
+            "pallas path takes [B, Sk] padding masks; full masks "
+            "route to impl='xla'")
+
+    if hkv != h or alibi_slopes is not None or _interpret():
+        # Grouped kernel: unrepeated KV, ALiBi computed in-kernel.
+        if bias is not None:
+            raise ValueError("materialized bias tensors route to impl='xla'")
+        ids = (mask != 0).astype(jnp.int32) if mask is not None else None
+        out = flash_kernel.flash_mha(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), slopes=alibi_slopes,
+            q_seg=ids, kv_seg=ids, causal=causal, scale=scale,
+            interpret=_interpret())
+        return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
@@ -114,10 +146,6 @@ def flash_attention(
 
     segment_ids = None
     if mask is not None:
-        if mask.ndim != 2:
-            raise ValueError(
-                "pallas path takes [B, Sk] padding masks; full masks "
-                "route to impl='xla'")
         ids = (mask != 0).astype(jnp.int32)
         segment_ids = SegmentIds(q=ids, kv=ids)
 

@@ -1,0 +1,487 @@
+"""Grouped-query flash attention with in-kernel ALiBi — a Pallas TPU kernel.
+
+The stock Pallas flash kernel (``jax.experimental.pallas.ops.tpu
+.flash_attention``) is MHA-only and takes additive bias as a materialized
+``[B, H, Sq, Sk]`` tensor.  Both limits matter here:
+
+* **GQA**: repeating KV heads up to the query head count costs exactly the
+  KV HBM bandwidth a fused kernel exists to save.  This kernel instead
+  grids over ``(batch, kv_head, group, q_block)`` and maps every query head
+  of a group onto the *same* unrepeated KV block via the BlockSpec index
+  map — consecutive grid steps reuse the resident VMEM copy, so K/V are
+  read from HBM once per group, not once per query head.
+* **ALiBi** (BLOOM, reference ``online-inference/bloom-176b*``): the bias
+  is a rank-1 function ``slope_h * k_pos`` — computed in-kernel from a
+  per-head scalar instead of streaming an [Sq, Sk]-sized tensor (and its
+  discarded ``dab`` cotangent) through HBM.
+
+Backward follows the FlashAttention-2 recompute scheme: forward saves only
+the logsumexp ``[B, H, Sq]``; ``dq`` grids like the forward, ``dk/dv``
+grid over ``(batch, kv_head, k_block, group)`` with the group dimension
+innermost so the unrepeated dk/dv output block stays resident in VMEM and
+accumulates across the group's query heads.
+
+Layout: [B, H, S, D] head-major (callers transpose from the framework's
+[B, S, H, D]).  Scores/softmax/accumulation in fp32 on the MXU
+(``preferred_element_type``), probabilities cast back to the input dtype
+for the p·V matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-negative instead of -inf: exp() stays NaN-free
+
+#: sequence block edge; S must divide by this (kernel uses min(_BLOCK, S))
+_BLOCK = 512
+_LANE = 128
+#: lane padding for row-vector tensors (lse/delta/segment ids): Mosaic
+#: requires the trailing block dim to divide 128 or equal the array dim,
+#: so [.., Sq]-shaped values are stored as [.., Sq, 8] (fp32 min tile).
+_ROWPAD = 8
+
+
+def block_for(s: int) -> int:
+    return min(_BLOCK, s)
+
+
+def _mask_scores(s, qi0, kj0, bq, bk, *, causal, q_seg, kv_seg):
+    """Apply causal + segment masks to a [bq, bk] score block in fp32.
+
+    ``q_seg``: [bq, 1] column, ``kv_seg``: [1, bk] row (lane-padded
+    storage, see ``_ROWPAD``)."""
+    if causal:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi0
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj0
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    if q_seg is not None:
+        s = jnp.where(q_seg == kv_seg, s, NEG_INF)
+    return s
+
+
+def _alibi_term(slope, kj0, bq, bk):
+    """ALiBi per-key bias ``slope * k_pos`` for a [bq, bk] block.
+
+    Per-key (not distance) form: softmax is shift-invariant per row, so
+    ``slope*j`` equals ``-slope*(i-j)`` under a causal mask — matching
+    :func:`ops.attention._mha_xla`'s materialized bias exactly.
+    """
+    kpos = (jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj0
+            ).astype(jnp.float32)
+    return slope * kpos
+
+
+def _expand_segs(q_seg, kv_seg):
+    """[B, Sq]/[B, Sk] ids -> lane-padded [B, Sq, _ROWPAD] / [B, _ROWPAD, Sk]."""
+    b, sq = q_seg.shape
+    sk = kv_seg.shape[1]
+    qx = jax.lax.broadcast_in_dim(q_seg.astype(jnp.int32),
+                                  (b, sq, _ROWPAD), (0, 1))
+    kx = jax.lax.broadcast_in_dim(kv_seg.astype(jnp.int32),
+                                  (b, _ROWPAD, sk), (0, 2))
+    return [qx, kx]
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(*refs, group: int, bq: int, bk: int, nk: int, causal: bool,
+                scale: float, have_slopes: bool, have_seg: bool):
+    idx = 0
+    q_ref = refs[idx]; idx += 1
+    k_ref = refs[idx]; idx += 1
+    v_ref = refs[idx]; idx += 1
+    slopes_ref = q_seg_ref = kv_seg_ref = None
+    if have_slopes:
+        slopes_ref = refs[idx]; idx += 1
+    if have_seg:
+        q_seg_ref = refs[idx]; idx += 1
+        kv_seg_ref = refs[idx]; idx += 1
+    o_ref, lse_ref = refs[idx], refs[idx + 1]
+
+    i = pl.program_id(3)
+    qi0 = i * bq
+    q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+    head = pl.program_id(1) * group + pl.program_id(2)
+    slope = slopes_ref[head, 0] if have_slopes else None
+    q_seg = q_seg_ref[0][:, :1] if have_seg else None
+
+    if causal:
+        # Only k blocks intersecting the causal triangle for this q block.
+        n_kb = (qi0 + bq + bk - 1) // bk
+    else:
+        n_kb = nk
+
+    def body(kb, carry):
+        acc, m, l = carry
+        kj0 = kb * bk
+        kblk = k_ref[0, 0, pl.ds(kj0, bk), :]
+        vblk = v_ref[0, 0, pl.ds(kj0, bk), :]
+        s = jax.lax.dot_general(
+            q, kblk.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if have_slopes:
+            s = s + _alibi_term(slope, kj0, bq, bk)
+        kv_seg = (kv_seg_ref[0, :1, pl.ds(kj0, bk)] if have_seg
+                  else None)
+        s = _mask_scores(s, qi0, kj0, bq, bk, causal=causal,
+                         q_seg=q_seg, kv_seg=kv_seg)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc * alpha + pv, m_new, l
+
+    d = q.shape[-1]
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l_safe), (bq, _ROWPAD))
+
+
+def _fwd(q, k, v, slopes, q_seg, kv_seg, causal, scale, interpret):
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    g = h // hkv
+    bq, bk = block_for(sq), block_for(sk)
+    nq, nk = sq // bq, sk // bk
+    have_slopes = slopes is not None
+    have_seg = q_seg is not None
+
+    grid = (b, hkv, g, nq)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, kh, g_, i: (b_, kh, 0, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, kh, g_, i: (b_, kh, 0, 0)),
+    ]
+    args = [q, k, v]
+    if have_slopes:
+        in_specs.append(
+            pl.BlockSpec((h, 1), lambda b_, kh, g_, i: (0, 0),
+                         memory_space=pltpu.SMEM))
+        args.append(slopes.reshape(h, 1).astype(jnp.float32))
+    if have_seg:
+        in_specs.append(
+            pl.BlockSpec((1, bq, _ROWPAD), lambda b_, kh, g_, i: (b_, i, 0)))
+        in_specs.append(
+            pl.BlockSpec((1, _ROWPAD, sk), lambda b_, kh, g_, i: (b_, 0, 0)))
+        args += _expand_segs(q_seg, kv_seg)
+
+    kernel = functools.partial(
+        _fwd_kernel, group=g, bq=bq, bk=bk, nk=nk, causal=causal,
+        scale=scale, have_slopes=have_slopes, have_seg=have_seg)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0)),
+            pl.BlockSpec((1, 1, bq, _ROWPAD),
+                         lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, _ROWPAD), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(*refs, group: int, bq: int, bk: int, nk: int, causal: bool,
+               scale: float, have_slopes: bool, have_seg: bool):
+    idx = 0
+    q_ref = refs[idx]; idx += 1
+    k_ref = refs[idx]; idx += 1
+    v_ref = refs[idx]; idx += 1
+    do_ref = refs[idx]; idx += 1
+    lse_ref = refs[idx]; idx += 1
+    delta_ref = refs[idx]; idx += 1
+    slopes_ref = q_seg_ref = kv_seg_ref = None
+    if have_slopes:
+        slopes_ref = refs[idx]; idx += 1
+    if have_seg:
+        q_seg_ref = refs[idx]; idx += 1
+        kv_seg_ref = refs[idx]; idx += 1
+    dq_ref = refs[idx]
+
+    i = pl.program_id(3)
+    qi0 = i * bq
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, :1]
+    delta = delta_ref[0, 0][:, :1]
+    head = pl.program_id(1) * group + pl.program_id(2)
+    slope = slopes_ref[head, 0] if have_slopes else None
+    q_seg = q_seg_ref[0][:, :1] if have_seg else None
+
+    n_kb = (qi0 + bq + bk - 1) // bk if causal else nk
+
+    def body(kb, dq):
+        kj0 = kb * bk
+        kblk = k_ref[0, 0, pl.ds(kj0, bk), :].astype(jnp.float32)
+        vblk = v_ref[0, 0, pl.ds(kj0, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if have_slopes:
+            s = s + _alibi_term(slope, kj0, bq, bk)
+        kv_seg = (kv_seg_ref[0, :1, pl.ds(kj0, bk)] if have_seg
+                  else None)
+        s = _mask_scores(s, qi0, kj0, bq, bk, causal=causal,
+                         q_seg=q_seg, kv_seg=kv_seg)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros_like(q)
+    dq = jax.lax.fori_loop(0, n_kb, body, dq0)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(*refs, group: int, bq: int, bk: int, nq: int, causal: bool,
+                scale: float, have_slopes: bool, have_seg: bool):
+    idx = 0
+    q_ref = refs[idx]; idx += 1
+    k_ref = refs[idx]; idx += 1
+    v_ref = refs[idx]; idx += 1
+    do_ref = refs[idx]; idx += 1
+    lse_ref = refs[idx]; idx += 1
+    delta_ref = refs[idx]; idx += 1
+    slopes_ref = q_seg_ref = kv_seg_ref = None
+    if have_slopes:
+        slopes_ref = refs[idx]; idx += 1
+    if have_seg:
+        q_seg_ref = refs[idx]; idx += 1
+        kv_seg_ref = refs[idx]; idx += 1
+    dk_ref, dv_ref = refs[idx], refs[idx + 1]
+
+    j = pl.program_id(2)
+    g_idx = pl.program_id(3)
+    kj0 = j * bk
+    kblk = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+    vblk = v_ref[0, 0].astype(jnp.float32)
+    head = pl.program_id(1) * group + pl.program_id(3)
+    slope = slopes_ref[head, 0] if have_slopes else None
+    kv_seg = kv_seg_ref[0, :1, :] if have_seg else None
+
+    # Causal: q blocks strictly above the diagonal band contribute nothing.
+    qb_start = kj0 // bq if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        qi0 = qb * bq
+        q = q_ref[0, 0, pl.ds(qi0, bq), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(qi0, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi0, bq), :1]
+        delta = delta_ref[0, 0, pl.ds(qi0, bq), :1]
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if have_slopes:
+            s = s + _alibi_term(slope, kj0, bq, bk)
+        q_seg = (q_seg_ref[0, pl.ds(qi0, bq), :1] if have_seg
+                 else None)
+        s = _mask_scores(s, qi0, kj0, bq, bk, causal=causal,
+                         q_seg=q_seg, kv_seg=kv_seg)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = kblk.shape[-1]
+    zero = (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(qb_start, nq, body, zero)
+
+    # The group axis is innermost, so this (b, kv_head, j) output block is
+    # resident across the g sweep: initialize at g==0, accumulate after.
+    @pl.when(g_idx == 0)
+    def _():
+        dk_ref[0, 0] = dk
+        dv_ref[0, 0] = dv
+
+    @pl.when(g_idx > 0)
+    def _():
+        dk_ref[0, 0] += dk
+        dv_ref[0, 0] += dv
+
+
+def _bwd(causal, scale, interpret, res, dout):
+    q, k, v, slopes, q_seg, kv_seg, out, lse = res
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    g = h // hkv
+    bq, bk = block_for(sq), block_for(sk)
+    nq, nk = sq // bq, sk // bk
+    have_slopes = slopes is not None
+    have_seg = q_seg is not None
+
+    delta = jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32),
+                    axis=-1)  # [B, H, Sq]
+    delta = jax.lax.broadcast_in_dim(delta, (b, h, sq, _ROWPAD), (0, 1, 2))
+
+    slope_arg = (slopes.reshape(h, 1).astype(jnp.float32)
+                 if have_slopes else None)
+    seg_args = _expand_segs(q_seg, kv_seg) if have_seg else []
+
+    # --- dq: grids like the forward -----------------------------------
+    qspec = pl.BlockSpec((1, 1, bq, d),
+                         lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0))
+    kvspec = pl.BlockSpec((1, 1, sk, d), lambda b_, kh, g_, i: (b_, kh, 0, 0))
+    rowspec = pl.BlockSpec((1, 1, bq, _ROWPAD),
+                           lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0))
+    in_specs = [qspec, kvspec, kvspec, qspec, rowspec, rowspec]
+    args = [q, k, v, dout, lse, delta]
+    if have_slopes:
+        in_specs.append(
+            pl.BlockSpec((h, 1), lambda b_, kh, g_, i: (0, 0),
+                         memory_space=pltpu.SMEM))
+        args.append(slope_arg)
+    if have_seg:
+        in_specs.append(
+            pl.BlockSpec((1, bq, _ROWPAD), lambda b_, kh, g_, i: (b_, i, 0)))
+        in_specs.append(
+            pl.BlockSpec((1, _ROWPAD, sk), lambda b_, kh, g_, i: (b_, 0, 0)))
+        args += seg_args
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, group=g, bq=bq, bk=bk, nk=nk, causal=causal,
+            scale=scale, have_slopes=have_slopes, have_seg=have_seg),
+        grid=(b, hkv, g, nq),
+        in_specs=in_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+        interpret=interpret,
+    )(*args)
+
+    # --- dk/dv: group axis innermost, output block accumulates --------
+    qfull = pl.BlockSpec((1, 1, sq, d),
+                         lambda b_, kh, j, g_: (b_, kh * g + g_, 0, 0))
+    kblk_spec = pl.BlockSpec((1, 1, bk, d),
+                             lambda b_, kh, j, g_: (b_, kh, j, 0))
+    rowfull = pl.BlockSpec((1, 1, sq, _ROWPAD),
+                           lambda b_, kh, j, g_: (b_, kh * g + g_, 0, 0))
+    in_specs = [qfull, kblk_spec, kblk_spec, qfull, rowfull, rowfull]
+    args = [q, k, v, dout, lse, delta]
+    if have_slopes:
+        in_specs.append(
+            pl.BlockSpec((h, 1), lambda b_, kh, j, g_: (0, 0),
+                         memory_space=pltpu.SMEM))
+        args.append(slope_arg)
+    if have_seg:
+        in_specs.append(
+            pl.BlockSpec((1, sq, _ROWPAD), lambda b_, kh, j, g_: (b_, 0, 0)))
+        in_specs.append(
+            pl.BlockSpec((1, _ROWPAD, bk), lambda b_, kh, j, g_: (b_, 0, j)))
+        args += seg_args
+    dkv_spec = pl.BlockSpec((1, 1, bk, d),
+                            lambda b_, kh, j, g_: (b_, kh, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, group=g, bq=bq, bk=bk, nq=nq, causal=causal,
+            scale=scale, have_slopes=have_slopes, have_seg=have_seg),
+        grid=(b, hkv, nk, g),
+        in_specs=in_specs,
+        out_specs=[dkv_spec, dkv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, sk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _flash(q, k, v, slopes, q_seg, kv_seg, causal, scale, interpret):
+    out, _ = _fwd(q, k, v, slopes, q_seg, kv_seg, causal, scale, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, slopes, q_seg, kv_seg, causal, scale, interpret):
+    out, lse = _fwd(q, k, v, slopes, q_seg, kv_seg, causal, scale, interpret)
+    return out, (q, k, v, slopes, q_seg, kv_seg, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def supported(sq: int, sk: int, d: int, h: int, hkv: int,
+              dtype_bytes: int = 2) -> bool:
+    """Shape eligibility: block-aligned sequences, whole-group heads, and
+    K/V resident in VMEM per (batch, kv-head) grid step."""
+    if h % hkv:
+        return False
+    if sq % _LANE or sk % _LANE or sq % block_for(sq) or sk % block_for(sk):
+        return False
+    # K+V resident + double buffering must fit comfortably in 16 MiB VMEM.
+    if 2 * sk * d * dtype_bytes > 4 * 1024 * 1024:
+        return False
+    return True
+
+
+def flash_mha(
+    q: jax.Array,  # [B, H, Sq, D]
+    k: jax.Array,  # [B, Hkv, Sk, D]
+    v: jax.Array,
+    *,
+    slopes: Optional[jax.Array] = None,  # [H] ALiBi slopes
+    q_seg: Optional[jax.Array] = None,   # [B, Sq] nonzero = real token
+    kv_seg: Optional[jax.Array] = None,  # [B, Sk]
+    causal: bool = True,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Grouped-query flash attention; returns [B, H, Sq, D].
+
+    KV heads stay unrepeated in HBM (the group dimension is a grid axis
+    reusing the resident VMEM block); ALiBi comes in as per-head slopes
+    and is computed on the fly inside each score block.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if (q_seg is None) != (kv_seg is None):
+        raise ValueError("q_seg and kv_seg must be given together")
+    return _flash(q, k, v, slopes, q_seg, kv_seg, causal, float(scale),
+                  interpret)

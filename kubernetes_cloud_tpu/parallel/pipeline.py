@@ -100,14 +100,14 @@ def pipeline_forward(
     x_micro = x.reshape(n_microbatches, mb, s, d).astype(jnp.float32)
 
     rope = None
-    bias = jnp.zeros((1, 1, 1, 1), jnp.float32)
+    bias = jnp.zeros((1,), jnp.float32)
     has_bias = False
     if cfg.pos_emb == "rope":
         rope = rope_cache(s, cfg.rotary_dim, cfg.rope_theta)
     elif cfg.pos_emb == "alibi":
-        slopes = alibi_slopes(cfg.num_heads)
-        kpos = jnp.arange(s, dtype=jnp.float32)
-        bias = slopes[None, :, None, None] * kpos[None, None, None, :]
+        # [H] slopes; _block materializes (XLA) or computes in-kernel
+        # (pallas) the per-key bias from them.
+        bias = alibi_slopes(cfg.num_heads)
         has_bias = True
 
     if attention_mask is None:

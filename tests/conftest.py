@@ -26,6 +26,60 @@ if jax.default_backend() != "cpu":
     jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
+# ---------------------------------------------------------------------------
+# quick / slow lanes: ``pytest -m quick`` gives a <5 min core signal on a
+# 1-CPU box; ``-m slow`` runs the heavy end-to-end/chaos/parity tests.
+# Measured on a 1-CPU runner; entries are tests >= ~10 s there.
+# ---------------------------------------------------------------------------
+
+SLOW_TESTS = {
+    "tests/test_causal_lm.py::test_chunked_loss_matches_dense",
+    "tests/test_causal_lm.py::test_remat_matches_no_remat",
+    "tests/test_chaos.py::test_kill_and_resume",
+    "tests/test_chaos.py::test_sigterm_graceful_checkpoint",
+    "tests/test_data_tools.py::TestReplicatedService::test_multi_candidate_generation",
+    "tests/test_diffusion.py::test_sd_dreambooth_prior_loss",
+    "tests/test_diffusion.py::test_sd_service_roundtrip",
+    "tests/test_diffusion.py::test_sd_train_loop_and_checkpoint",
+    "tests/test_diffusion.py::test_sd_v_prediction_changes_target",
+    "tests/test_entrypoints.py::test_classifier_service_roundtrip",
+    "tests/test_entrypoints.py::test_sd_finetuner_cli_end_to_end",
+    "tests/test_entrypoints.py::test_sd_serialize_entrypoint",
+    "tests/test_finetuner_cli.py::test_evaluator_main",
+    "tests/test_finetuner_cli.py::test_finetuner_main_end_to_end",
+    "tests/test_hf_parity.py::test_gpt_neox_parity",
+    "tests/test_moe.py::test_moe_grad_flows_to_router",
+    "tests/test_moe.py::test_moe_lm_expert_parallel_train",
+    "tests/test_multiprocess.py::test_two_process_training",
+    "tests/test_pipeline.py::test_pipeline_composed_with_moe",
+    "tests/test_pipeline.py::test_pipeline_composed_with_seq_parallel",
+    "tests/test_pipeline.py::test_pipeline_grad_matches_dense",
+    "tests/test_pipeline.py::test_pipeline_train_step",
+    "tests/test_resnet.py::test_bottleneck_param_count_resnet50",
+    "tests/test_resnet.py::test_forward_shapes_and_dtype",
+    "tests/test_resnet.py::test_synthetic_learning_and_eval",
+    "tests/test_ring_attention.py::test_ring_gqa",
+    "tests/test_seq_parallel.py::test_seq_parallel_remat",
+    "tests/test_seq_parallel.py::test_seq_parallel_train_step_matches_dense",
+    "tests/test_tp_serving.py::test_tp_matches_single_device",
+    "tests/test_train_step.py::test_loss_decreases_single_device",
+    "tests/test_train_step.py::test_sharded_training_matches_single_device",
+    "tests/test_trainer.py::test_fused_single_gas",
+    "tests/test_trainer.py::test_prompt_sampling",
+    "tests/test_trainer.py::test_resume_from_checkpoint",
+    "tests/test_trainer.py::test_train_end_to_end",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        base = item.nodeid.split("[")[0]
+        if base in SLOW_TESTS or item.get_closest_marker("slow"):
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.quick)
+
+
 def cpu_devices(n=8):
     devs = jax.devices("cpu")
     if len(devs) < n:

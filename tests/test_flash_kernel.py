@@ -1,0 +1,152 @@
+"""Grouped flash-attention kernel (ops/flash_kernel) vs the XLA path.
+
+Runs the Pallas kernels in interpreter mode on the CPU test machine; the
+same code compiles via Mosaic on TPU.  Matmul precision is forced to
+``highest`` — the kernel and XLA paths reduce in different orders, so
+comparisons are only meaningful with exact fp32 matmuls.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.ops.attention import _mha_xla
+from kubernetes_cloud_tpu.ops.flash_kernel import flash_mha, supported
+from kubernetes_cloud_tpu.ops.layers import alibi_slopes
+
+pytestmark = pytest.mark.slow  # interpret-mode kernels are minutes on 1 CPU
+
+
+@pytest.fixture(autouse=True)
+def _exact_matmuls():
+    with jax.default_matmul_precision("highest"):
+        yield
+
+
+def _ref(q, k, v, *, slopes=None, mask=None, causal=True):
+    """XLA reference in kernel layout [B, H, S, D]."""
+    d = q.shape[-1]
+    bias = None
+    if slopes is not None:
+        kpos = jnp.arange(k.shape[2], dtype=jnp.float32)
+        bias = slopes[None, :, None, None] * kpos[None, None, None, :]
+    out = _mha_xla(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                   v.transpose(0, 2, 1, 3), causal=causal, bias=bias,
+                   mask=mask, scale=d ** -0.5)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _qkv(b=1, h=4, hkv=2, s=1024, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32)
+    return q, k, v
+
+
+def test_gqa_multiblock_matches_xla():
+    """1024-seq = 2 blocks of 512: exercises the online-softmax carry."""
+    q, k, v = _qkv()
+    got = flash_mha(q, k, v, causal=True, interpret=True)
+    want = _ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_alibi_in_kernel_matches_materialized_bias():
+    q, k, v = _qkv(h=4, hkv=4)  # BLOOM is MHA
+    slopes = alibi_slopes(4)
+    got = flash_mha(q, k, v, slopes=slopes, causal=True, interpret=True)
+    want = _ref(q, k, v, slopes=slopes, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_padding_segments_match_xla_mask():
+    q, k, v = _qkv(b=2)
+    mask = jnp.ones((2, 1024), jnp.int32).at[:, 900:].set(0)
+    got = flash_mha(q, k, v, q_seg=mask, kv_seg=mask, causal=True,
+                    interpret=True)
+    want = _ref(q, k, v, mask=mask, causal=True)
+    np.testing.assert_allclose(np.asarray(got)[:, :, :900],
+                               np.asarray(want)[:, :, :900],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grads_match_xla_gqa_alibi_padded():
+    q, k, v = _qkv(b=2, h=4, hkv=2)
+    slopes = alibi_slopes(4)
+    mask = jnp.ones((2, 1024), jnp.int32).at[:, 1000:].set(0)
+    w = mask[:, None, :, None]
+
+    def loss_k(q, k, v):
+        return (flash_mha(q, k, v, slopes=slopes, q_seg=mask, kv_seg=mask,
+                          causal=True, interpret=True) * w).sum()
+
+    def loss_r(q, k, v):
+        return (_ref(q, k, v, slopes=slopes, mask=mask, causal=True)
+                * w).sum()
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        a, b = np.asarray(a), np.asarray(b)
+        scale = np.abs(b).max()
+        assert np.abs(a - b).max() < 1e-4 * scale + 1e-6
+
+
+def test_wrapper_dispatches_gqa_and_alibi(monkeypatch):
+    """attention(impl='auto') routes GQA/ALiBi shapes onto the grouped
+    kernel when the pallas backend is available."""
+    import importlib
+
+    attn_mod = importlib.import_module("kubernetes_cloud_tpu.ops.attention")
+    from kubernetes_cloud_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("KCT_FLASH_INTERPRET", "1")
+    monkeypatch.setattr(fa, "_MIN_SEQ", 256)
+
+    b, s, h, hkv, d = 1, 256, 4, 2, 32
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    slopes = alibi_slopes(h)
+
+    assert attn_mod._pick_impl(q, k, None, None, slopes) == "pallas"
+    got = attn_mod.attention(q, k, v, causal=True, alibi_slopes=slopes)
+    want = attn_mod.attention(q, k, v, causal=True, alibi_slopes=slopes,
+                              impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bloom_style_forward_on_kernel_path(monkeypatch):
+    """bloom-style preset (ALiBi, MHA) forward: pallas == xla end to end."""
+    from kubernetes_cloud_tpu.models.causal_lm import (
+        PRESETS,
+        forward,
+        init_params,
+    )
+
+    monkeypatch.setenv("KCT_FLASH_INTERPRET", "1")
+    cfg = dataclasses.replace(PRESETS["test-tiny"], pos_emb="alibi",
+                              dtype=jnp.float32, attn_impl="pallas")
+    params = init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 128), 0,
+                             cfg.vocab_size, dtype=jnp.int32)
+    got = forward(cfg, params, ids)
+    want = forward(dataclasses.replace(cfg, attn_impl="xla"), params, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_supported_gates():
+    assert supported(2048, 2048, 128, 8, 8)
+    assert supported(2048, 2048, 128, 8, 2)
+    assert not supported(2048, 2048, 128, 8, 3)       # ragged group
+    assert not supported(2000, 2000, 128, 8, 8)       # unaligned seq
+    assert not supported(32768, 32768, 128, 8, 8)     # K/V exceed VMEM
