@@ -74,4 +74,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import sys
+
+    if "--kernels" in sys.argv:
+        # Real-chip flash-kernel parity gate (Mosaic vs XLA, fwd+grads).
+        from scripts.kernel_parity import main as kernel_parity_main
+
+        sys.exit(kernel_parity_main())
     main()

@@ -175,8 +175,11 @@ void serve_connection(Server* s, int fd) {
                       resp.status, reason(resp.status),
                       resp.content_type.c_str(), resp.body.size(),
                       keep_alive ? "keep-alive" : "close");
-    // snprintf returns the untruncated would-be length; clamp so an
-    // oversized content_type can't read past the stack buffer.
+    // snprintf returns the untruncated would-be length (or negative on
+    // output error); clamp both sides so an oversized content_type can't
+    // read past the stack buffer and a negative hn can't become a huge
+    // size_t in write_all.
+    if (hn < 0) break;
     if (hn > (int)sizeof(head) - 1) hn = (int)sizeof(head) - 1;
     if (!write_all(fd, head, hn) ||
         !write_all(fd, resp.body.data(), resp.body.size())) {

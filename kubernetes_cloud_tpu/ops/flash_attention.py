@@ -69,27 +69,45 @@ def available() -> bool:
 _MIN_SEQ = 2048
 
 
+def _route(q, k, bias, alibi_slopes) -> str:
+    """THE routing decision, shared by :func:`supports` and
+    :func:`flash_attention` so eligibility and dispatch can't drift:
+
+    * ``'grouped'`` — this framework's kernel: unrepeated KV, in-kernel
+      ALiBi (GQA and/or ALiBi shapes passing its KV-resident VMEM gate).
+    * ``'stock-repeat'`` — GQA shapes past that gate (very long sk):
+      repeat KV heads onto the stock kernel.  Costs KV bandwidth, but the
+      XLA fallback would materialize the [Sq, Sk] scores — exactly what
+      OOMs at these lengths.  ALiBi has no stock-kernel form short of a
+      materialized bias tensor, so it can't take this route.
+    * ``'stock'`` — plain MHA on the battle-tested stock kernel.
+    * ``'xla'`` — everything else: short/unaligned sequences, Sq=1 decode
+      (a plain matmul already), and materialized ``bias`` tensors
+      (streaming [B,H,Sq,Sk] through HBM plus a discarded dab cotangent
+      is exactly the traffic a fused kernel exists to avoid).
+    """
+    if bias is not None:
+        return "xla"
+    sq, sk = q.shape[1], k.shape[1]
+    if not (sq == sk and sq >= _MIN_SEQ):
+        return "xla"
+    h, hkv, dh = q.shape[2], k.shape[2], q.shape[3]
+    if h != hkv or alibi_slopes is not None:
+        if flash_kernel.supported(sq, sk, dh, h, hkv,
+                                  dtype_bytes=q.dtype.itemsize):
+            return "grouped"
+        if (alibi_slopes is None and h % hkv == 0
+                and sq % (4 * _BLOCK) == 0):
+            return "stock-repeat"
+        return "xla"
+    return "stock" if sq % (4 * _BLOCK) == 0 else "xla"
+
+
 def supports(q: jax.Array, k: jax.Array,
              bias: Optional[jax.Array] = None,
              alibi_slopes: Optional[jax.Array] = None) -> bool:
-    """Shape eligibility: equal sequence lengths (self-attention; the Sq=1
-    decode path stays on the XLA impl, whose single-query einsum is
-    already a plain matmul), block-aligned, and long enough that a kernel
-    beats XLA's fused attention end-to-end.  ALiBi arrives as per-head
-    ``alibi_slopes`` and runs on the grouped kernel; arbitrary
-    materialized ``bias`` tensors stay on XLA (streaming [B,H,Sq,Sk]
-    through HBM plus a discarded dab cotangent is exactly the traffic a
-    fused kernel exists to avoid)."""
-    if bias is not None:
-        return False
-    sq, sk = q.shape[1], k.shape[1]
-    if not (sq == sk and sq >= _MIN_SEQ):
-        return False
-    h, hkv, dh = q.shape[2], k.shape[2], q.shape[3]
-    if h != hkv or alibi_slopes is not None:  # grouped-kernel path
-        return flash_kernel.supported(sq, sk, dh, h, hkv,
-                                      dtype_bytes=q.dtype.itemsize)
-    return sq % (4 * _BLOCK) == 0
+    """Shape eligibility for any fused path — see :func:`_route`."""
+    return _route(q, k, bias, alibi_slopes) != "xla"
 
 
 def _block_sizes(sq: int, sk: int) -> "BlockSizes":
@@ -128,7 +146,20 @@ def flash_attention(
             "pallas path takes [B, Sk] padding masks; full masks "
             "route to impl='xla'")
 
-    if hkv != h or alibi_slopes is not None or _interpret():
+    route = _route(q, k, bias, alibi_slopes)
+    if _interpret() and route != "stock-repeat":
+        # CI runs every interpretable shape on the grouped kernel.
+        route = "grouped"
+    if route == "xla":
+        raise ValueError(
+            f"shape {q.shape}/{k.shape} routes to impl='xla' "
+            "(see flash_attention._route)")
+    if route == "stock-repeat":
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        hkv = h
+    if route == "grouped":
         # Grouped kernel: unrepeated KV, ALiBi computed in-kernel.
         if bias is not None:
             raise ValueError("materialized bias tensors route to impl='xla'")

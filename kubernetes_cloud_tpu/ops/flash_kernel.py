@@ -66,6 +66,18 @@ def _mask_scores(s, qi0, kj0, bq, bk, *, causal, q_seg, kv_seg):
     return s
 
 
+def _prob(s, ref):
+    """``exp(s - ref)`` with masked entries (``s == NEG_INF``) forced to 0.
+
+    Real scores are |s| << 1e29, so ``NEG_INF/2`` cleanly separates
+    masked from live entries.  This keeps fully-masked query rows (legal
+    when ``causal=False`` with disjoint q/kv segments) sane end to end:
+    forward accumulates l = 0 so the row outputs zeros, and backward p
+    stays 0 instead of ``exp(s - lse)`` exploding when lse carries the
+    forward's 1e-30 clamp."""
+    return jnp.where(s > NEG_INF * 0.5, jnp.exp(s - ref), 0.0)
+
+
 def _alibi_term(slope, kj0, bq, bk):
     """ALiBi per-key bias ``slope * k_pos`` for a [bq, bk] block.
 
@@ -137,7 +149,7 @@ def _fwd_kernel(*refs, group: int, bq: int, bk: int, nk: int, causal: bool,
                          q_seg=q_seg, kv_seg=kv_seg)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
+        p = _prob(s, m_new)
         l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), vblk, (((1,), (0,)), ((), ())),
@@ -251,7 +263,7 @@ def _dq_kernel(*refs, group: int, bq: int, bk: int, nk: int, causal: bool,
                   else None)
         s = _mask_scores(s, qi0, kj0, bq, bk, causal=causal,
                          q_seg=q_seg, kv_seg=kv_seg)
-        p = jnp.exp(s - lse)
+        p = _prob(s, lse)
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -310,7 +322,7 @@ def _dkv_kernel(*refs, group: int, bq: int, bk: int, nq: int, causal: bool,
                  else None)
         s = _mask_scores(s, qi0, kj0, bq, bk, causal=causal,
                          q_seg=q_seg, kv_seg=kv_seg)
-        p = jnp.exp(s - lse)
+        p = _prob(s, lse)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

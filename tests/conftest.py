@@ -7,6 +7,14 @@ initializes its backends, hence the module-level env mutation.
 """
 
 import os
+import pathlib
+import sys
+
+# cwd-independence: the package imports and the slow/quick lane matching
+# below must work no matter where pytest was invoked from.
+_REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -71,10 +79,21 @@ SLOW_TESTS = {
 }
 
 
+# Matching keys on the repo-root-relative file path (not the nodeid, which
+# drops the "tests/" prefix when pytest runs from inside tests/; not the
+# basename, which would collide with same-named files in subdirectories).
+_REPO_PATH = pathlib.Path(_REPO_ROOT)
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
         base = item.nodeid.split("[")[0]
-        if base in SLOW_TESTS or item.get_closest_marker("slow"):
+        try:
+            rel = item.path.relative_to(_REPO_PATH).as_posix()
+        except ValueError:  # collected from outside the repo
+            rel = item.path.name
+        key = rel + "::" + base.split("::", 1)[-1]
+        if key in SLOW_TESTS or item.get_closest_marker("slow"):
             item.add_marker(pytest.mark.slow)
         else:
             item.add_marker(pytest.mark.quick)

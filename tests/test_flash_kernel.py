@@ -150,3 +150,49 @@ def test_supported_gates():
     assert not supported(2048, 2048, 128, 8, 3)       # ragged group
     assert not supported(2000, 2000, 128, 8, 8)       # unaligned seq
     assert not supported(32768, 32768, 128, 8, 8)     # K/V exceed VMEM
+
+
+def test_fully_masked_rows_zero_output_and_finite_grads():
+    """causal=False with disjoint q/kv segments gives query rows with zero
+    attention mass.  Forward must output zeros for them and backward must
+    stay finite (ADVICE r2: p = exp(s - lse) blew up to ~e^69)."""
+    b, h, s, d = 1, 2, 256, 32
+    q, k, v = _qkv(b=b, h=h, hkv=h, s=s, d=d, seed=7)
+    half = s // 2
+    q_seg = jnp.concatenate(
+        [jnp.ones((b, half), jnp.int32), jnp.full((b, half), 2, jnp.int32)],
+        axis=1)
+    kv_seg = jnp.ones((b, s), jnp.int32)  # rows in segment 2 attend nothing
+
+    def loss(q, k, v):
+        out = flash_mha(q, k, v, q_seg=q_seg, kv_seg=kv_seg, causal=False,
+                        interpret=True)
+        return jnp.sum(out ** 2), out
+
+    (val, out), grads = jax.value_and_grad(loss, argnums=(0, 1, 2),
+                                           has_aux=True)(q, k, v)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[:, :, half:, :], 0.0)
+    # live rows (segment 1 attends every key) match plain non-causal MHA
+    want = np.asarray(_ref(q, k, v, causal=False))
+    np.testing.assert_allclose(out[:, :, :half, :], want[:, :, :half, :],
+                               rtol=1e-5, atol=1e-5)
+    for g in grads:
+        g = np.asarray(g)
+        assert np.isfinite(g).all()
+    # masked query rows contribute no gradient anywhere
+    np.testing.assert_array_equal(np.asarray(grads[0])[:, :, half:, :], 0.0)
+
+
+def test_supports_falls_back_to_stock_kernel_for_huge_gqa():
+    """GQA shapes past the grouped kernel's VMEM gate stay on a fused path
+    (KV-repeat onto the stock kernel), not impl='xla' (ADVICE r2 medium)."""
+    from kubernetes_cloud_tpu.ops import flash_attention as fa
+
+    s, d, hq, hkv = 16384, 128, 8, 2
+    q = jax.ShapeDtypeStruct((1, s, hq, d), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((1, s, hkv, d), jnp.bfloat16)
+    assert not supported(s, s, d, hq, hkv)      # grouped kernel gated out
+    assert fa.supports(q, kv)                   # ...but still fused
+    # ALiBi at the same shape has no stock-kernel form -> xla
+    assert not fa.supports(q, kv, alibi_slopes=jnp.ones((hq,)))
