@@ -405,6 +405,21 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
             "attn_impl='ring' (sequence parallelism) requires mesh=; "
             "without it attention would silently fall back to the dense "
             "path and materialize full SxS logits")
+    import os as _os
+    if _os.environ.get("KCT_CAST_ONCE") == "1":
+        # Experiment lever (perf sweep): bulk-cast block weights to the
+        # compute dtype before the scan so the per-use .astype calls
+        # no-op and remat's backward recompute reuses the bf16 copies.
+        def _cast(path, leaf):
+            keys = {getattr(p, "key", None) for p in path}
+            if keys & {"ln1", "ln2"}:
+                return leaf
+            return leaf.astype(cfg.dtype)
+
+        params = dict(params)
+        params["blocks"] = jax.tree_util.tree_map_with_path(
+            _cast, params["blocks"])
+
     x = _embed(cfg, params, input_ids)
     seq_parallel = cfg.attn_impl == "ring" and mesh is not None
     if seq_parallel:

@@ -96,8 +96,9 @@ def vae_init(cfg: VAEConfig, rng: jax.Array) -> Params:
     return {"encoder": enc, "decoder": dec}
 
 
-def _encode_moments(cfg: VAEConfig, p: Params, x: jax.Array) -> jax.Array:
+def _encode_moments(cfg: VAEConfig, params: Params, x: jax.Array) -> jax.Array:
     g = cfg.norm_groups
+    p = params["encoder"]
     h = conv2d(p["conv_in"], x)
     for blk in p["down"]:
         for r in blk["resnets"]:

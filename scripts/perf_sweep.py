@@ -1,0 +1,80 @@
+"""Perf sweep for the headline training benchmark (round-3 task #3).
+
+Each variant runs in a fresh subprocess (clean compile cache / HBM) on the
+real chip.  Results append to /tmp/sweep_results.txt.
+"""
+import json
+import os
+import subprocess
+import sys
+
+VARIANT = os.environ.get("SWEEP_VARIANT")
+
+if VARIANT is None:
+    variants = sys.argv[1:] or [
+        "base", "castonce", "noremat", "nothing",
+        "pallas", "pallas_noremat", "pallas_castonce", "castonce_noremat",
+    ]
+    for v in variants:
+        env = dict(os.environ, SWEEP_VARIANT=v)
+        env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+        r = subprocess.run([sys.executable, __file__], env=env,
+                           capture_output=True, text=True, timeout=1200)
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else (
+            "ERROR: " + r.stderr.strip().splitlines()[-1] if r.stderr.strip() else "no output")
+        print(f"{v:20s} {line}", flush=True)
+        with open("/tmp/sweep_results.txt", "a") as f:
+            f.write(f"{v}\t{line}\n")
+    sys.exit(0)
+
+# ---- child: run one variant -------------------------------------------------
+import dataclasses
+import time
+
+if "castonce" in VARIANT:
+    os.environ["KCT_CAST_ONCE"] = "1"
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_cloud_tpu.models import causal_lm
+from kubernetes_cloud_tpu.models.causal_lm import PRESETS
+from kubernetes_cloud_tpu.parallel.sharding import shard_batch
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.train.train_step import (
+    TrainConfig, init_train_state, make_train_step)
+
+BATCH, SEQ = 16, 1024
+
+remat, policy, attn = True, "attn_out", "auto"
+if "noremat" in VARIANT:
+    remat = False
+if "nothing" in VARIANT:
+    policy = "nothing"
+if "pallas" in VARIANT:
+    from kubernetes_cloud_tpu.ops import flash_attention
+    flash_attention._MIN_SEQ = 1024
+
+cfg = dataclasses.replace(PRESETS["pythia-410m"], remat=remat,
+                          remat_policy=policy, attn_impl=attn)
+train_cfg = TrainConfig(warmup_steps=10, total_steps=1000)
+mesh = build_mesh(MeshSpec())
+state = init_train_state(cfg, train_cfg, jax.random.key(0), mesh)
+step = jax.jit(make_train_step(cfg, train_cfg), donate_argnums=0)
+rng = jax.random.key(1)
+batch = shard_batch({
+    "input_ids": jax.random.randint(rng, (BATCH, SEQ), 0, cfg.vocab_size,
+                                    dtype=jnp.int32),
+    "attention_mask": jnp.ones((BATCH, SEQ), jnp.int32)}, mesh)
+for _ in range(2):
+    state, m = step(state, batch)
+jax.block_until_ready(m["loss"])
+t0 = time.perf_counter()
+N = 10
+for _ in range(N):
+    state, m = step(state, batch)
+jax.block_until_ready(m["loss"])
+dt = time.perf_counter() - t0
+print(json.dumps({"variant": VARIANT,
+                  "tok_s": round(BATCH * SEQ * N / dt, 1),
+                  "ms_step": round(dt / N * 1000, 2)}))
