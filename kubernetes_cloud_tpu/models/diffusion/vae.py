@@ -117,7 +117,7 @@ def vae_encode(cfg: VAEConfig, params: Params, x: jax.Array,
     """Image [B, H, W, 3] (in [-1, 1]) → scaled latent sample
     [B, H/8, W/8, latent] — the reference's ``vae.encode(...).sample() *
     scaling_factor``."""
-    moments = _encode_moments(cfg, params["encoder"], x)
+    moments = _encode_moments(cfg, params, x)
     mean, logvar = jnp.split(moments, 2, axis=-1)
     logvar = jnp.clip(logvar.astype(jnp.float32), -30.0, 20.0)
     std = jnp.exp(0.5 * logvar)
