@@ -93,6 +93,12 @@ class CausalLMConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     moe_group_size: int = 1024
+    # Bulk-cast each block's weights to the compute dtype once before the
+    # layer scan (instead of per-use .astype inside the block), so remat's
+    # backward recompute reuses the bf16 copies.  Norm scales/biases
+    # (ln1/ln2) and the MoE router stay in fp32 — their numerics are
+    # load-bearing (ops/moe.py runs routing in fp32 on purpose).
+    cast_once: bool = False
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "xla", "pallas", "ring"):
@@ -405,14 +411,10 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
             "attn_impl='ring' (sequence parallelism) requires mesh=; "
             "without it attention would silently fall back to the dense "
             "path and materialize full SxS logits")
-    import os as _os
-    if _os.environ.get("KCT_CAST_ONCE") == "1":
-        # Experiment lever (perf sweep): bulk-cast block weights to the
-        # compute dtype before the scan so the per-use .astype calls
-        # no-op and remat's backward recompute reuses the bf16 copies.
+    if cfg.cast_once:
         def _cast(path, leaf):
             keys = {getattr(p, "key", None) for p in path}
-            if keys & {"ln1", "ln2"}:
+            if keys & {"ln1", "ln2", "router"}:
                 return leaf
             return leaf.astype(cfg.dtype)
 

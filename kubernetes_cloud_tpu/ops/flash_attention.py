@@ -147,8 +147,11 @@ def flash_attention(
             "route to impl='xla'")
 
     route = _route(q, k, bias, alibi_slopes)
-    if _interpret() and route != "stock-repeat":
-        # CI runs every interpretable shape on the grouped kernel.
+    if _interpret() and bias is None:
+        # CI runs every interpretable shape — including 'stock-repeat'
+        # GQA and shapes the TPU router would send to XLA — on the grouped
+        # kernel: the stock kernel has no interpret path and the VMEM gate
+        # behind 'stock-repeat' is irrelevant off-TPU.
         route = "grouped"
     if route == "xla":
         raise ValueError(

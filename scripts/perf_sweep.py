@@ -31,9 +31,6 @@ if VARIANT is None:
 import dataclasses
 import time
 
-if "castonce" in VARIANT:
-    os.environ["KCT_CAST_ONCE"] = "1"
-
 import jax
 import jax.numpy as jnp
 
@@ -56,7 +53,8 @@ if "pallas" in VARIANT:
     flash_attention._MIN_SEQ = 1024
 
 cfg = dataclasses.replace(PRESETS["pythia-410m"], remat=remat,
-                          remat_policy=policy, attn_impl=attn)
+                          remat_policy=policy, attn_impl=attn,
+                          cast_once="castonce" in VARIANT)
 train_cfg = TrainConfig(warmup_steps=10, total_steps=1000)
 mesh = build_mesh(MeshSpec())
 state = init_train_state(cfg, train_cfg, jax.random.key(0), mesh)

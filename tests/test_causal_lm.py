@@ -79,6 +79,23 @@ def test_remat_matches_no_remat(params, ids):
         g1, g2)
 
 
+def test_cast_once_matches_per_use_cast(params, ids):
+    """cast_once bulk-casts the exact leaves the block casts per use, so
+    logits are bitwise-equal; norm scales and the MoE router stay fp32."""
+    cfg_c = dataclasses.replace(CFG, cast_once=True)
+    base = jax.jit(forward, static_argnums=0)(CFG, params, ids)
+    cast = jax.jit(forward, static_argnums=0)(cfg_c, params, ids)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(cast))
+
+    # MoE variant: router numerics must be unaffected (fp32-routed).
+    cfg_m = dataclasses.replace(CFG, moe_experts=4)
+    cfg_mc = dataclasses.replace(cfg_m, cast_once=True)
+    pm = init_params(cfg_m, jax.random.key(0))
+    got = jax.jit(forward, static_argnums=0)(cfg_mc, pm, ids)
+    want = jax.jit(forward, static_argnums=0)(cfg_m, pm, ids)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_sharded_matches_unsharded(devices8, params, ids):
     mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2), devices=devices8)
     batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids)}

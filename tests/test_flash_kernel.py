@@ -184,6 +184,33 @@ def test_fully_masked_rows_zero_output_and_finite_grads():
     np.testing.assert_array_equal(np.asarray(grads[0])[:, :, half:, :], 0.0)
 
 
+def test_stock_repeat_route_runs_under_interpret(monkeypatch):
+    """Shapes routed 'stock-repeat' (GQA past the grouped VMEM gate) must
+    still execute under interpret mode — redirected onto the grouped
+    kernel — and match XLA (ADVICE r3 low)."""
+    from kubernetes_cloud_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("KCT_FLASH_INTERPRET", "1")
+    monkeypatch.setattr(fa, "_MIN_SEQ", 512)
+    # Force the grouped kernel's VMEM gate shut so _route picks the
+    # KV-repeat fallback at a CI-sized shape.
+    monkeypatch.setattr(fa.flash_kernel, "supported",
+                        lambda *a, **k: False)
+
+    b, s, h, hkv, d = 1, 512, 4, 2, 32
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    assert fa._route(q, k, None, None) == "stock-repeat"
+    got = fa.flash_attention(q, k, v, causal=True, bias=None, mask=None,
+                             scale=d ** -0.5)
+    want = _ref(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_supports_falls_back_to_stock_kernel_for_huge_gqa():
     """GQA shapes past the grouped kernel's VMEM gate stay on a fused path
     (KV-repeat onto the stock kernel), not impl='xla' (ADVICE r2 medium)."""
