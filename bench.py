@@ -11,7 +11,9 @@ recorded in prior rounds (1.0 until a baseline exists).
 
 from __future__ import annotations
 
+import glob
 import json
+import os
 import time
 
 import jax
@@ -30,6 +32,37 @@ BATCH = 16
 SEQ = 1024
 WARMUP_STEPS = 2
 BENCH_STEPS = 10
+
+#: v5e peak bf16 throughput (197 TFLOP/s) — the chip the driver benches on.
+PEAK_BF16_FLOPS = 197e12
+
+
+def _best_prior_value(metric: str) -> float | None:
+    """Best value for ``metric`` across prior rounds' BENCH_r*.json files."""
+    best = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed") or {}
+        if parsed.get("metric") == metric and isinstance(
+                parsed.get("value"), (int, float)):
+            v = float(parsed["value"])
+            best = v if best is None else max(best, v)
+    return best
+
+
+def _train_flops_per_token(cfg) -> float:
+    """fwd+bwd FLOPs/token: 6*N_params(non-embed) + 12*L*S*D attention."""
+    d, l, f, v = (cfg.hidden_size, cfg.num_layers, cfg.ffn_size,
+                  cfg.vocab_size)
+    n_block = l * (4 * d * d + 2 * d * f)  # qkvo + mlp matmul params
+    n_unembed = d * v
+    attn_scores = 12 * l * SEQ * d  # 2*(QK^T + PV) fwd, x3 with bwd
+    return 6 * (n_block + n_unembed) + attn_scores
 
 
 def main() -> None:
@@ -54,22 +87,35 @@ def main() -> None:
         mesh,
     )
 
+    def _sync(state, metrics):
+        # Wait for the full step (backward + optimizer update included),
+        # then force a host transfer of the step counter — the tunneled
+        # device backend has been observed returning from
+        # block_until_ready before enqueued executions actually ran.
+        jax.block_until_ready((state, metrics))
+        int(state["step"])
+
     for _ in range(WARMUP_STEPS):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    _sync(state, metrics)
 
     t0 = time.perf_counter()
     for _ in range(BENCH_STEPS):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    _sync(state, metrics)
     dt = time.perf_counter() - t0
 
     tokens_per_sec = BATCH * SEQ * BENCH_STEPS / dt
+    metric = "pythia410m_train_tokens_per_sec_bs16_seq1024"
+    prior = _best_prior_value(metric)
+    mfu = (tokens_per_sec * _train_flops_per_token(model_cfg)
+           / (PEAK_BF16_FLOPS * jax.device_count()))
     print(json.dumps({
-        "metric": "pythia410m_train_tokens_per_sec_bs16_seq1024",
+        "metric": metric,
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(tokens_per_sec / prior, 4) if prior else 1.0,
+        "mfu": round(mfu, 4),
     }))
 
 
