@@ -29,6 +29,9 @@ class CLIPTextConfig:
     num_layers: int = 12
     num_heads: int = 12
     max_length: int = 77
+    # SD-1.x's ViT-L tower uses quick-gelu; SD-2.x's OpenCLIP-derived
+    # tower uses exact gelu (hidden_act in the HF config).
+    act: str = "quick_gelu"
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -84,6 +87,8 @@ def clip_encode(cfg: CLIPTextConfig, params: Params,
     x = (params["wte"][input_ids]
          + params["wpe"][:s][None]).astype(cfg.dtype)
     h, dh = cfg.num_heads, cfg.head_dim
+    act = (_quick_gelu if cfg.act == "quick_gelu"
+           else lambda y: jax.nn.gelu(y, approximate=False))
 
     def body(carry, p):
         x = carry
@@ -97,7 +102,7 @@ def clip_encode(cfg: CLIPTextConfig, params: Params,
         x = x + a + p["bo"].astype(cfg.dtype)
         y = layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
         y = jnp.einsum("bsd,df->bsf", y, p["wi"].astype(cfg.dtype))
-        y = _quick_gelu(y + p["bi"].astype(cfg.dtype))
+        y = act(y + p["bi"].astype(cfg.dtype))
         y = jnp.einsum("bsf,fd->bsd", y, p["wout"].astype(cfg.dtype))
         return x + y + p["bout"].astype(cfg.dtype), None
 

@@ -130,9 +130,12 @@ def downsample_init(rng: jax.Array, ch: int, param_dtype=jnp.float32):
     return {"conv": conv_init(rng, 3, 3, ch, ch, param_dtype)}
 
 
-def downsample(p: Params, x: jax.Array) -> jax.Array:
-    # SD uses asymmetric (0,1) padding for stride-2 downsampling convs.
-    x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+def downsample(p: Params, x: jax.Array, pad: str = "asym") -> jax.Array:
+    # SD's VAE encoder uses asymmetric (0,1) padding for its stride-2
+    # downsampling convs; the UNet's downsamplers pad symmetrically (1,1).
+    # The distinction matters for weight-import parity.
+    lohi = (0, 1) if pad == "asym" else (1, 1)
+    x = jnp.pad(x, ((0, 0), lohi, lohi, (0, 0)))
     return conv2d(p["conv"], x, stride=2, padding="VALID")
 
 

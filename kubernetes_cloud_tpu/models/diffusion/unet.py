@@ -46,7 +46,9 @@ class UNetConfig:
     block_out_channels: tuple = (320, 640, 1280, 1280)
     layers_per_block: int = 2
     cross_attn_dim: int = 768
-    num_heads: int = 8
+    # int (SD-1.x: 8 everywhere) or per-down-block tuple (SD-2.x configs
+    # list heads per block, e.g. (5, 10, 20, 20)); up blocks mirror.
+    num_heads: Any = 8
     norm_groups: int = 32
     # blocks with a spatial transformer (SD: all but the last down block /
     # first up block)
@@ -61,6 +63,12 @@ class UNetConfig:
         if self.attn_blocks is not None:
             return i in self.attn_blocks
         return i < len(self.block_out_channels) - 1
+
+    def heads_at(self, i: int) -> int:
+        """Attention heads for down-block ``i`` (up blocks mirror)."""
+        if isinstance(self.num_heads, (tuple, list)):
+            return self.num_heads[i]
+        return self.num_heads
 
 
 def _xattn_init(rng: jax.Array, ch: int, ctx: int, heads: int) -> Params:
@@ -131,7 +139,8 @@ def _spatial_transformer(p: Params, x: jax.Array, ctx: jax.Array,
                      heads)
     z = linear(blk["ff1"], _layer_norm(blk["norm3"], y))
     z1, z2 = jnp.split(z, 2, axis=-1)
-    y = y + linear(blk["ff2"], z1 * jax.nn.gelu(z2))
+    # geglu's gate uses exact (erf) gelu, matching the weights' provenance
+    y = y + linear(blk["ff2"], z1 * jax.nn.gelu(z2, approximate=False))
     y = linear(p["proj_out"], y)
     return x + y.reshape(b, h, w, c)
 
@@ -212,7 +221,6 @@ def unet_apply(cfg: UNetConfig, params: Params, x: jax.Array,
     """(latents [B,h,w,C], timesteps [B], text states [B,S,ctx_dim]) →
     predicted noise/velocity [B,h,w,C]."""
     g = cfg.norm_groups
-    heads = cfg.num_heads
     x = x.astype(cfg.dtype)
     ctx = ctx.astype(cfg.dtype)
 
@@ -221,6 +229,7 @@ def unet_apply(cfg: UNetConfig, params: Params, x: jax.Array,
                   jax.nn.silu(linear(params["time_mlp1"],
                                      temb.astype(cfg.dtype))))
 
+    n = len(cfg.block_out_channels)
     h = conv2d(params["conv_in"], x)
     skips = [h]
     for i, blk in enumerate(params["down"]):
@@ -228,14 +237,16 @@ def unet_apply(cfg: UNetConfig, params: Params, x: jax.Array,
         for j, r in enumerate(blk["resnets"]):
             h = resnet_block(r, h, temb, groups=g)
             if attns:
-                h = _spatial_transformer(attns[j], h, ctx, heads, g)
+                h = _spatial_transformer(attns[j], h, ctx,
+                                         cfg.heads_at(i), g)
             skips.append(h)
         if "down" in blk:
-            h = downsample(blk["down"], h)
+            h = downsample(blk["down"], h, pad="same")
             skips.append(h)
 
     h = resnet_block(params["mid"]["res1"], h, temb, groups=g)
-    h = _spatial_transformer(params["mid"]["attn"], h, ctx, heads, g)
+    h = _spatial_transformer(params["mid"]["attn"], h, ctx,
+                             cfg.heads_at(n - 1), g)
     h = resnet_block(params["mid"]["res2"], h, temb, groups=g)
 
     for i, blk in enumerate(params["up"]):
@@ -244,7 +255,8 @@ def unet_apply(cfg: UNetConfig, params: Params, x: jax.Array,
             h = jnp.concatenate([h, skips.pop()], axis=-1)
             h = resnet_block(r, h, temb, groups=g)
             if attns:
-                h = _spatial_transformer(attns[j], h, ctx, heads, g)
+                h = _spatial_transformer(attns[j], h, ctx,
+                                         cfg.heads_at(n - 1 - i), g)
         if "up" in blk:
             h = upsample(blk["up"], h)
 

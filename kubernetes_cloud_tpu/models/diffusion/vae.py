@@ -118,6 +118,10 @@ def vae_encode(cfg: VAEConfig, params: Params, x: jax.Array,
     [B, H/8, W/8, latent] — the reference's ``vae.encode(...).sample() *
     scaling_factor``."""
     moments = _encode_moments(cfg, params, x)
+    if "quant_conv" in params:
+        # Diffusers AutoencoderKL applies a 1x1 conv between the encoder
+        # and the latent distribution; present only on imported weights.
+        moments = conv2d(params["quant_conv"], moments)
     mean, logvar = jnp.split(moments, 2, axis=-1)
     logvar = jnp.clip(logvar.astype(jnp.float32), -30.0, 20.0)
     std = jnp.exp(0.5 * logvar)
@@ -130,7 +134,10 @@ def vae_decode(cfg: VAEConfig, params: Params, z: jax.Array) -> jax.Array:
     """Scaled latent → image [B, H, W, 3] in [-1, 1]."""
     g = cfg.norm_groups
     p = params["decoder"]
-    h = conv2d(p["conv_in"], z / cfg.scaling_factor)
+    z = z / cfg.scaling_factor
+    if "post_quant_conv" in params:
+        z = conv2d(params["post_quant_conv"], z)
+    h = conv2d(p["conv_in"], z)
     h = resnet_block(p["mid"]["res1"], h, groups=g)
     h = self_attention_2d(p["mid"]["attn"], h, groups=g)
     h = resnet_block(p["mid"]["res2"], h, groups=g)
