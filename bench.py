@@ -71,7 +71,7 @@ def main() -> None:
     # attn_out remat policy: saving each block's attention output beats
     # full recompute by ~4% at this shape (backward never re-runs attn).
     model_cfg = dataclasses.replace(PRESETS["pythia-410m"], remat=True,
-                                    remat_policy="attn_out")
+                                    remat_policy="attn_out", cast_once=True)
     train_cfg = TrainConfig(warmup_steps=10, total_steps=1000)
     mesh = build_mesh(MeshSpec())
     state = init_train_state(model_cfg, train_cfg, jax.random.key(0), mesh)

@@ -58,7 +58,19 @@ def _mha_xla(
         if mask.ndim == 2:
             mask = mask[:, None, None, :]
         logits = jnp.where(mask != 0, logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if q.dtype == jnp.bfloat16:
+        # Softmax arithmetic stays fp32 (max/sub/exp/sum run in registers
+        # inside one fusion) but the [B, H, Sq, Sk] exp tensor is *stored*
+        # bf16: the logits were already bf16-rounded by the MXU matmul, so
+        # this costs <0.4% on probs while halving the dominant HBM traffic
+        # of the training step (1 GiB → 512 MiB per layer at bs16/seq1024;
+        # the fp32 materialization was ~40% of step time, round-4 trace).
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        e = jnp.exp(logits - m).astype(q.dtype)
+        s = jnp.sum(e, axis=-1, keepdims=True, dtype=jnp.float32)
+        probs = e * (1.0 / s).astype(q.dtype)
+    else:
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if hkv != h:
         group = h // hkv
         probs_g = probs.reshape(b, hkv, group, sq, sk)

@@ -43,7 +43,7 @@ from kubernetes_cloud_tpu.models.causal_lm import (
     _embed,
     _unembed,
     chunked_next_token_xent,
-    next_token_xent,
+    fused_next_token_xent,
 )
 from kubernetes_cloud_tpu.ops.layers import alibi_slopes, rope_cache
 from kubernetes_cloud_tpu.utils.compat import shard_map
@@ -264,22 +264,18 @@ def pipeline_loss_fn(
         raise ValueError("pipeline_loss_fn requires mesh=")
     input_ids = batch["input_ids"]
     attn_mask = batch.get("attention_mask")
+    # mirror loss_fn's structure exactly (same fused/chunked heads), so
+    # pipelined and unpipelined training share loss numerics
+    hidden, aux = pipeline_forward(
+        cfg, params, input_ids, attn_mask, mesh=mesh,
+        n_microbatches=n_microbatches, return_hidden=True)
     if cfg.loss_chunk_size:
-        hidden, aux = pipeline_forward(
-            cfg, params, input_ids, attn_mask, mesh=mesh,
-            n_microbatches=n_microbatches, return_hidden=True)
         loss, metrics = chunked_next_token_xent(cfg, params, hidden,
                                                 input_ids, attn_mask,
                                                 cfg.loss_chunk_size)
-    elif cfg.moe_experts:
-        logits, aux = pipeline_forward(
-            cfg, params, input_ids, attn_mask, mesh=mesh,
-            n_microbatches=n_microbatches, with_aux=True)
-        loss, metrics = next_token_xent(logits, input_ids, attn_mask)
     else:
-        logits = pipeline_forward(cfg, params, input_ids, attn_mask,
-                                  mesh=mesh, n_microbatches=n_microbatches)
-        return next_token_xent(logits, input_ids, attn_mask)
+        loss, metrics = fused_next_token_xent(cfg, params, hidden,
+                                              input_ids, attn_mask)
     if cfg.moe_experts:  # mirror loss_fn's shared aux combination
         loss = loss + cfg.moe_aux_weight * aux
         metrics = dict(metrics, loss=loss, aux_loss=aux)

@@ -1,0 +1,116 @@
+"""Locate train-step time: fwd-only vs value_and_grad vs full step.
+
+Each phase runs in its own subprocess (fresh HBM) on the real chip;
+prints ms per phase so the remat/backward/optimizer split is visible
+(round-4 plateau hunt).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+PHASE = os.environ.get("ABLATE_PHASE")
+
+if PHASE is None:
+    results = {}
+    for phase in sys.argv[1:] or ["fwd", "grad", "step"]:
+        env = dict(os.environ, ABLATE_PHASE=phase)
+        env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+        r = subprocess.run([sys.executable, __file__], env=env,
+                           capture_output=True, text=True, timeout=1200)
+        line = (r.stdout.strip().splitlines()[-1] if r.stdout.strip()
+                else "ERROR: " + r.stderr.strip().splitlines()[-1])
+        print(f"{phase:8s} {line}", flush=True)
+    sys.exit(0)
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.models.causal_lm import PRESETS, loss_fn
+from kubernetes_cloud_tpu.parallel.sharding import shard_batch
+from kubernetes_cloud_tpu.train.train_step import (
+    TrainConfig, init_train_state, make_train_step)
+
+BATCH, SEQ, N = 16, 1024, 10
+
+cfg = dataclasses.replace(PRESETS["pythia-410m"], remat=True,
+                          remat_policy="attn_out")
+train_cfg = TrainConfig(warmup_steps=10, total_steps=1000)
+mesh = build_mesh(MeshSpec())
+state = init_train_state(cfg, train_cfg, jax.random.key(0), mesh)
+batch = shard_batch({
+    "input_ids": jax.random.randint(jax.random.key(1), (BATCH, SEQ), 0,
+                                    cfg.vocab_size, dtype=jnp.int32),
+    "attention_mask": jnp.ones((BATCH, SEQ), jnp.int32)}, mesh)
+
+if "pallas" in PHASE:
+    from kubernetes_cloud_tpu.ops import flash_attention
+    flash_attention._MIN_SEQ = 1024
+if "noattn" in PHASE:
+    import kubernetes_cloud_tpu.models.causal_lm as clm
+
+    clm.attention = lambda q, k, v, **kw: v  # shape-preserving identity
+if not PHASE.startswith(("fwd", "grad", "step")):
+    raise SystemExit(f"unknown phase {PHASE!r}: must start fwd/grad/step")
+if "nohead" in PHASE:
+    if PHASE.startswith("step"):
+        # make_train_step binds causal_lm.loss_fn at module import; the
+        # local rebinding below would silently not apply
+        raise SystemExit("nohead only composes with fwd/grad phases")
+    import kubernetes_cloud_tpu.models.causal_lm as clm2
+
+    real_forward = clm2.forward
+
+    def loss_no_head(c, p, b):
+        hid, _aux = real_forward(c, p, b["input_ids"], b["attention_mask"],
+                                 return_hidden=True)
+        return jnp.mean(jnp.square(hid.astype(jnp.float32))), {}
+
+    loss_fn = loss_no_head
+
+if PHASE.startswith("fwd"):
+    fn = jax.jit(lambda p, b: loss_fn(cfg, p, b)[0])
+    args = (state["params"], batch)
+elif PHASE.startswith("step"):
+    fn = jax.jit(make_train_step(cfg, train_cfg), donate_argnums=0)
+    args = (state, batch)
+elif PHASE.startswith("grad"):
+    def _vg(p, b):
+        l, g = jax.value_and_grad(lambda q: loss_fn(cfg, q, b)[0])(p)
+        # cheap full-tree reduction keeps the backward alive in XLA
+        return l + sum(jnp.sum(jnp.abs(x[:1].ravel()[:1]))
+                       for x in jax.tree.leaves(g))
+
+    fn = jax.jit(_vg)
+    args = (state["params"], batch)
+else:
+    fn = jax.jit(make_train_step(cfg, train_cfg), donate_argnums=0)
+    args = (state, batch)
+
+if PHASE.startswith("step"):
+    for _ in range(2):
+        state, m = fn(state, batch)
+    jax.block_until_ready((state, m))
+    int(state["step"])
+    t0 = time.perf_counter()
+    for _ in range(N):
+        state, m = fn(state, batch)
+    jax.block_until_ready((state, m))
+    int(state["step"])
+    dt = time.perf_counter() - t0
+else:
+    out = fn(*args)
+    jax.block_until_ready(out)
+    float(out.reshape(-1)[0] if hasattr(out, "reshape") else out)
+    t0 = time.perf_counter()
+    for _ in range(N):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    float(out.reshape(-1)[0] if hasattr(out, "reshape") else out)
+    dt = time.perf_counter() - t0
+
+print(json.dumps({"phase": PHASE, "ms": round(dt / N * 1000, 2)}))

@@ -31,6 +31,10 @@ if VARIANT is None:
 import dataclasses
 import time
 
+if "lhs" in VARIANT:  # latency-hiding scheduler (read at backend init)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_tpu_enable_latency_hiding_scheduler=true")
+
 import jax
 import jax.numpy as jnp
 
@@ -52,9 +56,16 @@ if "pallas" in VARIANT:
     from kubernetes_cloud_tpu.ops import flash_attention
     flash_attention._MIN_SEQ = 1024
 
+chunk = 0
+if "chunk256" in VARIANT:
+    chunk = 256
+elif "chunk512" in VARIANT:
+    chunk = 512
+
 cfg = dataclasses.replace(PRESETS["pythia-410m"], remat=remat,
                           remat_policy=policy, attn_impl=attn,
-                          cast_once="castonce" in VARIANT)
+                          cast_once="castonce" in VARIANT,
+                          loss_chunk_size=chunk)
 train_cfg = TrainConfig(warmup_steps=10, total_steps=1000)
 mesh = build_mesh(MeshSpec())
 state = init_train_state(cfg, train_cfg, jax.random.key(0), mesh)
@@ -66,12 +77,14 @@ batch = shard_batch({
     "attention_mask": jnp.ones((BATCH, SEQ), jnp.int32)}, mesh)
 for _ in range(2):
     state, m = step(state, batch)
-jax.block_until_ready(m["loss"])
+jax.block_until_ready((state, m))
+int(state["step"])
 t0 = time.perf_counter()
 N = 10
 for _ in range(N):
     state, m = step(state, batch)
-jax.block_until_ready(m["loss"])
+jax.block_until_ready((state, m))
+int(state["step"])
 dt = time.perf_counter() - t0
 print(json.dumps({"variant": VARIANT,
                   "tok_s": round(BATCH * SEQ * N / dt, 1),

@@ -128,14 +128,15 @@ def test_chunked_loss_matches_dense():
                                np.asarray(dense_loss), rtol=1e-5)
     assert int(chunk_m["tokens"]) == int(dense_m["tokens"])
 
-    # grads agree to bf16 matmul noise: chunk-shaped [B,C,D]@[D,V]
-    # products tile differently than the full [B,S,D]@[D,V] one, so
-    # individual bf16 roundings differ slightly
+    # grads agree to bf16 matmul/storage noise: chunk-shaped [B,C,D]@[D,V]
+    # products tile differently than the full [B,S,D]@[D,V] one, and the
+    # lse path stores logits in the compute dtype, so individual bf16
+    # roundings differ slightly
     gd = jax.grad(lambda p: loss_fn(cfg, p, batch)[0])(params)
     gc = jax.grad(lambda p: loss_fn(ccfg, p, batch)[0])(params)
     for a, b in zip(jax.tree.leaves(gd), jax.tree.leaves(gc)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=5e-2, atol=1e-5)
+                                   rtol=5e-2, atol=1e-3)
 
 
 def test_chunked_loss_requires_divisible_seq():
