@@ -1,0 +1,135 @@
+"""CLIP text tokenizer (byte-level BPE, ``</w>`` word-final variant).
+
+SD conditions on CLIP-tokenized prompts; the reference gets this from
+``transformers.CLIPTokenizer`` inside its service container
+(``online-inference/stable-diffusion/service/service.py``).  This is the
+same published algorithm, dependency-free, built on the repo's BPE
+machinery (:mod:`kubernetes_cloud_tpu.serve.bpe`).  Differences from the
+GPT-2 codec it reuses:
+
+* text is whitespace-collapsed and lower-cased before splitting,
+* the pre-tokenizer keeps contractions/words/digits but never leading
+  spaces (CLIP drops them),
+* every word's last symbol carries a ``</w>`` suffix, so merges and
+  vocab entries distinguish word-final pieces,
+* prompts are framed ``<|startoftext|> ... <|endoftext|>`` and padded to
+  the conditioning length (SD-1.x pads with the end token, SD-2.x
+  overrides the pad token in its tokenizer config).
+
+Loads the standard ``vocab.json``/``merges.txt`` pair that ships inside
+every diffusers snapshot's ``tokenizer/`` directory (what
+``weights/sd_import.convert_checkpoint`` republishes for serving).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+import re
+
+from kubernetes_cloud_tpu.serve.bpe import BPECodec, bytes_to_unicode
+
+try:
+    import regex as _regex
+
+    _PAT = _regex.compile(
+        r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d"
+        r"|\p{L}+|\p{N}|[^\s\p{L}\p{N}]+",
+        _regex.IGNORECASE)
+except ImportError:  # pragma: no cover - regex is in the baked image
+    _PAT = re.compile(
+        r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d"
+        r"|[^\W\d_]+|\d|(?:[^\s\w]|_)+",
+        re.IGNORECASE | re.UNICODE)
+
+SOT = "<|startoftext|>"
+EOT = "<|endoftext|>"
+
+
+def _clean(text: str) -> str:
+    text = html.unescape(html.unescape(text))
+    return re.sub(r"\s+", " ", text).strip().lower()
+
+
+class CLIPBPECodec(BPECodec):
+    """CLIP variant of the byte-level BPE codec."""
+
+    def __init__(self, vocab: dict[str, int],
+                 merges: list[tuple[str, str]],
+                 pad_token: str = EOT):
+        super().__init__(vocab, merges)
+        self.sot = self.encoder[SOT]
+        self.eot = self.encoder[EOT]
+        self.pad = self.encoder.get(pad_token, self.eot)
+
+    @classmethod
+    def from_dir(cls, path: str) -> "CLIPBPECodec":
+        base = BPECodec.from_dir(path)
+        merges = sorted(base.ranks, key=base.ranks.get)
+        pad = EOT
+        cfg_path = os.path.join(path, "tokenizer_config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                raw = json.load(f).get("pad_token", EOT)
+            # transformers serializes added tokens either bare or as
+            # {"content": ...}
+            pad = raw["content"] if isinstance(raw, dict) else raw
+        return cls(base.encoder, merges, pad_token=pad)
+
+    def _bpe(self, token: str) -> tuple[str, ...]:
+        cached = self._cache.get(token)
+        if cached is not None:
+            return cached
+        word = tuple(token[:-1]) + (token[-1] + "</w>",)
+        while len(word) > 1:
+            pairs = set(zip(word, word[1:]))
+            best = min(pairs,
+                       key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            a, b = best
+            merged: list[str] = []
+            i = 0
+            while i < len(word):
+                if (i < len(word) - 1 and word[i] == a
+                        and word[i + 1] == b):
+                    merged.append(a + b)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+        self._cache[token] = word
+        return word
+
+    def encode(self, text: str) -> list[int]:
+        """Prompt text → BPE ids (no special-token framing)."""
+        ids: list[int] = []
+        for tok in _PAT.findall(_clean(text)):
+            if tok in (SOT, EOT):
+                ids.append(self.encoder[tok])
+                continue
+            mapped = "".join(self.byte_enc[b] for b in tok.encode("utf-8"))
+            ids.extend(self.encoder[piece] for piece in self._bpe(mapped))
+        return ids
+
+    def encode_batch(self, texts: list[str],
+                     length: int = 77) -> list[list[int]]:
+        """SD conditioning frames: ``[sot] ids[:length-2] [eot]`` padded
+        to ``length`` — CLIPTokenizer's ``padding="max_length",
+        truncation=True`` behavior."""
+        out = []
+        for t in texts:
+            ids = self.encode(t)[: length - 2]
+            row = [self.sot] + ids + [self.eot]
+            row += [self.pad] * (length - len(row))
+            out.append(row)
+        return out
+
+    def decode(self, ids: list[int]) -> str:
+        specials = {self.sot, self.eot, self.pad}
+        text = "".join(self.decoder[i] for i in ids if i not in specials)
+        data = bytes(self.byte_dec[c] for c in text)
+        decoded = data.decode("utf-8", errors="replace")
+        return decoded.replace("</w>", " ").strip()

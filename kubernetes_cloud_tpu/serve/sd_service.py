@@ -110,11 +110,21 @@ class StableDiffusionService(Model):
         self.clip_params = load_pytree(enc_path)
 
         if self._tokenize is None:
-            from kubernetes_cloud_tpu.train.sd_trainer import (
-                _byte_clip_tokenize,
-            )
+            tok_dir = os.path.join(self.model_dir, "tokenizer")
+            if os.path.exists(os.path.join(tok_dir, "vocab.json")):
+                # imported checkpoints ship their CLIP BPE assets
+                from kubernetes_cloud_tpu.serve.clip_bpe import CLIPBPECodec
 
-            self._tokenize = _byte_clip_tokenize(self.clip_cfg)
+                codec = CLIPBPECodec.from_dir(tok_dir)
+                max_len = self.clip_cfg.max_length
+                self._tokenize = (
+                    lambda texts: codec.encode_batch(texts, max_len))
+            else:  # self-trained models use the byte-level tokenizer
+                from kubernetes_cloud_tpu.train.sd_trainer import (
+                    _byte_clip_tokenize,
+                )
+
+                self._tokenize = _byte_clip_tokenize(self.clip_cfg)
         # Deserialization throughput log, as the reference's loader does
         # (``service.py:122-130``).
         nbytes = sum(os.path.getsize(os.path.join(self.model_dir, f))

@@ -455,6 +455,23 @@ def convert_checkpoint(src: str, dest: str, strict: bool = True) -> str:
                  meta={"config": dataclasses.asdict(clip_cfg) | {
                      "dtype": str(clip_cfg.dtype),
                      "param_dtype": str(clip_cfg.param_dtype)}})
+
+    # Republish the CLIP tokenizer assets so serving tokenizes prompts
+    # with the vocabulary the embedding table was trained against
+    # (serve/clip_bpe reads these; without them sd_service falls back to
+    # the byte-level tokenizer, which only fits self-trained models).
+    tok_src = os.path.join(src, "tokenizer")
+    if os.path.isdir(tok_src):
+        import shutil
+
+        tok_dest = os.path.join(dest, "tokenizer")
+        os.makedirs(tok_dest, exist_ok=True)
+        for name in ("vocab.json", "merges.txt", "tokenizer_config.json",
+                     "special_tokens_map.json"):
+            p = os.path.join(tok_src, name)
+            if os.path.exists(p):
+                shutil.copy2(p, os.path.join(tok_dest, name))
+
     mark_ready(dest)
     return dest
 

@@ -1,0 +1,85 @@
+"""CLIP BPE codec vs transformers.CLIPTokenizer on the same vocab files.
+
+A miniature CLIP-style vocabulary (byte alphabet + ``</w>`` variants +
+merge-built subwords + specials) is written to disk and loaded by both
+implementations; ids must agree exactly, including specials framing,
+max-length padding/truncation, cleanup, and lower-casing — transformers
+is the arbiter of the published algorithm.
+"""
+
+import json
+
+import pytest
+
+from kubernetes_cloud_tpu.serve.clip_bpe import CLIPBPECodec, bytes_to_unicode
+
+pytestmark = pytest.mark.slow  # transformers import is seconds
+
+
+@pytest.fixture(scope="module")
+def vocab_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("clip_tok")
+    b2u = bytes_to_unicode()
+    alphabet = sorted(set(b2u.values()))
+    vocab: dict[str, int] = {}
+    for ch in alphabet:
+        vocab[ch] = len(vocab)
+    for ch in alphabet:
+        vocab[ch + "</w>"] = len(vocab)
+    merges = [
+        ("t", "h"), ("th", "e</w>"), ("a", "n"), ("an", "d</w>"),
+        ("i", "n"), ("in", "g</w>"), ("t", "o</w>"), ("e", "r"),
+        ("c", "a"), ("ca", "t</w>"), ("d", "o"), ("do", "g</w>"),
+        ("s", "n"), ("sn", "o"), ("sno", "w</w>"), ("er", "</w>"),
+    ]
+    for a, b in merges:
+        vocab[a + b] = len(vocab)
+    vocab["<|startoftext|>"] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    with open(d / "vocab.json", "w") as f:
+        json.dump(vocab, f)
+    with open(d / "merges.txt", "w") as f:
+        f.write("#version: 0.2\n")
+        for a, b in merges:
+            f.write(f"{a} {b}\n")
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def both(vocab_dir):
+    from transformers import CLIPTokenizer
+
+    ours = CLIPBPECodec.from_dir(vocab_dir)
+    theirs = CLIPTokenizer(vocab_file=vocab_dir + "/vocab.json",
+                           merges_file=vocab_dir + "/merges.txt")
+    return ours, theirs
+
+
+PROMPTS = [
+    "the cat and the dog",
+    "A Dog In THE Snow",          # lower-casing
+    "snowing   to the   cat",     # whitespace collapse
+    "cat, dog; snow!",            # punctuation splits
+    "cats dogs snowcat",          # partial merges / unknown tails
+    "er catered",
+]
+
+
+@pytest.mark.parametrize("text", PROMPTS)
+def test_encode_matches_transformers(both, text):
+    ours, theirs = both
+    assert ours.encode(text) == theirs(text, add_special_tokens=False)[
+        "input_ids"]
+
+
+def test_framed_padded_batch_matches_transformers(both):
+    ours, theirs = both
+    want = theirs(PROMPTS, padding="max_length", truncation=True,
+                  max_length=16)["input_ids"]
+    assert ours.encode_batch(PROMPTS, length=16) == want
+
+
+def test_decode_roundtrip(both):
+    ours, _ = both
+    ids = ours.encode_batch(["the cat and the dog"], length=16)[0]
+    assert ours.decode(ids) == "the cat and the dog"

@@ -494,14 +494,8 @@ def test_convert_checkpoint_end_to_end(tmp_path):
     from transformers import CLIPTextConfig as HFConfig
     from transformers import CLIPTextModel
 
-    hf_cfg = HFConfig(vocab_size=99, hidden_size=32, intermediate_size=128,
-                      num_hidden_layers=2, num_attention_heads=4,
-                      max_position_embeddings=16, hidden_act="quick_gelu")
     enc_dir = src / "text_encoder"
     enc_dir.mkdir()
-    save_file(CLIPTextModel(hf_cfg).state_dict(),
-              str(enc_dir / "model.safetensors"))
-    (enc_dir / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
 
     sched_dir = src / "scheduler"
     sched_dir.mkdir()
@@ -510,14 +504,43 @@ def test_convert_checkpoint_end_to_end(tmp_path):
         "beta_end": 0.012, "beta_schedule": "scaled_linear",
         "prediction_type": "epsilon"}))
 
+    # CLIP tokenizer assets: byte alphabet + </w> variants + specials,
+    # sized exactly to the text encoder's vocab (so ids stay in range)
+    from kubernetes_cloud_tpu.serve.clip_bpe import bytes_to_unicode
+
+    alphabet = sorted(set(bytes_to_unicode().values()))
+    tok_vocab = {}
+    for ch in alphabet:
+        tok_vocab[ch] = len(tok_vocab)
+    for ch in alphabet:
+        tok_vocab[ch + "</w>"] = len(tok_vocab)
+    tok_vocab["<|startoftext|>"] = len(tok_vocab)
+    tok_vocab["<|endoftext|>"] = len(tok_vocab)
+    tok_dir = src / "tokenizer"
+    tok_dir.mkdir()
+    (tok_dir / "vocab.json").write_text(json.dumps(tok_vocab))
+    (tok_dir / "merges.txt").write_text("#version: 0.2\n")
+    hf_cfg = HFConfig(vocab_size=len(tok_vocab), hidden_size=32,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, max_position_embeddings=16,
+                      hidden_act="quick_gelu")
+    save_file(CLIPTextModel(hf_cfg).state_dict(),
+              str(enc_dir / "model.safetensors"))
+    (enc_dir / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
+
     dest = tmp_path / "serving"
     convert_checkpoint(str(src), str(dest))
     assert os.path.exists(dest / "unet.tensors")
+    assert os.path.exists(dest / "tokenizer" / "vocab.json")
     assert os.path.exists(dest / ".ready.txt") or any(
         f.startswith(".ready") or f == "ready.txt" for f in os.listdir(dest))
 
     svc = StableDiffusionService("sd", str(dest))
     svc.load()
+    # real-checkpoint path: prompts go through the imported CLIP BPE
+    from kubernetes_cloud_tpu.serve.clip_bpe import CLIPBPECodec  # noqa: F401
+
+    assert svc._tokenize(["a cat"])[0][0] == tok_vocab["<|startoftext|>"]
     img = svc.generate("a tpu in the snow", height=16, width=16, steps=2,
                        guidance_scale=5.0, seed=1)
     assert img.shape == (16, 16, 3) and img.dtype == np.uint8
