@@ -70,8 +70,11 @@ def main() -> None:
 
     # attn_out remat policy: saving each block's attention output beats
     # full recompute by ~4% at this shape (backward never re-runs attn).
+    # attn_mlp remat: save attention outputs + mlp hidden so the backward
+    # recompute skips both attention and the [D,4D] matmul (fits in HBM
+    # alongside fp32 adam state at this size; perf_sweep round 4).
     model_cfg = dataclasses.replace(PRESETS["pythia-410m"], remat=True,
-                                    remat_policy="attn_out", cast_once=True)
+                                    remat_policy="attn_mlp", cast_once=True)
     train_cfg = TrainConfig(warmup_steps=10, total_steps=1000)
     mesh = build_mesh(MeshSpec())
     state = init_train_state(model_cfg, train_cfg, jax.random.key(0), mesh)

@@ -103,7 +103,7 @@ class CausalLMConfig:
     def __post_init__(self):
         if self.attn_impl not in ("auto", "xla", "pallas", "ring"):
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
-        if self.remat_policy not in ("nothing", "attn_out"):
+        if self.remat_policy not in ("nothing", "attn_out", "attn_mlp"):
             raise ValueError(f"unknown remat_policy: {self.remat_policy!r}")
         if self.loss_chunk_size < 0:
             raise ValueError(
@@ -331,6 +331,11 @@ def _finish_block(cfg: CausalLMConfig, p: Params, x: jax.Array,
         if cfg.use_bias:
             hmid = hmid + p["mlp"]["bi"].astype(cfg.dtype)
         hmid = jax.nn.gelu(hmid, approximate=cfg.act == "gelu_tanh")
+        from jax.ad_checkpoint import checkpoint_name
+
+        # saveable under remat_policy="attn_mlp": skips re-running the
+        # [D,4D] matmul in the backward recompute at 4D*S*B bf16 memory
+        hmid = checkpoint_name(hmid, "mlp_mid")
         mlp_out = jnp.einsum("bsf,fd->bsd", hmid,
                              p["mlp"]["wo"].astype(cfg.dtype))
         if cfg.use_bias:
@@ -450,9 +455,10 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
 
     block = _block
     if cfg.remat:
-        policy = (jax.checkpoint_policies.save_only_these_names("attn_out")
-                  if cfg.remat_policy == "attn_out"
-                  else jax.checkpoint_policies.nothing_saveable)
+        saved = {"nothing": (), "attn_out": ("attn_out",),
+                 "attn_mlp": ("attn_out", "mlp_mid")}[cfg.remat_policy]
+        policy = (jax.checkpoint_policies.save_only_these_names(*saved)
+                  if saved else jax.checkpoint_policies.nothing_saveable)
         # cfg (0) and mesh (6) are static: hashable non-array metadata.
         block = jax.checkpoint(
             _block, static_argnums=(0, 6), policy=policy)

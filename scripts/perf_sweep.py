@@ -52,6 +52,8 @@ if "noremat" in VARIANT:
     remat = False
 if "nothing" in VARIANT:
     policy = "nothing"
+if "attnmlp" in VARIANT:
+    policy = "attn_mlp"
 if "pallas" in VARIANT:
     from kubernetes_cloud_tpu.ops import flash_attention
     flash_attention._MIN_SEQ = 1024

@@ -69,8 +69,9 @@ def test_architecture_variants(variant, ids):
     assert np.isfinite(np.asarray(logits)).all()
 
 
-def test_remat_matches_no_remat(params, ids):
-    cfg_r = dataclasses.replace(CFG, remat=True)
+@pytest.mark.parametrize("policy", ["nothing", "attn_out", "attn_mlp"])
+def test_remat_matches_no_remat(params, ids, policy):
+    cfg_r = dataclasses.replace(CFG, remat=True, remat_policy=policy)
     batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids)}
     g1 = jax.jit(jax.grad(lambda p: loss_fn(CFG, p, batch)[0]))(params)
     g2 = jax.jit(jax.grad(lambda p: loss_fn(cfg_r, p, batch)[0]))(params)
