@@ -134,12 +134,24 @@ def build_mesh(
             )
         per_slice = len(devices) // n_dcn
         ici_shape = spec.ici_shape(per_slice)
-        mesh_devices = mesh_utils.create_hybrid_device_mesh(
-            ici_shape,
-            spec.dcn_shape(),
-            devices=devices,
-            allow_split_physical_axes=True,
-        )
+        try:
+            mesh_devices = mesh_utils.create_hybrid_device_mesh(
+                ici_shape,
+                spec.dcn_shape(),
+                devices=devices,
+                allow_split_physical_axes=True,
+            )
+        except (ValueError, NotImplementedError, AssertionError,
+                AttributeError):
+            # Topology-unaware fallback (CPU simulation meshes have no
+            # slice_index): outer DCN axes major, ICI axes minor — the
+            # same logical nesting the hybrid builder produces.
+            mesh_devices = np.asarray(devices).reshape(
+                spec.dcn_shape() + ici_shape).transpose(
+                [k for i in range(len(ici_shape)) for k in
+                 (i, i + len(ici_shape))]).reshape(
+                tuple(d * i for d, i in
+                      zip(spec.dcn_shape(), ici_shape)))
         # Merge the outer DCN axis into the matching inner axis so user code
         # sees exactly one axis per logical meaning.
         merged_shape = tuple(

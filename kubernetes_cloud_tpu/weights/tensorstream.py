@@ -134,17 +134,38 @@ def write_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
     data_start = 16 + len(header)
     data_start = (data_start + ALIGN - 1) // ALIGN * ALIGN
 
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
+    def emit(f) -> None:
+        # strictly sequential (arrays preserve offset order), so the same
+        # writer serves local files and remote object streams
         f.write(MAGIC)
         f.write(len(header).to_bytes(8, "little"))
         f.write(header)
+        pos = 16 + len(header)
         for name, np_arr in arrays.items():
-            f.seek(data_start + index[name]["offset"])
+            target = data_start + index[name]["offset"]
+            if target > pos:
+                f.write(b"\0" * (target - pos))
+                pos = target
             f.write(np_arr.tobytes())
-        # extend through the last aligned block (zero-fills, never
-        # overwrites tensor bytes)
-        f.truncate(data_start + offset)
+            pos += np_arr.nbytes
+        end = data_start + offset
+        if end > pos:
+            f.write(b"\0" * (end - pos))
+
+    if is_remote(path):
+        # GCS/S3 objects are atomic on close — no tmp+rename needed.
+        # This replaces the reference's out-of-band upload Job
+        # (``online-inference/stable-diffusion/03-optional-s3-upload-job
+        # .yaml``): artifacts publish straight to object storage.
+        import fsspec
+
+        with fsspec.open(path, "wb") as f:
+            emit(f)
+        return
+
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        emit(f)
     os.replace(tmp, path)
 
 

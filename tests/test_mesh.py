@@ -62,3 +62,21 @@ def test_local_batch_size(devices8):
     assert local_batch_size(32, mesh) == 32  # single process owns all shards
     with pytest.raises(ValueError):
         local_batch_size(12, mesh)
+
+
+def test_hybrid_dcn_mesh(devices8):
+    """Multi-slice spec: outer DCN axes merge into the matching logical
+    axis (2 slices x 4-device ICI mesh -> one 8-device mesh), and every
+    device appears exactly once."""
+    mesh = build_mesh(MeshSpec(data=1, fsdp=2, model=2, dcn_data=2),
+                      devices=devices8)
+    assert dict(mesh.shape)["data"] == 2
+    assert dict(mesh.shape)["fsdp"] == 2
+    assert dict(mesh.shape)["model"] == 2
+    assert {d.id for d in mesh.devices.flat} == {d.id for d in devices8}
+
+    spec = MeshSpec(data=1, fsdp=2, model=2, dcn_data=2)
+    assert spec.is_multislice
+    with pytest.raises(ValueError):
+        # 8 devices don't divide into 3 slices
+        build_mesh(MeshSpec(data=1, dcn_data=3), devices=devices8)

@@ -128,8 +128,12 @@ def test_remote_stream_load(tmp_path, tree, devices8):
     write_pytree(local, tree, meta={"k": 1})
     uri = "memory://bucket/t.tensors"
     assert is_remote(uri) and not is_remote(local)
-    with open(local, "rb") as srcf, fsspec.open(uri, "wb") as dst:
-        dst.write(srcf.read())
+    # remote write path: write_pytree streams straight to object storage
+    # (replaces the reference's S3-upload Job) and must produce the same
+    # bytes as the local writer
+    write_pytree(uri, tree, meta={"k": 1})
+    with open(local, "rb") as srcf, fsspec.open(uri, "rb") as dst:
+        assert dst.read() == srcf.read()
 
     # header over the wire
     idx = read_index(uri)
