@@ -72,6 +72,13 @@ class CausalLMConfig:
     # save each block's attention output so the backward pass never
     # re-runs attention — the right pairing for the flash kernel, whose
     # custom-vjp backward already does its own internal recompute.
+    # "attn_island" / "attn_island_mlp": attention sits *outside* the
+    # rematerialized regions — the checkpointed front half (ln1+qkv+rope)
+    # and back half (wo+mlp) surround an un-rematted attention call, so
+    # its residuals (q/k/v/out/lse on the flash path) are saved and the
+    # backward never re-runs the attention forward at all.  Pair with the
+    # flash kernel: the XLA path would save [B,H,S,S] probabilities.
+    # "_mlp" additionally saves each block's MLP hidden activation.
     remat_policy: str = "nothing"
     # Cross-entropy chunking: 0 computes the full [B, S, V] fp32 logits
     # tensor at once (6 GiB at B=32, S=1024, V=50k — the largest single
@@ -103,7 +110,8 @@ class CausalLMConfig:
     def __post_init__(self):
         if self.attn_impl not in ("auto", "xla", "pallas", "ring"):
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
-        if self.remat_policy not in ("nothing", "attn_out", "attn_mlp"):
+        if self.remat_policy not in ("nothing", "attn_out", "attn_mlp",
+                                     "attn_island", "attn_island_mlp"):
             raise ValueError(f"unknown remat_policy: {self.remat_policy!r}")
         if self.loss_chunk_size < 0:
             raise ValueError(
@@ -346,24 +354,41 @@ def _finish_block(cfg: CausalLMConfig, p: Params, x: jax.Array,
     return x + mlp_out, aux
 
 
+def _qkv_half(cfg: CausalLMConfig, p: Params, x: jax.Array,
+              rope: Optional[tuple[jax.Array, jax.Array]]):
+    """Checkpointed front half for the ``attn_island`` remat policies."""
+    q, k, v, _ = _project_qkv(cfg, p, x, rope=rope)
+    return q, k, v
+
+
+def _mlp_half(cfg: CausalLMConfig, p: Params, x: jax.Array,
+              attn_vec: jax.Array, mask: Optional[jax.Array]):
+    """Checkpointed back half for the ``attn_island`` remat policies."""
+    return _finish_block(cfg, p, x, attn_vec, None, token_mask=mask)
+
+
+def _attn_call(cfg: CausalLMConfig, q, k, v, bias, mask, mesh):
+    """The attention dispatch shared by both block layouts."""
+    if cfg.attn_impl == "ring" and mesh is not None:
+        from kubernetes_cloud_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, mesh, causal=True, kv_mask=mask)
+    # ``bias`` rank disambiguates: [H] = ALiBi slopes (computed
+    # in-kernel on the pallas path), higher rank = materialized bias.
+    slopes = bias if bias is not None and bias.ndim == 1 else None
+    return attention(q, k, v, causal=True,
+                     bias=None if slopes is not None else bias,
+                     alibi_slopes=slopes, mask=mask,
+                     impl="auto" if cfg.attn_impl == "ring"
+                     else cfg.attn_impl)
+
+
 def _block(cfg: CausalLMConfig, p: Params, x: jax.Array,
            rope: Optional[tuple[jax.Array, jax.Array]],
            bias: Optional[jax.Array], mask: Optional[jax.Array],
            mesh=None) -> tuple[jax.Array, jax.Array]:
     q, k, v, attn_in = _project_qkv(cfg, p, x, rope=rope)
-    if cfg.attn_impl == "ring" and mesh is not None:
-        from kubernetes_cloud_tpu.ops.ring_attention import ring_attention
-
-        attn_vec = ring_attention(q, k, v, mesh, causal=True, kv_mask=mask)
-    else:
-        # ``bias`` rank disambiguates: [H] = ALiBi slopes (computed
-        # in-kernel on the pallas path), higher rank = materialized bias.
-        slopes = bias if bias is not None and bias.ndim == 1 else None
-        attn_vec = attention(q, k, v, causal=True,
-                             bias=None if slopes is not None else bias,
-                             alibi_slopes=slopes, mask=mask,
-                             impl="auto" if cfg.attn_impl == "ring"
-                             else cfg.attn_impl)
+    attn_vec = _attn_call(cfg, q, k, v, bias, mask, mesh)
     from jax.ad_checkpoint import checkpoint_name
 
     attn_vec = checkpoint_name(attn_vec, "attn_out")
@@ -453,20 +478,38 @@ def forward(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
         # is materialized by the XLA path or computed in-kernel by pallas.
         bias = alibi_slopes(cfg.num_heads)
 
-    block = _block
-    if cfg.remat:
-        saved = {"nothing": (), "attn_out": ("attn_out",),
-                 "attn_mlp": ("attn_out", "mlp_mid")}[cfg.remat_policy]
-        policy = (jax.checkpoint_policies.save_only_these_names(*saved)
-                  if saved else jax.checkpoint_policies.nothing_saveable)
-        # cfg (0) and mesh (6) are static: hashable non-array metadata.
-        block = jax.checkpoint(
-            _block, static_argnums=(0, 6), policy=policy)
+    if cfg.remat and cfg.remat_policy.startswith("attn_island"):
+        # Attention runs *outside* the two checkpointed halves: its
+        # forward is computed exactly once and its residuals (q/k/v/out
+        # + the flash kernel's logsumexp) are saved for the backward.
+        front = jax.checkpoint(_qkv_half, static_argnums=(0,))
+        mlp_policy = (
+            jax.checkpoint_policies.save_only_these_names("mlp_mid")
+            if cfg.remat_policy == "attn_island_mlp"
+            else jax.checkpoint_policies.nothing_saveable)
+        back = jax.checkpoint(_mlp_half, static_argnums=(0,),
+                              policy=mlp_policy)
 
-    def body(carry, layer_params):
-        out, aux = block(cfg, layer_params, carry, rope, bias,
-                         attention_mask, mesh)
-        return out, aux
+        def body(carry, layer_params):
+            q, k, v = front(cfg, layer_params, carry, rope)
+            attn_vec = _attn_call(cfg, q, k, v, bias, attention_mask, mesh)
+            return back(cfg, layer_params, carry, attn_vec, attention_mask)
+
+    else:
+        block = _block
+        if cfg.remat:
+            saved = {"nothing": (), "attn_out": ("attn_out",),
+                     "attn_mlp": ("attn_out", "mlp_mid")}[cfg.remat_policy]
+            policy = (jax.checkpoint_policies.save_only_these_names(*saved)
+                      if saved else jax.checkpoint_policies.nothing_saveable)
+            # cfg (0) and mesh (6) are static: hashable non-array metadata.
+            block = jax.checkpoint(
+                _block, static_argnums=(0, 6), policy=policy)
+
+        def body(carry, layer_params):
+            out, aux = block(cfg, layer_params, carry, rope, bias,
+                             attention_mask, mesh)
+            return out, aux
 
     x, auxs = jax.lax.scan(body, x, params["blocks"])
     if return_hidden:

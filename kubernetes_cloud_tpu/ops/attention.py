@@ -103,6 +103,7 @@ def attention(
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    explicit = impl == "pallas"
     if impl == "auto":
         impl = _pick_impl(q, k, bias, mask, alibi_slopes)
     if impl == "pallas":
@@ -110,7 +111,7 @@ def attention(
 
         return flash_attention.flash_attention(
             q, k, v, causal=causal, bias=bias, mask=mask, scale=scale,
-            alibi_slopes=alibi_slopes,
+            alibi_slopes=alibi_slopes, explicit=explicit,
         )
     if alibi_slopes is not None:
         kpos = jnp.arange(k.shape[1], dtype=jnp.float32)
@@ -124,8 +125,8 @@ def _pick_impl(q, k, bias, mask, alibi_slopes=None) -> str:
 
     if not flash_attention.available():
         return "xla"
-    if not flash_attention.supports(q, k, bias, alibi_slopes):
-        return "xla"
     if mask is not None and mask.ndim != 2:
         return "xla"  # full [B,1,Sq,Sk] masks stay on the einsum path
+    if not flash_attention.supports(q, k, bias, alibi_slopes, mask=mask):
+        return "xla"
     return "pallas"

@@ -29,7 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from kubernetes_cloud_tpu.ops import flash_kernel
+from kubernetes_cloud_tpu.ops import flash_kernel, flash_resident
 
 try:  # pragma: no cover - exercised on TPU only
     from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -67,12 +67,24 @@ def available() -> bool:
 #: 16.3k; seq 4096+ XLA OOMs on the SxS scores and pallas is the only
 #: impl that runs.
 _MIN_SEQ = 2048
+#: crossover for the batch-folded resident kernel: fwd+bwd 8.7 ms vs XLA
+#: 13.5 ms at B16 H16 S1024 D64 (scripts/resident_bench.py, v5e).
+_RESIDENT_MIN_SEQ = 1024
 
 
-def _route(q, k, bias, alibi_slopes) -> str:
+def _route(q, k, bias, alibi_slopes, *, mask=None, auto: bool = True) -> str:
     """THE routing decision, shared by :func:`supports` and
-    :func:`flash_attention` so eligibility and dispatch can't drift:
+    :func:`flash_attention` so eligibility and dispatch can't drift.
+    ``auto=False`` (explicit ``impl="pallas"``) skips the ``_MIN_SEQ``
+    throughput crossover and applies only the structural gates —
+    callers like the ``attn_island`` remat policies are faster on the
+    kernel at shorter sequences than the auto heuristic assumes.
 
+    * ``'resident'`` — the batch-folded short-sequence kernel
+      (:mod:`~kubernetes_cloud_tpu.ops.flash_resident`): maskless
+      self-attention whose K/V working set fits VMEM.  Fastest at
+      bench-class shapes (the per-grid-step fixed cost the other
+      kernels pay ~1000× is amortized across the folded batch).
     * ``'grouped'`` — this framework's kernel: unrepeated KV, in-kernel
       ALiBi (GQA and/or ALiBi shapes passing its KV-resident VMEM gate).
     * ``'stock-repeat'`` — GQA shapes past that gate (very long sk):
@@ -89,9 +101,14 @@ def _route(q, k, bias, alibi_slopes) -> str:
     if bias is not None:
         return "xla"
     sq, sk = q.shape[1], k.shape[1]
-    if not (sq == sk and sq >= _MIN_SEQ):
+    b, h, hkv, dh = q.shape[0], q.shape[2], k.shape[2], q.shape[3]
+    if (mask is None and sq == sk
+            and (sq >= _RESIDENT_MIN_SEQ if auto else sq >= 2 * _BLOCK)
+            and flash_resident.supported(b, sq, sk, dh, h, hkv,
+                                         q.dtype.itemsize)):
+        return "resident"
+    if not (sq == sk and (sq >= _MIN_SEQ if auto else sq >= 2 * _BLOCK)):
         return "xla"
-    h, hkv, dh = q.shape[2], k.shape[2], q.shape[3]
     if h != hkv or alibi_slopes is not None:
         if flash_kernel.supported(sq, sk, dh, h, hkv,
                                   dtype_bytes=q.dtype.itemsize):
@@ -105,9 +122,10 @@ def _route(q, k, bias, alibi_slopes) -> str:
 
 def supports(q: jax.Array, k: jax.Array,
              bias: Optional[jax.Array] = None,
-             alibi_slopes: Optional[jax.Array] = None) -> bool:
+             alibi_slopes: Optional[jax.Array] = None,
+             mask: Optional[jax.Array] = None) -> bool:
     """Shape eligibility for any fused path — see :func:`_route`."""
-    return _route(q, k, bias, alibi_slopes) != "xla"
+    return _route(q, k, bias, alibi_slopes, mask=mask) != "xla"
 
 
 def _block_sizes(sq: int, sk: int) -> "BlockSizes":
@@ -138,6 +156,7 @@ def flash_attention(
     mask: Optional[jax.Array],
     scale: float,
     alibi_slopes: Optional[jax.Array] = None,
+    explicit: bool = False,
 ) -> jax.Array:
     b, sq, h, dh = q.shape
     hkv = k.shape[2]
@@ -146,17 +165,27 @@ def flash_attention(
             "pallas path takes [B, Sk] padding masks; full masks "
             "route to impl='xla'")
 
-    route = _route(q, k, bias, alibi_slopes)
+    route = _route(q, k, bias, alibi_slopes, mask=mask, auto=not explicit)
     if _interpret() and bias is None:
         # CI runs every interpretable shape — including 'stock-repeat'
-        # GQA and shapes the TPU router would send to XLA — on the grouped
-        # kernel: the stock kernel has no interpret path and the VMEM gate
-        # behind 'stock-repeat' is irrelevant off-TPU.
-        route = "grouped"
+        # GQA and shapes the TPU router would send to XLA — on this
+        # framework's kernels: the stock kernel has no interpret path and
+        # the VMEM gates are irrelevant off-TPU.  Maskless *eligible*
+        # shapes take the resident kernel (mirroring the TPU router's
+        # preference); everything else runs the grouped kernel.
+        route = ("resident" if mask is None and flash_resident.supported(
+            q.shape[0], sq, k.shape[1], dh, h, hkv, q.dtype.itemsize)
+            else "grouped")
     if route == "xla":
         raise ValueError(
             f"shape {q.shape}/{k.shape} routes to impl='xla' "
             "(see flash_attention._route)")
+    if route == "resident":
+        out = flash_resident.flash_mha_resident(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), slopes=alibi_slopes, causal=causal,
+            scale=scale, interpret=_interpret())
+        return out.transpose(0, 2, 1, 3).astype(q.dtype)
     if route == "stock-repeat":
         rep = h // hkv
         k = jnp.repeat(k, rep, axis=2)

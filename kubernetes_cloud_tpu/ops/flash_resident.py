@@ -1,0 +1,454 @@
+"""Batch-folded flash attention for short sequences — a Pallas TPU kernel.
+
+The general flash kernels (:mod:`~kubernetes_cloud_tpu.ops.flash_kernel`
+and the stock Pallas op) grid over ``(batch, head, q_block, ...)``; at
+bench-class shapes (B16 H16 S1024 D64) that is ~1000 grid steps of
+~0.1 GFLOP each, and the fixed per-step cost (DMA latency, grid
+bookkeeping — measured ~4.4 µs/step on v5e) dominates: 4-7 ms per
+attention call, slower than XLA's materialized softmax.
+
+This kernel targets exactly those shapes.  It grids over
+``(batch_chunk, kv_head, group, q_block)`` where each step holds a
+*chunk of batches* of the full K/V sequence resident in VMEM and loops
+the chunk inside the kernel, so per-step work is
+``BB × 2·bq·S·D`` FLOPs and the fixed cost amortizes away.  The
+softmax is one-shot over the full key range (the [bq, S] score block
+lives in VMEM — no online renormalization).  A small planner picks the
+largest (batch_chunk, q_block) that fits the VMEM budget.  Forward
+saves only the logsumexp; backward recomputes probabilities from it
+(FlashAttention-2 style) in two kernels (dq, then dk/dv).
+
+Matmul operands stay in the input dtype (bf16 on the MXU's native
+path) with fp32 accumulation — an fp32×fp32 dot would run at a
+fraction of MXU rate.
+
+GQA maps every query head of a group onto the same resident KV block
+(like flash_kernel); ALiBi comes in as per-head slopes computed
+in-kernel.  No segment/padding masks: shapes with masks route to the
+general kernels — the packed-dataset training path and batched decode
+prefill both run maskless.
+
+Replaces the reference's fused CUDA attention at training/serving
+shapes (FasterTransformer decoders,
+``online-inference/fastertransformer/build/Dockerfile:16-70``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_ROWPAD = 8  # lane padding for [.., S]-shaped row vectors (see flash_kernel)
+
+#: Scoped-VMEM ceiling requested from Mosaic.  v5e has 128 MiB of
+#: physical VMEM; the default 16 MiB scoped limit is what makes other
+#: kernels shrink their blocks (and pay per-grid-step fixed costs ~1000
+#: times).  This kernel asks for most of it and folds the whole batch
+#: into each grid step instead.
+_VMEM_LIMIT = 100 * 1024 * 1024
+#: plan budget for the *estimated* working set; the Mosaic stack
+#: allocator roughly double-counts a naive estimate (double buffering +
+#: transient temporaries), so plan to about a third of the limit.
+_VMEM_BUDGET = 32 * 1024 * 1024
+#: measured on v5e at B16 H16 S1024 D64: bq256 fwd 3.5 ms vs bq512 4.9 ms
+_MAX_BLOCK_Q = 256
+
+
+def _vmem_estimate(bb: int, bq: int, sk: int, d: int,
+                   dtype_bytes: int) -> int:
+    """Rough per-grid-step VMEM bytes for the fwd/bwd kernels (double
+    buffering on block inputs/outputs, fp32 score scratch + bf16 probs)."""
+    io = 2 * (bb * bq * d          # q
+              + 2 * bb * sk * d    # k + v
+              + bb * bq * d)       # out / dq
+    io += 2 * bb * max(bq, _ROWPAD) * _ROWPAD * 2  # lse/delta rows (f32)
+    scratch = bq * sk * 4 + bq * sk * dtype_bytes + bq * sk * 4
+    return io * dtype_bytes + scratch
+
+
+def _plan(b: int, sq: int, sk: int, d: int,
+          dtype_bytes: int) -> Optional[tuple[int, int]]:
+    """Largest (batch_chunk, q_block) whose working set fits the budget."""
+    bq = min(_MAX_BLOCK_Q, sq)
+    while bq >= 128:
+        bb = b
+        while bb >= 1:
+            if (b % bb == 0 and sq % bq == 0
+                    and _vmem_estimate(bb, bq, sk, d, dtype_bytes)
+                    <= _VMEM_BUDGET):
+                return bb, bq
+            bb //= 2
+        bq //= 2
+    return None
+
+
+def _alibi(slope, bq, sk):
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 1).astype(
+        jnp.float32)
+    return slope * kpos
+
+
+def _score_addend(slope, qi0, bq, sk, causal: bool, have_slopes: bool):
+    """ALiBi + causal additive term for a [bq, sk] score block, hoisted
+    out of the kernels' batch loops (identical for every batch).  Masked
+    entries carry NEG_INF: exp() underflows them to exactly 0, so no
+    select is needed on the probability side (causal rows always have a
+    live diagonal)."""
+    addend = None
+    if have_slopes:
+        addend = _alibi(slope, bq, sk)
+    if causal:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 0) + qi0
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 1)
+        neg = jnp.where(qpos >= kpos, 0.0, NEG_INF)
+        addend = neg if addend is None else addend + neg
+    return addend
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(*refs, bb: int, group: int, bq: int, causal: bool,
+                scale: float, have_slopes: bool):
+    idx = 0
+    q_ref = refs[idx]; idx += 1
+    k_ref = refs[idx]; idx += 1
+    v_ref = refs[idx]; idx += 1
+    slopes_ref = None
+    if have_slopes:
+        slopes_ref = refs[idx]; idx += 1
+    o_ref, lse_ref = refs[idx], refs[idx + 1]
+
+    i = pl.program_id(3)
+    qi0 = i * bq
+    sk = k_ref.shape[2]
+    head = pl.program_id(1) * group + pl.program_id(2)
+    slope = slopes_ref[head, 0] if have_slopes else None
+
+    addend = _score_addend(slope, qi0, bq, sk, causal, have_slopes)
+
+    def body(b, _):
+        # scale folded onto the small [bq, D] operand, not the scores
+        qs = (q_ref[b, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        s = jax.lax.dot_general(
+            qs, k_ref[b, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, sk]
+        if addend is not None:
+            s = s + addend
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        l_safe = jnp.maximum(l, 1e-30)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[b, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_ref[b, 0] = (pv / l_safe).astype(o_ref.dtype)
+        lse_ref[b, 0] = jnp.broadcast_to(m + jnp.log(l_safe),
+                                         (bq, _ROWPAD))
+        return _
+
+    jax.lax.fori_loop(0, bb, body, 0)
+
+
+def _plan_or_raise(b, sq, sk, d, h, hkv, dtype_bytes):
+    if not supported(b, sq, sk, d, h, hkv, dtype_bytes):
+        raise ValueError(
+            f"shape B{b} H{h}/{hkv} S{sq}/{sk} D{d} is not resident-kernel "
+            "eligible (see flash_resident.supported); route via "
+            "ops.attention / ops.flash_attention instead of calling "
+            "flash_mha_resident directly")
+    return _plan(b, sq, sk, d, dtype_bytes)
+
+
+def _fwd(q, k, v, slopes, causal, scale, interpret):
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    g = h // hkv
+    bb, bq = _plan_or_raise(b, sq, sk, d, h, hkv, q.dtype.itemsize)
+    nb, nq = b // bb, sq // bq
+    have_slopes = slopes is not None
+
+    grid = (nb, hkv, g, nq)
+    in_specs = [
+        pl.BlockSpec((bb, 1, bq, d),
+                     lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0)),
+        pl.BlockSpec((bb, 1, sk, d), lambda b_, kh, g_, i: (b_, kh, 0, 0)),
+        pl.BlockSpec((bb, 1, sk, d), lambda b_, kh, g_, i: (b_, kh, 0, 0)),
+    ]
+    args = [q, k, v]
+    if have_slopes:
+        in_specs.append(pl.BlockSpec((h, 1), lambda b_, kh, g_, i: (0, 0),
+                                     memory_space=pltpu.SMEM))
+        args.append(slopes.reshape(h, 1).astype(jnp.float32))
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, bb=bb, group=g, bq=bq, causal=causal,
+            scale=scale, have_slopes=have_slopes),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((bb, 1, bq, d),
+                         lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0)),
+            pl.BlockSpec((bb, 1, bq, _ROWPAD),
+                         lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, _ROWPAD), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+    )(*args)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(*refs, bb: int, group: int, bq: int, causal: bool,
+               scale: float, have_slopes: bool):
+    idx = 0
+    q_ref = refs[idx]; idx += 1
+    k_ref = refs[idx]; idx += 1
+    v_ref = refs[idx]; idx += 1
+    do_ref = refs[idx]; idx += 1
+    lse_ref = refs[idx]; idx += 1
+    delta_ref = refs[idx]; idx += 1
+    slopes_ref = None
+    if have_slopes:
+        slopes_ref = refs[idx]; idx += 1
+    dq_ref = refs[idx]
+
+    i = pl.program_id(3)
+    qi0 = i * bq
+    sk = k_ref.shape[2]
+    head = pl.program_id(1) * group + pl.program_id(2)
+    slope = slopes_ref[head, 0] if have_slopes else None
+
+    addend = _score_addend(slope, qi0, bq, sk, causal, have_slopes)
+
+    def body(b, _):
+        qs = (q_ref[b, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        s = jax.lax.dot_general(
+            qs, k_ref[b, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if addend is not None:
+            s = s + addend
+        lse = lse_ref[b, 0][:, :1]
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do_ref[b, 0], v_ref[b, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = delta_ref[b, 0][:, :1]
+        ds = (p * (dp - delta) * scale).astype(k_ref.dtype)
+        dq_ref[b, 0] = jax.lax.dot_general(
+            ds, k_ref[b, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        return _
+
+    jax.lax.fori_loop(0, bb, body, 0)
+
+
+def _dkv_kernel(*refs, bb: int, group: int, bk: int, causal: bool,
+                scale: float, have_slopes: bool):
+    idx = 0
+    q_ref = refs[idx]; idx += 1
+    k_ref = refs[idx]; idx += 1
+    v_ref = refs[idx]; idx += 1
+    do_ref = refs[idx]; idx += 1
+    lse_ref = refs[idx]; idx += 1   # [bb, 1, _ROWPAD, Sq] pre-transposed
+    delta_ref = refs[idx]; idx += 1
+    slopes_ref = None
+    if have_slopes:
+        slopes_ref = refs[idx]; idx += 1
+    dk_ref, dv_ref = refs[idx], refs[idx + 1]
+
+    j = pl.program_id(3)
+    kj0 = j * bk
+    sq = q_ref.shape[2]
+    head = pl.program_id(1) * group + pl.program_id(2)
+    slope = slopes_ref[head, 0] if have_slopes else None
+
+    addend = None
+    if have_slopes:
+        kpos = (jax.lax.broadcasted_iota(jnp.int32, (bk, sq), 0) + kj0
+                ).astype(jnp.float32)
+        addend = slope * kpos
+    if causal:
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (bk, sq), 0) + kj0
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (bk, sq), 1)
+        neg = jnp.where(qpos >= kpos, 0.0, NEG_INF)
+        addend = neg if addend is None else addend + neg
+
+    def body(b, _):
+        # s^T layout: [bk, sq] so the dv/dk contractions are row-major
+        ks = (k_ref[b, 0].astype(jnp.float32) * scale).astype(k_ref.dtype)
+        st = jax.lax.dot_general(
+            ks, q_ref[b, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if addend is not None:
+            st = st + addend
+        lse_row = lse_ref[b, 0][:1, :]             # [1, sq]
+        pt = jnp.exp(st - lse_row)                 # [bk, sq]
+        ptb = pt.astype(v_ref.dtype)
+        dv_ref[b, 0] = jax.lax.dot_general(
+            ptb, do_ref[b, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        dpt = jax.lax.dot_general(
+            v_ref[b, 0], do_ref[b, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bk, sq]
+        delta_row = delta_ref[b, 0][:1, :]
+        dst = (pt * (dpt - delta_row) * scale).astype(q_ref.dtype)
+        dk_ref[b, 0] = jax.lax.dot_general(
+            dst, q_ref[b, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+        return _
+
+    jax.lax.fori_loop(0, bb, body, 0)
+
+
+def _bwd(causal, scale, interpret, res, dout):
+    q, k, v, slopes, out, lse = res
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    g = h // hkv
+    bb, bq = _plan_or_raise(b, sq, sk, d, h, hkv, q.dtype.itemsize)
+    bk = bq
+    nb, nq, nk = b // bb, sq // bq, sk // bk
+    have_slopes = slopes is not None
+
+    delta = jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32),
+                    axis=-1)
+    delta_pad = jax.lax.broadcast_in_dim(delta, (b, h, sq, _ROWPAD),
+                                         (0, 1, 2))
+    slope_arg = (slopes.reshape(h, 1).astype(jnp.float32)
+                 if have_slopes else None)
+
+    qspec = pl.BlockSpec((bb, 1, bq, d),
+                         lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0))
+    kvspec = pl.BlockSpec((bb, 1, sk, d),
+                          lambda b_, kh, g_, i: (b_, kh, 0, 0))
+    rowspec = pl.BlockSpec((bb, 1, bq, _ROWPAD),
+                           lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0))
+    in_specs = [qspec, kvspec, kvspec, qspec, rowspec, rowspec]
+    args = [q, k, v, dout, lse, delta_pad]
+    if have_slopes:
+        in_specs.append(pl.BlockSpec((h, 1), lambda b_, kh, g_, i: (0, 0),
+                                     memory_space=pltpu.SMEM))
+        args.append(slope_arg)
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, bb=bb, group=g, bq=bq, causal=causal,
+            scale=scale, have_slopes=have_slopes),
+        grid=(nb, hkv, g, nq),
+        in_specs=in_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+    )(*args)
+
+    # dk/dv kernel wants lse/delta as [1, Sq] row vectors (q along lanes);
+    # build the transposed copies host-side instead of transposing in-kernel.
+    lse_t = jax.lax.broadcast_in_dim(
+        lse[..., 0], (b, h, _ROWPAD, sq), (0, 1, 3))
+    delta_t = jax.lax.broadcast_in_dim(
+        delta, (b, h, _ROWPAD, sq), (0, 1, 3))
+    qfull = pl.BlockSpec((bb, 1, sq, d),
+                         lambda b_, kh, g_, j: (b_, kh * g + g_, 0, 0))
+    kblk = pl.BlockSpec((bb, 1, bk, d),
+                        lambda b_, kh, g_, j: (b_, kh, j, 0))
+    rowfull = pl.BlockSpec((bb, 1, _ROWPAD, sq),
+                           lambda b_, kh, g_, j: (b_, kh * g + g_, 0, 0))
+    in_specs = [qfull, kblk, kblk, qfull, rowfull, rowfull]
+    args = [q, k, v, dout, lse_t, delta_t]
+    if have_slopes:
+        in_specs.append(pl.BlockSpec((h, 1), lambda b_, kh, g_, j: (0, 0),
+                                     memory_space=pltpu.SMEM))
+        args.append(slope_arg)
+    # GQA: the kernel writes per-query-head dk/dv partials (unreduced over
+    # the group); for g == 1 that is already the answer, for g > 1 the
+    # group reduction happens outside in one cheap XLA sum.
+    out_h = h
+    per_head = pl.BlockSpec((bb, 1, bk, d),
+                            lambda b_, kh, g_, j: (b_, kh * g + g_, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, bb=bb, group=g, bk=bk, causal=causal,
+            scale=scale, have_slopes=have_slopes),
+        grid=(nb, hkv, g, nk),
+        in_specs=in_specs,
+        out_specs=[per_head, per_head],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, out_h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, out_h, sk, d), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+    )(*args)
+    if g > 1:
+        dk = dk.reshape(b, hkv, g, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, g, sk, d).sum(axis=2)
+
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None)
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, slopes, causal, scale, interpret):
+    out, _ = _fwd(q, k, v, slopes, causal, scale, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, slopes, causal, scale, interpret):
+    out, lse = _fwd(q, k, v, slopes, causal, scale, interpret)
+    return out, (q, k, v, slopes, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def supported(b: int, sq: int, sk: int, d: int, h: int, hkv: int,
+              dtype_bytes: int = 2) -> bool:
+    """Eligibility: aligned self-attention shapes whose K/V chunk plan
+    fits the VMEM budget."""
+    if h % hkv:
+        return False
+    if sq != sk or sq % 128 or d % 64 or d % 128 and d != 64:
+        return False
+    return _plan(b, sq, sk, d, dtype_bytes) is not None
+
+
+def flash_mha_resident(
+    q: jax.Array,  # [B, H, Sq, D]
+    k: jax.Array,  # [B, Hkv, Sk, D]
+    v: jax.Array,
+    *,
+    slopes: Optional[jax.Array] = None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Batch-folded resident flash attention; returns [B, H, Sq, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash(q, k, v, slopes, causal, float(scale), interpret)

@@ -1,0 +1,44 @@
+"""Shared timing helpers for the microbenchmark scripts.
+
+The tunneled device adds a ~6 ms per-dispatch floor and has been
+observed returning from ``block_until_ready`` before enqueued
+executions ran, so: (a) each measured op is iterated K times *inside*
+one jitted ``lax.scan`` (with a data dependency between iterations)
+and the per-op time is total/K; (b) synchronization forces a host
+transfer of one element.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+K_ITERS = 10
+
+
+def sync(out) -> None:
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.ravel(leaf)[0].astype(jnp.float32))
+
+
+def timeit_scan(step, init, n=3, warmup=1, k_iters=K_ITERS):
+    """step: carry -> carry, iterated k_iters times inside one jit;
+    returns ms per op."""
+
+    @jax.jit
+    def run(carry):
+        def body(c, _):
+            return step(c), None
+        out, _ = jax.lax.scan(body, carry, None, length=k_iters)
+        return out
+
+    out = init
+    for _ in range(warmup):
+        out = run(out)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = run(out)
+    sync(out)
+    return (time.perf_counter() - t0) / (n * k_iters) * 1e3

@@ -1,0 +1,114 @@
+"""Microbenchmark: attention fwd+bwd at the headline bench shape.
+
+The tunneled device adds a ~6 ms per-dispatch floor, so each measured op
+is iterated K times *inside* one jitted ``lax.scan`` (with a data
+dependency between iterations) and the per-op time is total/K.
+
+    python scripts/attn_bench.py
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+B, H, S, D = 16, 16, 1024, 64
+
+
+from _bench_util import sync as _sync, timeit_scan  # noqa: E402
+
+
+def main() -> None:
+    key = jax.random.key(0)
+    kq, kk, kv, kd = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
+    do = jax.random.normal(kd, (B, S, H, D), jnp.bfloat16)
+
+    # --- raw matmul ceiling ---------------------------------------------
+    a0 = jax.random.normal(kq, (B * S, 1024), jnp.bfloat16)
+    w1 = jax.random.normal(kk, (1024, 4096), jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(kv, (4096, 1024), jnp.bfloat16) * 0.02
+
+    ms = timeit_scan(lambda a: (a @ w1) @ w2, a0)
+    fl = 2 * 2 * B * S * 1024 * 4096  # two matmuls per iteration
+    print(f"raw matmul pair [16384,1024]x[1024,4096]x[4096,1024]: "
+          f"{ms:.3f} ms = {fl / ms / 1e9:.1f} TFLOP/s")
+
+    attn_flops_fwd = 4 * B * H * S * S * D
+    attn_flops = attn_flops_fwd * 3  # fwd QK+PV, x3 with bwd
+
+    def bench(fn, name):
+        def fwd_step(q):
+            return fn(q, k, v).astype(jnp.bfloat16)
+
+        def loss(q, k, v):
+            return (fn(q, k, v) * do).sum()
+
+        gradfn = jax.grad(loss, argnums=(0, 1, 2))
+
+        def bwd_step(q):
+            gq, gk, gv = gradfn(q, k, v)
+            return (q + 1e-6 * gq.astype(q.dtype)
+                    + 1e-6 * (gk + gv).astype(q.dtype))
+
+        try:
+            ms_f = timeit_scan(fwd_step, q)
+            ms_g = timeit_scan(bwd_step, q)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:44s} FAILED: {type(e).__name__}: {str(e)[:80]}")
+            return
+        print(f"{name:44s} fwd {ms_f:7.3f} ms ({attn_flops_fwd/ms_f/1e9:6.1f}"
+              f" TF/s)  fwd+bwd {ms_g:7.3f} ms "
+              f"({attn_flops / ms_g / 1e9:6.1f} TF/s)")
+
+    from kubernetes_cloud_tpu.ops.attention import attention
+
+    bench(functools.partial(attention, causal=True, impl="xla"),
+          "xla materialized")
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as stock_flash)
+
+    def stock(bs):
+        def fn(q, k, v):
+            out = stock_flash(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True, sm_scale=D ** -0.5,
+                block_sizes=bs)
+            return out.transpose(0, 2, 1, 3)
+        return fn
+
+    for blk in (256, 512, 1024):
+        bq = bk = min(blk, S)
+        bs = BlockSizes(
+            block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+            block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+            block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk,
+            block_q_dq=bq)
+        bench(stock(bs), f"stock pallas blk{blk}")
+
+    from kubernetes_cloud_tpu.ops import flash_kernel
+
+    def grouped(blk):
+        def fn(q, k, v):
+            old = flash_kernel._BLOCK
+            flash_kernel._BLOCK = blk
+            try:
+                out = flash_kernel.flash_mha(
+                    q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                    v.transpose(0, 2, 1, 3), causal=True)
+            finally:
+                flash_kernel._BLOCK = old
+            return out.transpose(0, 2, 1, 3)
+        return fn
+
+    for blk in (256, 512, 1024):
+        bench(grouped(blk), f"grouped kernel blk{blk}")
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,11 @@ if "nothing" in VARIANT:
     policy = "nothing"
 if "attnmlp" in VARIANT:
     policy = "attn_mlp"
+if "island" in VARIANT:
+    policy = "attn_island_mlp" if "islandmlp" in VARIANT else "attn_island"
+    attn = "pallas"
+if "nomask" in VARIANT:
+    pass  # handled at batch construction below
 if "pallas" in VARIANT:
     from kubernetes_cloud_tpu.ops import flash_attention
     flash_attention._MIN_SEQ = 1024
@@ -73,10 +78,13 @@ mesh = build_mesh(MeshSpec())
 state = init_train_state(cfg, train_cfg, jax.random.key(0), mesh)
 step = jax.jit(make_train_step(cfg, train_cfg), donate_argnums=0)
 rng = jax.random.key(1)
-batch = shard_batch({
-    "input_ids": jax.random.randint(rng, (BATCH, SEQ), 0, cfg.vocab_size,
-                                    dtype=jnp.int32),
-    "attention_mask": jnp.ones((BATCH, SEQ), jnp.int32)}, mesh)
+_batch = {"input_ids": jax.random.randint(rng, (BATCH, SEQ), 0,
+                                          cfg.vocab_size, dtype=jnp.int32)}
+if "nomask" not in VARIANT:
+    # packed datasets have no padding; "nomask" drops the all-ones mask
+    # (identical loss) to keep the maskless fused-attention path eligible
+    _batch["attention_mask"] = jnp.ones((BATCH, SEQ), jnp.int32)
+batch = shard_batch(_batch, mesh)
 for _ in range(2):
     state, m = step(state, batch)
 jax.block_until_ready((state, m))

@@ -1,0 +1,116 @@
+"""Capture + summarize an op-level TPU profile of the headline train step.
+
+Writes a jax.profiler trace for a few bench-shaped steps, then parses the
+trace-viewer JSON to rank XLA ops by total device time.  Usage:
+
+    python scripts/profile_step.py [variant]
+
+Variants mirror scripts/perf_sweep.py ("base" = the bench.py config).
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_cloud_tpu.models.causal_lm import PRESETS
+from kubernetes_cloud_tpu.parallel.sharding import shard_batch
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.train.train_step import (
+    TrainConfig, init_train_state, make_train_step)
+
+BATCH, SEQ = 16, 1024
+TRACE_DIR = "/tmp/kct_trace"
+
+
+def build_step(variant: str):
+    policy = "attn_mlp"
+    attn = "auto"
+    remat = True
+    if "attnout" in variant:
+        policy = "attn_out"
+    if "pallas" in variant:
+        from kubernetes_cloud_tpu.ops import flash_attention
+        flash_attention._MIN_SEQ = 1024
+        attn = "pallas"
+    cfg = dataclasses.replace(
+        PRESETS["pythia-410m"], remat=remat, remat_policy=policy,
+        attn_impl=attn, cast_once=True)
+    train_cfg = TrainConfig(warmup_steps=10, total_steps=1000)
+    mesh = build_mesh(MeshSpec())
+    state = init_train_state(cfg, train_cfg, jax.random.key(0), mesh)
+    step = jax.jit(make_train_step(cfg, train_cfg), donate_argnums=0)
+    batch = shard_batch({
+        "input_ids": jax.random.randint(
+            jax.random.key(1), (BATCH, SEQ), 0, cfg.vocab_size,
+            dtype=jnp.int32),
+        "attention_mask": jnp.ones((BATCH, SEQ), jnp.int32)}, mesh)
+    return step, state, batch
+
+
+def summarize(trace_dir: str, top: int = 40) -> None:
+    paths = glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        print("no trace found under", trace_dir)
+        return
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # device-side complete events only ("ph" == "X"), keyed by op name
+    by_name: dict[str, float] = defaultdict(float)
+    count: dict[str, int] = defaultdict(int)
+    pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
+                 for e in events if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pname = pid_names.get(e.get("pid"), "")
+        if "TPU" not in pname and "tpu" not in pname and (
+                "XLA" not in pname):
+            continue
+        dur = e.get("dur", 0) / 1e3  # ms
+        by_name[e["name"]] += dur
+        count[e["name"]] += 1
+    total = sum(by_name.values())
+    print(f"\ntrace: {path}")
+    print(f"total device-op time: {total:.1f} ms across {len(by_name)} op names")
+    print(f"{'ms':>10} {'n':>6}  name")
+    for name, ms in sorted(by_name.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{ms:10.2f} {count[name]:6d}  {name[:110]}")
+
+
+def main() -> None:
+    variant = sys.argv[1] if len(sys.argv) > 1 else "base"
+    step, state, batch = build_step(variant)
+    for _ in range(3):
+        state, m = step(state, batch)
+    jax.block_until_ready((state, m))
+    int(state["step"])
+
+    t0 = time.perf_counter()
+    N = 5
+    with jax.profiler.trace(TRACE_DIR):
+        for _ in range(N):
+            state, m = step(state, batch)
+        jax.block_until_ready((state, m))
+        int(state["step"])
+    dt = time.perf_counter() - t0
+    print(json.dumps({"variant": variant,
+                      "tok_s": round(BATCH * SEQ * N / dt, 1),
+                      "ms_step": round(dt / N * 1000, 2)}))
+    summarize(TRACE_DIR)
+
+
+if __name__ == "__main__":
+    main()
