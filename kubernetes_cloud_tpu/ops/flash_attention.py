@@ -181,11 +181,15 @@ def flash_attention(
             f"shape {q.shape}/{k.shape} routes to impl='xla' "
             "(see flash_attention._route)")
     if route == "resident":
-        out = flash_resident.flash_mha_resident(
-            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3), slopes=alibi_slopes, causal=causal,
-            scale=scale, interpret=_interpret())
-        return out.transpose(0, 2, 1, 3).astype(q.dtype)
+        # Flat [B, S, H·D] in/out: a reshape (H, D are trailing and
+        # adjacent), not a transpose — and the layout the custom-vjp
+        # residuals are saved in (tile-exact, no 64→128 lane padding).
+        outf = flash_resident.flash_mha_resident_flat(
+            q.reshape(b, sq, h * dh), k.reshape(b, k.shape[1], hkv * dh),
+            v.reshape(b, k.shape[1], hkv * dh), heads=h, kv_heads=hkv,
+            slopes=alibi_slopes, causal=causal, scale=scale,
+            interpret=_interpret())
+        return outf.reshape(b, sq, h, dh).astype(q.dtype)
     if route == "stock-repeat":
         rep = h // hkv
         k = jnp.repeat(k, rep, axis=2)

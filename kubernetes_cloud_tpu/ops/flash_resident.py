@@ -7,26 +7,37 @@ bench-class shapes (B16 H16 S1024 D64) that is ~1000 grid steps of
 bookkeeping — measured ~4.4 µs/step on v5e) dominates: 4-7 ms per
 attention call, slower than XLA's materialized softmax.
 
-This kernel targets exactly those shapes.  It grids over
-``(batch_chunk, kv_head, group, q_block)`` where each step holds a
-*chunk of batches* of the full K/V sequence resident in VMEM and loops
-the chunk inside the kernel, so per-step work is
-``BB × 2·bq·S·D`` FLOPs and the fixed cost amortizes away.  The
-softmax is one-shot over the full key range (the [bq, S] score block
-lives in VMEM — no online renormalization).  A small planner picks the
-largest (batch_chunk, q_block) that fits the VMEM budget.  Forward
-saves only the logsumexp; backward recomputes probabilities from it
-(FlashAttention-2 style) in two kernels (dq, then dk/dv).
+This kernel targets exactly those shapes:
 
-Matmul operands stay in the input dtype (bf16 on the MXU's native
-path) with fp32 accumulation — an fp32×fp32 dot would run at a
-fraction of MXU rate.
+* **Flat layout end to end.**  Inputs, outputs, and custom-vjp
+  residuals are ``[B, S, H·D]``.  A head-split ``[B, H, S, 64]`` array
+  tile-pads its trailing dim to 128 lanes — 2× HBM on every tensor, 2×
+  on every stacked residual of a scanned layer pytree, plus a
+  pad/transpose fusion on each kernel boundary (measured ~250 ms/step
+  of pure glue in the round-5 island trace).  Instead the kernels read
+  head slices straight out of the flat arrays: blocks are 128 lanes
+  wide — ``128/D`` heads per block — and heads are addressed by static
+  64-lane sub-slices in-kernel.
+* **Batch folding.**  The grid is ``(batch_chunk, kv_block, group,
+  q_block)``; each step holds a chunk of batches of the *full* K/V
+  sequence resident in VMEM (scoped limit raised — v5e has 128 MiB
+  physical) and loops the chunk inside the kernel, so the fixed cost
+  amortizes.  The softmax is one-shot over the full key range.
+* **k-major scores.**  Scores are ``[Sk, bq]`` so softmax reductions
+  run across *sublanes* (cheap) and lse/delta live in a clean
+  ``[B, H, 8, S]`` row form written directly by the forward kernel —
+  no lane/sublane transposes anywhere.
+* Matmul operands stay in the input dtype (bf16 on the MXU's native
+  path) with fp32 accumulation — an fp32×fp32 dot runs at a fraction
+  of MXU rate.
 
-GQA maps every query head of a group onto the same resident KV block
-(like flash_kernel); ALiBi comes in as per-head slopes computed
-in-kernel.  No segment/padding masks: shapes with masks route to the
-general kernels — the packed-dataset training path and batched decode
-prefill both run maskless.
+Backward recomputes probabilities from the saved logsumexp
+(FlashAttention-2 style) in two kernels (dq, then dk/dv).  Head
+packing requires MHA for D=64 (two query heads share a 128-lane
+block); GQA is supported at D≥128 where a block is one head.  ALiBi
+comes in as per-head slopes computed in-kernel.  No segment/padding
+masks: masked shapes route to the general kernels — the packed-dataset
+training path and batched decode prefill run maskless.
 
 Replaces the reference's fused CUDA attention at training/serving
 shapes (FasterTransformer decoders,
@@ -44,35 +55,49 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-_ROWPAD = 8  # lane padding for [.., S]-shaped row vectors (see flash_kernel)
+#: sublane rows for the [B, H, _ROWS, S] lse/delta row tensors
+_ROWS = 8
+#: lane width of every block (the TPU tile width)
+_LANES = 128
 
-#: Scoped-VMEM ceiling requested from Mosaic.  v5e has 128 MiB of
-#: physical VMEM; the default 16 MiB scoped limit is what makes other
-#: kernels shrink their blocks (and pay per-grid-step fixed costs ~1000
-#: times).  This kernel asks for most of it and folds the whole batch
-#: into each grid step instead.
+#: Scoped-VMEM ceiling requested from Mosaic (v5e: 128 MiB physical; the
+#: 16 MiB default is what forces other kernels into tiny blocks).
 _VMEM_LIMIT = 100 * 1024 * 1024
 #: plan budget for the *estimated* working set; the Mosaic stack
-#: allocator roughly double-counts a naive estimate (double buffering +
-#: transient temporaries), so plan to about a third of the limit.
+#: allocator roughly double-counts a naive estimate.
 _VMEM_BUDGET = 32 * 1024 * 1024
-#: measured on v5e at B16 H16 S1024 D64: bq256 fwd 3.5 ms vs bq512 4.9 ms
+#: measured on v5e at B16 H16 S1024 D64: bq256 beats bq512 on the fwd
 _MAX_BLOCK_Q = 256
 
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 
-def _vmem_estimate(bb: int, bq: int, sk: int, d: int,
-                   dtype_bytes: int) -> int:
-    """Rough per-grid-step VMEM bytes for the fwd/bwd kernels (double
-    buffering on block inputs/outputs, fp32 score scratch + bf16 probs)."""
-    io = 2 * (bb * bq * d          # q
-              + 2 * bb * sk * d    # k + v
-              + bb * bq * d)       # out / dq
-    io += 2 * bb * max(bq, _ROWPAD) * _ROWPAD * 2  # lse/delta rows (f32)
+
+def _heads_per_block(d: int) -> Optional[int]:
+    """How many heads share one 128-lane block (None = unsupported).
+
+    The kernels hard-code 128-lane blocks and address one block per
+    ``hpb`` heads, so only d == 128 (one head per block) or d dividing
+    128 (several heads per block) are expressible; d > 128 would need
+    multi-block heads and routes to the general kernels instead."""
+    if d == _LANES:
+        return 1
+    if d < _LANES and _LANES % d == 0:
+        return _LANES // d
+    return None
+
+
+def _vmem_estimate(bb: int, bq: int, sk: int, dtype_bytes: int) -> int:
+    """Rough per-grid-step VMEM bytes (double buffering on 128-lane
+    block inputs/outputs, fp32 score scratch + probs)."""
+    io = 2 * (bb * bq * _LANES       # q
+              + 2 * bb * sk * _LANES  # k + v
+              + bb * bq * _LANES)    # out / dq
+    io += 2 * bb * _ROWS * sk * 2    # lse/delta row blocks (f32)
     scratch = bq * sk * 4 + bq * sk * dtype_bytes + bq * sk * 4
     return io * dtype_bytes + scratch
 
 
-def _plan(b: int, sq: int, sk: int, d: int,
+def _plan(b: int, sq: int, sk: int,
           dtype_bytes: int) -> Optional[tuple[int, int]]:
     """Largest (batch_chunk, q_block) whose working set fits the budget."""
     bq = min(_MAX_BLOCK_Q, sq)
@@ -80,7 +105,7 @@ def _plan(b: int, sq: int, sk: int, d: int,
         bb = b
         while bb >= 1:
             if (b % bb == 0 and sq % bq == 0
-                    and _vmem_estimate(bb, bq, sk, d, dtype_bytes)
+                    and _vmem_estimate(bb, bq, sk, dtype_bytes)
                     <= _VMEM_BUDGET):
                 return bb, bq
             bb //= 2
@@ -88,27 +113,31 @@ def _plan(b: int, sq: int, sk: int, d: int,
     return None
 
 
-def _alibi(slope, bq, sk):
-    kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 1).astype(
-        jnp.float32)
+def _plan_or_raise(b, sq, sk, d, h, hkv, dtype_bytes):
+    plan = (_plan(b, sq, sk, dtype_bytes)
+            if supported(b, sq, sk, d, h, hkv, dtype_bytes) else None)
+    if plan is None:
+        raise ValueError(
+            f"shape B{b} H{h}/{hkv} S{sq}/{sk} D{d} is not resident-kernel "
+            "eligible (see flash_resident.supported); route via "
+            "ops.attention / ops.flash_attention instead of calling "
+            "flash_mha_resident directly")
+    return plan
+
+
+def _causal_neg(row0, col0, rows, cols):
+    """k-major causal mask term: NEG_INF where k > q, else 0.
+    Rows are k positions (offset row0), cols are q positions (col0)."""
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) + row0
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1) + col0
+    return jnp.where(qpos >= kpos, 0.0, NEG_INF)
+
+
+def _alibi_rows(slope, row0, rows, cols):
+    """ALiBi per-key bias for a k-major [rows, cols] block."""
+    kpos = (jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) + row0
+            ).astype(jnp.float32)
     return slope * kpos
-
-
-def _score_addend(slope, qi0, bq, sk, causal: bool, have_slopes: bool):
-    """ALiBi + causal additive term for a [bq, sk] score block, hoisted
-    out of the kernels' batch loops (identical for every batch).  Masked
-    entries carry NEG_INF: exp() underflows them to exactly 0, so no
-    select is needed on the probability side (causal rows always have a
-    live diagonal)."""
-    addend = None
-    if have_slopes:
-        addend = _alibi(slope, bq, sk)
-    if causal:
-        qpos = jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 0) + qi0
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 1)
-        neg = jnp.where(qpos >= kpos, 0.0, NEG_INF)
-        addend = neg if addend is None else addend + neg
-    return addend
 
 
 # ---------------------------------------------------------------------------
@@ -116,11 +145,11 @@ def _score_addend(slope, qi0, bq, sk, causal: bool, have_slopes: bool):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(*refs, bb: int, group: int, bq: int, causal: bool,
-                scale: float, have_slopes: bool):
+def _fwd_kernel(*refs, bb: int, hpb: int, d: int, group: int, bq: int,
+                causal: bool, scale: float, have_slopes: bool):
     idx = 0
-    q_ref = refs[idx]; idx += 1
-    k_ref = refs[idx]; idx += 1
+    q_ref = refs[idx]; idx += 1   # [bb, bq, 128]
+    k_ref = refs[idx]; idx += 1   # [bb, sk, 128]
     v_ref = refs[idx]; idx += 1
     slopes_ref = None
     if have_slopes:
@@ -129,61 +158,65 @@ def _fwd_kernel(*refs, bb: int, group: int, bq: int, causal: bool,
 
     i = pl.program_id(3)
     qi0 = i * bq
-    sk = k_ref.shape[2]
-    head = pl.program_id(1) * group + pl.program_id(2)
-    slope = slopes_ref[head, 0] if have_slopes else None
-
-    addend = _score_addend(slope, qi0, bq, sk, causal, have_slopes)
+    sk = k_ref.shape[1]
+    qblock = pl.program_id(1) * group + pl.program_id(2)
+    neg = _causal_neg(0, qi0, sk, bq) if causal else None
 
     def body(b, _):
-        # scale folded onto the small [bq, D] operand, not the scores
-        qs = (q_ref[b, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
-        s = jax.lax.dot_general(
-            qs, k_ref[b, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, sk]
-        if addend is not None:
-            s = s + addend
-        m = jnp.max(s, axis=1, keepdims=True)
-        p = jnp.exp(s - m)
-        l = jnp.sum(p, axis=1, keepdims=True)
-        l_safe = jnp.maximum(l, 1e-30)
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[b, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        o_ref[b, 0] = (pv / l_safe).astype(o_ref.dtype)
-        lse_ref[b, 0] = jnp.broadcast_to(m + jnp.log(l_safe),
-                                         (bq, _ROWPAD))
+        for j in range(hpb):
+            sl = slice(j * d, (j + 1) * d)
+            # scale folded onto the small [bq, d] operand, not the scores
+            qs = (q_ref[b, :, sl].astype(jnp.float32) * scale).astype(
+                q_ref.dtype)
+            st = jax.lax.dot_general(
+                k_ref[b, :, sl], qs, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [sk, bq] k-major
+            if have_slopes:
+                head = qblock * hpb + j
+                st = st + _alibi_rows(slopes_ref[head, 0], 0, sk, bq)
+            if neg is not None:
+                st = st + neg
+            m = jnp.max(st, axis=0, keepdims=True)    # [1, bq] sublane red
+            p = jnp.exp(st - m)
+            l = jnp.sum(p, axis=0, keepdims=True)
+            l_safe = jnp.maximum(l, 1e-30)
+            pn = (p * (1.0 / l_safe)).astype(v_ref.dtype)
+            o_ref[b, :, sl] = jax.lax.dot_general(
+                pn, v_ref[b, :, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(o_ref.dtype)
+            lse_ref[b, j, :, pl.ds(qi0, bq)] = jnp.broadcast_to(
+                m + jnp.log(l_safe), (_ROWS, bq))
         return _
 
     jax.lax.fori_loop(0, bb, body, 0)
 
 
-def _plan_or_raise(b, sq, sk, d, h, hkv, dtype_bytes):
-    if not supported(b, sq, sk, d, h, hkv, dtype_bytes):
-        raise ValueError(
-            f"shape B{b} H{h}/{hkv} S{sq}/{sk} D{d} is not resident-kernel "
-            "eligible (see flash_resident.supported); route via "
-            "ops.attention / ops.flash_attention instead of calling "
-            "flash_mha_resident directly")
-    return _plan(b, sq, sk, d, dtype_bytes)
+def _grid_geometry(b, h, hkv, d, sq, sk, dtype_bytes):
+    hpb = _heads_per_block(d)
+    g = h // hkv if hpb == 1 else 1          # hpb > 1 requires MHA
+    kb = (hkv // hpb) if hpb > 1 else hkv    # kv 128-lane blocks
+    bb, bq = _plan_or_raise(b, sq, sk, d, h, hkv, dtype_bytes)
+    return hpb, g, kb, bb, bq
 
 
-def _fwd(q, k, v, slopes, causal, scale, interpret):
-    b, h, sq, d = q.shape
-    hkv, sk = k.shape[1], k.shape[2]
-    g = h // hkv
-    bb, bq = _plan_or_raise(b, sq, sk, d, h, hkv, q.dtype.itemsize)
+def _fwd(qf, kf, vf, slopes, heads, kv_heads, causal, scale, interpret):
+    b, sq, hd = qf.shape
+    h, hkv = heads, kv_heads
+    d = hd // h
+    sk = kf.shape[1]
+    hpb, g, kb, bb, bq = _grid_geometry(b, h, hkv, d, sq, sk,
+                                        qf.dtype.itemsize)
     nb, nq = b // bb, sq // bq
     have_slopes = slopes is not None
 
-    grid = (nb, hkv, g, nq)
+    grid = (nb, kb, g, nq)
     in_specs = [
-        pl.BlockSpec((bb, 1, bq, d),
-                     lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0)),
-        pl.BlockSpec((bb, 1, sk, d), lambda b_, kh, g_, i: (b_, kh, 0, 0)),
-        pl.BlockSpec((bb, 1, sk, d), lambda b_, kh, g_, i: (b_, kh, 0, 0)),
+        pl.BlockSpec((bb, bq, _LANES),
+                     lambda b_, kh, g_, i: (b_, i, kh * g + g_)),
+        pl.BlockSpec((bb, sk, _LANES), lambda b_, kh, g_, i: (b_, 0, kh)),
+        pl.BlockSpec((bb, sk, _LANES), lambda b_, kh, g_, i: (b_, 0, kh)),
     ]
-    args = [q, k, v]
+    args = [qf, kf, vf]
     if have_slopes:
         in_specs.append(pl.BlockSpec((h, 1), lambda b_, kh, g_, i: (0, 0),
                                      memory_space=pltpu.SMEM))
@@ -191,23 +224,23 @@ def _fwd(q, k, v, slopes, causal, scale, interpret):
 
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, bb=bb, group=g, bq=bq, causal=causal,
-            scale=scale, have_slopes=have_slopes),
+            _fwd_kernel, bb=bb, hpb=hpb, d=d, group=g, bq=bq,
+            causal=causal, scale=scale, have_slopes=have_slopes),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((bb, 1, bq, d),
-                         lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0)),
-            pl.BlockSpec((bb, 1, bq, _ROWPAD),
-                         lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0)),
+            pl.BlockSpec((bb, bq, _LANES),
+                         lambda b_, kh, g_, i: (b_, i, kh * g + g_)),
+            # full-S row block, revisited across q-blocks (written via ds)
+            pl.BlockSpec((bb, hpb, _ROWS, sq),
+                         lambda b_, kh, g_, i: (b_, kh * g + g_, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, _ROWPAD), jnp.float32),
+            jax.ShapeDtypeStruct((b, sq, hd), qf.dtype),
+            jax.ShapeDtypeStruct((b, h, _ROWS, sq), jnp.float32),
         ],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_COMPILER_PARAMS,
     )(*args)
     return out, lse
 
@@ -217,14 +250,14 @@ def _fwd(q, k, v, slopes, causal, scale, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(*refs, bb: int, group: int, bq: int, causal: bool,
-               scale: float, have_slopes: bool):
+def _dq_kernel(*refs, bb: int, hpb: int, d: int, group: int, bq: int,
+               causal: bool, scale: float, have_slopes: bool):
     idx = 0
-    q_ref = refs[idx]; idx += 1
-    k_ref = refs[idx]; idx += 1
+    q_ref = refs[idx]; idx += 1   # [bb, bq, 128]
+    k_ref = refs[idx]; idx += 1   # [bb, sk, 128]
     v_ref = refs[idx]; idx += 1
-    do_ref = refs[idx]; idx += 1
-    lse_ref = refs[idx]; idx += 1
+    do_ref = refs[idx]; idx += 1  # [bb, bq, 128]
+    lse_ref = refs[idx]; idx += 1   # [bb, hpb, _ROWS, Sq] row form
     delta_ref = refs[idx]; idx += 1
     slopes_ref = None
     if have_slopes:
@@ -233,178 +266,169 @@ def _dq_kernel(*refs, bb: int, group: int, bq: int, causal: bool,
 
     i = pl.program_id(3)
     qi0 = i * bq
-    sk = k_ref.shape[2]
-    head = pl.program_id(1) * group + pl.program_id(2)
-    slope = slopes_ref[head, 0] if have_slopes else None
-
-    addend = _score_addend(slope, qi0, bq, sk, causal, have_slopes)
+    sk = k_ref.shape[1]
+    qblock = pl.program_id(1) * group + pl.program_id(2)
+    neg = _causal_neg(0, qi0, sk, bq) if causal else None
 
     def body(b, _):
-        qs = (q_ref[b, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
-        s = jax.lax.dot_general(
-            qs, k_ref[b, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if addend is not None:
-            s = s + addend
-        lse = lse_ref[b, 0][:, :1]
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do_ref[b, 0], v_ref[b, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        delta = delta_ref[b, 0][:, :1]
-        ds = (p * (dp - delta) * scale).astype(k_ref.dtype)
-        dq_ref[b, 0] = jax.lax.dot_general(
-            ds, k_ref[b, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        for j in range(hpb):
+            sl = slice(j * d, (j + 1) * d)
+            qs = (q_ref[b, :, sl].astype(jnp.float32) * scale).astype(
+                q_ref.dtype)
+            st = jax.lax.dot_general(
+                k_ref[b, :, sl], qs, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [sk, bq]
+            if have_slopes:
+                head = qblock * hpb + j
+                st = st + _alibi_rows(slopes_ref[head, 0], 0, sk, bq)
+            if neg is not None:
+                st = st + neg
+            lse_row = lse_ref[b, j, :1, pl.ds(qi0, bq)]   # [1, bq]
+            pt = jnp.exp(st - lse_row)
+            dpt = jax.lax.dot_general(
+                v_ref[b, :, sl], do_ref[b, :, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [sk, bq]
+            delta_row = delta_ref[b, j, :1, pl.ds(qi0, bq)]
+            dst = (pt * (dpt - delta_row) * scale).astype(k_ref.dtype)
+            dq_ref[b, :, sl] = jax.lax.dot_general(
+                dst, k_ref[b, :, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dq_ref.dtype)
         return _
 
     jax.lax.fori_loop(0, bb, body, 0)
 
 
-def _dkv_kernel(*refs, bb: int, group: int, bk: int, causal: bool,
-                scale: float, have_slopes: bool):
+def _dkv_kernel(*refs, bb: int, hpb: int, d: int, group: int, bk: int,
+                causal: bool, scale: float, have_slopes: bool):
     idx = 0
-    q_ref = refs[idx]; idx += 1
-    k_ref = refs[idx]; idx += 1
+    q_ref = refs[idx]; idx += 1   # [bb, sq, 128] (full)
+    k_ref = refs[idx]; idx += 1   # [bb, bk, 128]
     v_ref = refs[idx]; idx += 1
-    do_ref = refs[idx]; idx += 1
-    lse_ref = refs[idx]; idx += 1   # [bb, 1, _ROWPAD, Sq] pre-transposed
+    do_ref = refs[idx]; idx += 1  # [bb, sq, 128] (full)
+    lse_ref = refs[idx]; idx += 1   # [bb, hpb, _ROWS, Sq] row form
     delta_ref = refs[idx]; idx += 1
     slopes_ref = None
     if have_slopes:
         slopes_ref = refs[idx]; idx += 1
     dk_ref, dv_ref = refs[idx], refs[idx + 1]
 
-    j = pl.program_id(3)
-    kj0 = j * bk
-    sq = q_ref.shape[2]
-    head = pl.program_id(1) * group + pl.program_id(2)
-    slope = slopes_ref[head, 0] if have_slopes else None
-
-    addend = None
-    if have_slopes:
-        kpos = (jax.lax.broadcasted_iota(jnp.int32, (bk, sq), 0) + kj0
-                ).astype(jnp.float32)
-        addend = slope * kpos
-    if causal:
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (bk, sq), 0) + kj0
-        qpos = jax.lax.broadcasted_iota(jnp.int32, (bk, sq), 1)
-        neg = jnp.where(qpos >= kpos, 0.0, NEG_INF)
-        addend = neg if addend is None else addend + neg
+    j_blk = pl.program_id(3)
+    kj0 = j_blk * bk
+    sq = q_ref.shape[1]
+    qblock = pl.program_id(1) * group + pl.program_id(2)
+    neg = _causal_neg(kj0, 0, bk, sq) if causal else None
 
     def body(b, _):
-        # s^T layout: [bk, sq] so the dv/dk contractions are row-major
-        ks = (k_ref[b, 0].astype(jnp.float32) * scale).astype(k_ref.dtype)
-        st = jax.lax.dot_general(
-            ks, q_ref[b, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if addend is not None:
-            st = st + addend
-        lse_row = lse_ref[b, 0][:1, :]             # [1, sq]
-        pt = jnp.exp(st - lse_row)                 # [bk, sq]
-        ptb = pt.astype(v_ref.dtype)
-        dv_ref[b, 0] = jax.lax.dot_general(
-            ptb, do_ref[b, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
-        dpt = jax.lax.dot_general(
-            v_ref[b, 0], do_ref[b, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)    # [bk, sq]
-        delta_row = delta_ref[b, 0][:1, :]
-        dst = (pt * (dpt - delta_row) * scale).astype(q_ref.dtype)
-        dk_ref[b, 0] = jax.lax.dot_general(
-            dst, q_ref[b, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+        for j in range(hpb):
+            sl = slice(j * d, (j + 1) * d)
+            ks = (k_ref[b, :, sl].astype(jnp.float32) * scale).astype(
+                k_ref.dtype)
+            st = jax.lax.dot_general(
+                ks, q_ref[b, :, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bk, sq]
+            if have_slopes:
+                head = qblock * hpb + j
+                st = st + _alibi_rows(slopes_ref[head, 0], kj0, bk, sq)
+            if neg is not None:
+                st = st + neg
+            lse_row = lse_ref[b, j, :1, :]               # [1, sq]
+            pt = jnp.exp(st - lse_row)
+            ptb = pt.astype(v_ref.dtype)
+            dv_ref[b, :, sl] = jax.lax.dot_general(
+                ptb, do_ref[b, :, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+            dpt = jax.lax.dot_general(
+                v_ref[b, :, sl], do_ref[b, :, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [bk, sq]
+            delta_row = delta_ref[b, j, :1, :]
+            dst = (pt * (dpt - delta_row) * scale).astype(q_ref.dtype)
+            dk_ref[b, :, sl] = jax.lax.dot_general(
+                dst, q_ref[b, :, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dk_ref.dtype)
         return _
 
     jax.lax.fori_loop(0, bb, body, 0)
 
 
-def _bwd(causal, scale, interpret, res, dout):
-    q, k, v, slopes, out, lse = res
-    b, h, sq, d = q.shape
-    hkv, sk = k.shape[1], k.shape[2]
-    g = h // hkv
-    bb, bq = _plan_or_raise(b, sq, sk, d, h, hkv, q.dtype.itemsize)
+def _bwd(heads, kv_heads, causal, scale, interpret, res, dof):
+    qf, kf, vf, slopes, outf, lse = res
+    h, hkv = heads, kv_heads
+    b, sq, hd = qf.shape
+    d = hd // h
+    sk = kf.shape[1]
+    hpb, g, kb, bb, bq = _grid_geometry(b, h, hkv, d, sq, sk,
+                                        qf.dtype.itemsize)
     bk = bq
     nb, nq, nk = b // bb, sq // bq, sk // bk
     have_slopes = slopes is not None
 
-    delta = jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32),
-                    axis=-1)
-    delta_pad = jax.lax.broadcast_in_dim(delta, (b, h, sq, _ROWPAD),
-                                         (0, 1, 2))
+    # delta = sum_d(out * dout) per (b, h, s), in the clean row form
+    delta_bsh = jnp.sum(
+        (outf * dof).astype(jnp.float32).reshape(b, sq, h, d), axis=-1)
+    delta = jax.lax.broadcast_in_dim(
+        delta_bsh.transpose(0, 2, 1), (b, h, _ROWS, sq), (0, 1, 3))
     slope_arg = (slopes.reshape(h, 1).astype(jnp.float32)
                  if have_slopes else None)
 
-    qspec = pl.BlockSpec((bb, 1, bq, d),
-                         lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0))
-    kvspec = pl.BlockSpec((bb, 1, sk, d),
-                          lambda b_, kh, g_, i: (b_, kh, 0, 0))
-    rowspec = pl.BlockSpec((bb, 1, bq, _ROWPAD),
-                           lambda b_, kh, g_, i: (b_, kh * g + g_, i, 0))
+    qspec = pl.BlockSpec((bb, bq, _LANES),
+                         lambda b_, kh, g_, i: (b_, i, kh * g + g_))
+    kvspec = pl.BlockSpec((bb, sk, _LANES),
+                          lambda b_, kh, g_, i: (b_, 0, kh))
+    rowspec = pl.BlockSpec((bb, hpb, _ROWS, sq),
+                           lambda b_, kh, g_, i: (b_, kh * g + g_, 0, 0))
     in_specs = [qspec, kvspec, kvspec, qspec, rowspec, rowspec]
-    args = [q, k, v, dout, lse, delta_pad]
+    args = [qf, kf, vf, dof, lse, delta]
     if have_slopes:
         in_specs.append(pl.BlockSpec((h, 1), lambda b_, kh, g_, i: (0, 0),
                                      memory_space=pltpu.SMEM))
         args.append(slope_arg)
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, bb=bb, group=g, bq=bq, causal=causal,
-            scale=scale, have_slopes=have_slopes),
-        grid=(nb, hkv, g, nq),
+            _dq_kernel, bb=bb, hpb=hpb, d=d, group=g, bq=bq,
+            causal=causal, scale=scale, have_slopes=have_slopes),
+        grid=(nb, kb, g, nq),
         in_specs=in_specs,
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, sq, hd), qf.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_COMPILER_PARAMS,
     )(*args)
 
-    # dk/dv kernel wants lse/delta as [1, Sq] row vectors (q along lanes);
-    # build the transposed copies host-side instead of transposing in-kernel.
-    lse_t = jax.lax.broadcast_in_dim(
-        lse[..., 0], (b, h, _ROWPAD, sq), (0, 1, 3))
-    delta_t = jax.lax.broadcast_in_dim(
-        delta, (b, h, _ROWPAD, sq), (0, 1, 3))
-    qfull = pl.BlockSpec((bb, 1, sq, d),
-                         lambda b_, kh, g_, j: (b_, kh * g + g_, 0, 0))
-    kblk = pl.BlockSpec((bb, 1, bk, d),
-                        lambda b_, kh, g_, j: (b_, kh, j, 0))
-    rowfull = pl.BlockSpec((bb, 1, _ROWPAD, sq),
+    qfull = pl.BlockSpec((bb, sq, _LANES),
+                         lambda b_, kh, g_, j: (b_, 0, kh * g + g_))
+    kblk = pl.BlockSpec((bb, bk, _LANES), lambda b_, kh, g_, j: (b_, j, kh))
+    rowfull = pl.BlockSpec((bb, hpb, _ROWS, sq),
                            lambda b_, kh, g_, j: (b_, kh * g + g_, 0, 0))
     in_specs = [qfull, kblk, kblk, qfull, rowfull, rowfull]
-    args = [q, k, v, dout, lse_t, delta_t]
+    args = [qf, kf, vf, dof, lse, delta]
     if have_slopes:
         in_specs.append(pl.BlockSpec((h, 1), lambda b_, kh, g_, j: (0, 0),
                                      memory_space=pltpu.SMEM))
         args.append(slope_arg)
-    # GQA: the kernel writes per-query-head dk/dv partials (unreduced over
-    # the group); for g == 1 that is already the answer, for g > 1 the
-    # group reduction happens outside in one cheap XLA sum.
-    out_h = h
-    per_head = pl.BlockSpec((bb, 1, bk, d),
-                            lambda b_, kh, g_, j: (b_, kh * g + g_, j, 0))
+    # GQA (hpb == 1, g > 1): the kernel writes per-query-head dk/dv
+    # partials (unreduced over the group); the group reduction happens
+    # outside in one cheap XLA sum.  MHA writes the answer directly.
+    per_qhead = pl.BlockSpec((bb, bk, _LANES),
+                             lambda b_, kh, g_, j: (b_, j, kh * g + g_))
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, bb=bb, group=g, bk=bk, causal=causal,
-            scale=scale, have_slopes=have_slopes),
-        grid=(nb, hkv, g, nk),
+            _dkv_kernel, bb=bb, hpb=hpb, d=d, group=g, bk=bk,
+            causal=causal, scale=scale, have_slopes=have_slopes),
+        grid=(nb, kb, g, nk),
         in_specs=in_specs,
-        out_specs=[per_head, per_head],
+        out_specs=[per_qhead, per_qhead],
         out_shape=[
-            jax.ShapeDtypeStruct((b, out_h, sk, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, out_h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, sk, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, sk, hd), jnp.float32),
         ],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_COMPILER_PARAMS,
     )(*args)
     if g > 1:
-        dk = dk.reshape(b, hkv, g, sk, d).sum(axis=2)
-        dv = dv.reshape(b, hkv, g, sk, d).sum(axis=2)
+        dk = dk.reshape(b, sk, hkv, g, d).sum(axis=3).reshape(b, sk, -1)
+        dv = dv.reshape(b, sk, hkv, g, d).sum(axis=3).reshape(b, sk, -1)
 
-    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+    return (dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype),
             None)
 
 
@@ -413,29 +437,62 @@ def _bwd(causal, scale, interpret, res, dout):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, slopes, causal, scale, interpret):
-    out, _ = _fwd(q, k, v, slopes, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_flat(qf, kf, vf, slopes, heads, kv_heads, causal, scale,
+                interpret):
+    out, _ = _flash_flat_fwd(qf, kf, vf, slopes, heads, kv_heads, causal,
+                             scale, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, slopes, causal, scale, interpret):
-    out, lse = _fwd(q, k, v, slopes, causal, scale, interpret)
-    return out, (q, k, v, slopes, out, lse)
+def _flash_flat_fwd(qf, kf, vf, slopes, heads, kv_heads, causal, scale,
+                    interpret):
+    out, lse = _fwd(qf, kf, vf, slopes, heads, kv_heads, causal, scale,
+                    interpret)
+    return out, (qf, kf, vf, slopes, out, lse)
 
 
-_flash.defvjp(_flash_fwd, _bwd)
+_flash_flat.defvjp(_flash_flat_fwd, _bwd)
 
 
 def supported(b: int, sq: int, sk: int, d: int, h: int, hkv: int,
               dtype_bytes: int = 2) -> bool:
     """Eligibility: aligned self-attention shapes whose K/V chunk plan
-    fits the VMEM budget."""
-    if h % hkv:
+    fits the VMEM budget and whose heads pack into 128-lane blocks."""
+    hpb = _heads_per_block(d)
+    if hpb is None:
         return False
-    if sq != sk or sq % 128 or d % 64 or d % 128 and d != 64:
+    if hpb > 1 and (h != hkv or h % hpb):
+        return False  # D<128 head packing requires MHA
+    if hpb == 1 and h % hkv:
         return False
-    return _plan(b, sq, sk, d, dtype_bytes) is not None
+    if sq != sk or sq % 128:
+        return False
+    return _plan(b, sq, sk, dtype_bytes) is not None
+
+
+def flash_mha_resident_flat(
+    qf: jax.Array,  # [B, S, H·D]
+    kf: jax.Array,  # [B, S, Hkv·D]
+    vf: jax.Array,
+    *,
+    heads: int,
+    kv_heads: Optional[int] = None,
+    slopes: Optional[jax.Array] = None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flat-layout entry point; returns [B, S, H·D].
+
+    This is the layout the kernels read and the residuals are saved in —
+    callers coming from [B, S, H, D] framework tensors reshape (free:
+    H, D are trailing and adjacent) rather than transpose."""
+    kv_heads = kv_heads or heads
+    if scale is None:
+        scale = (qf.shape[-1] // heads) ** -0.5
+    return _flash_flat(qf, kf, vf, slopes, heads, kv_heads, causal,
+                       float(scale), interpret)
 
 
 def flash_mha_resident(
@@ -448,7 +505,15 @@ def flash_mha_resident(
     scale: Optional[float] = None,
     interpret: bool = False,
 ) -> jax.Array:
-    """Batch-folded resident flash attention; returns [B, H, Sq, D]."""
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    return _flash(q, k, v, slopes, causal, float(scale), interpret)
+    """Kernel-layout ([B, H, S, D]) convenience wrapper (tests, parity
+    harnesses); production callers use the flat entry point."""
+    b, h, sq, d = q.shape
+    hkv = k.shape[1]
+
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(b, x.shape[2], -1)
+
+    outf = flash_mha_resident_flat(
+        flat(q), flat(k), flat(v), heads=h, kv_heads=hkv,
+        slopes=slopes, causal=causal, scale=scale, interpret=interpret)
+    return outf.reshape(b, sq, h, d).transpose(0, 2, 1, 3)

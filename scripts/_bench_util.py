@@ -42,3 +42,33 @@ def timeit_scan(step, init, n=3, warmup=1, k_iters=K_ITERS):
         out = run(out)
     sync(out)
     return (time.perf_counter() - t0) / (n * k_iters) * 1e3
+
+
+def bench_attention(fn, q, k, v, do, name, attn_flops_fwd):
+    """Time fn(q, k, v) forward and fwd+bwd at the bench shape and print
+    one formatted line.  ``attn_flops_fwd`` is the dense forward FLOPs
+    (x3 for the fwd+bwd figure)."""
+    def fwd_step(qc):
+        return fn(qc, k, v).astype(q.dtype)
+
+    def loss(qc, kc, vc):
+        return (fn(qc, kc, vc) * do).sum()
+
+    gradfn = jax.grad(loss, argnums=(0, 1, 2))
+
+    def bwd_step(qc):
+        gq, gk, gv = gradfn(qc, k, v)
+        return (qc + 1e-6 * gq.astype(qc.dtype)
+                + 1e-6 * (gk + gv).astype(qc.dtype))
+
+    try:
+        ms_f = timeit_scan(fwd_step, q)
+        ms_g = timeit_scan(bwd_step, q)
+    except Exception as e:  # noqa: BLE001 - report and continue the sweep
+        print(f"{name:44s} FAILED: {type(e).__name__}: {str(e)[:200]}",
+              flush=True)
+        return
+    attn_flops = attn_flops_fwd * 3
+    print(f"{name:44s} fwd {ms_f:7.3f} ms ({attn_flops_fwd/ms_f/1e9:6.1f}"
+          f" TF/s)  fwd+bwd {ms_g:7.3f} ms "
+          f"({attn_flops / ms_g / 1e9:6.1f} TF/s)", flush=True)

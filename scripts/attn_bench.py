@@ -9,7 +9,6 @@ dependency between iterations) and the per-op time is total/K.
 from __future__ import annotations
 
 import functools
-import time
 
 import jax
 import jax.numpy as jnp
@@ -17,7 +16,7 @@ import jax.numpy as jnp
 B, H, S, D = 16, 16, 1024, 64
 
 
-from _bench_util import sync as _sync, timeit_scan  # noqa: E402
+from _bench_util import bench_attention, timeit_scan  # noqa: E402
 
 
 def main() -> None:
@@ -39,31 +38,9 @@ def main() -> None:
           f"{ms:.3f} ms = {fl / ms / 1e9:.1f} TFLOP/s")
 
     attn_flops_fwd = 4 * B * H * S * S * D
-    attn_flops = attn_flops_fwd * 3  # fwd QK+PV, x3 with bwd
 
     def bench(fn, name):
-        def fwd_step(q):
-            return fn(q, k, v).astype(jnp.bfloat16)
-
-        def loss(q, k, v):
-            return (fn(q, k, v) * do).sum()
-
-        gradfn = jax.grad(loss, argnums=(0, 1, 2))
-
-        def bwd_step(q):
-            gq, gk, gv = gradfn(q, k, v)
-            return (q + 1e-6 * gq.astype(q.dtype)
-                    + 1e-6 * (gk + gv).astype(q.dtype))
-
-        try:
-            ms_f = timeit_scan(fwd_step, q)
-            ms_g = timeit_scan(bwd_step, q)
-        except Exception as e:  # noqa: BLE001
-            print(f"{name:44s} FAILED: {type(e).__name__}: {str(e)[:80]}")
-            return
-        print(f"{name:44s} fwd {ms_f:7.3f} ms ({attn_flops_fwd/ms_f/1e9:6.1f}"
-              f" TF/s)  fwd+bwd {ms_g:7.3f} ms "
-              f"({attn_flops / ms_g / 1e9:6.1f} TF/s)")
+        bench_attention(fn, q, k, v, do, name, attn_flops_fwd)
 
     from kubernetes_cloud_tpu.ops.attention import attention
 

@@ -37,6 +37,10 @@ def build_step(variant: str):
     remat = True
     if "attnout" in variant:
         policy = "attn_out"
+    if "island" in variant:
+        policy = ("attn_island_mlp" if "islandmlp" in variant
+                  else "attn_island")
+        attn = "pallas"
     if "pallas" in variant:
         from kubernetes_cloud_tpu.ops import flash_attention
         flash_attention._MIN_SEQ = 1024
@@ -48,11 +52,12 @@ def build_step(variant: str):
     mesh = build_mesh(MeshSpec())
     state = init_train_state(cfg, train_cfg, jax.random.key(0), mesh)
     step = jax.jit(make_train_step(cfg, train_cfg), donate_argnums=0)
-    batch = shard_batch({
-        "input_ids": jax.random.randint(
-            jax.random.key(1), (BATCH, SEQ), 0, cfg.vocab_size,
-            dtype=jnp.int32),
-        "attention_mask": jnp.ones((BATCH, SEQ), jnp.int32)}, mesh)
+    data = {"input_ids": jax.random.randint(
+        jax.random.key(1), (BATCH, SEQ), 0, cfg.vocab_size,
+        dtype=jnp.int32)}
+    if "nomask" not in variant and "island" not in variant:
+        data["attention_mask"] = jnp.ones((BATCH, SEQ), jnp.int32)
+    batch = shard_batch(data, mesh)
     return step, state, batch
 
 

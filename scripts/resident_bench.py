@@ -1,68 +1,36 @@
-"""Benchmark the resident kernel vs the best alternatives at bench shape."""
+"""Benchmark the resident kernel across planner settings at bench shape."""
 from __future__ import annotations
-
-import sys
-import time
 
 import jax
 import jax.numpy as jnp
 
+from _bench_util import bench_attention
+
 B, H, S, D = 16, 16, 1024, 64
 
 
-from _bench_util import sync as _sync, timeit_scan  # noqa: E402
-
-
 def main() -> None:
-    key = jax.random.key(0)
-    kq, kk, kv, kd = jax.random.split(key, 4)
+    from kubernetes_cloud_tpu.ops import flash_resident
+    from kubernetes_cloud_tpu.ops.flash_resident import flash_mha_resident
+
+    kq, kk, kv, kd = jax.random.split(jax.random.key(0), 4)
     q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
     k = jax.random.normal(kk, (B, H, S, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, H, S, D), jnp.bfloat16)
     do = jax.random.normal(kd, (B, H, S, D), jnp.bfloat16)
-
     attn_flops_fwd = 4 * B * H * S * S * D
-    attn_flops = attn_flops_fwd * 3
 
-    def bench(fn, name):
-        def fwd_step(q):
-            return fn(q, k, v).astype(jnp.bfloat16)
-
-        def loss(q, k, v):
-            return (fn(q, k, v) * do).sum()
-
-        gradfn = jax.grad(loss, argnums=(0, 1, 2))
-
-        def bwd_step(q):
-            gq, gk, gv = gradfn(q, k, v)
-            return (q + 1e-6 * gq.astype(q.dtype)
-                    + 1e-6 * (gk + gv).astype(q.dtype))
-
-        try:
-            ms_f = timeit_scan(fwd_step, q)
-            ms_g = timeit_scan(bwd_step, q)
-        except Exception as e:  # noqa: BLE001
-            print(f"{name:44s} FAILED: {type(e).__name__}: {str(e)[:200]}")
-            return
-        print(f"{name:44s} fwd {ms_f:7.3f} ms ({attn_flops_fwd/ms_f/1e9:6.1f}"
-              f" TF/s)  fwd+bwd {ms_g:7.3f} ms "
-              f"({attn_flops / ms_g / 1e9:6.1f} TF/s)", flush=True)
-
-    from kubernetes_cloud_tpu.ops import flash_resident
-
-    for budget_mb in (7, 8, 9, 10):
-        for bq in (256, 512):
+    for budget_mb in (20, 32):
+        for bq in (128, 256, 512):
             flash_resident._MAX_BLOCK_Q = bq
             flash_resident._VMEM_BUDGET = budget_mb * 1024 * 1024
-            plan = flash_resident._plan(B, S, S, D, 2)
-            bench(lambda q, k, v: flash_mha_res(q, k, v),
-                  f"resident bq{bq} budget{budget_mb}MB plan={plan}")
-
-
-def flash_mha_res(q, k, v):
-    from kubernetes_cloud_tpu.ops.flash_resident import flash_mha_resident
-    return flash_mha_resident(q, k, v, causal=True)
+            plan = flash_resident._plan(B, S, S, 2)
+            bench_attention(
+                lambda q, k, v: flash_mha_resident(q, k, v, causal=True),
+                q, k, v, do,
+                f"resident bq{bq} budget{budget_mb}MB plan={plan}",
+                attn_flops_fwd)
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    main()

@@ -56,7 +56,8 @@ def test_forward_matches_xla():
 
 
 def test_gqa_forward_and_grads():
-    q, k, v = _qkv(h=4, hkv=2)
+    # GQA rides the hpb=1 path (one head per 128-lane block), so D=128
+    q, k, v = _qkv(h=4, hkv=2, d=128)
     do = jnp.asarray(
         np.random.default_rng(1).standard_normal(q.shape), jnp.float32)
 
@@ -102,7 +103,7 @@ def test_grads_match_xla():
 
 def test_plan_fits_budget_and_divides():
     for (b, s) in [(16, 1024), (8, 2048), (32, 512), (1, 1024)]:
-        plan = _plan(b, s, s, 64, 2)
+        plan = _plan(b, s, s, 2)
         assert plan is not None
         bb, bq = plan
         assert b % bb == 0 and s % bq == 0
@@ -110,9 +111,14 @@ def test_plan_fits_budget_and_divides():
 
 def test_supported_gates():
     assert supported(16, 1024, 1024, 64, 16, 16)
+    assert supported(8, 1024, 1024, 128, 8, 2)        # GQA at D=128
     assert not supported(16, 1024, 512, 64, 16, 16)   # cross-attention
     assert not supported(16, 1000, 1000, 64, 16, 16)  # unaligned
     assert not supported(16, 1024, 1024, 64, 16, 3)   # h % hkv
+    assert not supported(16, 1024, 1024, 64, 16, 8)   # D<128 GQA (packing)
+    assert not supported(16, 1024, 1024, 96, 16, 16)  # 96 lanes unpackable
+    assert not supported(16, 1024, 1024, 256, 16, 16)  # D>128 (gpt-j) —
+    # kernels hard-code one 128-lane block per head; routes to general
 
 
 def test_attn_island_policy_matches_dense(monkeypatch):
