@@ -143,9 +143,23 @@ def build_mesh(
             )
         except (ValueError, NotImplementedError, AssertionError,
                 AttributeError):
-            # Topology-unaware fallback (CPU simulation meshes have no
-            # slice_index): outer DCN axes major, ICI axes minor — the
-            # same logical nesting the hybrid builder produces.
+            # Topology-unaware fallback: outer DCN axes major, ICI axes
+            # minor — the same logical nesting the hybrid builder
+            # produces.  Expected only for CPU-simulation meshes (no
+            # slice_index); on real multi-slice TPU hardware falling
+            # through here silently would misplace DCN/ICI axes — a
+            # silent perf cliff — so warn loudly.
+            if any(getattr(d, "slice_index", None) is not None
+                   for d in devices):
+                import warnings
+
+                warnings.warn(
+                    "create_hybrid_device_mesh failed on devices that "
+                    "report slice_index; falling back to a "
+                    "topology-unaware DCN-major ordering. Collectives "
+                    "may cross DCN where ICI was intended — check the "
+                    "mesh axis placement.",
+                    RuntimeWarning, stacklevel=2)
             mesh_devices = np.asarray(devices).reshape(
                 spec.dcn_shape() + ici_shape).transpose(
                 [k for i in range(len(ici_shape)) for k in

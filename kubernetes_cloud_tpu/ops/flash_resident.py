@@ -76,13 +76,15 @@ def _heads_per_block(d: int) -> Optional[int]:
     """How many heads share one 128-lane block (None = unsupported).
 
     The kernels hard-code 128-lane blocks and address one block per
-    ``hpb`` heads, so only d == 128 (one head per block) or d dividing
-    128 (several heads per block) are expressible; d > 128 would need
-    multi-block heads and routes to the general kernels instead."""
+    ``hpb`` heads, so only d == 128 (one head per block) or d == 64
+    (two heads, statically sub-sliced — the tested packing) are
+    expressible here; d > 128 would need multi-block heads and smaller
+    head dims are untested sub-slice widths — both route to the general
+    kernels instead."""
     if d == _LANES:
         return 1
-    if d < _LANES and _LANES % d == 0:
-        return _LANES // d
+    if d == 64:
+        return 2
     return None
 
 
@@ -364,7 +366,8 @@ def _bwd(heads, kv_heads, causal, scale, interpret, res, dof):
 
     # delta = sum_d(out * dout) per (b, h, s), in the clean row form
     delta_bsh = jnp.sum(
-        (outf * dof).astype(jnp.float32).reshape(b, sq, h, d), axis=-1)
+        (outf.astype(jnp.float32) * dof.astype(jnp.float32)).reshape(
+            b, sq, h, d), axis=-1)
     delta = jax.lax.broadcast_in_dim(
         delta_bsh.transpose(0, 2, 1), (b, h, _ROWS, sq), (0, 1, 3))
     slope_arg = (slopes.reshape(h, 1).astype(jnp.float32)

@@ -48,6 +48,12 @@ EOT = "<|endoftext|>"
 
 
 def _clean(text: str) -> str:
+    try:  # mirror transformers' basic_clean: ftfy first when available
+        import ftfy
+
+        text = ftfy.fix_text(text)
+    except ImportError:
+        pass
     text = html.unescape(html.unescape(text))
     return re.sub(r"\s+", " ", text).strip().lower()
 
@@ -128,8 +134,16 @@ class CLIPBPECodec(BPECodec):
         return out
 
     def decode(self, ids: list[int]) -> str:
-        specials = {self.sot, self.eot, self.pad}
-        text = "".join(self.decoder[i] for i in ids if i not in specials)
+        # Strip only *trailing* pad tokens: SD-2.x tokenizers pad with
+        # '!', a real vocab token that may legitimately appear mid-text.
+        # (When pad == eot the eot filter below covers interior pads the
+        # way CLIPTokenizer does.)
+        end = len(ids)
+        while end > 0 and ids[end - 1] == self.pad:
+            end -= 1
+        specials = {self.sot, self.eot}
+        text = "".join(self.decoder[i] for i in ids[:end]
+                       if i not in specials)
         data = bytes(self.byte_dec[c] for c in text)
         decoded = data.decode("utf-8", errors="replace")
         return decoded.replace("</w>", " ").strip()

@@ -43,11 +43,32 @@ def latest_checkpoint(directory: str) -> Optional[str]:
 
 
 def mark_ready(directory: str, text: str = "ready") -> None:
+    """Write the ready sentinel; ``directory`` may be a remote URI
+    (``gs://…``) — object stores need no mkdir and go through fsspec."""
+    from kubernetes_cloud_tpu.weights.tensorstream import is_remote
+
+    path = directory.rstrip("/") + "/" + READY_SENTINEL
+    if is_remote(directory):
+        import fsspec
+
+        with fsspec.open(path, "w") as f:
+            f.write(text)
+        return
     with open(os.path.join(directory, READY_SENTINEL), "w") as f:
         f.write(text)
 
 
 def is_ready(directory: str) -> bool:
+    from kubernetes_cloud_tpu.weights.tensorstream import is_remote
+
+    if is_remote(directory):
+        import fsspec
+
+        fs, root = fsspec.core.url_to_fs(directory)
+        # url_to_fs returns a cached filesystem instance; drop its stale
+        # listing cache so wait_ready's polling actually re-checks.
+        fs.invalidate_cache()
+        return fs.exists(root.rstrip("/") + "/" + READY_SENTINEL)
     return os.path.exists(os.path.join(directory, READY_SENTINEL))
 
 

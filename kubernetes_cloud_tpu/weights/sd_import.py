@@ -443,15 +443,24 @@ def convert_checkpoint(src: str, dest: str, strict: bool = True) -> str:
     )
     v_pred = sched.get("prediction_type", "epsilon") == "v_prediction"
 
-    os.makedirs(dest, exist_ok=True)
-    write_pytree(os.path.join(dest, "unet.tensors"), unet_params,
+    from kubernetes_cloud_tpu.weights.tensorstream import is_remote
+
+    remote = is_remote(dest)
+    if not remote:
+        os.makedirs(dest, exist_ok=True)
+
+    def _join(base, name):
+        return (base.rstrip("/") + "/" + name) if remote else os.path.join(
+            base, name)
+
+    write_pytree(_join(dest, "unet.tensors"), unet_params,
                  meta={"config": dataclasses.asdict(unet_cfg) | {
                      "dtype": str(unet_cfg.dtype)},
                      "v_prediction": v_pred,
                      "schedule": dataclasses.asdict(sched_cfg)})
-    write_pytree(os.path.join(dest, "vae.tensors"), vae_params,
+    write_pytree(_join(dest, "vae.tensors"), vae_params,
                  meta={"config": dataclasses.asdict(vae_cfg)})
-    write_pytree(os.path.join(dest, "encoder.tensors"), clip_params,
+    write_pytree(_join(dest, "encoder.tensors"), clip_params,
                  meta={"config": dataclasses.asdict(clip_cfg) | {
                      "dtype": str(clip_cfg.dtype),
                      "param_dtype": str(clip_cfg.param_dtype)}})
@@ -462,14 +471,23 @@ def convert_checkpoint(src: str, dest: str, strict: bool = True) -> str:
     # the byte-level tokenizer, which only fits self-trained models).
     tok_src = os.path.join(src, "tokenizer")
     if os.path.isdir(tok_src):
-        import shutil
+        tok_dest = _join(dest, "tokenizer")
+        if not remote:
+            import shutil
 
-        tok_dest = os.path.join(dest, "tokenizer")
-        os.makedirs(tok_dest, exist_ok=True)
+            os.makedirs(tok_dest, exist_ok=True)
         for name in ("vocab.json", "merges.txt", "tokenizer_config.json",
                      "special_tokens_map.json"):
             p = os.path.join(tok_src, name)
-            if os.path.exists(p):
+            if not os.path.exists(p):
+                continue
+            if remote:
+                import fsspec
+
+                with open(p, "rb") as rf, fsspec.open(
+                        _join(tok_dest, name), "wb") as wf:
+                    wf.write(rf.read())
+            else:
                 shutil.copy2(p, os.path.join(tok_dest, name))
 
     mark_ready(dest)

@@ -83,3 +83,24 @@ def test_decode_roundtrip(both):
     ours, _ = both
     ids = ours.encode_batch(["the cat and the dog"], length=16)[0]
     assert ours.decode(ids) == "the cat and the dog"
+
+
+def test_decode_keeps_interior_pad_token(vocab_dir):
+    """SD-2.x pads with '!', a real vocab token: decode must strip only
+    *trailing* pads, not legitimate interior occurrences."""
+    import json as _json
+
+    with open(vocab_dir + "/vocab.json") as f:
+        vocab = _json.load(f)
+    if "!" not in vocab:
+        vocab["!"] = len(vocab)
+    if "!</w>" not in vocab:
+        vocab["!</w>"] = len(vocab)
+    merges = sorted(CLIPBPECodec.from_dir(vocab_dir).ranks,
+                    key=CLIPBPECodec.from_dir(vocab_dir).ranks.get)
+    codec = CLIPBPECodec(vocab, merges, pad_token="!")
+    ids = codec.encode("cat ! dog")
+    framed = [codec.sot] + ids + [codec.eot] + [codec.pad] * 4
+    out = codec.decode(framed)
+    assert "!" in out          # interior '!' survives
+    assert not out.endswith("!")  # trailing pads stripped
