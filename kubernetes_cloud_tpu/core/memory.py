@@ -52,6 +52,38 @@ class DeviceMemoryUsage:
         return f"HBM: {_mib(self.used)}MiB used"
 
 
+#: Per-chip HBM by device kind, used when the backend exposes no
+#: memory_stats() (e.g. tunneled/experimental PJRT plugins).  Values are
+#: the XLA-visible capacity (slightly under the marketing number).
+_HBM_BY_KIND = {
+    "TPU v3": 16 << 30,
+    "TPU v4": 32 << 30,
+    "TPU v5 lite": 16 << 30,
+    "TPU v5e": 16 << 30,
+    "TPU v5p": 95 << 30,
+    "TPU v6 lite": 32 << 30,
+    "TPU v6e": 32 << 30,
+}
+
+
+def device_hbm_limit(device: Optional[jax.Device] = None) -> Optional[int]:
+    """Best-known HBM capacity for ``device``: live memory_stats when the
+    backend reports them, else the device-kind table."""
+    if device is None:
+        local = jax.local_devices()
+        device = local[0] if local else None
+    if device is None:
+        return None
+    limit = DeviceMemoryUsage.now(device).limit
+    if limit:
+        return limit
+    kind = getattr(device, "device_kind", "") or ""
+    for prefix, cap in _HBM_BY_KIND.items():
+        if kind.startswith(prefix):
+            return cap
+    return None
+
+
 @dataclasses.dataclass
 class HostMemoryUsage:
     """Host RSS via getrusage (reference: ``CPUMemoryUsage``,

@@ -47,13 +47,15 @@ SOT = "<|startoftext|>"
 EOT = "<|endoftext|>"
 
 
-def _clean(text: str) -> str:
-    try:  # mirror transformers' basic_clean: ftfy first when available
-        import ftfy
+try:  # mirror transformers' basic_clean: ftfy first when available
+    import ftfy as _ftfy
+except ImportError:  # pragma: no cover - optional dependency
+    _ftfy = None
 
-        text = ftfy.fix_text(text)
-    except ImportError:
-        pass
+
+def _clean(text: str) -> str:
+    if _ftfy is not None:
+        text = _ftfy.fix_text(text)
     text = html.unescape(html.unescape(text))
     return re.sub(r"\s+", " ", text).strip().lower()
 

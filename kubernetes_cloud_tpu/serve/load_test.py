@@ -58,7 +58,13 @@ class Summary:
         return sum(r.ok for r in self.results)
 
     def stats(self) -> dict:
-        lat = [r.latency for r in self.results if r.ok]
+        lat = sorted(r.latency for r in self.results if r.ok)
+
+        def pct(p: float):
+            if not lat:
+                return None
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 4)
+
         return {
             "requests": self.n,
             "successful": self.n_ok,
@@ -72,6 +78,9 @@ class Summary:
             if len(lat) > 1 else None,
             "latency_min_s": round(min(lat), 4) if lat else None,
             "latency_max_s": round(max(lat), 4) if lat else None,
+            "latency_p50_s": pct(0.50),
+            "latency_p90_s": pct(0.90),
+            "latency_p99_s": pct(0.99),
         }
 
 
@@ -105,6 +114,36 @@ def run_concurrent(url: str, payloads: list[bytes], *, concurrency: int = 8,
     return Summary(time.monotonic() - t0, results)
 
 
+def run_ramp(url: str, payload_pool: list[bytes], *,
+             stages: list[int], stage_duration: float,
+             timeout: float = 300.0) -> dict:
+    """Locust-style ramping profile (reference
+    ``tensorizer-isvc/benchmark/locustfile.py``): each stage holds a
+    concurrency level for ``stage_duration`` seconds — workers loop
+    firing requests until the stage deadline — and reports per-stage
+    throughput/goodput + latency percentiles, so saturation shows up as
+    the knee where p90 climbs while goodput flattens."""
+    cycle = itertools.cycle(payload_pool)
+    out = []
+    for conc in stages:
+        deadline = time.monotonic() + stage_duration
+        results: list[Result] = []
+
+        def worker():
+            got = []
+            while time.monotonic() < deadline:
+                got.append(_one_request(url, next(cycle), timeout))
+            return got
+
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(max_workers=conc) as pool:
+            for batch in pool.map(lambda _: worker(), range(conc)):
+                results.extend(batch)
+        summary = Summary(time.monotonic() - t0, results)
+        out.append({"concurrency": conc, **summary.stats()})
+    return {"stages": out}
+
+
 def build_payloads(args) -> list[bytes]:
     if args.inputs:
         with open(args.inputs) as f:
@@ -120,21 +159,30 @@ def main(argv=None) -> dict:
     ap.add_argument("--url", required=True)
     ap.add_argument("--requests", type=int, default=100)
     ap.add_argument("--concurrency", type=int, default=8)
-    ap.add_argument("--mode", choices=("async", "sync"), default="async")
+    ap.add_argument("--mode", choices=("async", "sync", "ramp"),
+                    default="async")
     ap.add_argument("--payload", default='{"instances": ["hello"]}')
     ap.add_argument("--inputs", default=None,
                     help="file of prompt lines cycled into payloads")
     ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--ramp-stages", default="1,2,4,8",
+                    help="comma-separated concurrency levels (ramp mode)")
+    ap.add_argument("--stage-duration", type=float, default=15.0,
+                    help="seconds per ramp stage")
     args = ap.parse_args(argv)
 
     payloads = build_payloads(args)
-    if args.mode == "sync":
-        summary = run_sync(args.url, payloads, timeout=args.timeout)
+    if args.mode == "ramp":
+        stats = run_ramp(
+            args.url, payloads,
+            stages=[int(s) for s in args.ramp_stages.split(",") if s],
+            stage_duration=args.stage_duration, timeout=args.timeout)
+    elif args.mode == "sync":
+        stats = run_sync(args.url, payloads, timeout=args.timeout).stats()
     else:
-        summary = run_concurrent(args.url, payloads,
-                                 concurrency=args.concurrency,
-                                 timeout=args.timeout)
-    stats = summary.stats()
+        stats = run_concurrent(args.url, payloads,
+                               concurrency=args.concurrency,
+                               timeout=args.timeout).stats()
     print(json.dumps(stats))
     return stats
 

@@ -213,6 +213,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         Trainer,
         TrainerConfig,
         estimate_batch_size,
+        estimate_batch_size_compiled,
     )
 
     args = build_parser().parse_args(argv)
@@ -273,13 +274,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     train_ds, eval_ds = dataset.split(args.train_ratio)
 
     n_batch = mesh.shape["data"] * mesh.shape["fsdp"]
-    # With --bs -1 the real estimate happens after the model/optimizer is
-    # materialized (the heuristic needs their HBM in the denominator,
-    # ``finetuner.py:447-466``); size the schedule with a floor for now.
-    bs = args.bs if args.bs != -1 else n_batch
+    bs = args.bs
+    compiled_est = None
+    if bs == -1:
+        # Preferred: XLA's compiled memory analysis of the real train
+        # step gives exact fixed + per-sample byte costs — resolved
+        # *before* the LR schedule so total/warmup steps are sized for
+        # the batch actually used.  Fallback: the reference's free/used
+        # HBM ratio (clamped), meaningful only once the model occupies
+        # HBM, hence re-estimated after trainer construction below.
+        compiled_est = estimate_batch_size_compiled(
+            model_cfg, TrainConfig(), mesh, args.context_size,
+            divisor=args.bs_divisor)
+        if compiled_est is not None:
+            log.info("compiled batch-size estimate: %d", compiled_est)
+        # schedule floor when unavailable; heuristic refines after the
+        # model is materialized
+        bs = compiled_est if compiled_est is not None else n_batch
     if bs % n_batch:
         bs = max(n_batch, bs - bs % n_batch)
-    log.info("global batch size (pre-estimate): %d", bs)
+    log.info("global batch size (pre-materialize): %d", bs)
 
     steps_per_epoch = max(1, len(train_ds) // (bs * args.gradients))
     total_steps = steps_per_epoch * args.epochs
@@ -316,13 +330,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     trainer = Trainer(model_cfg, train_cfg, trainer_cfg, mesh, train_ds,
                       eval_dataset=eval_ds, tokenizer=tokenizer,
                       initial_params=params)
-    if args.bs == -1:
-        # Model + optimizer now occupy HBM; the free/used ratio is
-        # meaningful.  Align up to the batch shard count.
+    if args.bs == -1 and compiled_est is None:
+        # Compiled estimate unavailable: fall back to the reference's
+        # free/used heuristic now that model + optimizer occupy HBM.
         est = estimate_batch_size(args.bs_divisor)
         bs = max(n_batch, est - est % n_batch)
         trainer.cfg.batch_size = bs
-        log.info("estimated global batch size: %d", bs)
+        log.info("estimated global batch size (HBM heuristic): %d", bs)
     trainer.install_preemption_handler()  # SIGTERM => checkpoint + exit
     try:
         result = trainer.train()

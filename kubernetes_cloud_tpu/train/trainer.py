@@ -44,6 +44,7 @@ from kubernetes_cloud_tpu.train.train_step import (
     TrainConfig,
     init_train_state,
     make_optimizer,
+    make_train_step,
 )
 from kubernetes_cloud_tpu.weights.checkpoint import Checkpointer, mark_ready
 from kubernetes_cloud_tpu.weights.tensorstream import write_pytree
@@ -81,15 +82,112 @@ class TrainerConfig:
 
 
 def estimate_batch_size(divisor: float = 1.0,
-                        device: Optional[jax.Device] = None) -> int:
-    """HBM-based batch autosizing (the reference's VRAM heuristic,
-    ``finetuner.py:447-466``): free bytes over bytes already used by the
-    materialized model/optimizer, scaled by ``divisor``."""
+                        device: Optional[jax.Device] = None,
+                        max_batch: int = 512) -> int:
+    """HBM-based batch autosizing fallback (the reference's VRAM
+    heuristic, ``finetuner.py:447-466``): free bytes over bytes already
+    used by the materialized model/optimizer, scaled by ``divisor``.
+
+    The reference divides free VRAM by the *model's* resident bytes —
+    treating one batch as costing about one model.  With a small model
+    resident that returns absurdly large batches, so the result is
+    clamped to ``max_batch``; :func:`estimate_batch_size_compiled` is
+    the accurate path."""
     mem = DeviceMemoryUsage.now(device)
     if mem.used and mem.limit and mem.used > 0:
         free = mem.limit - mem.used
-        return max(1, math.ceil(free / (mem.used * divisor)))
+        return min(max_batch,
+                   max(1, math.ceil(free / (mem.used * divisor))))
     return 1
+
+
+def estimate_batch_size_compiled(
+    model_cfg: CausalLMConfig,
+    train_cfg: TrainConfig,
+    mesh,
+    seq_len: int,
+    probe_bs: Optional[int] = None,
+    headroom: float = 0.92,
+    max_batch: int = 4096,
+    device: Optional[jax.Device] = None,
+    divisor: float = 1.0,
+) -> Optional[int]:
+    """Derive the largest safe global batch from XLA's own memory
+    analysis of the *real* train step.
+
+    The reference guesses per-batch cost from the model's resident VRAM
+    (``finetuner.py:447-466``); under XLA we can do strictly better: AOT
+    compile the step at a small probe batch, read the compiled
+    executable's temp/argument byte counts, and treat the temp pool as
+    linear in batch (dividing the probe's whole temp pool by ``probe_bs``
+    also charges fixed scratch to every sample, so the estimate is
+    conservative).  ``divisor`` scales the result down (the reference's
+    ``--bs_divisor`` safety knob).  Returns None when the backend
+    exposes no memory analysis — callers fall back to
+    :func:`estimate_batch_size`.
+    """
+    from jax.sharding import NamedSharding
+
+    from kubernetes_cloud_tpu.models.causal_lm import init_params
+    from kubernetes_cloud_tpu.parallel.sharding import (
+        batch_spec, logical_to_physical, param_specs)
+
+    n_batch = max(1, mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
+    probe = probe_bs or n_batch
+    try:
+        optimizer = make_optimizer(train_cfg)
+
+        def init():
+            params = init_params(model_cfg, jax.random.key(0))
+            return {"params": params, "opt_state": optimizer.init(params),
+                    "step": jnp.zeros((), jnp.int32)}
+
+        state_shapes = jax.eval_shape(init)
+        shardings = logical_to_physical(param_specs(state_shapes), mesh)
+        state_abs = jax.tree_util.tree_map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            state_shapes, shardings)
+        step = make_train_step(model_cfg, train_cfg, mesh=mesh)
+
+        def temp_bytes(bs: int) -> tuple[int, int]:
+            batch_abs = {"input_ids": jax.ShapeDtypeStruct(
+                (bs, seq_len), jnp.int32,
+                sharding=NamedSharding(mesh, batch_spec(2)))}
+            ma = jax.jit(step, donate_argnums=0).lower(
+                state_abs, batch_abs).compile().memory_analysis()
+            return int(ma.temp_size_in_bytes), int(
+                ma.argument_size_in_bytes)
+
+        # Two probe sizes: the delta isolates the true per-sample cost
+        # from batch-independent scratch (which a single probe would
+        # charge to every sample, wildly underestimating capacity).
+        t1, fixed_args = temp_bytes(probe)
+        t2, _ = temp_bytes(2 * probe)
+        per_sample = (t2 - t1) // probe
+        if per_sample < 1024:
+            # Zero/near-zero delta means both probes landed in the same
+            # padded allocation — the linear model is meaningless and
+            # dividing by it would explode the estimate.
+            return None
+        fixed_temp = max(0, t1 - per_sample * probe)
+        from kubernetes_cloud_tpu.core.memory import device_hbm_limit
+
+        limit = device_hbm_limit(device)
+        if not limit:
+            return None
+        budget = int(limit * headroom) - fixed_args - fixed_temp
+        if budget <= 0:
+            return n_batch
+        est = int(budget // per_sample / max(divisor, 1e-6))
+        cap = max(n_batch, max_batch - max_batch % n_batch)
+        est = min(cap, max(n_batch, est - est % n_batch))
+        return est
+    except Exception as e:  # noqa: BLE001 - backend without memory analysis
+        logging.getLogger("kct.trainer").info(
+            "compiled batch-size estimate unavailable (%s: %s); falling "
+            "back to the HBM ratio heuristic", type(e).__name__, e)
+        return None
 
 
 def read_prompts(path: str) -> list[str]:

@@ -45,16 +45,15 @@ def latest_checkpoint(directory: str) -> Optional[str]:
 def mark_ready(directory: str, text: str = "ready") -> None:
     """Write the ready sentinel; ``directory`` may be a remote URI
     (``gs://…``) — object stores need no mkdir and go through fsspec."""
-    from kubernetes_cloud_tpu.weights.tensorstream import is_remote
+    from kubernetes_cloud_tpu.weights.tensorstream import is_remote, join_path
 
-    path = directory.rstrip("/") + "/" + READY_SENTINEL
     if is_remote(directory):
         import fsspec
 
-        with fsspec.open(path, "w") as f:
+        with fsspec.open(join_path(directory, READY_SENTINEL), "w") as f:
             f.write(text)
         return
-    with open(os.path.join(directory, READY_SENTINEL), "w") as f:
+    with open(join_path(directory, READY_SENTINEL), "w") as f:
         f.write(text)
 
 

@@ -443,15 +443,12 @@ def convert_checkpoint(src: str, dest: str, strict: bool = True) -> str:
     )
     v_pred = sched.get("prediction_type", "epsilon") == "v_prediction"
 
-    from kubernetes_cloud_tpu.weights.tensorstream import is_remote
+    from kubernetes_cloud_tpu.weights.tensorstream import (
+        is_remote, join_path as _join)
 
     remote = is_remote(dest)
     if not remote:
         os.makedirs(dest, exist_ok=True)
-
-    def _join(base, name):
-        return (base.rstrip("/") + "/" + name) if remote else os.path.join(
-            base, name)
 
     write_pytree(_join(dest, "unet.tensors"), unet_params,
                  meta={"config": dataclasses.asdict(unet_cfg) | {

@@ -52,6 +52,14 @@ def is_remote(path: str) -> bool:
     return path.startswith(REMOTE_SCHEMES)
 
 
+def join_path(base: str, *names: str) -> str:
+    """os.path.join that also understands remote URIs (which always use
+    '/', never the platform separator)."""
+    if is_remote(base):
+        return "/".join([base.rstrip("/"), *names])
+    return os.path.join(base, *names)
+
+
 def resolve_artifact(path: str, default_name: str = "model.tensors") -> str:
     """Resolve a ``--model`` argument to the ``.tensors`` object: accepts
     a file/object path directly, a local directory holding

@@ -117,9 +117,11 @@ def pytest_collection_modifyitems(config, items):
     # multi-process/parity/e2e suites run with ``-m slow`` (or
     # ``-m "slow or quick"`` / KCT_FULL_TESTS=1 for everything — CI's
     # full lane).
-    explicit_ids = any("::" in a for a in config.args)
+    # Explicitly named tests or files bypass the lane filter — whoever
+    # types a node id or .py path means to run exactly that.
+    explicit = any("::" in a or a.endswith(".py") for a in config.args)
     if (not config.getoption("-m") and not config.getoption("keyword")
-            and not explicit_ids
+            and not explicit
             and not os.environ.get("KCT_FULL_TESTS")):
         selected = [i for i in items if not i.get_closest_marker("slow")]
         if len(selected) != len(items):

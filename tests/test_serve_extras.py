@@ -10,7 +10,11 @@ import numpy as np
 import pytest
 
 from kubernetes_cloud_tpu.serve.bpe import BPECodec, bytes_to_unicode
-from kubernetes_cloud_tpu.serve.load_test import run_concurrent, run_sync
+from kubernetes_cloud_tpu.serve.load_test import (
+    run_concurrent,
+    run_ramp,
+    run_sync,
+)
 from kubernetes_cloud_tpu.serve.model import Model
 from kubernetes_cloud_tpu.serve.server import ModelServer
 
@@ -125,6 +129,19 @@ class TestLoadTest:
             assert stats["successful"] == 12
             assert stats["goodput_rps"] == stats["throughput_rps"]
             assert stats["latency_mean_s"] > 0
+
+    def test_ramp_profile_stages(self, echo_server):
+        """Locust-style ramp: one stats row per concurrency stage with
+        percentiles (reference locustfile.py's ramping-user profile)."""
+        url = (f"http://127.0.0.1:{echo_server.port}"
+               f"/v1/models/echo:predict")
+        payloads = [json.dumps({"instances": ["x"]}).encode()]
+        out = run_ramp(url, payloads, stages=[1, 2], stage_duration=0.5)
+        assert [s["concurrency"] for s in out["stages"]] == [1, 2]
+        for stage in out["stages"]:
+            assert stage["successful"] >= 1
+            assert stage["latency_p50_s"] is not None
+            assert stage["latency_p99_s"] >= stage["latency_p50_s"]
 
     def test_goodput_counts_failures(self, echo_server):
         url = (f"http://127.0.0.1:{echo_server.port}"

@@ -104,3 +104,22 @@ def test_fused_single_gas(tmp_path, dataset, devices8):
 
 def test_estimate_batch_size_positive():
     assert estimate_batch_size() >= 1
+
+
+def test_estimate_batch_size_clamped():
+    # The free/used heuristic must clamp: a tiny resident model would
+    # otherwise return absurd batch sizes (round-4 verdict item 6).
+    assert estimate_batch_size(max_batch=64) <= 64
+
+
+def test_estimate_batch_size_compiled_smoke():
+    """Returns a positive batch size, or None (backend without memory
+    analysis) — never raises."""
+    from kubernetes_cloud_tpu.train.trainer import (
+        estimate_batch_size_compiled)
+
+    mesh = build_mesh(MeshSpec(data=1), devices=jax.devices("cpu")[:1])
+    cfg = PRESETS["test-tiny"]
+    est = estimate_batch_size_compiled(
+        cfg, TrainConfig(total_steps=10), mesh, seq_len=128)
+    assert est is None or est >= 1
