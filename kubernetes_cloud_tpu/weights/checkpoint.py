@@ -83,7 +83,14 @@ def wait_ready(directory: str, timeout: float = 600.0,
 
 
 class Checkpointer:
-    """Async sharding-aware checkpoint manager over ``checkpoint-N`` dirs."""
+    """Async sharding-aware checkpoint manager over ``checkpoint-N`` dirs.
+
+    Restores are *elastic*: pass :meth:`restore` a template whose
+    shardings come from a different mesh than the save (fewer devices, a
+    different dp/tp split) and Orbax reshards transparently — the
+    preemption-resume story survives a replacement slice of a different
+    shape (tests/test_elastic_restore.py), which the reference's
+    world-size-locked DeepSpeed checkpoints do not."""
 
     def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
                  async_save: bool = True):
