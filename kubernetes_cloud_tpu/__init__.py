@@ -18,6 +18,8 @@ ops       Pallas TPU kernels (flash attention, ring attention) + core layers
 parallel  sharding policies: DP / FSDP / TP / PP / sequence parallel
 train     trainers with checkpoint-resume, perf metrics, in-training sampling
 serve     KServe V1 data-plane HTTP serving + generation runtime
+workflow  Argo-style DAG engine: retries, templating, preemption-safe
+          resume; runs the deploy/ manifests locally or as k8s Jobs
 """
 
 __version__ = "0.1.0"

@@ -25,13 +25,17 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--revision", default=None)
     ap.add_argument("--tokenizer-only", default="false",
                     help="fetch only tokenizer/config files")
+    ap.add_argument("--retries", type=int, default=1,
+                    help="re-attempts on fetch failure (reference Argo "
+                         "retryStrategy: download=1)")
     args = ap.parse_args(argv)
     tokenizer_only = str(args.tokenizer_only).strip().lower() in (
         "1", "true", "yes", "on")
     patterns = (["*.json", "*.txt", "*.model", "tokenizer*", "vocab*",
                  "merges*"] if tokenizer_only else None)
     download_model(args.model, args.dest, model_type=args.model_type,
-                   revision=args.revision, allow_patterns=patterns)
+                   revision=args.revision, allow_patterns=patterns,
+                   retries=args.retries)
     return 0
 
 

@@ -47,24 +47,30 @@ from kubernetes_cloud_tpu.weights.checkpoint import (  # noqa: F401
 
 def download_model(model: str, dest: str, *, model_type: str = "hf",
                    revision: str | None = None,
-                   allow_patterns: list[str] | None = None) -> str:
+                   allow_patterns: list[str] | None = None,
+                   retries: int = 1) -> str:
     """HF snapshot → flat dir on the PVC.  ``model_type='diffusers'``
     keeps the pipeline subfolder layout (vae/ unet/ text_encoder/);
-    ``'hf'`` flattens a transformers checkpoint."""
+    ``'hf'`` flattens a transformers checkpoint.  ``retries`` bounds
+    re-attempts of a failed fetch (the reference's Argo retryStrategy
+    uses download=1; snapshot_download resumes partial files, so a retry
+    only refetches what is missing)."""
     if is_ready(dest):
         print(f"{dest} already ready, skipping")
         return dest
     os.makedirs(dest, exist_ok=True)
-    if os.path.isdir(model):
-        # Local path (pre-mounted snapshot): copy is the download.
-        for entry in os.listdir(model):
-            src = os.path.join(model, entry)
-            dst = os.path.join(dest, entry)
-            if os.path.isdir(src):
-                shutil.copytree(src, dst, dirs_exist_ok=True)
-            else:
-                shutil.copy2(src, dst)
-    else:
+
+    def _fetch():
+        if os.path.isdir(model):
+            # Local path (pre-mounted snapshot): copy is the download.
+            for entry in os.listdir(model):
+                src = os.path.join(model, entry)
+                dst = os.path.join(dest, entry)
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                else:
+                    shutil.copy2(src, dst)
+            return
         from huggingface_hub import snapshot_download
 
         patterns = allow_patterns
@@ -75,6 +81,19 @@ def download_model(model: str, dest: str, *, model_type: str = "hf",
                         "tokenizer*", "*.bin"]
         snapshot_download(model, revision=revision, local_dir=dest,
                           allow_patterns=patterns)
+
+    last_err: Exception | None = None
+    for attempt in range(max(1, retries + 1)):
+        try:
+            _fetch()
+            last_err = None
+            break
+        except Exception as e:  # noqa: BLE001 - retry any fetch error
+            last_err = e
+            if attempt < retries:
+                time.sleep(2.0 * (attempt + 1))
+    if last_err is not None:
+        raise RuntimeError(f"failed to fetch {model}: {last_err}")
     mark_ready(dest)
     return dest
 
@@ -129,6 +148,9 @@ def main(argv=None) -> int:
     m.add_argument("--type", dest="model_type", default="hf",
                    choices=("hf", "diffusers"))
     m.add_argument("--revision", default=None)
+    m.add_argument("--retries", type=int, default=1,
+                   help="re-attempts on fetch failure (reference Argo "
+                        "retryStrategy: download=1)")
 
     d = sub.add_parser("dataset")
     d.add_argument("--urls", required=True,
@@ -143,7 +165,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.cmd == "model":
         download_model(args.model, args.dest, model_type=args.model_type,
-                       revision=args.revision)
+                       revision=args.revision, retries=args.retries)
     elif args.cmd == "dataset":
         if os.path.exists(args.urls):
             with open(args.urls) as f:

@@ -39,3 +39,28 @@ def run_tokenizer(args: Sequence[str], *, binary: Optional[str] = None,
         binary = build_tokenizer()
     return subprocess.run([binary, *args], check=check,
                           capture_output=True, text=True)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Module entry point for workflow steps: build the native binary if
+    needed, then exec it with the given flags (same surface as the
+    container's ``/usr/local/bin/dataset_tokenizer``)."""
+    import sys
+
+    if argv is None:
+        argv = sys.argv[1:]
+    try:
+        binary = build_tokenizer()
+    except subprocess.CalledProcessError as e:
+        print(e.stderr or str(e), file=sys.stderr)
+        return 1
+    except OSError as e:  # g++ itself missing
+        print(f"cannot build dataset_tokenizer: {e}", file=sys.stderr)
+        return 1
+    return subprocess.run([binary, *argv]).returncode
+
+
+if __name__ == "__main__":  # pragma: no cover - container entry
+    import sys
+
+    sys.exit(main())

@@ -17,12 +17,24 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import ssl
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Optional
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+#: HTTP statuses worth retrying: apiserver overload/unavailable and
+#: client-side throttling.  4xx (conflict, not-found, forbidden) are
+#: deterministic and surface immediately.
+RETRYABLE_STATUS = frozenset({429, 500, 502, 503, 504})
+#: POST (create) is not idempotent, and a 5xx from a gateway (504
+#: especially) may arrive *after* the apiserver persisted the object —
+#: replaying would double-create.  Only throttling, which guarantees the
+#: request was never admitted, is replay-safe for creates.
+POST_RETRYABLE_STATUS = frozenset({429})
 
 
 class ApiError(RuntimeError):
@@ -37,8 +49,16 @@ class K8sClient:
                  token: Optional[str] = None,
                  ca_file: Optional[str] = None,
                  insecure: bool = False,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 retries: int = 3,
+                 backoff: float = 0.5):
         self.timeout = timeout
+        # Transient-failure policy shared by every caller (wait_ready
+        # loops, the workflow Job executor): exponential backoff with
+        # jitter on 5xx/429/connection errors — one watchdog kicking a
+        # flaky apiserver instead of N ad-hoc loops.
+        self.retries = max(0, retries)
+        self.backoff = backoff
         if api_server is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
@@ -65,21 +85,42 @@ class K8sClient:
                 content_type: str = "application/json") -> Any:
         url = f"{self.api_server}{path}"
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", content_type)
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
-        try:
-            # Bounded: a hung apiserver connection must not stall
-            # wait_ready loops past their own deadlines.
-            with urllib.request.urlopen(req, context=self._ctx,
-                                        timeout=self.timeout) as resp:
-                raw = resp.read()
-        except urllib.error.HTTPError as e:
-            raise ApiError(e.code, e.read().decode(errors="replace")) from e
-        return json.loads(raw) if raw else None
+        # POST is not idempotent: a create whose *response* was lost (or
+        # 5xx'd at a gateway after being applied) must not be blindly
+        # replayed — callers like the Job executor handle the follow-up
+        # 409 themselves when they choose to re-attempt.
+        replay_safe = method.upper() != "POST"
+        retryable = RETRYABLE_STATUS if replay_safe else POST_RETRYABLE_STATUS
+        last_err: Exception
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Accept", "application/json")
+            if data is not None:
+                req.add_header("Content-Type", content_type)
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            try:
+                # Bounded: a hung apiserver connection must not stall
+                # wait_ready loops past their own deadlines.
+                with urllib.request.urlopen(req, context=self._ctx,
+                                            timeout=self.timeout) as resp:
+                    raw = resp.read()
+                return json.loads(raw) if raw else None
+            except urllib.error.HTTPError as e:
+                err = ApiError(e.code, e.read().decode(errors="replace"))
+                err.__cause__ = e
+                if e.code not in retryable:
+                    raise err
+                last_err = err
+            except (urllib.error.URLError, TimeoutError,
+                    ConnectionError) as e:
+                if not replay_safe:
+                    raise
+                last_err = e
+            if attempt < self.retries:
+                time.sleep(self.backoff * (2 ** attempt)
+                           * (1.0 + 0.25 * random.random()))
+        raise last_err
 
     # -- typed helpers over CRD paths --------------------------------------
 

@@ -69,7 +69,9 @@ _VMEM_BUDGET = 32 * 1024 * 1024
 #: measured on v5e at B16 H16 S1024 D64: bq256 beats bq512 on the fwd
 _MAX_BLOCK_Q = 256
 
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+from kubernetes_cloud_tpu.utils.compat import tpu_compiler_params
+
+_COMPILER_PARAMS = tpu_compiler_params(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 def _heads_per_block(d: int) -> Optional[int]:

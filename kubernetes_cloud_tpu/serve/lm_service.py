@@ -262,6 +262,12 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--max-seq-len", type=int, default=0)
     ap.add_argument("--config", default=None,
                     help="model_config.json for batcher knobs")
+    ap.add_argument("--smoke", default=None, metavar="PROMPT",
+                    help="load, run one generation for PROMPT, print the "
+                         "KServe V1 response, and exit (workflow "
+                         "serve-smoke step; no HTTP server)")
+    ap.add_argument("--smoke-tokens", type=int, default=16,
+                    help="max new tokens for --smoke")
     boot.add_common_args(ap)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -290,6 +296,23 @@ def main(argv: Optional[list] = None) -> int:
         args.model_name or "model", cfg,
         tokenizer=_tokenizer_for(model_dir), weights_path=weights,
         weights_index=index, mesh=mesh)
+    if args.smoke is not None:
+        # one-shot readiness probe: the workflow's serve step must prove
+        # the finetuned artifact loads and generates, then release the
+        # (simulated) accelerator — no listener left behind
+        import json
+
+        svc.load()
+        out = svc.predict({
+            "instances": [args.smoke],
+            "parameters": {"max_new_tokens": max(1, args.smoke_tokens)},
+        })
+        if not (out.get("predictions") and all(
+                "generated_text" in p for p in out["predictions"])):
+            print(f"smoke test got malformed response: {out}")
+            return 1
+        print(json.dumps(out))
+        return 0
     if args.max_batch_size > 0 or args.config:
         from kubernetes_cloud_tpu.serve.batcher import (
             BatchingModel,

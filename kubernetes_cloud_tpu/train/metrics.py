@@ -22,6 +22,44 @@ def _is_rank0() -> bool:
     return jax.process_index() == 0
 
 
+class JsonlWriter:
+    """Append-only JSONL sink — the one serialization used by both the
+    training metrics stream (below) and the workflow step-event log
+    (:mod:`kubernetes_cloud_tpu.workflow.events`), so one reader tooling
+    chain consumes either."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = open(path, "a", buffering=1)
+
+    def write(self, record: Mapping[str, Any]) -> None:
+        self._fh.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def read_jsonl(path: str) -> list:
+    """Load a JSONL stream, tolerating a torn final line (the writer may
+    have been SIGKILLed mid-record — preemption is a first-class event)."""
+    records = []
+    if not os.path.exists(path):
+        return records
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records
+
+
 class MetricsLogger:
     """Rank-0 metrics sink with the reference's wandb surface."""
 
@@ -49,9 +87,8 @@ class MetricsLogger:
             except Exception:
                 self._wandb = None
         if self._wandb is None:
-            os.makedirs(log_dir, exist_ok=True)
-            path = os.path.join(log_dir, f"{run_name}.metrics.jsonl")
-            self._fh = open(path, "a", buffering=1)
+            self._fh = JsonlWriter(
+                os.path.join(log_dir, f"{run_name}.metrics.jsonl"))
 
     def log(self, metrics: Mapping[str, Any], step: Optional[int] = None,
             commit: bool = True) -> None:
@@ -60,10 +97,9 @@ class MetricsLogger:
         if self._wandb is not None:
             self._wandb.log(dict(metrics), step=step, commit=commit)
             return
-        rec = {"ts": time.time(), "step": step, **{
+        self._fh.write({"ts": time.time(), "step": step, **{
             k: (float(v) if hasattr(v, "__float__") else v)
-            for k, v in metrics.items()}}
-        self._fh.write(json.dumps(rec) + "\n")
+            for k, v in metrics.items()}})
 
     def log_table(self, key: str, columns: Sequence[str],
                   rows: Sequence[Sequence[Any]]) -> None:

@@ -15,6 +15,17 @@ _CHECK_KW = "check_vma" if "check_vma" in _PARAMS else "check_rep"
 _HAS_AXIS_NAMES = "axis_names" in _PARAMS
 
 
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across the rename (jax < 0.5 spells it
+    ``TPUCompilerParams``)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 def shard_map(*args, **kwargs):
     """jax.shard_map accepting either check_rep= or check_vma=."""
     for alias in ("check_rep", "check_vma"):

@@ -1,0 +1,43 @@
+"""Executable Argo-style workflow orchestration.
+
+The reference repo's identity is "five primitives composed by a workflow
+DAG" (SURVEY §1); this package is the engine that actually executes that
+composition, locally (subprocess over the in-tree CLIs) or in-cluster
+(``batch/v1`` Jobs via the stdlib k8s client):
+
+* :mod:`.spec` — typed ``WorkflowSpec``/``Step`` with Argo's
+  ``retryStrategy``, ``when``, and parameter templating;
+* :mod:`.engine` — concurrent topological scheduling, retry with
+  backoff+jitter, persisted-state + ``.ready.txt``-sentinel resume;
+* :mod:`.executors` — local subprocess and Kubernetes Job executors;
+* :mod:`.events` — JSONL step-event log (start/finish/retry/duration);
+* :mod:`.argo_import` — loads the shipped ``deploy/`` Argo manifests
+  into executable specs;
+* :mod:`.pipelines` — canned ``finetune-and-serve`` end-to-end DAG;
+* :mod:`.cli` — ``python -m kubernetes_cloud_tpu.workflow``.
+"""
+
+from kubernetes_cloud_tpu.workflow.engine import WorkflowRun, load_state
+from kubernetes_cloud_tpu.workflow.spec import (
+    RetryStrategy,
+    SpecError,
+    Step,
+    TemplateError,
+    WorkflowSpec,
+    artifact_complete,
+    evaluate_when,
+    render,
+)
+
+__all__ = [
+    "RetryStrategy",
+    "SpecError",
+    "Step",
+    "TemplateError",
+    "WorkflowRun",
+    "WorkflowSpec",
+    "artifact_complete",
+    "evaluate_when",
+    "load_state",
+    "render",
+]

@@ -1,0 +1,8 @@
+"""``python -m kubernetes_cloud_tpu.workflow`` entry point."""
+
+import sys
+
+from kubernetes_cloud_tpu.workflow.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

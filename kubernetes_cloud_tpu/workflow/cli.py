@@ -1,0 +1,185 @@
+"""Workflow runner CLI — the local ``argo submit``.
+
+::
+
+    python -m kubernetes_cloud_tpu.workflow run finetune-and-serve
+    python -m kubernetes_cloud_tpu.workflow run spec.json -p run_name=r1
+    python -m kubernetes_cloud_tpu.workflow run \
+        deploy/finetuner-workflow/finetune-workflow.yaml -p run_name=r1
+    python -m kubernetes_cloud_tpu.workflow import \
+        deploy/finetuner-workflow/finetune-workflow.yaml -o spec.json
+    python -m kubernetes_cloud_tpu.workflow status --workdir runs/...
+    python -m kubernetes_cloud_tpu.workflow list
+
+``run`` targets a canned pipeline name, a spec JSON file, or an Argo
+Workflow YAML (imported on the fly).  ``-p key=value`` mirrors ``argo
+submit -p``; reruns over the same ``--workdir`` resume, skipping steps
+whose state or artifacts are already complete.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional, Sequence
+
+from kubernetes_cloud_tpu.workflow import pipelines
+from kubernetes_cloud_tpu.workflow.engine import STATE_FILE, WorkflowRun, load_state
+from kubernetes_cloud_tpu.workflow.events import EVENT_LOG, read_events, summarize
+from kubernetes_cloud_tpu.workflow.spec import SpecError, WorkflowSpec
+
+
+def _parse_overrides(pairs: Sequence[str]) -> dict:
+    out = {}
+    for pair in pairs or []:
+        if "=" not in pair:
+            raise SpecError(f"-p expects key=value, got {pair!r}")
+        key, value = pair.split("=", 1)
+        out[key.strip()] = value
+    return out
+
+
+def _load_target(target: str, overrides=None) -> WorkflowSpec:
+    if target in pipelines.CANNED:
+        return pipelines.canned(target)
+    if target.endswith((".yaml", ".yml")):
+        from kubernetes_cloud_tpu.workflow.argo_import import (
+            load_argo_workflow,
+        )
+
+        # -p overrides shape withParam fan-outs, fixed at import time
+        return load_argo_workflow(target, overrides)
+    if target.endswith(".json"):
+        with open(target) as fh:
+            return WorkflowSpec.from_dict(json.load(fh))
+    raise SpecError(
+        f"unknown target {target!r}: expected a canned pipeline "
+        f"({sorted(pipelines.CANNED)}), a .json spec, or an Argo .yaml")
+
+
+def _print_summary(result: dict) -> None:
+    width = max((len(n) for n in result["steps"]), default=4)
+    print(f"workflow: {result['status']}  ({result['workdir']})")
+    for name, status in result["steps"].items():
+        print(f"  {name:<{width}}  {status}")
+
+
+def cmd_run(args) -> int:
+    overrides = _parse_overrides(args.param)
+    spec = _load_target(args.target, overrides)
+    workdir = args.workdir or os.path.join(
+        "workflow-runs", spec.name)
+    os.makedirs(workdir, exist_ok=True)
+    if "workdir" in spec.parameters and "workdir" not in overrides:
+        # canned pipelines root their artifacts in the run directory
+        overrides["workdir"] = os.path.abspath(workdir)
+    executors = None
+    if args.executor == "k8s":
+        from kubernetes_cloud_tpu.deploy.k8s_client import K8sClient
+        from kubernetes_cloud_tpu.workflow.executors import K8sJobExecutor
+
+        client = K8sClient(retries=3)
+        executors = {"local": K8sJobExecutor(client,
+                                             namespace=args.namespace),
+                     "k8s": K8sJobExecutor(client,
+                                           namespace=args.namespace)}
+    run = WorkflowRun(spec, workdir, params=overrides,
+                      executors=executors, max_workers=args.max_workers)
+    result = run.run(resume=not args.no_resume)
+    _print_summary(result)
+    return 0 if result["status"] == "succeeded" else 1
+
+
+def cmd_import(args) -> int:
+    from kubernetes_cloud_tpu.workflow.argo_import import load_argo_workflow
+
+    spec = load_argo_workflow(args.path)
+    order = spec.validate()
+    if args.output:
+        with open(args.output, "w") as fh:
+            json.dump(spec.to_dict(), fh, indent=1)
+        print(f"wrote {args.output}")
+    print(f"workflow {spec.name}: {len(spec.steps)} steps, "
+          f"{len(spec.parameters)} parameters")
+    for name in order:
+        step = spec.step(name)
+        deps = f" <- {','.join(step.deps)}" if step.deps else ""
+        cond = f"  when: {step.when}" if step.when else ""
+        print(f"  {name}{deps}{cond}")
+    return 0
+
+
+def cmd_status(args) -> int:
+    state = load_state(args.workdir)
+    if not state:
+        print(f"no {STATE_FILE} under {args.workdir}")
+        return 1
+    rollup = summarize(read_events(os.path.join(args.workdir, EVENT_LOG)))
+    print(f"workflow: {state.get('workflow')}")
+    width = max((len(n) for n in state.get("steps", {})), default=4)
+    for name, info in state.get("steps", {}).items():
+        extra = rollup.get(name, {})
+        attempts = info.get("attempts", 0)
+        dur = extra.get("duration", 0.0)
+        print(f"  {name:<{width}}  {info.get('status', '?'):<16} "
+              f"attempts={attempts} duration={dur:.1f}s")
+    return 0
+
+
+def cmd_list(_args) -> int:
+    for name in sorted(pipelines.CANNED):
+        print(name)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m kubernetes_cloud_tpu.workflow",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="execute a pipeline / spec / manifest")
+    run.add_argument("target",
+                     help="canned pipeline name, spec.json, or Argo .yaml")
+    run.add_argument("-p", "--param", action="append", default=[],
+                     metavar="KEY=VALUE", help="parameter override")
+    run.add_argument("--workdir", default=None,
+                     help="state/artifact dir (default workflow-runs/<name>)")
+    run.add_argument("--max-workers", type=int, default=4)
+    run.add_argument("--no-resume", action="store_true",
+                     help="ignore prior state and artifacts")
+    run.add_argument("--executor", choices=("local", "k8s"),
+                     default="local")
+    run.add_argument("--namespace", default="default")
+    run.set_defaults(fn=cmd_run)
+
+    imp = sub.add_parser("import", help="Argo YAML -> executable spec")
+    imp.add_argument("path")
+    imp.add_argument("-o", "--output", default=None,
+                     help="write the spec as JSON")
+    imp.set_defaults(fn=cmd_import)
+
+    status = sub.add_parser("status", help="inspect a run directory")
+    status.add_argument("--workdir", required=True)
+    status.set_defaults(fn=cmd_status)
+
+    lst = sub.add_parser("list", help="canned pipelines")
+    lst.set_defaults(fn=cmd_list)
+    return ap
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except (SpecError, FileNotFoundError) as e:
+        print(f"error: {e}")
+        return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,313 @@
+"""DAG engine: topological scheduling, concurrent independent steps,
+retry with backoff, and preemption-safe resume.
+
+What Argo's workflow-controller does for the reference's manifests, as a
+local library: steps whose dependencies are satisfied run concurrently in
+a thread pool (each step is a subprocess or k8s Job — threads only wait);
+failures retry per the step's :class:`~.spec.RetryStrategy` with
+exponential backoff + jitter; a failure fail-fasts scheduling (running
+branches drain, nothing new starts).
+
+Resume is stricter than the reference's restart hack
+(``gpt-neox/04-finetune-workflow.yaml:420-425``): every state transition
+is persisted to ``state.json`` (atomic rename), and on rerun a step is
+skipped when its prior state is terminal-successful **or** its declared
+artifacts are already sentinel-complete (``.ready.txt`` contract) — so a
+SIGKILL'd run re-executes only the interrupted tail.  Every attempt is
+recorded in the JSONL step-event log (:mod:`.events`).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import json
+import os
+import random
+import time
+from typing import Any, Mapping, Optional
+
+from kubernetes_cloud_tpu.workflow.events import EVENT_LOG, WorkflowEventLog
+from kubernetes_cloud_tpu.workflow.executors import LocalExecutor, StepResult
+from kubernetes_cloud_tpu.workflow.spec import (
+    Step,
+    WorkflowSpec,
+    artifact_complete,
+    evaluate_when,
+    render,
+)
+
+STATE_FILE = "state.json"
+
+PENDING = "pending"
+RUNNING = "running"
+SUCCEEDED = "succeeded"
+FAILED = "failed"
+SKIPPED = "skipped"
+UPSTREAM_FAILED = "upstream_failed"
+
+_DONE_OK = (SUCCEEDED, SKIPPED)
+_TERMINAL_BAD = (FAILED, UPSTREAM_FAILED)
+
+
+def load_state(workdir: str) -> dict:
+    path = os.path.join(workdir, STATE_FILE)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (json.JSONDecodeError, OSError):
+        # torn write can't happen (atomic rename), but a hand-edited or
+        # foreign file shouldn't wedge the engine
+        return {}
+
+
+class WorkflowRun:
+    """One execution (or resumption) of a :class:`WorkflowSpec`."""
+
+    def __init__(self, spec: WorkflowSpec, workdir: str, *,
+                 params: Optional[Mapping[str, str]] = None,
+                 executors: Optional[Mapping[str, Any]] = None,
+                 max_workers: int = 4,
+                 sleep=time.sleep,
+                 rng: Optional[random.Random] = None):
+        self.spec = spec
+        self.topo = spec.validate()
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.params = spec.resolve_parameters(params)
+        self.executors = {"local": LocalExecutor()}
+        self.executors.update(executors or {})
+        self.max_workers = max(1, max_workers)
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+        self.events = WorkflowEventLog(os.path.join(workdir, EVENT_LOG))
+        self.run_id = ""  # assigned (or restored) by run()
+        self._status: dict = {}
+        self._outputs: dict = {}
+        self._attempts: dict = {}
+
+    # -- state persistence -------------------------------------------------
+
+    def _save_state(self) -> None:
+        state = {
+            "workflow": self.spec.name,
+            "run_id": self.run_id,
+            "params": self.params,
+            "steps": {
+                name: {"status": status,
+                       "attempts": self._attempts.get(name, 0),
+                       "output": self._outputs.get(name, "")}
+                for name, status in self._status.items()},
+        }
+        path = os.path.join(self.workdir, STATE_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(state, fh, indent=1)
+        os.replace(tmp, path)
+
+    # -- rendering ---------------------------------------------------------
+
+    def _rendered(self, step: Step) -> Step:
+        """Template-expand a step against parameters + upstream outputs at
+        submission time (outputs of deps exist by then)."""
+        import dataclasses
+
+        env = {k: render(str(v), self.params, self._outputs)
+               for k, v in step.env.items()}
+        # per-run identity for executors that name external resources
+        # (K8sJobExecutor Job names must not collide across runs)
+        env.setdefault("WORKFLOW_RUN_ID", self.run_id)
+        return dataclasses.replace(
+            step,
+            command=[render(str(a), self.params, self._outputs)
+                     for a in step.command],
+            env=env,
+            artifacts=self._artifacts(step),
+            manifest=(render(step.manifest, self.params, self._outputs)
+                      if step.manifest else ""),
+        )
+
+    def _artifacts(self, step: Step) -> list:
+        return [render(str(a), self.params, self._outputs)
+                for a in step.artifacts]
+
+    # -- execution ---------------------------------------------------------
+
+    def _run_step(self, step: Step) -> StepResult:
+        executor = self.executors.get(step.executor)
+        if executor is None:
+            # e.g. a resource-template step from an imported manifest under
+            # --executor local: fail the step with a pointer, not the engine
+            msg = (f"no {step.executor!r} executor registered "
+                   f"(have: {sorted(self.executors)}); "
+                   f"run with --executor k8s for resource steps")
+            self._attempts[step.name] = 1
+            self.events.emit("step_finish", step.name, status=FAILED,
+                             rc=-1, stderr=msg)
+            return StepResult(rc=-1, stderr=msg)
+        try:
+            rendered = self._rendered(step)
+        except Exception as e:  # noqa: BLE001 - template/spec fault
+            self._attempts[step.name] = 1
+            self.events.emit("step_finish", step.name, status=FAILED,
+                             rc=-1, stderr=f"{type(e).__name__}: {e}")
+            return StepResult(rc=-1, stderr=f"{type(e).__name__}: {e}")
+        attempt = 0
+        while True:
+            self._attempts[step.name] = attempt + 1
+            self.events.emit("step_start", step.name, attempt=attempt,
+                             command=rendered.command[:8])
+            try:
+                result = executor.execute(rendered, timeout=step.timeout,
+                                          attempt=attempt)
+            except Exception as e:  # noqa: BLE001 - executor/infra fault
+                # must not escape the worker: an uncaught exception would
+                # abort run() with half-written state and no finish event
+                result = StepResult(rc=-1, stderr=f"{type(e).__name__}: {e}")
+            if result.ok:
+                self.events.emit("step_finish", step.name, status=SUCCEEDED,
+                                 attempt=attempt, rc=result.rc,
+                                 duration=round(result.duration, 4))
+                return result
+            if attempt >= step.retry.limit:
+                self.events.emit("step_finish", step.name, status=FAILED,
+                                 attempt=attempt, rc=result.rc,
+                                 duration=round(result.duration, 4),
+                                 stderr=result.stderr[-2000:])
+                return result
+            delay = step.retry.delay(attempt, self._rng)
+            self.events.emit("step_retry", step.name, attempt=attempt,
+                             rc=result.rc, delay=round(delay, 4))
+            self._sleep(delay)
+            attempt += 1
+
+    def _skip(self, name: str, reason: str) -> None:
+        self._status[name] = SKIPPED
+        # a skipped step has no captured stdout; downstream
+        # {{steps.<name>.outputs.result}} references resolve to ""
+        self._outputs.setdefault(name, "")
+        self.events.emit("step_skipped", name, reason=reason)
+
+    def _deps_state(self, step: Step) -> str:
+        states = [self._status[d] for d in step.deps]
+        if any(s in _TERMINAL_BAD for s in states):
+            return "failed"
+        if all(s in _DONE_OK for s in states):
+            return "ready"
+        return "waiting"
+
+    def run(self, resume: bool = True) -> dict:
+        import uuid
+
+        prior = (load_state(self.workdir) or {}) if resume else {}
+        # prior state only resumes the *same* run: same workflow AND same
+        # resolved parameters — a rerun with different -p overrides must
+        # re-execute (its artifacts land elsewhere), relying only on
+        # sentinel-complete artifact gates for skipping
+        same_run = (prior.get("workflow") == self.spec.name
+                    and prior.get("params") == self.params)
+        prior_steps = prior.get("steps", {}) if same_run else {}
+        self.run_id = ((same_run and prior.get("run_id"))
+                       or uuid.uuid4().hex[:8])
+
+        self._status = {s.name: PENDING for s in self.spec.steps}
+        for s in self.spec.steps:
+            carried = prior_steps.get(s.name, {})
+            if carried.get("status") in _DONE_OK:
+                self._status[s.name] = carried["status"]
+                self._outputs[s.name] = carried.get("output", "")
+                self._attempts[s.name] = carried.get("attempts", 0)
+                self.events.emit("step_skipped", s.name, reason="prior-state")
+
+        self.events.emit("workflow_start", workflow=self.spec.name,
+                         resumed=bool(prior_steps))
+        self._save_state()
+
+        failed_fast = False
+        futures: dict = {}
+        with cf.ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            while True:
+                progressed = True
+                while progressed and not failed_fast:
+                    progressed = False
+                    for name in self.topo:
+                        if self._status[name] != PENDING or name in futures:
+                            continue
+                        step = self.spec.step(name)
+                        deps = self._deps_state(step)
+                        if deps == "failed":
+                            self._status[name] = UPSTREAM_FAILED
+                            self.events.emit("step_finish", name,
+                                             status=UPSTREAM_FAILED)
+                            progressed = True
+                        elif deps == "ready":
+                            try:
+                                gated = not evaluate_when(
+                                    step.when, self.params, self._outputs)
+                                complete = not gated and step.artifacts \
+                                    and all(artifact_complete(a)
+                                            for a in self._artifacts(step))
+                            except Exception as e:  # noqa: BLE001
+                                # bad when/artifact template: fail the step,
+                                # not the engine
+                                self._status[name] = FAILED
+                                self.events.emit(
+                                    "step_finish", name, status=FAILED,
+                                    rc=-1,
+                                    stderr=f"{type(e).__name__}: {e}")
+                                failed_fast = True
+                                progressed = True
+                                break
+                            if gated:
+                                self._skip(name, "when-false")
+                                progressed = True
+                            elif complete:
+                                # preemption-safe resume: outputs already on
+                                # disk from a killed prior run
+                                self._skip(name, "sentinel-complete")
+                                progressed = True
+                            else:
+                                self._status[name] = RUNNING
+                                futures[name] = pool.submit(
+                                    self._run_step, step)
+                    if progressed:
+                        self._save_state()
+
+                if not futures:
+                    break
+                done, _ = cf.wait(futures.values(),
+                                  return_when=cf.FIRST_COMPLETED)
+                for name in [n for n, f in futures.items() if f in done]:
+                    result = futures.pop(name).result()
+                    if result.ok:
+                        self._status[name] = SUCCEEDED
+                        self._outputs[name] = result.output
+                    else:
+                        self._status[name] = FAILED
+                        failed_fast = True
+                self._save_state()
+
+        # fail-fast stopped scheduling; steps downstream of a failure are
+        # terminally unreachable (mark them), while pending steps whose
+        # deps all succeeded stay pending — a rerun resumes exactly there
+        changed = True
+        while changed:
+            changed = False
+            for name in self.topo:
+                if self._status[name] != PENDING:
+                    continue
+                if self._deps_state(self.spec.step(name)) == "failed":
+                    self._status[name] = UPSTREAM_FAILED
+                    self.events.emit("step_finish", name,
+                                     status=UPSTREAM_FAILED)
+                    changed = True
+
+        ok = all(s in _DONE_OK for s in self._status.values())
+        status = SUCCEEDED if ok else FAILED
+        self.events.emit("workflow_finish", status=status,
+                         steps=dict(self._status))
+        self._save_state()
+        self.events.close()
+        return {"status": status, "steps": dict(self._status),
+                "outputs": dict(self._outputs), "workdir": self.workdir}

@@ -1,0 +1,73 @@
+"""JSONL step-event log — the orchestrator's operational record.
+
+Argo keeps per-node phase/retry history in the Workflow CRD status; the
+local engine writes the same information as an append-only JSONL stream
+(``events.jsonl`` in the run directory) through the exact writer the
+training metrics use (:class:`kubernetes_cloud_tpu.train.metrics
+.JsonlWriter`), so the one reader chain consumes both streams.
+
+Events: ``workflow_start`` / ``workflow_finish``, ``step_start`` /
+``step_finish`` (with duration + rc), ``step_retry`` (with the backoff
+delay), ``step_skipped`` (sentinel-complete resume or ``when`` false).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from kubernetes_cloud_tpu.train.metrics import JsonlWriter, read_jsonl
+
+EVENT_LOG = "events.jsonl"
+
+
+class WorkflowEventLog:
+    """Append-only event emitter; safe to leave open across a SIGKILL
+    (line-buffered writes, torn tails tolerated by :func:`read_events`)
+    and across threads (concurrent steps emit from the pool's workers)."""
+
+    def __init__(self, path: str):
+        self._writer = JsonlWriter(path)
+        self._lock = threading.Lock()
+        self.path = path
+
+    def emit(self, event: str, step: Optional[str] = None,
+             **fields: Any) -> None:
+        rec = {"ts": time.time(), "event": event}
+        if step is not None:
+            rec["step"] = step
+        rec.update(fields)
+        with self._lock:
+            self._writer.write(rec)
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+def read_events(path: str) -> list:
+    return read_jsonl(path)
+
+
+def summarize(events: list) -> dict:
+    """Per-step rollup: attempts, last status, total wall time."""
+    steps: dict = {}
+    for rec in events:
+        name = rec.get("step")
+        if not name:
+            continue
+        info = steps.setdefault(
+            name, {"attempts": 0, "status": "pending", "duration": 0.0})
+        event = rec.get("event")
+        if event == "step_start":
+            info["attempts"] += 1
+            info["status"] = "running"
+        elif event == "step_retry":
+            info["status"] = "retrying"
+        elif event == "step_finish":
+            info["status"] = rec.get("status", "unknown")
+            info["duration"] += float(rec.get("duration", 0.0))
+        elif event == "step_skipped":
+            info["status"] = "skipped"
+            info["reason"] = rec.get("reason", "")
+    return steps

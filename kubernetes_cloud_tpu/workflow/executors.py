@@ -1,0 +1,227 @@
+"""Step executors: local subprocess and Kubernetes Job.
+
+The local executor drives the package's own CLIs (``python -m
+kubernetes_cloud_tpu...``) — the CPU-simulated-mesh path that makes the
+shipped Argo manifests runnable without a cluster.  The k8s executor is
+the in-cluster path: it materializes a step as a ``batch/v1`` Job through
+the stdlib :class:`~kubernetes_cloud_tpu.deploy.k8s_client.K8sClient`
+(whose request layer now retries transient apiserver failures, shared
+with every other client caller) and polls Job status; ``resource``
+templates (the InferenceService apply step) POST their manifest to the
+derived CRD path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Mapping, Optional
+
+from kubernetes_cloud_tpu.workflow.spec import Step
+
+
+@dataclasses.dataclass
+class StepResult:
+    rc: int
+    stdout: str = ""
+    stderr: str = ""
+    #: Argo ``outputs.result`` analogue: last non-empty stdout line,
+    #: referenceable downstream as ``{{steps.<name>.outputs.result}}``.
+    output: str = ""
+    duration: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.rc == 0
+
+
+def _result_from_stdout(rc: int, stdout: str, stderr: str,
+                        duration: float) -> StepResult:
+    lines = [ln for ln in stdout.strip().splitlines() if ln.strip()]
+    return StepResult(rc=rc, stdout=stdout, stderr=stderr,
+                      output=lines[-1].strip() if lines else "",
+                      duration=duration)
+
+
+class LocalExecutor:
+    """Run a step's argv as a subprocess.
+
+    Container-image argv heads are remapped to their local equivalents
+    here — an executor-local concern, so the same imported spec still
+    submits the *unmodified* command when run through the k8s executor:
+    ``python``/``python3`` become the running interpreter, and binaries
+    that exist only inside the reference images (the Go/C++ tokenizer)
+    become the in-tree CLI.  stdout is captured for ``outputs.result``
+    templating."""
+
+    #: argv-head -> replacement prefix (None => [sys.executable])
+    REMAP = {
+        "python": None,
+        "python3": None,
+        "/usr/local/bin/dataset_tokenizer":
+            [None, "-m", "kubernetes_cloud_tpu.data.tokenizer_cli"],
+        "/ko-app/dataset_tokenizer":
+            [None, "-m", "kubernetes_cloud_tpu.data.tokenizer_cli"],
+    }
+
+    def __init__(self, base_env: Optional[Mapping[str, str]] = None,
+                 cwd: Optional[str] = None):
+        self.base_env = dict(base_env or {})
+        self.cwd = cwd
+
+    def _argv(self, step: Step) -> list:
+        argv = list(step.command)
+        if argv and argv[0] in self.REMAP:
+            prefix = self.REMAP[argv[0]] or [None]
+            argv = [sys.executable if p is None else p
+                    for p in prefix] + argv[1:]
+        return argv
+
+    def execute(self, step: Step, *, timeout: Optional[float] = None,
+                attempt: int = 0) -> StepResult:
+        env = dict(os.environ)
+        env.update(self.base_env)
+        env.update(step.env)
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                self._argv(step), env=env, cwd=self.cwd,
+                capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout or b""
+            return _result_from_stdout(
+                124,
+                out.decode(errors="replace") if isinstance(out, bytes)
+                else out,
+                f"step {step.name!r} timed out after {timeout}s",
+                time.monotonic() - t0)
+        except FileNotFoundError as e:
+            return _result_from_stdout(127, "", str(e),
+                                       time.monotonic() - t0)
+        return _result_from_stdout(proc.returncode, proc.stdout, proc.stderr,
+                                   time.monotonic() - t0)
+
+
+# ---------------------------------------------------------------------------
+# kubernetes
+
+
+def _crd_path_for(manifest: Mapping[str, Any], namespace: str) -> str:
+    api_version = manifest["apiVersion"]
+    kind = manifest["kind"]
+    plural = kind.lower() + "s"
+    if "/" in api_version:
+        group, version = api_version.split("/", 1)
+        return f"/apis/{group}/{version}/namespaces/{namespace}/{plural}"
+    return f"/api/{api_version}/namespaces/{namespace}/{plural}"
+
+
+class K8sJobExecutor:
+    """Run a step as a ``batch/v1`` Job and wait for completion.
+
+    Retries of the *step* stay with the engine (``backoffLimit: 0`` on the
+    Job), so the JSONL event log sees every attempt; transient apiserver
+    errors are absorbed by the client's own request retries."""
+
+    def __init__(self, client, namespace: str = "default", *,
+                 poll: float = 2.0, sleep=time.sleep):
+        self.client = client
+        self.namespace = namespace
+        self.poll = poll
+        self._sleep = sleep
+
+    def job_manifest(self, step: Step, run_id: str,
+                     attempt: int = 0) -> dict:
+        # attempt-suffixed: Jobs are immutable and attempt N-1's failed Job
+        # (backoffLimit 0, not deleted) would 409 an identically-named
+        # retry.  The suffix survives the 63-char truncation — otherwise a
+        # retry could silently poll the previous attempt's Job.
+        suffix = f"-a{attempt}"
+        base = f"{run_id}-{step.name}".replace("_", "-").lower()
+        name = base[:63 - len(suffix)] + suffix
+        container = {
+            "name": "main",
+            "image": step.image or "python:3.11-slim",
+            "command": [str(a) for a in step.command],
+        }
+        if step.env:
+            container["env"] = [{"name": k, "value": str(v)}
+                                for k, v in sorted(step.env.items())]
+        return {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {"name": name,
+                         "labels": {"workflow-run": run_id,
+                                    "workflow-step": step.name}},
+            "spec": {
+                "backoffLimit": 0,
+                "template": {
+                    "metadata": {"labels": {"workflow-step": step.name}},
+                    "spec": {"restartPolicy": "Never",
+                             "containers": [container]},
+                },
+            },
+        }
+
+    def _apply_resource(self, step: Step,
+                        timeout: Optional[float]) -> StepResult:
+        # Apply-and-forget: the manifest is POSTed (or merge-patched on
+        # 409) and the step succeeds on acceptance.  Argo's
+        # successCondition wait is not implemented — gate downstream steps
+        # on the artifact/readiness contract instead (the canned pipeline
+        # uses `lm_service --ready-file`).
+        import yaml
+
+        from kubernetes_cloud_tpu.deploy.k8s_client import ApiError
+
+        t0 = time.monotonic()
+        manifest = yaml.safe_load(step.manifest)
+        path = _crd_path_for(manifest, self.namespace)
+        try:
+            self.client.create(path, manifest)
+        except ApiError as e:
+            if e.status != 409:  # already exists => apply semantics
+                return StepResult(rc=1, stderr=str(e),
+                                  duration=time.monotonic() - t0)
+            name = manifest["metadata"]["name"]
+            self.client.patch(f"{path}/{name}", manifest)
+        return StepResult(rc=0, output=manifest["metadata"].get("name", ""),
+                          duration=time.monotonic() - t0)
+
+    def execute(self, step: Step, *, timeout: Optional[float] = None,
+                attempt: int = 0) -> StepResult:
+        if step.manifest:
+            return self._apply_resource(step, timeout)
+        from kubernetes_cloud_tpu.deploy.k8s_client import ApiError
+
+        t0 = time.monotonic()
+        run_id = step.env.get("WORKFLOW_RUN_ID", "wf")
+        manifest = self.job_manifest(step, run_id, attempt)
+        path = f"/apis/batch/v1/namespaces/{self.namespace}/jobs"
+        name = manifest["metadata"]["name"]
+        try:
+            self.client.create(path, manifest)
+        except ApiError as e:
+            # 409: the Job already exists — a lost create response was
+            # retried, or a prior orchestrator died after creating it.
+            # Either way the Job is there; fall through to polling it.
+            if e.status != 409:
+                raise
+        deadline = (time.monotonic() + timeout) if timeout else None
+        while True:
+            status = (self.client.get(f"{path}/{name}") or {}).get(
+                "status", {})
+            if status.get("succeeded"):
+                return StepResult(rc=0, output=name,
+                                  duration=time.monotonic() - t0)
+            if status.get("failed"):
+                return StepResult(rc=1, stderr=f"job {name} failed",
+                                  duration=time.monotonic() - t0)
+            if deadline and time.monotonic() > deadline:
+                return StepResult(rc=124,
+                                  stderr=f"job {name} timed out",
+                                  duration=time.monotonic() - t0)
+            self._sleep(self.poll)

@@ -1,0 +1,152 @@
+"""Canned pipelines — the reference's flagship composition as a built-in.
+
+``finetune-and-serve`` is the five-primitive pipeline (corpus →
+dataset-downloader → tokenizer → finetuner → serve smoke-test) sized to
+complete on the CPU-simulated mesh in one command::
+
+    python -m kubernetes_cloud_tpu.workflow run finetune-and-serve
+
+Every step is one of the package's real CLIs driven through the local
+subprocess executor, every artifact hand-off uses the ``.ready.txt``
+sentinel contract, and the whole DAG is preemption-safe: kill it at any
+point and a rerun resumes from the completed steps.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from kubernetes_cloud_tpu.workflow.spec import RetryStrategy, Step, WorkflowSpec
+
+#: deterministic corpus generator (the demo-dataset step's local stand-in);
+#: argv: corpus_dir urls_file n_docs
+_SEED_SRC = """\
+import os, random, sys, urllib.request
+corpus, urls_file, n = sys.argv[1], sys.argv[2], int(sys.argv[3])
+os.makedirs(corpus, exist_ok=True)
+rng = random.Random(0)
+words = ("tpu pod slice mesh shard batch token train serve scale "
+         "cloud workload tensor stream fast jax xla graph").split()
+paths = []
+for i in range(n):
+    text = "\\n".join(
+        " ".join(rng.choice(words) for _ in range(rng.randint(6, 14)))
+        for _ in range(rng.randint(20, 40)))
+    path = os.path.join(corpus, f"doc{i:03d}.txt")
+    with open(path, "w") as fh:
+        fh.write(text + "\\n")
+    paths.append(path)
+tmp = urls_file + ".tmp"
+with open(tmp, "w") as fh:
+    for p in paths:
+        fh.write("file://" + urllib.request.pathname2url(os.path.abspath(p))
+                 + "\\n")
+os.replace(tmp, urls_file)
+print(urls_file)
+"""
+
+_CPU_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def build_finetune_and_serve() -> WorkflowSpec:
+    """The flagship DAG with the reference's step names
+    (``finetune-workflow.yaml:200-321``), CPU-sim sized."""
+    py = sys.executable
+    wd = "{{workflow.parameters.workdir}}"
+    run = "{{workflow.parameters.run_name}}"
+    tokens = f"{wd}/dataset.tokens"
+    steps = [
+        Step(
+            name="seed-corpus",
+            command=[py, "-c", _SEED_SRC, f"{wd}/corpus", f"{wd}/urls.txt",
+                     "{{workflow.parameters.docs}}"],
+            artifacts=[f"{wd}/urls.txt"],
+        ),
+        Step(
+            name="dataset-downloader",
+            command=[py, "-m", "kubernetes_cloud_tpu.data.dataset_downloader",
+                     "--urls", f"{wd}/urls.txt",
+                     "--output", f"{wd}/dataset", "--retries", "3"],
+            deps=["seed-corpus"],
+            retry=RetryStrategy(limit=2, backoff=0.5),
+            artifacts=[f"{wd}/dataset"],
+        ),
+        Step(
+            name="tokenizer",
+            command=[py, "-m", "kubernetes_cloud_tpu.data.tokenizer_cli",
+                     "--input", f"{wd}/dataset", "--output", tokens,
+                     "--tokenizer", "byte",
+                     "--context-size", "{{workflow.parameters.context}}",
+                     "--eot-token", "0", "--pad-token", "1"],
+            deps=["dataset-downloader"],
+            retry=RetryStrategy(limit=1, backoff=0.5),
+            artifacts=[tokens, tokens + ".json"],
+        ),
+        Step(
+            name="finetuner",
+            command=[py, "-m", "kubernetes_cloud_tpu.train.finetuner_cli",
+                     "--run-name", run,
+                     "--model", "{{workflow.parameters.model}}",
+                     "--dataset", tokens,
+                     "--context-size", "{{workflow.parameters.context}}",
+                     "--mesh", "{{workflow.parameters.mesh}}",
+                     "--bs", "{{workflow.parameters.bs}}",
+                     "--gradients", "1",
+                     "--epochs", "{{workflow.parameters.epochs}}",
+                     "--save-steps", "2",
+                     "--output-path", wd,
+                     "--logs", f"{wd}/logs"],
+            deps=["tokenizer"],
+            retry=RetryStrategy(limit=1, backoff=2.0),
+            timeout=1800.0,
+            env=dict(_CPU_ENV),
+            artifacts=[f"{wd}/results-{run}"],
+        ),
+        Step(
+            name="serve-smoke",
+            command=[py, "-m", "kubernetes_cloud_tpu.serve.lm_service",
+                     "--model", f"{wd}/results-{run}/final",
+                     "--ready-file", f"{wd}/results-{run}/.ready.txt",
+                     "--smoke", "{{workflow.parameters.prompt}}",
+                     "--smoke-tokens",
+                     "{{workflow.parameters.max_new_tokens}}"],
+            deps=["finetuner"],
+            retry=RetryStrategy(limit=1, backoff=2.0),
+            timeout=900.0,
+            env=dict(_CPU_ENV),
+        ),
+    ]
+    return WorkflowSpec(
+        name="finetune-and-serve",
+        steps=steps,
+        parameters={
+            # workdir is injected by the CLI (the run directory)
+            "workdir": None,
+            "run_name": "finetune-local",
+            "docs": "6",
+            "context": "32",
+            "model": "test-tiny",
+            "mesh": "data=8",
+            "bs": "8",
+            "epochs": "1",
+            "prompt": "Hello TPU",
+            "max_new_tokens": "8",
+        },
+    )
+
+
+CANNED = {
+    "finetune-and-serve": build_finetune_and_serve,
+}
+
+
+def canned(name: str) -> WorkflowSpec:
+    from kubernetes_cloud_tpu.workflow.spec import SpecError
+
+    if name not in CANNED:
+        raise SpecError(
+            f"unknown pipeline {name!r}; available: {sorted(CANNED)}")
+    return CANNED[name]()

@@ -1,0 +1,336 @@
+"""Typed workflow model — the Argo Workflow surface as plain dataclasses.
+
+The reference composes its five primitives (PVC → downloader Job →
+tokenizer → trainer → InferenceService) with an Argo Workflow
+(``deploy/finetuner-workflow/finetune-workflow.yaml``): step dependencies,
+``retryStrategy``, 56 ``{{workflow.parameters.x}}`` parameters, ``when``
+conditions, and sprig expressions.  This module is the executable spec
+those manifests import into (:mod:`.argo_import`) and the engine
+(:mod:`.engine`) schedules:
+
+* :class:`RetryStrategy` — Argo's ``limit`` plus exponential backoff with
+  jitter (the reference relies on bare ``limit: 1``; preemptible TPU
+  slices need real backoff);
+* :class:`Step` — argv + deps + retry + timeout + artifact gates on the
+  existing ``.ready.txt`` sentinel contract (``weights/checkpoint.py``);
+* :class:`WorkflowSpec` — parameters + DAG with cycle/unknown-dep
+  validation and a topological order;
+* :func:`render` / :func:`evaluate_when` — Argo-compatible
+  ``{{workflow.parameters.x}}`` / ``{{steps.x.outputs.result}}``
+  templating, a safe subset of ``{{=sprig...}}`` expressions, and the
+  ``when`` condition grammar (``==``/``!=``/``&&``/``||``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import random
+import re
+from types import SimpleNamespace
+from typing import Any, Mapping, Optional
+
+#: completion sentinel written next to finished artifacts; must stay in
+#: sync with ``weights.checkpoint.READY_SENTINEL`` (kept literal here so
+#: importing the spec never drags in orbax — tests assert the equality).
+READY_SENTINEL = ".ready.txt"
+
+
+class SpecError(ValueError):
+    """Structural problem in a workflow spec (cycle, unknown dep, ...)."""
+
+
+class TemplateError(ValueError):
+    """Unresolvable ``{{...}}`` reference."""
+
+
+# ---------------------------------------------------------------------------
+# templating
+
+
+_TEMPLATE_RE = re.compile(r"\{\{(.+?)\}\}")
+_STEP_OUT_RE = re.compile(r"^steps\.([\w.-]+)\.outputs\.result$")
+_TERNARY_RE = re.compile(r"^(?P<cond>[^?]+)\?(?P<then>[^:]+):(?P<else>.+)$")
+
+
+class _Sprig:
+    """The sprig functions the shipped manifests actually use."""
+
+    @staticmethod
+    def replace(old: str, new: str, s: str) -> str:
+        return s.replace(old, new)
+
+    @staticmethod
+    def default(default: Any, value: Any = "") -> Any:
+        return value if value not in ("", None) else default
+
+    @staticmethod
+    def trim(s: str) -> str:
+        return s.strip()
+
+    @staticmethod
+    def lower(s: str) -> str:
+        return s.lower()
+
+    @staticmethod
+    def upper(s: str) -> str:
+        return s.upper()
+
+
+_ALLOWED_NODES = (
+    ast.Expression, ast.Name, ast.Attribute, ast.Constant, ast.Load,
+    ast.BinOp, ast.Add, ast.Compare, ast.Eq, ast.NotEq, ast.IfExp,
+    ast.Call, ast.BoolOp, ast.And, ast.Or, ast.UnaryOp, ast.Not,
+)
+
+
+def _eval_expression(expr: str, params: Mapping[str, str]) -> str:
+    """Evaluate an Argo ``{{=...}}`` expression over the parameter dict.
+
+    Supports the subset the shipped manifests use: ``sprig.replace``,
+    ``sprig.default``, string ``+`` concatenation, ``==``/``!=``, and the
+    ``cond ? a : b`` ternary — validated against an AST whitelist, never
+    raw ``eval`` of arbitrary code."""
+    m = _TERNARY_RE.match(expr)
+    if m:
+        expr = (f"({m.group('then').strip()}) if ({m.group('cond').strip()})"
+                f" else ({m.group('else').strip()})")
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError as e:
+        raise TemplateError(f"bad expression {expr!r}: {e}") from e
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise TemplateError(
+                f"disallowed construct {type(node).__name__} in {expr!r}")
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "sprig"):
+                raise TemplateError(f"only sprig.* calls allowed: {expr!r}")
+    ns = {
+        "sprig": _Sprig,
+        "workflow": SimpleNamespace(
+            parameters=SimpleNamespace(**dict(params))),
+    }
+    try:
+        out = eval(compile(tree, "<workflow-template>", "eval"),  # noqa: S307
+                   {"__builtins__": {}}, ns)
+    except AttributeError as e:
+        raise TemplateError(f"unknown reference in {expr!r}: {e}") from e
+    return str(out)
+
+
+def render(text: str, params: Mapping[str, str],
+           step_outputs: Optional[Mapping[str, str]] = None,
+           strict: bool = True) -> str:
+    """Expand ``{{workflow.parameters.x}}``, ``{{steps.s.outputs.result}}``
+    and ``{{=expr}}`` templates in ``text`` (Argo semantics: parameters are
+    strings)."""
+
+    def _sub(m: re.Match) -> str:
+        inner = m.group(1).strip()
+        if inner.startswith("="):
+            return _eval_expression(inner[1:].strip(), params)
+        if inner.startswith("workflow.parameters."):
+            key = inner[len("workflow.parameters."):]
+            if key in params:
+                value = params[key]
+                if value is None:
+                    raise TemplateError(f"parameter {key!r} has no value")
+                return str(value)
+            if strict:
+                raise TemplateError(f"unknown workflow parameter {key!r}")
+            return m.group(0)
+        out = _STEP_OUT_RE.match(inner)
+        if out:
+            name = out.group(1)
+            if step_outputs is not None and name in step_outputs:
+                return str(step_outputs[name])
+            if strict:
+                raise TemplateError(f"no recorded output for step {name!r}")
+            return m.group(0)
+        if strict:
+            raise TemplateError(f"unsupported template {m.group(0)!r}")
+        return m.group(0)
+
+    return _TEMPLATE_RE.sub(_sub, text)
+
+
+_TRUTHY = {"true", "t", "yes", "y", "on", "1"}
+
+
+def _atom(token: str) -> str:
+    token = token.strip()
+    if len(token) >= 2 and token[0] == token[-1] and token[0] in "'\"":
+        return token[1:-1]
+    return token
+
+
+def evaluate_when(cond: str, params: Mapping[str, str],
+                  step_outputs: Optional[Mapping[str, str]] = None) -> bool:
+    """Argo ``when`` grammar over rendered text: ``==``/``!=`` comparisons
+    of (possibly quoted) atoms combined with ``&&`` and ``||`` (``&&``
+    binds tighter, as in Argo's govaluate)."""
+    if not cond or not cond.strip():
+        return True
+    rendered = render(cond, params, step_outputs)
+
+    def _compare(term: str) -> bool:
+        if "!=" in term:
+            lhs, rhs = term.split("!=", 1)
+            return _atom(lhs) != _atom(rhs)
+        if "==" in term:
+            lhs, rhs = term.split("==", 1)
+            return _atom(lhs) == _atom(rhs)
+        return _atom(term).lower() in _TRUTHY
+
+    return any(
+        all(_compare(term) for term in clause.split("&&"))
+        for clause in rendered.split("||"))
+
+
+# ---------------------------------------------------------------------------
+# model
+
+
+@dataclasses.dataclass
+class RetryStrategy:
+    """Argo ``retryStrategy`` with the backoff the reference leaves out.
+
+    ``limit`` is the number of *retries* (Argo semantics: total attempts =
+    limit + 1).  Delay before retry ``n`` (0-based) is
+    ``min(backoff * factor**n, max_backoff) * (1 + jitter * U[0,1))``."""
+
+    limit: int = 0
+    backoff: float = 1.0
+    factor: float = 2.0
+    max_backoff: float = 60.0
+    jitter: float = 0.25
+
+    def delay(self, attempt: int,
+              rng: Optional[random.Random] = None) -> float:
+        base = min(self.backoff * self.factor ** attempt, self.max_backoff)
+        return base * (1.0 + self.jitter * (rng or random).random())
+
+
+@dataclasses.dataclass
+class Step:
+    """One node of the DAG.
+
+    ``command`` is the templated argv the executor runs (the package's own
+    CLIs for the local executor; the container command for the k8s Job
+    executor).  ``artifacts`` are paths gating resume: a directory is
+    complete when it holds the ``.ready.txt`` sentinel, a file when it
+    exists — a step whose artifacts are all complete is skipped on rerun
+    (preemption-safe resume, SURVEY §5.3)."""
+
+    name: str
+    command: list = dataclasses.field(default_factory=list)
+    deps: list = dataclasses.field(default_factory=list)
+    retry: RetryStrategy = dataclasses.field(default_factory=RetryStrategy)
+    timeout: Optional[float] = None
+    artifacts: list = dataclasses.field(default_factory=list)
+    env: dict = dataclasses.field(default_factory=dict)
+    when: str = ""
+    executor: str = "local"
+    image: str = ""
+    manifest: str = ""  # raw resource-template manifest (k8s apply steps)
+
+    def validate(self) -> None:
+        if not self.name:
+            raise SpecError("step with empty name")
+        if not self.command and not self.manifest:
+            raise SpecError(f"step {self.name!r} has no command or manifest")
+
+
+@dataclasses.dataclass
+class WorkflowSpec:
+    """Parameters + step DAG.  ``parameters`` maps name → default value;
+    ``None`` marks a required parameter (Argo parameters without
+    ``value:``)."""
+
+    name: str
+    steps: list = dataclasses.field(default_factory=list)
+    parameters: dict = dataclasses.field(default_factory=dict)
+
+    def step(self, name: str) -> Step:
+        for s in self.steps:
+            if s.name == name:
+                return s
+        raise KeyError(name)
+
+    def validate(self) -> list:
+        """Cycle / duplicate / unknown-dep checks; returns a topological
+        order of step names (Kahn)."""
+        names = [s.name for s in self.steps]
+        if len(set(names)) != len(names):
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise SpecError(f"duplicate step names: {dupes}")
+        known = set(names)
+        for s in self.steps:
+            s.validate()
+            for d in s.deps:
+                if d not in known:
+                    raise SpecError(
+                        f"step {s.name!r} depends on unknown step {d!r}")
+        indeg = {s.name: len(set(s.deps)) for s in self.steps}
+        children: dict = {n: [] for n in names}
+        for s in self.steps:
+            for d in set(s.deps):
+                children[d].append(s.name)
+        order = [n for n in names if indeg[n] == 0]
+        seen = list(order)
+        while order:
+            n = order.pop(0)
+            for c in children[n]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    order.append(c)
+                    seen.append(c)
+        if len(seen) != len(names):
+            stuck = sorted(set(names) - set(seen))
+            raise SpecError(f"dependency cycle involving: {stuck}")
+        return seen
+
+    def resolve_parameters(self,
+                           overrides: Optional[Mapping[str, str]] = None
+                           ) -> dict:
+        """Defaults + overrides; rejects unknown overrides and missing
+        required parameters (mirrors ``argo submit -p`` behavior)."""
+        params = dict(self.parameters)
+        for key, value in (overrides or {}).items():
+            if key not in params:
+                raise SpecError(f"unknown parameter {key!r} "
+                                f"(spec has: {sorted(params)})")
+            params[key] = value
+        missing = sorted(k for k, v in params.items() if v is None)
+        if missing:
+            raise SpecError(f"missing required parameters: {missing}")
+        return {k: str(v) for k, v in params.items()}
+
+    # -- (de)serialization for spec files ----------------------------------
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: Mapping[str, Any]) -> "WorkflowSpec":
+        steps = []
+        for raw in data.get("steps", []):
+            raw = dict(raw)
+            retry = raw.pop("retry", None) or {}
+            steps.append(Step(retry=RetryStrategy(**retry), **raw))
+        return cls(name=data.get("name", "workflow"), steps=steps,
+                   parameters=dict(data.get("parameters", {})))
+
+
+def artifact_complete(path: str) -> bool:
+    """Sentinel gate: directories require the ``.ready.txt`` contract the
+    downloader/trainer already write; plain files just need to exist."""
+    import os
+
+    if os.path.isdir(path):
+        return os.path.exists(os.path.join(path, READY_SENTINEL))
+    return os.path.exists(path)

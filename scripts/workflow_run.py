@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Launcher for the workflow orchestrator CLI.
+
+Equivalent to ``python -m kubernetes_cloud_tpu.workflow``; exists so the
+scripts/ directory exposes every operational entry point::
+
+    python scripts/workflow_run.py run finetune-and-serve
+    python scripts/workflow_run.py import \
+        deploy/finetuner-workflow/finetune-workflow.yaml
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_cloud_tpu.workflow.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

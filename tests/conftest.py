@@ -90,6 +90,11 @@ SLOW_TESTS = {
     "tests/test_ring_attention.py::test_ring_under_jit_grad",
     "tests/test_moe.py::test_moe_matches_per_token_reference",
     "tests/test_train_step.py::test_opt_state_is_sharded",
+    # workflow orchestrator: the unit/chaos suites (test_workflow.py,
+    # test_workflow_chaos.py) are jax-free and stay in the quick tier-1
+    # lane; only the full canned-pipeline run (download → tokenize →
+    # train → serve, minutes of subprocess work) is slow
+    "tests/test_workflow_e2e.py::test_finetune_and_serve_end_to_end",
 }
 
 

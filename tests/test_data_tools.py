@@ -57,6 +57,41 @@ class TestDownloader:
                              str(dest2), retries=1)
         assert not is_ready(str(dest2))
 
+    def test_model_download_retries(self, tmp_path, monkeypatch):
+        """--retries re-attempts a failed fetch with backoff (reference
+        Argo retryStrategy: download=1, the-eye=3) instead of failing on
+        the first error."""
+        import shutil as shutil_mod
+
+        from kubernetes_cloud_tpu.data import downloader_cli
+
+        src = tmp_path / "snapshot"
+        src.mkdir()
+        (src / "config.json").write_text("{}")
+        attempts = []
+        real_copy2 = shutil_mod.copy2
+
+        def flaky_copy2(a, b):
+            attempts.append(a)
+            if len(attempts) == 1:
+                raise OSError("transient I/O error")
+            return real_copy2(a, b)
+
+        monkeypatch.setattr(downloader_cli.shutil, "copy2", flaky_copy2)
+        monkeypatch.setattr(downloader_cli.time, "sleep", lambda _d: None)
+        dest = tmp_path / "dest-retry"
+        download_model(str(src), str(dest), retries=1)
+        assert len(attempts) == 2
+        assert is_ready(str(dest))
+
+        # retries=0 keeps the old fail-fast behavior
+        attempts.clear()
+        dest2 = tmp_path / "dest-failfast"
+        with pytest.raises(RuntimeError, match="failed to fetch"):
+            download_model(str(src), str(dest2), retries=0)
+        assert len(attempts) == 1
+        assert not is_ready(str(dest2))
+
     def test_wait_ready(self, tmp_path):
         dest = tmp_path / "w"
         dest.mkdir()

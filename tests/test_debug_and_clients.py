@@ -154,3 +154,105 @@ class TestVirtualServerClient:
         with pytest.raises(ApiError) as ei:
             vs.get("missing")
         assert ei.value.status == 404
+
+
+class TestRequestRetries:
+    """Transient-failure retry in the shared request path (5xx/429 and
+    connection errors back off and re-attempt; 4xx surface immediately)."""
+
+    def _client(self, monkeypatch, responses, retries=3):
+        import io
+        import urllib.error
+
+        from kubernetes_cloud_tpu.deploy import k8s_client as mod
+
+        calls = []
+        sleeps = []
+
+        def fake_urlopen(req, context=None, timeout=None):
+            calls.append(req.full_url)
+            outcome = responses[min(len(calls) - 1, len(responses) - 1)]
+            if isinstance(outcome, int):
+                raise urllib.error.HTTPError(
+                    req.full_url, outcome, "err", {}, io.BytesIO(b"boom"))
+            if isinstance(outcome, Exception):
+                raise outcome
+
+            class _Resp:
+                def read(self):
+                    return json.dumps(outcome).encode()
+
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *a):
+                    return False
+
+            return _Resp()
+
+        monkeypatch.setattr(mod.urllib.request, "urlopen", fake_urlopen)
+        monkeypatch.setattr(mod.time, "sleep", sleeps.append)
+        client = K8sClient(api_server="http://api", token="t",
+                           retries=retries, backoff=0.5)
+        return client, calls, sleeps
+
+    def test_5xx_then_success(self, monkeypatch):
+        client, calls, sleeps = self._client(
+            monkeypatch, [503, 502, {"ok": True}])
+        assert client.get("/api/v1/x") == {"ok": True}
+        assert len(calls) == 3
+        # exponential: base*2^0, base*2^1 (plus jitter <= 25%)
+        assert 0.5 <= sleeps[0] <= 0.625 and 1.0 <= sleeps[1] <= 1.25
+
+    def test_connection_error_retried(self, monkeypatch):
+        import urllib.error
+
+        client, calls, _ = self._client(
+            monkeypatch,
+            [urllib.error.URLError("refused"), {"ok": 1}])
+        assert client.get("/x") == {"ok": 1}
+        assert len(calls) == 2
+
+    def test_4xx_not_retried(self, monkeypatch):
+        client, calls, sleeps = self._client(monkeypatch, [404])
+        with pytest.raises(ApiError) as ei:
+            client.get("/x")
+        assert ei.value.status == 404
+        assert len(calls) == 1 and not sleeps
+
+    def test_exhaustion_raises_last_error(self, monkeypatch):
+        client, calls, sleeps = self._client(
+            monkeypatch, [500, 500, 500], retries=2)
+        with pytest.raises(ApiError) as ei:
+            client.get("/x")
+        assert ei.value.status == 500
+        assert len(calls) == 3 and len(sleeps) == 2
+
+    def test_retries_disabled(self, monkeypatch):
+        client, calls, _ = self._client(monkeypatch, [503], retries=0)
+        with pytest.raises(ApiError):
+            client.get("/x")
+        assert len(calls) == 1
+
+    def test_post_not_replayed(self, monkeypatch):
+        """POST is not idempotent: neither a lost response nor a gateway
+        5xx (which may follow a successful apply) is blindly re-sent — the
+        Job executor owns the 409 follow-up.  Only 429 (never admitted)
+        retries a create."""
+        import urllib.error
+
+        client, calls, _ = self._client(
+            monkeypatch,
+            [urllib.error.URLError("reset"), {"ok": 1}])
+        with pytest.raises(urllib.error.URLError):
+            client.create("/x", {"metadata": {"name": "j"}})
+        assert len(calls) == 1
+
+        client2, calls2, _ = self._client(monkeypatch, [504, {"ok": 1}])
+        with pytest.raises(ApiError):
+            client2.create("/x", {"metadata": {"name": "j"}})
+        assert len(calls2) == 1
+
+        client3, calls3, _ = self._client(monkeypatch, [429, {"ok": 1}])
+        assert client3.create("/x", {"metadata": {"name": "j"}}) == {"ok": 1}
+        assert len(calls3) == 2

@@ -10,6 +10,7 @@ from kubernetes_cloud_tpu.core import (
     build_mesh,
     local_batch_size,
 )
+from kubernetes_cloud_tpu.utils.compat import shard_map
 
 
 def test_default_spec_fills_data_axis(devices8):
@@ -49,7 +50,7 @@ def test_psum_over_mesh(devices8):
         jnp.ones((8, 4)), NamedSharding(mesh, P("data", None))
     )
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a: jax.lax.psum(a, "data"),
             mesh=mesh, in_specs=P("data", None), out_specs=P(None, None),
         )

@@ -1,0 +1,634 @@
+"""workflow/ orchestrator: spec model, templating, DAG engine, executors,
+Argo importer.
+
+All engine tests here drive real subprocesses or fakes — no jax — so the
+whole module stays in the quick tier-1 lane.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from kubernetes_cloud_tpu.workflow import (
+    RetryStrategy,
+    SpecError,
+    Step,
+    TemplateError,
+    WorkflowRun,
+    WorkflowSpec,
+    artifact_complete,
+    evaluate_when,
+    render,
+)
+from kubernetes_cloud_tpu.workflow.argo_import import load_argo_workflow
+from kubernetes_cloud_tpu.workflow.events import read_events, summarize
+from kubernetes_cloud_tpu.workflow.executors import K8sJobExecutor
+from kubernetes_cloud_tpu.workflow.spec import READY_SENTINEL
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+# -------------------------------------------------------------------------
+# templating
+
+
+def test_render_parameters():
+    params = {"model": "pythia", "pvc": "finetune-data"}
+    out = render("/{{workflow.parameters.pvc}}/models/"
+                 "{{workflow.parameters.model}}", params)
+    assert out == "/finetune-data/models/pythia"
+
+
+def test_render_unknown_parameter_strict():
+    with pytest.raises(TemplateError, match="unknown workflow parameter"):
+        render("{{workflow.parameters.nope}}", {})
+
+
+def test_render_step_outputs():
+    out = render("r={{steps.check-model.outputs.result}}", {},
+                 {"check-model": "true"})
+    assert out == "r=true"
+    with pytest.raises(TemplateError, match="no recorded output"):
+        render("{{steps.gone.outputs.result}}", {}, {})
+
+
+def test_render_sprig_replace_and_default():
+    params = {"model": "EleutherAI/pythia-2.8b", "tokenizer": "",
+              "pvc": "data"}
+    # the content-addressed tokenizer output expression from the manifest
+    out = render("{{=sprig.replace('/', '_', sprig.replace('.','_', "
+                 "sprig.replace('-','_', workflow.parameters.model)))}}",
+                 params)
+    assert out == "EleutherAI_pythia_2_8b"
+    out = render("{{=sprig.default('/' + workflow.parameters.pvc + "
+                 "'/models', workflow.parameters.tokenizer)}}", params)
+    assert out == "/data/models"
+    params["tokenizer"] = "custom"
+    out = render("{{=sprig.default('x', workflow.parameters.tokenizer)}}",
+                 params)
+    assert out == "custom"
+
+
+def test_render_sprig_ternary():
+    params = {"prompt_file": "", "pvc": "data"}
+    tmpl = ("{{=workflow.parameters.prompt_file == '' ? '' : '/' + "
+            "workflow.parameters.pvc + '/' + "
+            "workflow.parameters.prompt_file}}")
+    assert render(tmpl, params) == ""
+    params["prompt_file"] = "p.txt"
+    assert render(tmpl, params) == "/data/p.txt"
+
+
+def test_sprig_rejects_arbitrary_code():
+    with pytest.raises(TemplateError):
+        render("{{=__import__('os').system('true')}}", {})
+    with pytest.raises(TemplateError):
+        render("{{=open('/etc/passwd')}}", {})
+
+
+def test_evaluate_when():
+    params = {"uri": "", "only": "false", "dl": "true"}
+    assert evaluate_when("'{{workflow.parameters.uri}}' == ''", params)
+    assert not evaluate_when("'{{workflow.parameters.uri}}' != ''", params)
+    # the manifest's compound condition
+    assert evaluate_when(
+        "{{workflow.parameters.only}} == false && "
+        "{{workflow.parameters.dl}} == true", params)
+    assert not evaluate_when(
+        "{{workflow.parameters.only}} == true && "
+        "{{workflow.parameters.dl}} == true", params)
+    assert evaluate_when("x == y || {{workflow.parameters.dl}} == true",
+                         params)
+    assert evaluate_when("", params)  # no condition => run
+
+
+# -------------------------------------------------------------------------
+# spec model
+
+
+def test_spec_validate_topo_and_errors():
+    spec = WorkflowSpec("w", steps=[
+        Step("c", ["true"], deps=["a", "b"]),
+        Step("a", ["true"]),
+        Step("b", ["true"], deps=["a"]),
+    ])
+    order = spec.validate()
+    assert order.index("a") < order.index("b") < order.index("c")
+
+    with pytest.raises(SpecError, match="unknown step"):
+        WorkflowSpec("w", steps=[Step("a", ["true"], deps=["ghost"])
+                                 ]).validate()
+    with pytest.raises(SpecError, match="cycle"):
+        WorkflowSpec("w", steps=[
+            Step("a", ["true"], deps=["b"]),
+            Step("b", ["true"], deps=["a"]),
+        ]).validate()
+    with pytest.raises(SpecError, match="duplicate"):
+        WorkflowSpec("w", steps=[Step("a", ["true"]),
+                                 Step("a", ["true"])]).validate()
+
+
+def test_resolve_parameters():
+    spec = WorkflowSpec("w", steps=[Step("a", ["true"])],
+                        parameters={"req": None, "opt": "x"})
+    with pytest.raises(SpecError, match="missing required"):
+        spec.resolve_parameters()
+    with pytest.raises(SpecError, match="unknown parameter"):
+        spec.resolve_parameters({"req": "1", "typo": "2"})
+    assert spec.resolve_parameters({"req": "1"}) == {"req": "1", "opt": "x"}
+
+
+def test_retry_backoff_schedule():
+    import random
+
+    r = RetryStrategy(limit=5, backoff=1.0, factor=2.0, max_backoff=5.0,
+                      jitter=0.0)
+    rng = random.Random(0)
+    assert [r.delay(i, rng) for i in range(4)] == [1.0, 2.0, 4.0, 5.0]
+    jittered = RetryStrategy(backoff=1.0, jitter=0.5).delay(0, rng)
+    assert 1.0 <= jittered <= 1.5
+
+
+def test_spec_roundtrip():
+    spec = WorkflowSpec("w", parameters={"p": "1"}, steps=[
+        Step("a", ["echo", "{{workflow.parameters.p}}"],
+             retry=RetryStrategy(limit=2), artifacts=["/tmp/x"],
+             env={"K": "V"}, when="a == a"),
+    ])
+    back = WorkflowSpec.from_dict(json.loads(json.dumps(spec.to_dict())))
+    assert back == spec
+
+
+def test_sentinel_matches_checkpoint_contract(tmp_path):
+    from kubernetes_cloud_tpu.weights.checkpoint import (
+        READY_SENTINEL as CKPT_SENTINEL,
+    )
+
+    assert READY_SENTINEL == CKPT_SENTINEL
+    d = tmp_path / "artifact"
+    d.mkdir()
+    assert not artifact_complete(str(d))
+    (d / READY_SENTINEL).write_text("ready")
+    assert artifact_complete(str(d))
+    f = tmp_path / "out.tokens"
+    assert not artifact_complete(str(f))
+    f.write_bytes(b"\0")
+    assert artifact_complete(str(f))
+
+
+# -------------------------------------------------------------------------
+# engine
+
+
+def _sleeps():
+    delays = []
+
+    def fake_sleep(d):
+        delays.append(d)
+
+    return delays, fake_sleep
+
+
+def test_engine_dag_concurrency_and_outputs(tmp_path):
+    marker = tmp_path / "order.txt"
+    spec = WorkflowSpec("t", parameters={"msg": "hi"}, steps=[
+        Step("a", [PY, "-c", "print('A-{{workflow.parameters.msg}}')"]),
+        Step("b", [PY, "-c", "print('B')"]),
+        Step("join", [PY, "-c",
+                      f"open({str(marker)!r},'w').write("
+                      "'{{steps.a.outputs.result}}+"
+                      "{{steps.b.outputs.result}}')"],
+             deps=["a", "b"]),
+    ])
+    result = WorkflowRun(spec, str(tmp_path / "run")).run()
+    assert result["status"] == "succeeded"
+    assert result["outputs"]["a"] == "A-hi"
+    assert marker.read_text() == "A-hi+B"
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "workflow_start" and kinds[-1] == "workflow_finish"
+    # join must start only after both finishes
+    idx = {(e["event"], e.get("step")): i for i, e in enumerate(events)}
+    assert idx[("step_start", "join")] > idx[("step_finish", "a")]
+    assert idx[("step_start", "join")] > idx[("step_finish", "b")]
+
+
+def test_engine_retry_events_and_backoff(tmp_path):
+    """A step configured with retryStrategy(limit=3) retries with backoff
+    and the JSONL event log records each attempt (acceptance criterion)."""
+    flag = tmp_path / "flag"
+    code = (f"import os,sys; p={str(flag)!r}\n"
+            "if os.path.exists(p): sys.exit(0)\n"
+            "open(p,'w').close(); sys.exit(1)")
+    spec = WorkflowSpec("t", steps=[
+        Step("flaky", [PY, "-c", code],
+             retry=RetryStrategy(limit=3, backoff=0.2, factor=2.0,
+                                 jitter=0.0)),
+    ])
+    delays, fake_sleep = _sleeps()
+    result = WorkflowRun(spec, str(tmp_path / "run"),
+                         sleep=fake_sleep).run()
+    assert result["status"] == "succeeded"
+    assert delays == [0.2]  # one retry, exponential base
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    starts = [e for e in events if e["event"] == "step_start"]
+    retries = [e for e in events if e["event"] == "step_retry"]
+    assert len(starts) == 2 and len(retries) == 1
+    assert retries[0]["delay"] == pytest.approx(0.2)
+    assert summarize(events)["flaky"]["attempts"] == 2
+
+
+def test_engine_retry_exhaustion_fails(tmp_path):
+    spec = WorkflowSpec("t", steps=[
+        Step("bad", [PY, "-c", "import sys; sys.exit(3)"],
+             retry=RetryStrategy(limit=2, backoff=0.01)),
+        Step("child", [PY, "-c", "print('x')"], deps=["bad"]),
+    ])
+    delays, fake_sleep = _sleeps()
+    result = WorkflowRun(spec, str(tmp_path / "run"),
+                         sleep=fake_sleep).run()
+    assert result["status"] == "failed"
+    assert result["steps"]["bad"] == "failed"
+    assert len(delays) == 2  # limit=2 => 3 attempts, 2 backoffs
+    # fail-fast: the child never started
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    assert not any(e["event"] == "step_start" and e["step"] == "child"
+                   for e in events)
+
+
+def test_engine_upstream_failure_propagates(tmp_path):
+    # two roots: one fails, one succeeds; only the failed branch is marked
+    spec = WorkflowSpec("t", steps=[
+        Step("bad", [PY, "-c", "import sys; sys.exit(1)"]),
+        Step("child", [PY, "-c", "print('x')"], deps=["bad"]),
+    ])
+    result = WorkflowRun(spec, str(tmp_path / "run")).run()
+    assert result["steps"] == {"bad": "failed", "child": "upstream_failed"}
+
+
+def test_engine_timeout_kills_step(tmp_path):
+    spec = WorkflowSpec("t", steps=[
+        Step("slow", [PY, "-c", "import time; time.sleep(60)"],
+             timeout=0.5),
+    ])
+    result = WorkflowRun(spec, str(tmp_path / "run")).run()
+    assert result["status"] == "failed"
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    finish = [e for e in events if e["event"] == "step_finish"][0]
+    assert finish["rc"] == 124
+
+
+def test_engine_when_skip_satisfies_deps(tmp_path):
+    spec = WorkflowSpec("t", parameters={"go": "false"}, steps=[
+        Step("gated", [PY, "-c", "print('g')"],
+             when="{{workflow.parameters.go}} == true"),
+        Step("after", [PY, "-c", "print('a')"], deps=["gated"]),
+    ])
+    result = WorkflowRun(spec, str(tmp_path / "run")).run()
+    assert result["steps"] == {"gated": "skipped", "after": "succeeded"}
+
+
+def test_engine_resume_skips_state_and_sentinel(tmp_path):
+    """Preemption-safe resume: prior-state steps and sentinel-complete
+    artifacts are both skipped on rerun."""
+    out_dir = tmp_path / "artifact"
+    spec = WorkflowSpec("t", steps=[
+        Step("make", [PY, "-c",
+                      f"import os; d={str(out_dir)!r}; os.makedirs(d, "
+                      f"exist_ok=True); open(os.path.join(d, "
+                      f"{READY_SENTINEL!r}), 'w').close()"],
+             artifacts=[str(out_dir)]),
+        Step("use", [PY, "-c", "print('used')"], deps=["make"]),
+    ])
+    run1 = WorkflowRun(spec, str(tmp_path / "run")).run()
+    assert run1["status"] == "succeeded"
+
+    # rerun in the same workdir: both steps skip via prior state
+    run2 = WorkflowRun(spec, str(tmp_path / "run")).run()
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    skips = [e for e in events if e["event"] == "step_skipped"]
+    assert {e["step"] for e in skips} == {"make", "use"}
+    assert all(e["reason"] == "prior-state" for e in skips)
+    assert run2["status"] == "succeeded"
+
+    # fresh workdir, artifact already on disk: sentinel-complete skip
+    run3 = WorkflowRun(spec, str(tmp_path / "run2")).run()
+    assert run3["status"] == "succeeded"
+    events = read_events(str(tmp_path / "run2" / "events.jsonl"))
+    skip = [e for e in events if e["event"] == "step_skipped"][0]
+    assert skip["step"] == "make" and skip["reason"] == "sentinel-complete"
+    # "use" has no artifacts => really ran
+    assert any(e["event"] == "step_start" and e["step"] == "use"
+               for e in events)
+
+
+def test_engine_resume_requires_same_params(tmp_path):
+    """Prior state resumes only the *same* run: different -p overrides
+    re-execute (their artifacts land elsewhere) instead of reporting
+    success for work the new run never did."""
+    spec = WorkflowSpec("t", parameters={"tag": "a"}, steps=[
+        Step("write", [PY, "-c", "print('tag={{workflow.parameters.tag}}')"]),
+    ])
+    run1 = WorkflowRun(spec, str(tmp_path / "run")).run()
+    assert run1["outputs"]["write"] == "tag=a"
+    run2 = WorkflowRun(spec, str(tmp_path / "run"),
+                       params={"tag": "b"}).run()
+    assert run2["outputs"]["write"] == "tag=b"  # re-executed, not skipped
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    starts = [e for e in events if e["event"] == "step_start"]
+    assert len(starts) == 2
+
+
+def test_engine_no_resume_flag(tmp_path):
+    spec = WorkflowSpec("t", steps=[Step("a", [PY, "-c", "print('x')"])])
+    WorkflowRun(spec, str(tmp_path / "run")).run()
+    run2 = WorkflowRun(spec, str(tmp_path / "run")).run(resume=False)
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    starts = [e for e in events
+              if e["event"] == "step_start" and e["step"] == "a"]
+    assert len(starts) == 2 and run2["status"] == "succeeded"
+
+
+# -------------------------------------------------------------------------
+# k8s executor (fake client)
+
+
+class FakeClient:
+    def __init__(self, fail_polls=1, outcome="succeeded"):
+        self.created = []
+        self.patched = []
+        self.polls = 0
+        self.fail_polls = fail_polls
+        self.outcome = outcome
+
+    def create(self, path, manifest):
+        self.created.append((path, manifest))
+        return manifest
+
+    def patch(self, path, manifest):
+        self.patched.append((path, manifest))
+        return manifest
+
+    def get(self, path):
+        self.polls += 1
+        if self.polls <= self.fail_polls:
+            return {"status": {"active": 1}}
+        return {"status": {self.outcome: 1}}
+
+
+def test_k8s_job_executor_success():
+    client = FakeClient(fail_polls=2)
+    ex = K8sJobExecutor(client, namespace="ml", sleep=lambda _d: None)
+    step = Step("train-step", ["python3", "-m", "x"],
+                image="ghcr.io/img:1", env={"WORKFLOW_RUN_ID": "r1",
+                                            "A": "b"})
+    result = ex.execute(step, timeout=60)
+    assert result.ok
+    path, manifest = client.created[0]
+    assert path == "/apis/batch/v1/namespaces/ml/jobs"
+    assert manifest["spec"]["backoffLimit"] == 0  # engine owns retries
+    container = manifest["spec"]["template"]["spec"]["containers"][0]
+    assert container["image"] == "ghcr.io/img:1"
+    assert container["command"] == ["python3", "-m", "x"]
+    assert manifest["metadata"]["name"].startswith("r1-train-step")
+
+
+def test_k8s_job_executor_failure_and_timeout():
+    ex = K8sJobExecutor(FakeClient(fail_polls=0, outcome="failed"),
+                        sleep=lambda _d: None)
+    assert ex.execute(Step("s", ["x"]), timeout=60).rc == 1
+
+    class NeverDone(FakeClient):
+        def get(self, path):
+            return {"status": {"active": 1}}
+
+    ex = K8sJobExecutor(NeverDone(), sleep=lambda _d: None, poll=0.0)
+    assert ex.execute(Step("s", ["x"]), timeout=-1).rc == 124
+
+
+def test_k8s_job_retry_names_and_409_tolerance():
+    """Each attempt creates a distinctly-named Job; a 409 on create (lost
+    response replayed, or a prior orchestrator died post-create) polls the
+    existing Job instead of failing."""
+    from kubernetes_cloud_tpu.deploy.k8s_client import ApiError
+
+    client = FakeClient(fail_polls=0)
+    ex = K8sJobExecutor(client, sleep=lambda _d: None)
+    step = Step("s", ["x"], env={"WORKFLOW_RUN_ID": "r1"})
+    ex.execute(step, timeout=60, attempt=0)
+    ex.execute(step, timeout=60, attempt=1)
+    names = [m["metadata"]["name"] for _p, m in client.created]
+    assert names == ["r1-s-a0", "r1-s-a1"]
+    # the attempt suffix survives the 63-char DNS-label truncation
+    long_step = Step("x" * 70, ["x"], env={"WORKFLOW_RUN_ID": "r1"})
+    manifest = ex.job_manifest(long_step, "r1", attempt=7)
+    name = manifest["metadata"]["name"]
+    assert len(name) <= 63 and name.endswith("-a7")
+
+    class Conflict(FakeClient):
+        def create(self, path, manifest):
+            raise ApiError(409, "exists")
+
+    assert K8sJobExecutor(Conflict(fail_polls=0),
+                          sleep=lambda _d: None).execute(
+        step, timeout=60).ok
+
+
+def test_engine_skipped_step_output_renders_empty(tmp_path):
+    """A sentinel-skipped step has no captured stdout; downstream
+    {{steps.x.outputs.result}} resolves to '' instead of crashing."""
+    artifact = tmp_path / "a.txt"
+    artifact.write_text("done")
+    spec = WorkflowSpec("t", steps=[
+        Step("make", [PY, "-c", "print('never runs')"],
+             artifacts=[str(artifact)]),
+        Step("use", [PY, "-c",
+                     "print('got:[{{steps.make.outputs.result}}]')"],
+             deps=["make"]),
+    ])
+    result = WorkflowRun(spec, str(tmp_path / "run")).run()
+    assert result["status"] == "succeeded"
+    assert result["outputs"]["use"] == "got:[]"
+
+
+def test_engine_bad_template_fails_step_not_engine(tmp_path):
+    spec = WorkflowSpec("t", steps=[
+        Step("bad", [PY, "-c", "print('{{workflow.parameters.nope}}')"]),
+    ])
+    result = WorkflowRun(spec, str(tmp_path / "run")).run()
+    assert result["status"] == "failed"
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    finish = [e for e in events if e["event"] == "step_finish"][0]
+    assert "TemplateError" in finish["stderr"]
+    assert events[-1]["event"] == "workflow_finish"  # clean shutdown
+
+
+def test_k8s_resource_apply():
+    client = FakeClient()
+    ex = K8sJobExecutor(client, namespace="ml")
+    manifest = ("apiVersion: serving.kserve.io/v1beta1\n"
+                "kind: InferenceService\n"
+                "metadata:\n  name: svc-1\n")
+    result = ex.execute(Step("apply", [], manifest=manifest))
+    assert result.ok and result.output == "svc-1"
+    path, body = client.created[0]
+    assert path == ("/apis/serving.kserve.io/v1beta1/namespaces/ml/"
+                    "inferenceservices")
+    assert body["kind"] == "InferenceService"
+
+
+# -------------------------------------------------------------------------
+# argo importer over the shipped manifests
+
+
+def test_import_finetune_workflow():
+    spec = load_argo_workflow(os.path.join(
+        REPO, "deploy", "finetuner-workflow", "finetune-workflow.yaml"))
+    assert len(spec.parameters) == 56  # reference parity (SURVEY §5.6)
+    assert spec.parameters["run_name"] is None  # required
+    order = spec.validate()
+    assert order == ["check-model", "model-downloader",
+                     "dataset-downloader", "tokenizer", "finetuner",
+                     "inference-service"]
+    # retryStrategy carried over
+    assert spec.step("model-downloader").retry.limit == 1
+    # sequential groups: each step depends on the previous group
+    assert spec.step("tokenizer").deps == ["dataset-downloader"]
+    # container command became argv, carried verbatim (executors own any
+    # local remapping)
+    dl = spec.step("model-downloader")
+    assert dl.command[:3] == ["python3", "-m",
+                              "kubernetes_cloud_tpu.data.downloader"]
+    # inputs substituted: the step's --model arg templates the workflow param
+    assert "{{workflow.parameters.model}}" in " ".join(dl.command)
+    assert "{{inputs.parameters" not in " ".join(dl.command)
+    # resource template kept for the k8s executor
+    isvc = spec.step("inference-service")
+    assert isvc.executor == "k8s" and "InferenceService" in isvc.manifest
+    # when conditions preserved
+    assert spec.step("finetuner").when
+
+
+def test_import_withparam_fanout():
+    path = os.path.join(REPO, "deploy", "argo-workflow",
+                        "tpu-say-workflow.yaml")
+    spec = load_argo_workflow(path)
+    names = [s.name for s in spec.steps]
+    assert names == [f"tpu-say-{i}" for i in range(4)]
+    # {{item}} substituted into each instance
+    assert any("Hello" in " ".join(s.command) for s in spec.steps)
+    assert all(s.retry.limit == 1 for s in spec.steps)
+
+    # -p overrides reshape the fan-out at import time
+    spec2 = load_argo_workflow(path, {"messages": '["x", "y"]'})
+    assert [s.name for s in spec2.steps] == ["tpu-say-0", "tpu-say-1"]
+    assert any("y" in " ".join(s.command) for s in spec2.steps)
+
+
+def test_import_missing_required_input_errors(tmp_path):
+    """A defaultless template input with no supplied argument is an import
+    error, not a literal 'None' in the argv."""
+    doc = """
+apiVersion: argoproj.io/v1alpha1
+kind: Workflow
+metadata: {generateName: broken-}
+spec:
+  entrypoint: main
+  templates:
+    - name: main
+      steps:
+        - - name: s1
+            template: worker
+    - name: worker
+      inputs:
+        parameters:
+          - name: dest
+      container:
+        image: img
+        command: [run, "{{inputs.parameters.dest}}"]
+"""
+    path = tmp_path / "w.yaml"
+    path.write_text(doc)
+    with pytest.raises(SpecError, match="'dest' not supplied"):
+        load_argo_workflow(str(path))
+
+
+def test_imported_tokenizer_command_remapped_locally_only():
+    """The spec keeps the container's verbatim argv (so --executor k8s
+    ships the image's own binary); only the local executor remaps it to
+    the in-tree CLI."""
+    from kubernetes_cloud_tpu.workflow.executors import LocalExecutor
+
+    spec = load_argo_workflow(os.path.join(
+        REPO, "deploy", "finetuner-workflow", "finetune-workflow.yaml"))
+    tok = spec.step("tokenizer")
+    assert tok.command[0] == "/usr/local/bin/dataset_tokenizer"
+    argv = LocalExecutor()._argv(tok)
+    assert argv[:3] == [
+        sys.executable, "-m", "kubernetes_cloud_tpu.data.tokenizer_cli"]
+    assert argv[3:] == tok.command[1:]
+
+
+def test_engine_unregistered_executor_fails_step(tmp_path):
+    spec = WorkflowSpec("t", steps=[
+        Step("apply", [], executor="k8s", manifest="kind: X"),
+    ])
+    result = WorkflowRun(spec, str(tmp_path / "run")).run()
+    assert result["status"] == "failed"
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    finish = [e for e in events if e["event"] == "step_finish"][0]
+    assert "no 'k8s' executor registered" in finish["stderr"]
+    assert events[-1]["event"] == "workflow_finish"
+
+
+# -------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_list_and_import(tmp_path, capsys):
+    from kubernetes_cloud_tpu.workflow.cli import main
+
+    assert main(["list"]) == 0
+    assert "finetune-and-serve" in capsys.readouterr().out
+
+    out = tmp_path / "spec.json"
+    rc = main(["import",
+               os.path.join(REPO, "deploy", "finetuner-workflow",
+                            "finetune-workflow.yaml"),
+               "-o", str(out)])
+    assert rc == 0
+    spec = WorkflowSpec.from_dict(json.loads(out.read_text()))
+    assert len(spec.steps) == 6
+
+
+def test_cli_run_spec_file_and_status(tmp_path, capsys):
+    from kubernetes_cloud_tpu.workflow.cli import main
+
+    spec = WorkflowSpec("mini", parameters={"msg": None}, steps=[
+        Step("hello", [PY, "-c", "print('{{workflow.parameters.msg}}')"]),
+    ])
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps(spec.to_dict()))
+    workdir = tmp_path / "run"
+    rc = main(["run", str(path), "-p", "msg=yo", "--workdir", str(workdir)])
+    assert rc == 0
+    assert "hello" in capsys.readouterr().out
+    rc = main(["status", "--workdir", str(workdir)])
+    assert rc == 0
+    assert "succeeded" in capsys.readouterr().out
+
+
+def test_cli_run_missing_required_param(tmp_path, capsys):
+    from kubernetes_cloud_tpu.workflow.cli import main
+
+    spec = WorkflowSpec("mini", parameters={"msg": None}, steps=[
+        Step("hello", [PY, "-c", "print('x')"]),
+    ])
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps(spec.to_dict()))
+    rc = main(["run", str(path), "--workdir", str(tmp_path / "r")])
+    assert rc == 2
+    assert "missing required" in capsys.readouterr().out

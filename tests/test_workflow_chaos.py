@@ -1,0 +1,105 @@
+"""Orchestrator preemption chaos: SIGKILL the workflow runner mid-step,
+rerun, and verify completed steps are skipped and the run finishes.
+
+Extends the ``tests/test_chaos.py`` subprocess pattern one layer up the
+stack — there the *trainer* is killed; here the *orchestrator* is, which
+is exactly what a GKE node preemption does to an in-cluster runner
+(SURVEY §5.3: stricter than the reference's restart hack at
+``gpt-neox/04-finetune-workflow.yaml:420-425``).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(tmp_path):
+    """step1 writes its artifact quickly; step2 sleeps a parameterized
+    time before writing its own — the kill window."""
+    py = sys.executable
+    a_out = str(tmp_path / "a.txt")
+    b_out = str(tmp_path / "b.txt")
+    return {
+        "name": "chaos",
+        "parameters": {"sleep": "30"},
+        "steps": [
+            {"name": "fast", "artifacts": [a_out],
+             "command": [py, "-c",
+                         f"open({a_out!r}, 'w').write('A')"]},
+            {"name": "slow", "deps": ["fast"], "artifacts": [b_out],
+             "command": [py, "-c",
+                         "import time,sys; "
+                         "time.sleep(float('{{workflow.parameters.sleep}}'"
+                         f")); open({b_out!r}, 'w').write('B')"]},
+        ],
+    }
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _cli(spec_path, workdir, sleep):
+    return [sys.executable, "-m", "kubernetes_cloud_tpu.workflow", "run",
+            str(spec_path), "--workdir", str(workdir),
+            "-p", f"sleep={sleep}"]
+
+
+def test_kill_workflow_and_resume(tmp_path):
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(_spec(tmp_path)))
+    workdir = tmp_path / "run"
+
+    # phase 1: kill the orchestrator while 'slow' is mid-run
+    p = subprocess.Popen(_cli(spec_path, workdir, sleep=30), env=_env(),
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 120
+    try:
+        while time.monotonic() < deadline:
+            if (tmp_path / "a.txt").exists():
+                time.sleep(0.5)  # let 'slow' start
+                p.send_signal(signal.SIGKILL)
+                break
+            if p.poll() is not None:
+                raise AssertionError(
+                    "runner exited early:\n"
+                    + p.stdout.read().decode(errors="replace"))
+            time.sleep(0.1)
+        else:
+            raise AssertionError("fast step never produced its artifact")
+        p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert not (tmp_path / "b.txt").exists()
+    a_mtime = os.path.getmtime(tmp_path / "a.txt")
+
+    # phase 2: rerun (short sleep) — must resume, not restart
+    out = subprocess.run(_cli(spec_path, workdir, sleep=0), env=_env(),
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert (tmp_path / "b.txt").read_text() == "B"
+    # completed step was skipped: artifact untouched...
+    assert os.path.getmtime(tmp_path / "a.txt") == a_mtime
+    # ...and the event log says so explicitly
+    from kubernetes_cloud_tpu.workflow.events import read_events
+
+    events = read_events(str(workdir / "events.jsonl"))
+    skips = [e for e in events if e["event"] == "step_skipped"
+             and e["step"] == "fast"]
+    assert skips and skips[-1]["reason"] in ("prior-state",
+                                             "sentinel-complete")
+    starts = [e for e in events if e["event"] == "step_start"
+              and e["step"] == "fast"]
+    assert len(starts) == 1  # only the first run ever executed it
+
+    state = json.loads((workdir / "state.json").read_text())
+    assert state["steps"]["fast"]["status"] in ("succeeded", "skipped")
+    assert state["steps"]["slow"]["status"] == "succeeded"
