@@ -142,6 +142,50 @@ def decode_step(cfg: CausalLMConfig, params: Params, token: jax.Array,
     return _unembed(cfg, params, x)[:, 0], cache
 
 
+def prefill_into_slots(cfg: CausalLMConfig, params: Params,
+                       input_ids: jax.Array, attention_mask: jax.Array,
+                       pool: dict, slot_ids: jax.Array
+                       ) -> tuple[jax.Array, dict]:
+    """Prefill a new request batch and scatter its K/V into pool rows.
+
+    ``pool`` is a persistent slot-based cache (``init_cache`` with
+    batch = SLOTS); ``slot_ids`` [B] names the rows the scheduler
+    assigned.  Runs the ordinary :func:`prefill` into a scratch cache of
+    the pool's ``max_len`` so the block math (and therefore numerics)
+    cannot diverge from one-shot generation, then writes the rows in.
+    Returns (last-real-token logits [B, V], pool).
+    """
+    b = input_ids.shape[0]
+    max_len = pool["k"].shape[2]
+    scratch = init_cache(cfg, b, max_len, pool["k"].dtype)
+    logits, scratch = prefill(cfg, params, input_ids, attention_mask,
+                              scratch)
+    pool = dict(pool)
+    pool["k"] = pool["k"].at[:, slot_ids].set(scratch["k"])
+    pool["v"] = pool["v"].at[:, slot_ids].set(scratch["v"])
+    pool["length"] = pool["length"].at[slot_ids].set(scratch["length"])
+    return logits, pool
+
+
+def decode_step_slots(cfg: CausalLMConfig, params: Params, tokens: jax.Array,
+                      pool: dict, active: jax.Array
+                      ) -> tuple[jax.Array, dict]:
+    """One decode iteration for every slot in the pool.
+
+    ``tokens`` [SLOTS] is each slot's previously sampled token (pad for
+    free slots); ``active`` [SLOTS] bool masks slots holding a request.
+    Reuses :func:`decode_step`'s block math unchanged — attention is
+    row-independent, so free slots cost FLOPs but cannot perturb active
+    rows.  Free slots stay frozen: their length does not advance, and
+    their (garbage) K/V write lands at their reset position 0, which the
+    next admission's prefill overwrites.  Returns (logits [SLOTS, V],
+    pool).
+    """
+    logits, new = decode_step(cfg, params, tokens, pool)
+    new["length"] = jnp.where(active, new["length"], pool["length"])
+    return logits, new
+
+
 def sample_token(logits: jax.Array, rng: jax.Array, *, temperature: float,
                  top_k: int, top_p: float) -> jax.Array:
     """Temperature / top-k / top-p sampling; temperature 0 = greedy."""

@@ -4,3 +4,8 @@ from kubernetes_cloud_tpu.serve.lm_service import (  # noqa: F401
     ByteTokenizer,
     CausalLMService,
 )
+from kubernetes_cloud_tpu.serve.continuous import (  # noqa: F401
+    ContinuousBatchingEngine,
+    ContinuousBatchingModel,
+    EngineConfig,
+)

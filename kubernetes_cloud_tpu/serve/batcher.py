@@ -29,7 +29,7 @@ import queue
 import threading
 from typing import Any, Callable, Mapping, Optional, Sequence
 
-from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.model import Model, parse_instances
 
 log = logging.getLogger(__name__)
 
@@ -142,19 +142,24 @@ class BatchingModel(Model):
         self._thread.start()
         self.ready = True
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # ready flips False over a live dispatcher; load() refuses
+                # to start a second one until it actually exits — surface
+                # that instead of letting load() discover it later.
+                log.warning(
+                    "batcher %s dispatcher did not stop within %.1f s "
+                    "(batch still executing); call stop() again before "
+                    "load()", self.name, timeout)
         self.ready = False
 
     # -- request side ------------------------------------------------------
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
-        instances = payload.get("instances")
-        if not isinstance(instances, list) or not instances:
-            raise ValueError(
-                'payload needs a non-empty {"instances": [...]}')
+        instances = parse_instances(payload)
         if len(instances) > self.cfg.max_batch_size:
             raise ValueError(
                 f"request carries {len(instances)} instances > "

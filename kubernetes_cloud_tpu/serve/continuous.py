@@ -1,0 +1,620 @@
+"""Continuous-batching decode engine — iteration-level scheduling.
+
+The request-level batcher (:mod:`kubernetes_cloud_tpu.serve.batcher`)
+coalesces queued requests into ONE batch and runs it to completion:
+throughput is gated by the longest completion in each wave, and the MXU
+idles between waves.  This module replaces run-to-completion generation
+with Orca-style iteration-level scheduling (OSDI '22; the technique
+behind vLLM, see PAPERS.md): a persistent slot-based KV pool
+(``[L, SLOTS, max_len, Hkv, Dh]``, slots shard over the mesh like the
+one-shot cache) plus a host-side scheduler that every iteration
+
+1. admits queued requests into free slots (one compiled
+   ``prefill_into_slots`` per prompt-length bucket),
+2. steps the whole active batch one token (``decode_step_slots`` — ONE
+   compiled program, reused forever),
+3. emits each slot's token to its waiting request (token streaming), and
+4. evicts slots on EOS / max-tokens / cancel, so the next queued request
+   starts immediately instead of waiting for the batch.
+
+Decode therefore always runs near-full regardless of how request
+lengths mix.  Sampling runs host-side per slot (each request carries
+its own temperature/top-k/top-p/seed — requests never need
+parameter-compatible merging like the Triton-style batcher requires).
+
+Contract parity with :class:`~kubernetes_cloud_tpu.serve.batcher.
+BatchingModel`: ``self_batching = True`` (ModelServer skips its
+per-model lock), bounded queue with
+:class:`~kubernetes_cloud_tpu.serve.batcher.QueueFullError`
+backpressure (HTTP 503), and ``stop()`` drains in-flight slots before
+returning.  Correctness is locked by
+``tests/test_continuous_batching.py``: greedy outputs are
+token-identical to :func:`~kubernetes_cloud_tpu.models.generate.
+generate` for any admission order.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Any, Iterator, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig
+from kubernetes_cloud_tpu.models.generate import (
+    decode_step_slots,
+    init_cache,
+    prefill_into_slots,
+)
+from kubernetes_cloud_tpu.serve.batcher import QueueFullError
+from kubernetes_cloud_tpu.serve.model import (
+    Model,
+    instance_text,
+    parse_instances,
+)
+
+log = logging.getLogger(__name__)
+
+_STREAM_END = object()  # sentinel closing a request's token stream
+
+
+class RequestCancelled(RuntimeError):
+    """The client cancelled (or disappeared from) an in-flight request."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Knobs for the continuous-batching engine (deploy/README.md maps
+    them onto the KServe ``containerConcurrency`` contract)."""
+
+    slots: int = 8            # persistent decode batch width
+    max_len: int = 512        # KV rows per slot (prompt + completion)
+    max_queue_size: int = 256  # admission queue bound (503 beyond)
+    max_admit_per_step: int = 4  # prefills per iteration (admission policy)
+    idle_wait_s: float = 0.05  # poll interval when no slot is active
+    drain_timeout_s: float = 30.0  # stop(): max wait for in-flight slots
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError("slots must be >= 1")
+        if self.max_len < 2:
+            raise ValueError("max_len must be >= 2")
+        if self.max_queue_size < 1:
+            raise ValueError("max_queue_size must be >= 1")
+        if self.max_admit_per_step < 1:
+            raise ValueError("max_admit_per_step must be >= 1")
+
+
+class GenRequest:
+    """One in-flight generation: prompt ids in, a token stream out."""
+
+    __slots__ = ("prompt_ids", "max_new_tokens", "temperature", "top_k",
+                 "top_p", "rng", "tokens", "stream", "event", "error",
+                 "claimed", "cancelled", "submitted_at", "first_token_at",
+                 "done_at")
+
+    def __init__(self, prompt_ids: Sequence[int], *, max_new_tokens: int,
+                 temperature: float, top_k: int, top_p: float, seed: int):
+        self.prompt_ids = list(prompt_ids)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.rng = np.random.default_rng(int(seed))
+        self.tokens: list[int] = []  # emitted completion tokens
+        self.stream: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
+        self.event = threading.Event()
+        self.error: Optional[Exception] = None
+        #: set by the scheduler at admission — a claimed request occupies
+        #: a slot and WILL finish (stop() drains it)
+        self.claimed = False
+        self.cancelled = False
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self.done_at: Optional[float] = None
+
+    def cancel(self) -> None:
+        """Mark the request dead (client gone).  The scheduler purges it
+        at its next iteration — out of the bounded queue (so it can't
+        hold capacity against live clients) or out of its slot."""
+        self.cancelled = True
+
+    def iter_tokens(self, timeout: float = 60.0) -> Iterator[int]:
+        """Stream tokens as the scheduler emits them (SSE-style)."""
+        while True:
+            item = self.stream.get(timeout=timeout)
+            if item is _STREAM_END:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield item
+
+    def wait(self, engine: "ContinuousBatchingEngine") -> list[int]:
+        """Block until finished; returns emitted tokens or raises."""
+        # Bounded wait re-checking engine liveness: a request enqueued in
+        # a crash/stop race window must not hang (same shape as
+        # BatchingModel.predict's wait loop).
+        while not self.event.wait(timeout=0.5):
+            if not engine.alive and not self.event.is_set():
+                raise RuntimeError("engine stopped")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+
+def _sample_host(logits: np.ndarray, rng: np.random.Generator, *,
+                 temperature: float, top_k: int, top_p: float) -> int:
+    """Host-side mirror of :func:`models.generate.sample_token` for one
+    slot's [V] logits row.  Greedy (temperature 0) is exactly argmax, so
+    greedy decode is token-identical to the device sampler; stochastic
+    sampling matches its distribution (numpy RNG, not jax's)."""
+    if temperature == 0.0:
+        return int(logits.argmax())
+    logits = logits.astype(np.float64) / temperature
+    if 0 < top_k < logits.shape[-1]:
+        kth = np.sort(logits)[-top_k]
+        logits = np.where(logits < kth, -np.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = np.sort(logits)[::-1]
+        probs = _softmax(sorted_logits)
+        cum = np.cumsum(probs)
+        cutoff = sorted_logits[min(int((cum < top_p).sum()),
+                                   len(sorted_logits) - 1)]
+        logits = np.where(logits < cutoff, -np.inf, logits)
+    return int(rng.choice(logits.shape[-1], p=_softmax(logits)))
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - x[np.isfinite(x)].max())
+    e = np.where(np.isfinite(x), e, 0.0)
+    return e / e.sum()
+
+
+_JITTED: dict[str, Any] = {}
+
+
+def _jit_prefill():
+    # Module-level singletons so every engine instance (and every test)
+    # shares one compilation cache.  Pool buffers are donated: every
+    # iteration replaces the engine's pool reference, so the device
+    # updates K/V in place instead of copying the whole pool.
+    if "prefill" not in _JITTED:
+        _JITTED["prefill"] = jax.jit(prefill_into_slots, static_argnums=0,
+                                     donate_argnums=4)
+    return _JITTED["prefill"]
+
+
+def _jit_decode():
+    if "decode" not in _JITTED:
+        _JITTED["decode"] = jax.jit(decode_step_slots, static_argnums=0,
+                                    donate_argnums=3)
+    return _JITTED["decode"]
+
+
+class ContinuousBatchingEngine:
+    """Owns the slot pool and the scheduler thread.
+
+    Works on token ids only — tokenization/option plumbing lives in
+    :class:`ContinuousBatchingModel`.  Thread-safe: ``submit`` may be
+    called from any number of HTTP threads; one scheduler thread owns
+    the device.
+    """
+
+    def __init__(self, cfg: CausalLMConfig, params: Any,
+                 engine_cfg: EngineConfig = EngineConfig(), *,
+                 eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+                 mesh=None):
+        self.cfg = cfg
+        self.params = params
+        self.ecfg = engine_cfg
+        self.eos = eos_token_id
+        self.pad = pad_token_id
+        self.mesh = mesh
+        self.pool: Optional[dict] = None
+        self._slots: list[Optional[GenRequest]] = [None] * engine_cfg.slots
+        # deque + lock rather than queue.Queue: cancelled requests must be
+        # purgeable from the middle (a dead request sitting in a bounded
+        # queue would 503 live clients while every slot is busy)
+        self._queue: "collections.deque[GenRequest]" = collections.deque()
+        self._qlock = threading.Lock()
+        self._stop = threading.Event()
+        self._work = threading.Event()  # submit()/stop() wake the loop
+        self._thread: Optional[threading.Thread] = None
+        self._prefill = _jit_prefill()
+        self._decode = _jit_decode()
+        # iteration-level telemetry (the serving bench reads these)
+        self.stats = {"iterations": 0, "admitted": 0, "emitted_tokens": 0,
+                      "evictions": 0, "cancelled": 0, "active_slot_steps": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def draining(self) -> bool:
+        """A timed-out stop() left the scheduler still running."""
+        return self.alive and self._stop.is_set()
+
+    def start(self) -> None:
+        if self.alive:
+            if self._stop.is_set():
+                # a previous stop() timed out mid-drain; two schedulers
+                # would race the queue and the pool
+                raise RuntimeError(
+                    "previous scheduler still draining; call stop() again")
+            return
+        self._stop.clear()
+        self.pool = self._init_pool()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cb-engine")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop admitting, fail queued requests, drain in-flight slots
+        to completion, then stop the scheduler."""
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.ecfg.drain_timeout_s)
+            if self._thread.is_alive():
+                log.warning(
+                    "continuous-batching engine did not drain within "
+                    "%.0f s; scheduler thread still running",
+                    self.ecfg.drain_timeout_s)
+
+    def _init_pool(self) -> dict:
+        pool = init_cache(self.cfg, self.ecfg.slots, self.ecfg.max_len)
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from kubernetes_cloud_tpu.core.mesh import AXIS_MODEL, BATCH_AXES
+            from kubernetes_cloud_tpu.parallel.sharding import (
+                logical_to_physical,
+            )
+
+            batch_ways = 1
+            for ax in BATCH_AXES:
+                batch_ways *= self.mesh.shape.get(ax, 1)
+            if self.ecfg.slots % max(batch_ways, 1):
+                raise ValueError(
+                    f"slots ({self.ecfg.slots}) must be divisible by the "
+                    f"mesh batch ways ({batch_ways})")
+            heads = (AXIS_MODEL if self.cfg.kv_heads
+                     % max(self.mesh.shape.get(AXIS_MODEL, 1), 1) == 0
+                     else None)
+            kv = P(None, BATCH_AXES, None, heads, None)
+            pool = jax.device_put(pool, logical_to_physical(
+                {"k": kv, "v": kv, "length": P(BATCH_AXES)}, self.mesh))
+        return pool
+
+    # -- request side ------------------------------------------------------
+
+    def submit(self, prompt_ids: Sequence[int], *, max_new_tokens: int = 64,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               seed: int = 0) -> GenRequest:
+        if not prompt_ids:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt_ids) + max_new_tokens > self.ecfg.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt_ids)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the pool max_len "
+                f"({self.ecfg.max_len})")
+        if (self.cfg.pos_emb == "learned"
+                and len(prompt_ids) + max_new_tokens > self.cfg.max_seq_len):
+            # same guard as generate(): wpe gathers clamp silently beyond
+            # the table, so reject instead of degrading completions
+            raise ValueError(
+                f"prompt ({len(prompt_ids)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({self.cfg.max_seq_len}) for learned positions")
+        if self._stop.is_set() or not self.alive:
+            raise RuntimeError("engine stopped")
+        req = GenRequest(prompt_ids, max_new_tokens=max_new_tokens,
+                         temperature=temperature, top_k=top_k, top_p=top_p,
+                         seed=seed)
+        with self._qlock:
+            if len(self._queue) >= self.ecfg.max_queue_size:
+                raise QueueFullError("request queue full")
+            self._queue.append(req)
+        if self._stop.is_set():
+            # lost the race with stop(): the scheduler may already have
+            # run its final queue drain, so fail the stragglers here —
+            # every request must get its error + stream close exactly
+            # once (the queue hands each to one drainer)
+            self._fail_queued(RuntimeError("engine stopped"))
+        self._work.set()
+        return req
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        # Never die silently (a dead scheduler hangs every waiter): fail
+        # the in-flight work, rebuild the pool, keep scheduling.
+        while True:
+            stopping = self._stop.is_set()
+            if stopping:
+                self._fail_queued(RuntimeError("engine stopped"))
+            if stopping and not any(s is not None for s in self._slots):
+                return
+            try:
+                self._step(stopping)
+            except Exception as e:  # noqa: BLE001
+                log.exception("continuous-batching scheduler error; "
+                              "resetting pool")
+                self._fail_active(RuntimeError(f"engine error: {e}"))
+                self.pool = self._init_pool()
+
+    def _step(self, stopping: bool) -> None:
+        self._reap_cancelled()
+        if not stopping:
+            self._admit()
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            if not stopping:
+                self._work.clear()
+                if not self._queue:
+                    self._work.wait(self.ecfg.idle_wait_s)
+            return
+        tokens = np.full((self.ecfg.slots,), self.pad, np.int32)
+        mask = np.zeros((self.ecfg.slots,), bool)
+        for i in active:
+            tokens[i] = self._slots[i].tokens[-1]
+            mask[i] = True
+        logits, self.pool = self._decode(self.cfg, self.params,
+                                         jnp.asarray(tokens), self.pool,
+                                         jnp.asarray(mask))
+        logits = np.asarray(logits)
+        self.stats["iterations"] += 1
+        self.stats["active_slot_steps"] += len(active)
+        for i in active:
+            self._emit(i, logits[i])
+
+    def _reap_cancelled(self) -> None:
+        for i, req in enumerate(self._slots):
+            if req is not None and req.cancelled:
+                self.stats["cancelled"] += 1
+                self._finish_slot(i, error=RequestCancelled(
+                    "request cancelled"))
+        # Purge cancelled requests from anywhere in the queue, even with
+        # zero free slots — a dead request must not hold bounded queue
+        # capacity (503ing live clients) while long generations run.
+        with self._qlock:
+            dead = [r for r in self._queue if r.cancelled]
+            if dead:
+                alive = [r for r in self._queue if not r.cancelled]
+                self._queue.clear()
+                self._queue.extend(alive)
+        for req in dead:
+            self.stats["cancelled"] += 1
+            req.error = RequestCancelled("request cancelled")
+            req.stream.put(_STREAM_END)
+            req.event.set()
+
+    def _pop_queued(self) -> Optional[GenRequest]:
+        with self._qlock:
+            return self._queue.popleft() if self._queue else None
+
+    def _admit(self) -> None:
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        budget = min(len(free), self.ecfg.max_admit_per_step)
+        batch: list[GenRequest] = []
+        while len(batch) < budget:
+            req = self._pop_queued()
+            if req is None:
+                break
+            if req.cancelled:  # cancel landed after this step's purge
+                self.stats["cancelled"] += 1
+                req.error = RequestCancelled("request cancelled")
+                req.stream.put(_STREAM_END)
+                req.event.set()
+                continue
+            req.claimed = True
+            batch.append(req)
+        # One prefill dispatch per prompt-length bucket, not per request:
+        # a same-bucket burst scatters into its slots with a single
+        # program call (compile count stays bounded at
+        # #buckets x max_admit_per_step shapes).
+        by_bucket: dict[int, list[GenRequest]] = {}
+        for req in batch:
+            by_bucket.setdefault(self._bucket(len(req.prompt_ids)),
+                                 []).append(req)
+        for bucket, group in by_bucket.items():
+            slots = [free.pop(0) for _ in group]
+            ids = np.full((len(group), bucket), self.pad, np.int32)
+            mask = np.zeros((len(group), bucket), np.int32)
+            for r, req in enumerate(group):
+                ids[r, :len(req.prompt_ids)] = req.prompt_ids
+                mask[r, :len(req.prompt_ids)] = 1
+            logits, self.pool = self._prefill(
+                self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
+                self.pool, jnp.asarray(slots, jnp.int32))
+            logits = np.asarray(logits)
+            for r, (slot, req) in enumerate(zip(slots, group)):
+                self._slots[slot] = req
+                self.stats["admitted"] += 1
+                self._emit(slot, logits[r])
+
+    def _bucket(self, n: int) -> int:
+        """Power-of-two prompt bucket (same rationale as
+        ``CausalLMService._encode_batch``: log-many compiled prefill
+        shapes), clamped to the pool's max_len."""
+        bucket = 32
+        while bucket < n:
+            bucket *= 2
+        return min(bucket, self.ecfg.max_len)
+
+    def _emit(self, slot: int, logits_row: np.ndarray) -> None:
+        """Sample the slot's next token, stream it out, and evict the
+        slot if the request just finished — ordering identical to
+        :func:`models.generate.generate`'s sample→emit→check-eos loop."""
+        req = self._slots[slot]
+        tok = _sample_host(logits_row, req.rng, temperature=req.temperature,
+                           top_k=req.top_k, top_p=req.top_p)
+        if req.first_token_at is None:
+            req.first_token_at = time.monotonic()
+        req.tokens.append(tok)
+        req.stream.put(tok)
+        self.stats["emitted_tokens"] += 1
+        if ((self.eos is not None and tok == self.eos)
+                or len(req.tokens) >= req.max_new_tokens):
+            self._finish_slot(slot)
+
+    def _finish_slot(self, slot: int,
+                     error: Optional[Exception] = None) -> None:
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self.stats["evictions"] += 1
+        # Reset the freed row's length so the frozen-slot K/V write in
+        # decode_step_slots stays at position 0 until the next admission.
+        self.pool = dict(self.pool)
+        self.pool["length"] = self.pool["length"].at[slot].set(0)
+        req.error = error
+        req.done_at = time.monotonic()
+        req.stream.put(_STREAM_END)
+        req.event.set()
+
+    def _fail_queued(self, err: Exception) -> None:
+        with self._qlock:
+            drained = list(self._queue)
+            self._queue.clear()
+        for req in drained:
+            req.error = err
+            req.stream.put(_STREAM_END)
+            req.event.set()
+
+    def _fail_active(self, err: Exception) -> None:
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[i] = None
+                req.error = err
+                req.done_at = time.monotonic()
+                req.stream.put(_STREAM_END)
+                req.event.set()
+
+
+class ContinuousBatchingModel(Model):
+    """Serve a :class:`~kubernetes_cloud_tpu.serve.lm_service.
+    CausalLMService` through the continuous-batching engine.
+
+    Drop-in alternative to wrapping the service in ``BatchingModel``:
+    same V1 predict / completion surface, same ``self_batching``
+    contract (ModelServer skips its lock), same ``QueueFullError``
+    backpressure.  Requests are tokenized on the HTTP thread, submitted
+    per-prompt (no parameter-compatibility merging needed), and decoded
+    as their slots finish.
+    """
+
+    self_batching = True
+
+    def __init__(self, name: str, service, cfg: EngineConfig = EngineConfig()):
+        super().__init__(name)
+        self.service = service
+        self.cfg = cfg
+        self.engine: Optional[ContinuousBatchingEngine] = None
+
+    def load(self) -> None:
+        if self.engine is not None and self.engine.draining:
+            # flipping ready=True over a stopped-but-draining engine
+            # would make every predict 500 until someone load()s again
+            raise RuntimeError(
+                "previous engine still draining; call stop() again")
+        if not self.service.ready:
+            self.service.load()
+        if self.engine is None or not self.engine.alive:
+            tok = self.service.tokenizer
+            self.engine = ContinuousBatchingEngine(
+                self.service.cfg, self.service.params, self.cfg,
+                eos_token_id=getattr(tok, "eos_token_id", None),
+                pad_token_id=getattr(tok, "pad_token_id", 0) or 0,
+                mesh=self.service.mesh)
+            self.engine.start()
+        self.ready = True
+
+    def stop(self) -> None:
+        if self.engine is not None:
+            self.engine.stop()
+        self.ready = False
+
+    # -- request side ------------------------------------------------------
+
+    def _submit_all(self, prompts: Sequence[str],
+                    opts: Mapping[str, Any]) -> list[GenRequest]:
+        if self.engine is None or not self.ready:
+            raise RuntimeError("engine stopped")
+        tok = self.service.tokenizer
+        reqs: list[GenRequest] = []
+        try:
+            for i, p in enumerate(prompts):
+                reqs.append(self.engine.submit(
+                    tok.encode(p),
+                    max_new_tokens=max(1, min(int(opts["MAX_NEW_TOKENS"]),
+                                              2048)),
+                    temperature=float(opts["TEMPERATURE"]),
+                    top_k=int(opts["TOP_K"]),
+                    top_p=float(opts["TOP_P"]),
+                    seed=int(opts["SEED"]) + i))
+        except Exception:
+            for r in reqs:  # don't orphan already-queued siblings
+                r.cancel()
+            raise
+        return reqs
+
+    def _finish(self, req: GenRequest, opts: Mapping[str, Any]) -> dict:
+        toks = req.wait(self.engine)
+        tok = self.service.tokenizer
+        pad = getattr(tok, "pad_token_id", None)
+        eos = getattr(tok, "eos_token_id", None)
+        kept = [t for t in toks if t != pad and t != eos]
+        out_ids = kept
+        if opts.get("ECHO_PROMPT"):
+            # token-level echo, one decode call — byte-compatible with
+            # CausalLMService.generate_outputs for any tokenizer
+            out_ids = [t for t in req.prompt_ids
+                       if t != pad and t != eos] + kept
+        return {"generated_text": tok.decode(out_ids),
+                "tokens_out": len(kept)}
+
+    def predict(self, payload: Mapping[str, Any]) -> dict:
+        prompts = [instance_text(i) for i in parse_instances(payload)]
+        opts = self.service.configure_request(payload)
+        reqs = self._submit_all(prompts, opts)
+        return {"predictions": [self._finish(r, opts) for r in reqs]}
+
+    def completion(self, payload: Mapping[str, Any]) -> dict:
+        prompt = payload.get("prompt", "")
+        opts = self.service.completion_options(payload)
+        req = self._submit_all([prompt], opts)[0]
+        return {"completion": self._finish(req, opts)["generated_text"]}
+
+
+def load_engine_config(model_dir: str) -> EngineConfig:
+    """Read continuous-batching knobs from ``model_config.json`` (the
+    same file the dynamic batcher reads), ``continuous_batching`` key."""
+    import json
+    import os
+
+    path = os.path.join(model_dir, "model_config.json")
+    if not os.path.exists(path):
+        return EngineConfig()
+    with open(path) as f:
+        raw = json.load(f)
+    cb = raw.get("continuous_batching") or {}
+    base = EngineConfig()
+    return EngineConfig(
+        slots=int(cb.get("slots", base.slots)),
+        max_len=int(cb.get("max_len", base.max_len)),
+        max_queue_size=int(cb.get("max_queue_size", base.max_queue_size)),
+        max_admit_per_step=int(cb.get("max_admit_per_step",
+                                      base.max_admit_per_step)),
+    )

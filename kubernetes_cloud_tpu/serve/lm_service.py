@@ -27,7 +27,11 @@ from kubernetes_cloud_tpu.parallel.sharding import (
     logical_to_physical,
     param_specs,
 )
-from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.model import (
+    Model,
+    instance_text,
+    parse_instances,
+)
 from kubernetes_cloud_tpu.weights.tensorstream import load_pytree
 
 log = logging.getLogger(__name__)
@@ -147,8 +151,11 @@ class CausalLMService(Model):
             mask[i, : len(e)] = 1
         return jnp.asarray(ids), jnp.asarray(mask)
 
-    def generate_texts(self, prompts: Sequence[str],
-                       opts: Mapping[str, Any]) -> list[str]:
+    def generate_outputs(self, prompts: Sequence[str],
+                         opts: Mapping[str, Any]) -> list[dict]:
+        """Generate; returns ``{"generated_text", "tokens_out"}`` per
+        prompt (``tokens_out`` = completion tokens excluding pad/eos, the
+        figure the load test aggregates into end-to-end tokens/s)."""
         ids, mask = self._encode_batch(prompts)
         t0 = time.perf_counter()
         out = self._generate(
@@ -163,38 +170,50 @@ class CausalLMService(Model):
         )
         out = np.asarray(jax.block_until_ready(out))
         log.info("INFERENCE TIME: %.2fs", time.perf_counter() - t0)
-        texts = []
+        outputs = []
         prompt_lens = np.asarray(mask.sum(-1))
+        pad = getattr(self.tokenizer, "pad_token_id", None)
+        eos = getattr(self.tokenizer, "eos_token_id", None)
         for i, row in enumerate(out):
-            start = 0 if opts.get("ECHO_PROMPT") else int(prompt_lens[i])
-            pad = getattr(self.tokenizer, "pad_token_id", None)
-            eos = getattr(self.tokenizer, "eos_token_id", None)
-            toks = [t for t in row[start:].tolist()
-                    if t != pad and t != eos]
-            texts.append(self.tokenizer.decode(toks))
-        return texts
+            plen = int(prompt_lens[i])
+            completion = [t for t in row[plen:].tolist()
+                          if t != pad and t != eos]
+            toks = completion
+            if opts.get("ECHO_PROMPT"):
+                toks = [t for t in row[:plen].tolist()
+                        if t != pad and t != eos] + completion
+            outputs.append({"generated_text": self.tokenizer.decode(toks),
+                            "tokens_out": len(completion)})
+        return outputs
+
+    def generate_texts(self, prompts: Sequence[str],
+                       opts: Mapping[str, Any]) -> list[str]:
+        return [o["generated_text"]
+                for o in self.generate_outputs(prompts, opts)]
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
-        instances = payload.get("instances")
-        if instances is None:
-            raise ValueError('payload must contain "instances"')
-        prompts = [inst["text"] if isinstance(inst, Mapping) else str(inst)
-                   for inst in instances]
+        prompts = [instance_text(i) for i in parse_instances(payload)]
         opts = self.configure_request(payload)
-        texts = self.generate_texts(prompts, opts)
-        return {"predictions": [{"generated_text": t} for t in texts]}
+        return {"predictions": self.generate_outputs(prompts, opts)}
+
+    #: FastAPI-completion body keys → OPTIONS keys; shared by every
+    #: completion route (one-shot here, continuous-batching wrapper)
+    COMPLETION_ALIASES = {"max_new_tokens": "MAX_NEW_TOKENS",
+                          "temperature": "TEMPERATURE", "top_k": "TOP_K",
+                          "top_p": "TOP_P", "seed": "SEED"}
+
+    def completion_options(self, payload: Mapping[str, Any]) -> dict:
+        opts = self.default_options()
+        for key, target in self.COMPLETION_ALIASES.items():
+            if key in payload:
+                opts[target] = payload[key]
+        return opts
 
     def completion(self, payload: Mapping[str, Any]) -> dict:
         """FastAPI-completion-compatible route (reference
         ``inference.py:43-56``: prompt + max_new_tokens/temperature/...)."""
         prompt = payload.get("prompt", "")
-        opts = self.default_options()
-        alias = {"max_new_tokens": "MAX_NEW_TOKENS",
-                 "temperature": "TEMPERATURE", "top_k": "TOP_K",
-                 "top_p": "TOP_P", "seed": "SEED"}
-        for key, target in alias.items():
-            if key in payload:
-                opts[target] = payload[key]
+        opts = self.completion_options(payload)
         text = self.generate_texts([prompt], opts)[0]
         return {"completion": text}
 
@@ -259,6 +278,16 @@ def main(argv: Optional[list] = None) -> int:
                     help="tensor-parallel ways (model mesh axis)")
     ap.add_argument("--max-batch-size", type=int, default=0,
                     help=">0 wraps the service in the dynamic batcher")
+    ap.add_argument("--continuous-batching", action="store_true",
+                    help="serve through the slot-based continuous-"
+                         "batching engine instead of the request-level "
+                         "dynamic batcher (serve/continuous.py)")
+    ap.add_argument("--slots", type=int, default=0,
+                    help="continuous batching: persistent decode batch "
+                         "width (default from model_config.json)")
+    ap.add_argument("--pool-max-len", type=int, default=0,
+                    help="continuous batching: KV rows per slot "
+                         "(prompt + completion)")
     ap.add_argument("--max-seq-len", type=int, default=0)
     ap.add_argument("--config", default=None,
                     help="model_config.json for batcher knobs")
@@ -313,7 +342,20 @@ def main(argv: Optional[list] = None) -> int:
             return 1
         print(json.dumps(out))
         return 0
-    if args.max_batch_size > 0 or args.config:
+    if args.continuous_batching:
+        from kubernetes_cloud_tpu.serve.continuous import (
+            ContinuousBatchingModel,
+            load_engine_config,
+        )
+
+        ecfg = load_engine_config(os.path.dirname(args.config)
+                                  if args.config else model_dir)
+        if args.slots > 0:
+            ecfg = dataclasses.replace(ecfg, slots=args.slots)
+        if args.pool_max_len > 0:
+            ecfg = dataclasses.replace(ecfg, max_len=args.pool_max_len)
+        svc = ContinuousBatchingModel(svc.name, svc, ecfg)
+    elif args.max_batch_size > 0 or args.config:
         from kubernetes_cloud_tpu.serve.batcher import (
             BatchingModel,
             load_model_config,

@@ -38,6 +38,9 @@ class Result:
     latency: float
     status: int
     error: str = ""
+    #: generated tokens reported by the response (LM endpoints attach
+    #: ``tokens_out`` per prediction); 0 for non-LM payloads
+    tokens_out: int = 0
 
     @property
     def ok(self) -> bool:
@@ -59,6 +62,7 @@ class Summary:
 
     def stats(self) -> dict:
         lat = sorted(r.latency for r in self.results if r.ok)
+        toks = sum(r.tokens_out for r in self.results if r.ok)
 
         def pct(p: float):
             if not lat:
@@ -80,8 +84,25 @@ class Summary:
             "latency_max_s": round(max(lat), 4) if lat else None,
             "latency_p50_s": pct(0.50),
             "latency_p90_s": pct(0.90),
+            "latency_p95_s": pct(0.95),
             "latency_p99_s": pct(0.99),
+            # end-to-end generation throughput (not just request rate):
+            # only meaningful for LM endpoints that report tokens_out
+            "tokens_out_total": toks,
+            "tokens_out_per_sec": round(toks / self.total_time, 4),
         }
+
+
+def _count_tokens_out(body: bytes) -> int:
+    """Sum ``tokens_out`` fields from a V1 response body (LM endpoints
+    attach one per prediction); 0 for any other response shape."""
+    try:
+        obj = json.loads(body)
+        return sum(int(p.get("tokens_out", 0))
+                   for p in obj.get("predictions", [])
+                   if isinstance(p, dict))
+    except (ValueError, TypeError, AttributeError):
+        return 0
 
 
 def _one_request(url: str, payload: bytes, timeout: float) -> Result:
@@ -90,8 +111,9 @@ def _one_request(url: str, payload: bytes, timeout: float) -> Result:
         req = urllib.request.Request(
             url, data=payload, headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            resp.read()
-            return Result(time.monotonic() - t0, resp.status)
+            body = resp.read()
+            return Result(time.monotonic() - t0, resp.status,
+                          tokens_out=_count_tokens_out(body))
     except Exception as e:  # noqa: BLE001 - goodput counts all failures
         return Result(time.monotonic() - t0, 0, str(e))
 

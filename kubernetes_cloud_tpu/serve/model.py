@@ -16,6 +16,20 @@ import os
 from typing import Any, Mapping
 
 
+def parse_instances(payload: Mapping[str, Any]) -> list:
+    """V1 data-plane ``instances`` validation, shared by every batching
+    predictor (one error message, one shape rule)."""
+    instances = payload.get("instances")
+    if not isinstance(instances, list) or not instances:
+        raise ValueError('payload needs a non-empty {"instances": [...]}')
+    return instances
+
+
+def instance_text(inst: Any) -> str:
+    """A V1 instance is either a bare string or ``{"text": ...}``."""
+    return inst["text"] if isinstance(inst, Mapping) else str(inst)
+
+
 class Model:
     def __init__(self, name: str):
         self.name = name

@@ -78,26 +78,32 @@ class ModelServer:
 
         return 405, {"error": "method not allowed"}
 
+    def _dispatch(self, model: Model, fn, payload: dict,
+                  what: str) -> tuple[int, dict]:
+        """Shared model-call ladder: self-batching lock bypass (batchers
+        coalesce concurrent requests themselves; the per-model lock
+        would serialize them and defeat batching) + the error → status
+        mapping, identical for every data-plane route."""
+        try:
+            if getattr(model, "self_batching", False):
+                return 200, fn(payload)
+            with self.locks[model.name]:
+                return 200, fn(payload)
+        except ValueError as e:  # request validation problems
+            return 400, {"error": str(e)}
+        except QueueFullError as e:  # backpressure: retriable overload
+            return 503, {"error": str(e)}
+        except Exception as e:  # surface as a 500, keep serving
+            log.exception("%s failed", what)
+            return 500, {"error": str(e)}
+
     def _predict(self, name: str, payload: dict) -> tuple[int, dict]:
         model = self.models.get(name)
         if model is None:
             return 404, {"error": f"model {name} not found"}
         if not model.ready:
             return 503, {"error": f"model {name} is not ready"}
-        try:
-            if getattr(model, "self_batching", False):
-                # dynamic batchers coalesce concurrent requests; the
-                # per-model lock would serialize them and defeat batching
-                return 200, model.predict(payload)
-            with self.locks[name]:
-                return 200, model.predict(payload)
-        except ValueError as e:  # request validation problems
-            return 400, {"error": str(e)}
-        except QueueFullError as e:  # backpressure: retriable overload
-            return 503, {"error": str(e)}
-        except Exception as e:  # surface as a 500, keep serving
-            log.exception("predict failed")
-            return 500, {"error": str(e)}
+        return self._dispatch(model, model.predict, payload, "predict")
 
     def _completion(self, payload: dict) -> tuple[int, dict]:
         capable = [(n, m) for n, m in self.models.items()
@@ -107,14 +113,8 @@ class ModelServer:
         for name, model in capable:
             if not model.ready:
                 continue
-            try:
-                with self.locks[name]:
-                    return 200, model.completion(payload)
-            except ValueError as e:
-                return 400, {"error": str(e)}
-            except Exception as e:
-                log.exception("completion failed")
-                return 500, {"error": str(e)}
+            return self._dispatch(model, model.completion, payload,
+                                  "completion")
         return 503, {"error": "completion model is not ready"}
 
     # -- http plumbing -----------------------------------------------------

@@ -150,6 +150,21 @@ def test_stop_fails_pending():
         m.predict({"instances": ["late"]})
 
 
+def test_stop_timeout_warns_over_live_dispatcher(caplog):
+    """stop() returning with the dispatcher still mid-batch used to be
+    silent (ready flipped False over a live thread; only a later load()
+    noticed) — it must warn."""
+    m, _ = make(delay=0.6)
+    t = threading.Thread(target=lambda: m.predict({"instances": ["x"]}))
+    t.start()
+    time.sleep(0.2)  # batch is now executing inside the dispatcher
+    with caplog.at_level("WARNING"):
+        m.stop(timeout=0.05)
+    assert any("did not stop" in r.message for r in caplog.records)
+    t.join(timeout=10)
+    m.stop()  # dispatcher has drained by now; clean shutdown, no warning
+
+
 def test_model_config_file(tmp_path):
     cfg_file = tmp_path / "model_config.json"
     cfg_file.write_text(json.dumps({

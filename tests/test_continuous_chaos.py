@@ -1,0 +1,171 @@
+"""Continuous-batching chaos: clients dying mid-stream.
+
+Mirrors ``tests/test_workflow_chaos.py`` one layer down the stack —
+there the orchestrator is SIGKILLed mid-step; here a *client* dies (or
+cancels) mid-generation, which is what every dropped HTTP connection /
+killed pod does to a streaming LM endpoint.  The engine must reclaim
+the dead request's slot and keep serving everyone else.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingEngine,
+    ContinuousBatchingModel,
+    EngineConfig,
+    RequestCancelled,
+)
+from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+def test_cancel_mid_stream_reclaims_slot(params):
+    """Kill a client mid-stream: its slot frees immediately and the next
+    queued request runs to completion unaffected."""
+    eng = ContinuousBatchingEngine(
+        CFG, params, EngineConfig(slots=1, max_len=64), pad_token_id=0)
+    eng.start()
+    try:
+        victim = eng.submit(list(range(1, 9)), max_new_tokens=50,
+                            temperature=0.0)
+        queued = eng.submit([7, 8, 9], max_new_tokens=5, temperature=0.0)
+        stream = victim.iter_tokens(timeout=60)
+        next(stream)  # mid-stream: the victim occupies the only slot
+        victim.cancel()
+        with pytest.raises(RequestCancelled):
+            for _ in stream:
+                pass
+        # the slot was reclaimed: the queued request finishes long before
+        # the victim's 50 tokens would have
+        assert len(queued.wait(eng)) == 5
+        assert eng.stats["cancelled"] == 1
+        # engine healthy: a fresh request still works
+        again = eng.submit(list(range(20, 30)), max_new_tokens=4,
+                           temperature=0.0)
+        assert len(again.wait(eng)) == 4
+        assert all(s is None for s in eng._slots)
+    finally:
+        eng.stop()
+
+
+def test_cancel_queued_request_dropped_at_admission(params):
+    eng = ContinuousBatchingEngine(
+        CFG, params, EngineConfig(slots=1, max_len=64), pad_token_id=0)
+    eng.start()
+    try:
+        active = eng.submit(list(range(1, 9)), max_new_tokens=20,
+                            temperature=0.0)
+        doomed = eng.submit([5, 6], max_new_tokens=20, temperature=0.0)
+        doomed.cancel()
+        with pytest.raises(RequestCancelled):
+            doomed.wait(eng)
+        assert len(active.wait(eng)) == 20  # bystander unaffected
+        assert doomed.claimed is False  # never occupied a slot
+    finally:
+        eng.stop()
+
+
+def test_cancelled_queued_request_frees_queue_capacity(params):
+    """A cancelled request must be purged from the bounded queue even
+    while every slot is busy — otherwise dead requests 503 live clients
+    for the remainder of the longest running generation."""
+    from kubernetes_cloud_tpu.serve.batcher import QueueFullError
+
+    eng = ContinuousBatchingEngine(
+        CFG, params, EngineConfig(slots=1, max_len=64, max_queue_size=1),
+        pad_token_id=0)
+    eng.start()
+    try:
+        active = eng.submit(list(range(1, 9)), max_new_tokens=54,
+                            temperature=0.0)
+        next(active.iter_tokens(timeout=60))  # slot occupied, long run
+        doomed = eng.submit([5, 6], max_new_tokens=5, temperature=0.0)
+        with pytest.raises(QueueFullError):
+            eng.submit([1, 2], max_new_tokens=5, temperature=0.0)
+        doomed.cancel()
+        # capacity must open up from the purge alone, while the slot is
+        # still held by the long-running request
+        replacement = None
+        deadline = time.monotonic() + 30
+        while replacement is None and time.monotonic() < deadline:
+            try:
+                replacement = eng.submit([1, 2], max_new_tokens=5,
+                                         temperature=0.0)
+            except QueueFullError:
+                time.sleep(0.002)
+        assert replacement is not None
+        assert not active.event.is_set()  # slot never freed in between
+        with pytest.raises(RequestCancelled):
+            doomed.wait(eng)
+        assert len(replacement.wait(eng)) == 5
+        assert len(active.wait(eng)) == 54
+    finally:
+        eng.stop()
+
+
+def test_sigkilled_http_client_does_not_poison_server(params):
+    """SIGKILL a real HTTP client mid-request (the workflow-chaos
+    pattern): the server thread finishes the orphaned generation, the
+    slot frees, and subsequent requests are unaffected."""
+    svc = CausalLMService("lm", CFG, params=params, dtype=jnp.float32)
+    svc.load()
+    m = ContinuousBatchingModel("lm", svc, EngineConfig(slots=2, max_len=64))
+    m.load()
+    server = ModelServer([m], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/v1/models/lm:predict"
+        client = (
+            "import urllib.request, json\n"
+            f"req = urllib.request.Request({url!r}, data=json.dumps("
+            "{'instances': ['a long doomed prompt'], 'parameters': "
+            "{'max_new_tokens': 50, 'temperature': 0.0}}).encode(), "
+            "headers={'Content-Type': 'application/json'})\n"
+            "urllib.request.urlopen(req, timeout=120).read()\n")
+        p = subprocess.Popen([sys.executable, "-c", client])
+        time.sleep(0.5)  # let the request reach the engine
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=30)
+
+        # the server must keep answering while/after the orphan drains
+        req = urllib.request.Request(
+            url, data=json.dumps({
+                "instances": ["survivor"],
+                "parameters": {"max_new_tokens": 4, "temperature": 0.0},
+            }).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["predictions"][0]["tokens_out"] == 4
+
+        # orphaned generation runs to completion, then its slot frees
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(s is None for s in m.engine._slots):
+                break
+            time.sleep(0.1)
+        assert all(s is None for s in m.engine._slots)
+    finally:
+        server.stop()
+        m.stop()
