@@ -15,6 +15,7 @@
 //   hs_stop(handle)
 // handler signature:
 //   void handler(const char* method, const char* path,
+//                const char* headers,  // raw header block, NUL-terminated
 //                const char* body, long body_len, void* resp);
 // the handler MUST call exactly once:
 //   hs_respond(resp, status, content_type, body, body_len)
@@ -39,8 +40,8 @@
 
 namespace {
 
-using Handler = void (*)(const char*, const char*, const char*, long,
-                         void*);
+using Handler = void (*)(const char*, const char*, const char*,
+                         const char*, long, void*);
 
 struct Response {
   int status = 500;
@@ -165,7 +166,12 @@ void serve_connection(Server* s, int fd) {
 
     Response resp;
     if (s->handler) {
-      s->handler(method.c_str(), path.c_str(), buf.c_str() + header_end,
+      // Raw header block (request line included; the Python side skips
+      // colon-less lines) so the data plane can read per-request
+      // metadata like X-Request-Deadline-Ms without reparsing sockets.
+      std::string header_blk = buf.substr(0, header_end);
+      s->handler(method.c_str(), path.c_str(), header_blk.c_str(),
+                 buf.c_str() + header_end,
                  static_cast<long>(content_len), &resp);
     }
     char head[256];

@@ -1,0 +1,191 @@
+"""Deterministic, config-gated fault injection.
+
+Chaos for the serving stack (and the pattern every future trainer /
+workflow chaos suite reuses): production code is threaded with named
+**injection sites** — one ``faults.fire(site)`` call at each place a
+real failure strikes — and a test (or ``scripts/bench_serving.py
+--inject hang``) arms them with :class:`FaultSpec` s.  Disarmed (the
+default, and the only state outside chaos runs) a site costs one module
+attribute check and a ``None`` comparison.
+
+Sites wired through ``serve/``:
+
+=====================  ====================================================
+``model_fn``           inside the engine/batcher device-call path — a
+                       ``raise`` here is a crashed model program (the
+                       engine's scheduler thread dies; the batcher fails
+                       the batch)
+``decode_step``        just before the engine's decode dispatch — a
+                       ``hang`` here is a wedged device/driver
+``iteration``          once per engine scheduler iteration — ``slow``
+                       models stragglers / preempted hosts
+``stream``             per emitted token — ``drop`` loses the token on
+                       the way to the client (stalled stream)
+``queue``              at admission — a ``drop`` firing short-circuits
+                       into ``QueueFullError`` (queue exhaustion
+                       without real load)
+``dispatch``           once per batcher dispatch cycle — any firing
+                       (``raise`` or ``drop``) kills the dispatcher
+                       thread with no drain
+``server.handle``      HTTP routing layer — ``raise`` becomes a 500
+=====================  ====================================================
+
+Determinism: every site counts its hits under a lock; a spec names the
+1-based hit index it starts firing at (``at``) and how many consecutive
+hits it fires for (``times``, ``-1`` = forever).  Same test, same
+schedule, every run — no probabilistic chaos-monkey flakiness.
+
+Hung threads are releasable: ``hang`` waits on the injector's release
+event (bounded by ``delay_s``), so a test's teardown calls
+:meth:`FaultInjector.release` instead of leaking a thread for the
+remaining sleep.
+
+Config gating for containers: ``KCT_FAULTS`` holds a JSON list of spec
+dicts (``[{"site": "decode_step", "mode": "hang", "at": 50}]``);
+:func:`install_from_env` arms them at boot.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional, Sequence
+
+#: modes a spec can take; "drop" does not raise/sleep — the call site
+#: asks ``fired`` and suppresses its own side effect (e.g. the token put)
+MODES = ("raise", "hang", "slow", "drop")
+
+
+class FaultError(RuntimeError):
+    """An injected failure (the ``raise`` mode's default exception)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    mode: str = "raise"
+    at: int = 1          # 1-based hit index the fault starts firing on
+    times: int = 1       # consecutive firings; -1 = every hit from `at`
+    delay_s: float = 30.0  # hang upper bound / slow duration
+    message: str = "injected fault"
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {self.mode!r}")
+        if self.at < 1:
+            raise ValueError("at is a 1-based hit index (>= 1)")
+        if self.times == 0 or self.times < -1:
+            raise ValueError("times must be >= 1 or -1 (forever)")
+
+    def due(self, hit: int) -> bool:
+        if hit < self.at:
+            return False
+        return self.times == -1 or hit < self.at + self.times
+
+
+class FaultInjector:
+    """Arms a set of specs; thread-safe; records every firing."""
+
+    def __init__(self, specs: Sequence[FaultSpec] = ()):
+        self._specs: dict[str, list[FaultSpec]] = {}
+        for s in specs:
+            self._specs.setdefault(s.site, []).append(s)
+        self._hits: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._release = threading.Event()
+        #: (site, mode, hit) tuples, in firing order — assertable history
+        self.fired: list[tuple[str, str, int]] = []
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def release(self) -> None:
+        """Free every thread parked in a ``hang`` (test teardown)."""
+        self._release.set()
+
+    def fire(self, site: str) -> Optional[str]:
+        """Count a hit at ``site`` and apply the due spec, if any.
+
+        Returns the fired mode (``"drop"`` is the only one a call site
+        must act on — raise/hang/slow happen right here), or ``None``.
+        """
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+            spec = next((s for s in self._specs.get(site, ())
+                         if s.due(hit)), None)
+            if spec is None:
+                return None
+            self.fired.append((site, spec.mode, hit))
+        if spec.mode == "raise":
+            raise FaultError(f"{spec.message} [{site} hit {hit}]")
+        if spec.mode == "hang":
+            self._release.wait(timeout=spec.delay_s)
+        elif spec.mode == "slow":
+            time.sleep(spec.delay_s)
+        return spec.mode
+
+
+#: the armed injector, or None (disarmed — the production state)
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    global _ACTIVE
+    _ACTIVE = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.release()  # never leave a thread parked in a hang
+    _ACTIVE = None
+
+
+def fire(site: str) -> Optional[str]:
+    """The injection-site call: free when disarmed."""
+    inj = _ACTIVE
+    if inj is None:
+        return None
+    return inj.fire(site)
+
+
+@contextlib.contextmanager
+def inject(*specs: FaultSpec) -> Iterator[FaultInjector]:
+    """Scoped arming for tests::
+
+        with faults.inject(FaultSpec("decode_step", mode="hang", at=3)):
+            ...
+    """
+    inj = install(FaultInjector(specs))
+    try:
+        yield inj
+    finally:
+        uninstall()
+
+
+def parse_specs(raw: str) -> list[FaultSpec]:
+    """JSON list of spec dicts → specs (the ``KCT_FAULTS`` format)."""
+    data = json.loads(raw)
+    if not isinstance(data, list):
+        raise ValueError("KCT_FAULTS must be a JSON list of spec objects")
+    return [FaultSpec(**d) for d in data]
+
+
+def install_from_env(env_var: str = "KCT_FAULTS") -> Optional[FaultInjector]:
+    """Arm faults from the environment at container boot (no-op when the
+    variable is unset/empty)."""
+    raw = os.environ.get(env_var)
+    if not raw:
+        return None
+    return install(FaultInjector(parse_specs(raw)))

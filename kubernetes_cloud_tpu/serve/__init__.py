@@ -1,5 +1,17 @@
 from kubernetes_cloud_tpu.serve.model import Model  # noqa: F401
+from kubernetes_cloud_tpu.serve.errors import (  # noqa: F401
+    DeadlineExceededError,
+    EngineRestartedError,
+    QueueFullError,
+    RetryableError,
+    StreamTimeoutError,
+)
 from kubernetes_cloud_tpu.serve.server import ModelServer  # noqa: F401
+from kubernetes_cloud_tpu.serve.supervisor import (  # noqa: F401
+    ServingSupervisor,
+    SupervisorConfig,
+    supervise,
+)
 from kubernetes_cloud_tpu.serve.lm_service import (  # noqa: F401
     ByteTokenizer,
     CausalLMService,

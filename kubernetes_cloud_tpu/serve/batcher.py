@@ -27,9 +27,21 @@ import logging
 import os
 import queue
 import threading
+import time
 from typing import Any, Callable, Mapping, Optional, Sequence
 
-from kubernetes_cloud_tpu.serve.model import Model, parse_instances
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.serve.errors import (  # noqa: F401 - re-export
+    DeadlineExceededError,
+    QueueFullError,
+    RetryableError,
+)
+from kubernetes_cloud_tpu.serve.model import (
+    Model,
+    parse_instances,
+    request_deadline,
+)
+from kubernetes_cloud_tpu.serve.supervisor import Heartbeat
 
 log = logging.getLogger(__name__)
 
@@ -67,17 +79,12 @@ def load_model_config(model_dir: str) -> BatcherConfig:
     )
 
 
-class QueueFullError(RuntimeError):
-    """Backpressure: the request queue is at max_queue_size.  Mapped to
-    HTTP 503 by the server so clients/autoscalers can retry, unlike a
-    real fault's 500."""
-
-
 class _Pending:
     __slots__ = ("instances", "params", "event", "result", "error",
-                 "claimed")
+                 "claimed", "deadline")
 
-    def __init__(self, instances: Sequence[Any], params: Mapping[str, Any]):
+    def __init__(self, instances: Sequence[Any], params: Mapping[str, Any],
+                 deadline: Optional[float] = None):
         self.instances = list(instances)
         self.params = dict(params)
         self.event = threading.Event()
@@ -86,6 +93,9 @@ class _Pending:
         #: set by the dispatcher when dequeued — a claimed request's batch
         #: WILL complete (and set event), even across stop()
         self.claimed = False
+        #: absolute monotonic deadline (None = wait forever); expired
+        #: entries are shed by the dispatcher instead of batched
+        self.deadline = deadline
 
 
 class BatchingModel(Model):
@@ -110,8 +120,18 @@ class BatchingModel(Model):
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._held: Optional[_Pending] = None  # didn't fit/merge last batch
+        #: beaten once per dispatch cycle; the supervisor's watchdog
+        #: reads it (stale + live thread = wedged inner model call)
+        self.heartbeat = Heartbeat()
+        # Dispatcher generation: a supervisor restart bumps it so an
+        # abandoned (wedged) dispatcher that eventually wakes exits
+        # instead of racing the replacement for the queue.
+        self._gen = 0
+        #: the batch currently executing (supervisor fails it on restart)
+        self._current_batch: list[_Pending] = []
         # batching telemetry (the Triton metrics a load test reads)
-        self.stats = {"requests": 0, "batches": 0, "batched_instances": 0}
+        self.stats = {"requests": 0, "batches": 0, "batched_instances": 0,
+                      "deadline_shed": 0}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -134,10 +154,10 @@ class BatchingModel(Model):
                 stale = self._queue.get_nowait()
             except queue.Empty:
                 break
-            stale.error = RuntimeError("batcher restarted")
+            stale.error = RetryableError("batcher restarted")
             stale.event.set()
         self._thread = threading.Thread(target=self._safe_dispatch_loop,
-                                        daemon=True,
+                                        args=(self._gen,), daemon=True,
                                         name=f"batcher-{self.name}")
         self._thread.start()
         self.ready = True
@@ -156,6 +176,58 @@ class BatchingModel(Model):
                     "load()", self.name, timeout)
         self.ready = False
 
+    # -- supervision -------------------------------------------------------
+
+    def restart_dispatcher(self, err: Exception) -> int:
+        """Supervisor restart path: abandon the current dispatcher (it
+        may be wedged inside a batch — unjoinable), fail the work it had
+        claimed with the retryable ``err``, and start a fresh dispatcher
+        over the same queue.  Unclaimed queued requests survive and are
+        served by the replacement; returns how many."""
+        self._gen += 1  # wedged loop exits when (if) it wakes
+        batch, self._current_batch = list(self._current_batch), []
+        held, self._held = self._held, None
+        for p in batch + ([held] if held is not None else []):
+            p.error = err
+            p.event.set()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._safe_dispatch_loop,
+                                        args=(self._gen,), daemon=True,
+                                        name=f"batcher-{self.name}")
+        self._thread.start()
+        self.ready = True
+        return self._queue.qsize()
+
+    def abandon_dispatcher(self, err: Exception) -> None:
+        """Circuit-open path: no replacement — fail everything."""
+        self._gen += 1
+        # Set _stop BEFORE draining (mirrors the engine's abandon): a
+        # predict() racing this shutdown either fails its entry check,
+        # gets failed by its own post-enqueue recheck, or escapes via
+        # the waiter loop's _stop condition — without this flag all
+        # three guards stay dark and the straggler hangs forever.
+        self._stop.set()
+        batch, self._current_batch = list(self._current_batch), []
+        held, self._held = self._held, None
+        leftovers = batch + ([held] if held is not None else [])
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for p in leftovers:
+            p.error = err
+            p.event.set()
+        self.ready = False
+
+    def _local_health(self) -> dict:
+        if not self.ready:
+            return {"ok": False, "reason": "not loaded"}
+        t = self._thread
+        if t is None or not t.is_alive():
+            return {"ok": False, "reason": "dispatcher dead"}
+        return {"ok": True, "reason": "ok"}
+
     # -- request side ------------------------------------------------------
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
@@ -165,12 +237,30 @@ class BatchingModel(Model):
                 f"request carries {len(instances)} instances > "
                 f"max_batch_size {self.cfg.max_batch_size}")
         if self._stop.is_set() or not self.ready:
-            raise RuntimeError("batcher stopped")
-        pending = _Pending(instances, payload.get("parameters") or {})
+            raise RetryableError("batcher stopped")
+        deadline = request_deadline(payload)
+        if deadline is not None and time.monotonic() > deadline:
+            raise DeadlineExceededError("deadline expired before admission")
+        if faults.fire("queue") == "drop":
+            raise QueueFullError("request queue full (injected)")
+        pending = _Pending(instances, payload.get("parameters") or {},
+                           deadline)
         try:
             self._queue.put_nowait(pending)
         except queue.Full:
             raise QueueFullError("request queue full") from None
+        if self._stop.is_set():
+            # lost the race with stop()/abandon_dispatcher: the final
+            # queue drain may already have run, so fail the stragglers
+            # here (the queue hands each pending to exactly one drainer
+            # — same shape as the engine's submit() recheck)
+            while True:
+                try:
+                    stale = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                stale.error = RetryableError("batcher stopped")
+                stale.event.set()
         # Bounded wait re-checking for shutdown: a request enqueued in the
         # race window after the dispatcher's final drain must not hang.
         # A CLAIMED request's batch is already executing and will finish
@@ -178,7 +268,7 @@ class BatchingModel(Model):
         while not pending.event.wait(timeout=0.5):
             if (self._stop.is_set() and not pending.claimed
                     and not pending.event.is_set()):
-                raise RuntimeError("batcher stopped")
+                raise RetryableError("batcher stopped")
         if pending.error is not None:
             raise pending.error
         return {"predictions": pending.result}
@@ -192,16 +282,39 @@ class BatchingModel(Model):
             return list(out["predictions"])
         return list(self.inner(instances, params))
 
-    def _safe_dispatch_loop(self) -> None:
+    def _safe_dispatch_loop(self, gen: int) -> None:
         # The dispatcher must never die silently: a dead dispatcher with
         # ready=True hangs every request.  Unexpected loop errors fail the
-        # in-flight work and the loop resumes.
-        while not self._stop.is_set():
+        # in-flight work and the loop resumes.  The "dispatch" fault site
+        # is different: it kills the THREAD (no drain, queue stranded) —
+        # the segfault-class failure the supervisor's crash detection is
+        # tested against.
+        while not self._stop.is_set() and self._gen == gen:
+            self.heartbeat.beat()
+            try:
+                if faults.fire("dispatch") is not None:
+                    log.error("injected dispatcher death")
+                    return
+            except faults.FaultError:
+                log.error("injected dispatcher death (raise)")
+                return
             try:
                 self._dispatch_once()
             except Exception:  # noqa: BLE001
                 log.exception("batcher dispatch error; continuing")
+        if self._gen != gen:
+            return  # superseded by a supervisor restart; queue not ours
         self._drain_on_stop()
+
+    def _shed_expired(self, p: _Pending) -> bool:
+        """Fail (504) a pending whose deadline passed while it queued —
+        a slot spent on it would produce an answer nobody reads."""
+        if p.deadline is not None and time.monotonic() > p.deadline:
+            self.stats["deadline_shed"] += 1
+            p.error = DeadlineExceededError("deadline expired in queue")
+            p.event.set()
+            return True
+        return False
 
     def _dispatch_once(self) -> None:
         delay_s = self.cfg.max_queue_delay_us / 1e6
@@ -212,6 +325,8 @@ class BatchingModel(Model):
                 first = self._queue.get(timeout=0.05)
             except queue.Empty:
                 return
+        if self._shed_expired(first):
+            return
         first.claimed = True
         batch = [first]
         total = len(first.instances)
@@ -224,6 +339,8 @@ class BatchingModel(Model):
                 nxt = self._queue.get(timeout=deadline)
             except queue.Empty:
                 break
+            if self._shed_expired(nxt):
+                continue
             nxt.claimed = True
             if (nxt.params != first.params
                     or total + len(nxt.instances)
@@ -245,7 +362,7 @@ class BatchingModel(Model):
             except queue.Empty:
                 break
         for p in leftovers:
-            p.error = RuntimeError("batcher stopped")
+            p.error = RetryableError("batcher stopped")
             p.event.set()
 
     def _execute(self, batch: list[_Pending]) -> None:
@@ -253,7 +370,9 @@ class BatchingModel(Model):
         self.stats["requests"] += len(batch)
         self.stats["batches"] += 1
         self.stats["batched_instances"] += len(instances)
+        self._current_batch = batch
         try:
+            faults.fire("model_fn")
             results = self._run_inner(instances, batch[0].params)
             if len(results) != len(instances):
                 raise RuntimeError(
@@ -272,5 +391,11 @@ class BatchingModel(Model):
             for p in batch:
                 p.error = e
         finally:
+            # Identity-guarded: an ABANDONED dispatcher waking from a
+            # wedged inner call must not clobber the record of the
+            # replacement dispatcher's in-flight batch — losing it would
+            # strand that batch's waiters across the next restart.
+            if self._current_batch is batch:
+                self._current_batch = []
             for p in batch:
                 p.event.set()

@@ -35,6 +35,14 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
                     help="persistent XLA compile cache dir (PVC-mount it "
                          "so replica cold starts skip the 20-40s first "
                          "compile; empty string disables)")
+    ap.add_argument("--drain-timeout", type=float, default=30.0,
+                    help="SIGTERM: max seconds to wait for in-flight "
+                         "requests before closing (size the manifest's "
+                         "terminationGracePeriodSeconds above this)")
+    ap.add_argument("--hang-timeout", type=float, default=10.0,
+                    help="supervisor: engine heartbeat staleness that "
+                         "counts as a hang (must exceed the slowest "
+                         "legitimate scheduler iteration)")
 
 
 def enable_compile_cache(args) -> None:
@@ -78,7 +86,44 @@ def make_server(models: Iterable[Model], args):
     return cls(models, port=args.port)
 
 
+def install_sigterm_drain(server, drain_timeout: float = 30.0) -> bool:
+    """Knative pod termination: SIGTERM → graceful drain (readiness 503,
+    stop admitting, finish in-flight, drain worker slots, close) instead
+    of dropping every open stream.  The drain runs on its own thread —
+    ``ThreadingHTTPServer.shutdown`` deadlocks if called from the thread
+    running ``serve_forever`` (which is where the handler fires)."""
+    import signal
+    import threading
+
+    def _terminate(signum, frame):
+        log.info("SIGTERM: draining (timeout %.0fs)", drain_timeout)
+        threading.Thread(target=server.drain, args=(drain_timeout,),
+                         daemon=True, name="sigterm-drain").start()
+
+    try:
+        signal.signal(signal.SIGTERM, _terminate)
+        return True
+    except ValueError:  # not on the main thread (embedded/test use)
+        log.warning("not on the main thread; SIGTERM drain not installed")
+        return False
+
+
 def serve(models: Iterable[Model], args) -> None:  # pragma: no cover - loop
+    from kubernetes_cloud_tpu import faults
+    from kubernetes_cloud_tpu.serve.supervisor import (
+        SupervisorConfig,
+        supervise,
+    )
+
     enable_compile_cache(args)
+    faults.install_from_env()  # chaos drills: KCT_FAULTS json specs
+    models = list(models)  # iterated twice (server + supervisor); a
+    # generator would leave the supervisor silently watching nothing
     server = make_server(models, args)
-    server.serve_forever()
+    sup = supervise(models, SupervisorConfig(
+        hang_timeout_s=getattr(args, "hang_timeout", 10.0)))
+    if sup is not None:
+        log.info("serving supervisor watching %d worker model(s)",
+                 len(sup._watched))
+    install_sigterm_drain(server, getattr(args, "drain_timeout", 30.0))
+    server.serve_forever()  # returns after a SIGTERM drain completes

@@ -47,18 +47,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_cloud_tpu import faults
 from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig
 from kubernetes_cloud_tpu.models.generate import (
     decode_step_slots,
     init_cache,
     prefill_into_slots,
 )
-from kubernetes_cloud_tpu.serve.batcher import QueueFullError
+from kubernetes_cloud_tpu.serve.errors import (
+    DeadlineExceededError,
+    EngineRestartedError,
+    QueueFullError,
+    RetryableError,
+    StreamTimeoutError,
+)
 from kubernetes_cloud_tpu.serve.model import (
     Model,
     instance_text,
     parse_instances,
+    request_deadline,
 )
+from kubernetes_cloud_tpu.serve.supervisor import Heartbeat
 
 log = logging.getLogger(__name__)
 
@@ -80,6 +89,12 @@ class EngineConfig:
     max_admit_per_step: int = 4  # prefills per iteration (admission policy)
     idle_wait_s: float = 0.05  # poll interval when no slot is active
     drain_timeout_s: float = 30.0  # stop(): max wait for in-flight slots
+    #: hang-detection grace around each FIRST prefill of a new
+    #: (bucket, batch) shape: a cold-cache XLA compile blocks the
+    #: scheduler for 20-40s on real hardware, which is indistinguishable
+    #: from a wedge by heartbeat alone.  Must exceed the worst-case
+    #: single compile; applies only while the cold call is in flight.
+    compile_grace_s: float = 120.0
 
     def __post_init__(self):
         if self.slots < 1:
@@ -98,10 +113,11 @@ class GenRequest:
     __slots__ = ("prompt_ids", "max_new_tokens", "temperature", "top_k",
                  "top_p", "rng", "tokens", "stream", "event", "error",
                  "claimed", "cancelled", "submitted_at", "first_token_at",
-                 "done_at")
+                 "done_at", "deadline", "engine")
 
     def __init__(self, prompt_ids: Sequence[int], *, max_new_tokens: int,
-                 temperature: float, top_k: int, top_p: float, seed: int):
+                 temperature: float, top_k: int, top_p: float, seed: int,
+                 deadline: Optional[float] = None):
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -119,6 +135,13 @@ class GenRequest:
         self.submitted_at = time.monotonic()
         self.first_token_at: Optional[float] = None
         self.done_at: Optional[float] = None
+        #: absolute monotonic deadline (None = wait forever); expired
+        #: queued requests are shed at admission instead of decoded
+        self.deadline = deadline
+        #: the engine currently responsible for this request — updated
+        #: by ``requeue()`` when a supervisor transplants the queue to a
+        #: replacement engine, so liveness re-checks follow the request
+        self.engine: Optional["ContinuousBatchingEngine"] = None
 
     def cancel(self) -> None:
         """Mark the request dead (client gone).  The scheduler purges it
@@ -127,23 +150,59 @@ class GenRequest:
         self.cancelled = True
 
     def iter_tokens(self, timeout: float = 60.0) -> Iterator[int]:
-        """Stream tokens as the scheduler emits them (SSE-style)."""
+        """Stream tokens as the scheduler emits them (SSE-style).
+
+        A stalled stream raises the typed, retryable
+        :class:`~kubernetes_cloud_tpu.serve.errors.StreamTimeoutError`
+        instead of leaking a raw ``queue.Empty``; each short poll
+        re-checks engine liveness first, so a dead engine surfaces in
+        ≤0.5 s rather than after the full ``timeout``."""
         while True:
-            item = self.stream.get(timeout=timeout)
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    item = self.stream.get(timeout=min(0.5, timeout))
+                    break
+                except queue.Empty:
+                    eng = self.engine
+                    if (eng is not None and not eng.alive
+                            and self.stream.empty()):
+                        # the client gets its 503 now — mark the request
+                        # dead so a supervisor transplant doesn't decode
+                        # it into a void on the replacement engine
+                        self.cancel()
+                        raise StreamTimeoutError(
+                            "token stream stalled: engine is dead; "
+                            "retry") from None
+                    if time.monotonic() >= deadline:
+                        state = ("alive" if eng is not None and eng.alive
+                                 else "dead")
+                        raise StreamTimeoutError(
+                            f"no token within {timeout:.1f}s "
+                            f"(engine {state}); retry") from None
             if item is _STREAM_END:
                 if self.error is not None:
                     raise self.error
                 return
             yield item
 
-    def wait(self, engine: "ContinuousBatchingEngine") -> list[int]:
+    def wait(self, engine: Optional["ContinuousBatchingEngine"] = None
+             ) -> list[int]:
         """Block until finished; returns emitted tokens or raises."""
         # Bounded wait re-checking engine liveness: a request enqueued in
         # a crash/stop race window must not hang (same shape as
-        # BatchingModel.predict's wait loop).
+        # BatchingModel.predict's wait loop).  self.engine (kept current
+        # across supervisor transplants) takes precedence over the
+        # caller's possibly-stale reference.
         while not self.event.wait(timeout=0.5):
-            if not engine.alive and not self.event.is_set():
-                raise RuntimeError("engine stopped")
+            eng = self.engine or engine
+            if (eng is not None and not eng.alive
+                    and not self.event.is_set()):
+                # raising IS the client's answer (503): mark the request
+                # dead so a supervisor transplanting the crashed
+                # engine's queue doesn't burn slots decoding it
+                self.cancel()
+                raise RetryableError("engine stopped")
         if self.error is not None:
             raise self.error
         return list(self.tokens)
@@ -229,9 +288,33 @@ class ContinuousBatchingEngine:
         self._thread: Optional[threading.Thread] = None
         self._prefill = _jit_prefill()
         self._decode = _jit_decode()
+        #: beaten once per scheduler pass (idle polls included), so a
+        #: fresh heartbeat always means "the loop is turning" — the
+        #: supervisor's watchdog reads it
+        self.heartbeat = Heartbeat()
+        #: set by a supervisor giving up on this engine; the scheduler
+        #: exits at the next opportunity without touching the queue
+        self._abandoned = False
+        #: requests popped+claimed by _admit but not yet slotted — a
+        #: wedge/crash inside prefill leaves them in neither the queue
+        #: nor _slots, so failure paths must fail them explicitly or
+        #: their waiters would hang on a live-but-wedged engine
+        self._admitting: list[GenRequest] = []
+        #: prefill shapes already compiled; a first-time shape raises
+        #: grace_until around its dispatch so the watchdog doesn't read
+        #: the cold compile as a hang (cleared the moment it returns)
+        self._warm_shapes: set[tuple[int, int]] = set()
+        self.grace_until = 0.0  # monotonic; heartbeat staleness before
+        # this instant is a compile, not a wedge
+        #: the exception that killed the scheduler, if it crashed
+        self.last_error: Optional[Exception] = None
+        #: EWMA of decode-iteration wall time — admission control uses
+        #: it to estimate queued-work delay for deadline shedding
+        self.iter_s: Optional[float] = None
         # iteration-level telemetry (the serving bench reads these)
         self.stats = {"iterations": 0, "admitted": 0, "emitted_tokens": 0,
-                      "evictions": 0, "cancelled": 0, "active_slot_steps": 0}
+                      "evictions": 0, "cancelled": 0, "active_slot_steps": 0,
+                      "deadline_shed": 0}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -254,6 +337,20 @@ class ContinuousBatchingEngine:
             return
         self._stop.clear()
         self.pool = self._init_pool()
+        # Warm the steady-state decode program BEFORE the scheduler (and
+        # readiness) exists: the loop's first real iteration must not
+        # sit in a 20-40s XLA compile looking exactly like a wedged
+        # device to the supervisor's heartbeat watchdog.  An all-frozen
+        # step is a semantic no-op on a fresh pool (every slot writes at
+        # length 0), and the persistent compile cache (serve/boot.py)
+        # makes this instant on warm boots.  Prefill compiles stay
+        # per-bucket on demand, protected by the compile_grace_s window
+        # (_admit raises grace_until around each first-time shape).
+        _, self.pool = self._decode(
+            self.cfg, self.params,
+            jnp.zeros((self.ecfg.slots,), jnp.int32), self.pool,
+            jnp.zeros((self.ecfg.slots,), bool))
+        self.heartbeat.beat()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="cb-engine")
         self._thread.start()
@@ -298,9 +395,24 @@ class ContinuousBatchingEngine:
 
     # -- request side ------------------------------------------------------
 
+    def queue_depth(self) -> int:
+        with self._qlock:
+            return len(self._queue)
+
+    def estimated_queue_delay(self) -> float:
+        """Admission-control estimate: how long freshly queued work will
+        wait, from the current queue depth and the measured iteration
+        time.  0.0 until the first decode iteration lands (optimism at
+        cold start beats shedding the warmup request)."""
+        if self.iter_s is None:
+            return 0.0
+        return (self.queue_depth() / self.ecfg.max_admit_per_step
+                ) * self.iter_s
+
     def submit(self, prompt_ids: Sequence[int], *, max_new_tokens: int = 64,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-               seed: int = 0) -> GenRequest:
+               seed: int = 0, deadline: Optional[float] = None
+               ) -> GenRequest:
         if not prompt_ids:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
@@ -319,10 +431,24 @@ class ContinuousBatchingEngine:
                 f"({max_new_tokens}) exceeds max_seq_len "
                 f"({self.cfg.max_seq_len}) for learned positions")
         if self._stop.is_set() or not self.alive:
-            raise RuntimeError("engine stopped")
+            raise RetryableError("engine stopped")
+        if deadline is not None:
+            now = time.monotonic()
+            if deadline <= now:
+                raise DeadlineExceededError(
+                    "deadline expired before admission")
+            est = self.estimated_queue_delay()
+            if now + est > deadline:
+                # shedding at the door beats burning a slot on an
+                # answer nobody is waiting for
+                raise DeadlineExceededError(
+                    f"queue delay ~{est:.3f}s implies a deadline miss")
+        if faults.fire("queue") == "drop":
+            raise QueueFullError("request queue full (injected)")
         req = GenRequest(prompt_ids, max_new_tokens=max_new_tokens,
                          temperature=temperature, top_k=top_k, top_p=top_p,
-                         seed=seed)
+                         seed=seed, deadline=deadline)
+        req.engine = self
         with self._qlock:
             if len(self._queue) >= self.ecfg.max_queue_size:
                 raise QueueFullError("request queue full")
@@ -332,30 +458,70 @@ class ContinuousBatchingEngine:
             # run its final queue drain, so fail the stragglers here —
             # every request must get its error + stream close exactly
             # once (the queue hands each to one drainer)
-            self._fail_queued(RuntimeError("engine stopped"))
+            self._fail_queued(RetryableError("engine stopped"))
         self._work.set()
         return req
+
+    def requeue(self, req: GenRequest) -> None:
+        """Re-admit a request a previous engine was abandoned with
+        (supervisor transplant).  Bypasses the queue bound — the request
+        already won admission once."""
+        req.engine = self
+        req.claimed = False
+        with self._qlock:
+            self._queue.append(req)
+        self._work.set()
+
+    def abandon(self, err: Exception) -> list[GenRequest]:
+        """Supervisor restart path: give up on this engine NOW, without
+        joining its (possibly wedged) scheduler thread.  Active requests
+        fail with the retryable ``err``; queued, never-claimed requests
+        are returned for re-admission into the replacement.  If the old
+        thread ever wakes it sees ``_abandoned`` and exits without
+        touching the queue again."""
+        self._abandoned = True
+        self._stop.set()
+        self._work.set()
+        with self._qlock:
+            queued = [r for r in self._queue if not r.cancelled]
+            self._queue.clear()
+        self._fail_active(err)
+        return queued
 
     # -- scheduler ---------------------------------------------------------
 
     def _loop(self) -> None:
-        # Never die silently (a dead scheduler hangs every waiter): fail
-        # the in-flight work, rebuild the pool, keep scheduling.
+        # A scheduler fault is a CRASH, not something to paper over:
+        # fail the in-flight work loudly (retryable 503s) and exit —
+        # restart policy (fresh pool, queue transplant, crash-loop
+        # circuit breaker) belongs to serve/supervisor.py, not to a loop
+        # reusing state that just proved corrupt.  Waiters never hang: a
+        # dead engine fails wait()/iter_tokens() within one poll.
         while True:
+            if self._abandoned:
+                return
+            self.heartbeat.beat()
             stopping = self._stop.is_set()
             if stopping:
-                self._fail_queued(RuntimeError("engine stopped"))
+                self._fail_queued(RetryableError("engine stopped"))
             if stopping and not any(s is not None for s in self._slots):
                 return
             try:
                 self._step(stopping)
             except Exception as e:  # noqa: BLE001
-                log.exception("continuous-batching scheduler error; "
-                              "resetting pool")
-                self._fail_active(RuntimeError(f"engine error: {e}"))
-                self.pool = self._init_pool()
+                if self._abandoned or self._stop.is_set():
+                    return  # already failed over / shutting down
+                log.exception("continuous-batching scheduler crashed")
+                self.last_error = e
+                self._fail_active(
+                    EngineRestartedError(f"engine crashed: {e}; retry"))
+                # queued (unclaimed) requests stay queued: a supervisor
+                # transplants them to the replacement engine; without
+                # one, their waiters see the dead engine within a poll.
+                return
 
     def _step(self, stopping: bool) -> None:
+        faults.fire("iteration")
         self._reap_cancelled()
         if not stopping:
             self._admit()
@@ -371,10 +537,16 @@ class ContinuousBatchingEngine:
         for i in active:
             tokens[i] = self._slots[i].tokens[-1]
             mask[i] = True
+        faults.fire("decode_step")
+        faults.fire("model_fn")
+        t0 = time.monotonic()
         logits, self.pool = self._decode(self.cfg, self.params,
                                          jnp.asarray(tokens), self.pool,
                                          jnp.asarray(mask))
         logits = np.asarray(logits)
+        dt = time.monotonic() - t0
+        self.iter_s = dt if self.iter_s is None else (
+            0.9 * self.iter_s + 0.1 * dt)
         self.stats["iterations"] += 1
         self.stats["active_slot_steps"] += len(active)
         for i in active:
@@ -419,8 +591,22 @@ class ContinuousBatchingEngine:
                 req.stream.put(_STREAM_END)
                 req.event.set()
                 continue
+            if (req.deadline is not None
+                    and time.monotonic() > req.deadline):
+                # expired while queued: shed instead of spending prefill
+                # + decode on an answer nobody is waiting for
+                self.stats["deadline_shed"] += 1
+                req.error = DeadlineExceededError(
+                    "deadline expired in queue")
+                req.stream.put(_STREAM_END)
+                req.event.set()
+                continue
             req.claimed = True
             batch.append(req)
+        # Claimed but not yet slotted: visible to the failure paths
+        # until every group lands in _slots (cleared at the end; a
+        # crash in between is _fail_active's to clean up).
+        self._admitting = batch
         # One prefill dispatch per prompt-length bucket, not per request:
         # a same-bucket burst scatters into its slots with a single
         # program call (compile count stays bounded at
@@ -436,14 +622,26 @@ class ContinuousBatchingEngine:
             for r, req in enumerate(group):
                 ids[r, :len(req.prompt_ids)] = req.prompt_ids
                 mask[r, :len(req.prompt_ids)] = 1
+            shape_key = (bucket, len(group))
+            cold = shape_key not in self._warm_shapes
+            if cold:
+                # first compile of this shape: 20-40s of legitimate
+                # silence on cold-cache hardware — tell the watchdog
+                self.grace_until = (time.monotonic()
+                                    + self.ecfg.compile_grace_s)
+            faults.fire("model_fn")
             logits, self.pool = self._prefill(
                 self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
                 self.pool, jnp.asarray(slots, jnp.int32))
             logits = np.asarray(logits)
+            if cold:
+                self._warm_shapes.add(shape_key)
+                self.grace_until = 0.0  # compiled; wedges detect normally
             for r, (slot, req) in enumerate(zip(slots, group)):
                 self._slots[slot] = req
                 self.stats["admitted"] += 1
                 self._emit(slot, logits[r])
+        self._admitting = []
 
     def _bucket(self, n: int) -> int:
         """Power-of-two prompt bucket (same rationale as
@@ -464,7 +662,8 @@ class ContinuousBatchingEngine:
         if req.first_token_at is None:
             req.first_token_at = time.monotonic()
         req.tokens.append(tok)
-        req.stream.put(tok)
+        if faults.fire("stream") != "drop":  # "drop" loses the delivery
+            req.stream.put(tok)
         self.stats["emitted_tokens"] += 1
         if ((self.eos is not None and tok == self.eos)
                 or len(req.tokens) >= req.max_new_tokens):
@@ -497,6 +696,17 @@ class ContinuousBatchingEngine:
         for i, req in enumerate(self._slots):
             if req is not None:
                 self._slots[i] = None
+                req.error = err
+                req.done_at = time.monotonic()
+                req.stream.put(_STREAM_END)
+                req.event.set()
+        # Requests claimed by a mid-flight _admit (popped from the
+        # queue, not yet slotted — e.g. wedged inside prefill): without
+        # this they would be orphaned with no error, no stream close,
+        # and a live-looking engine to wait on forever.
+        admitting, self._admitting = self._admitting, []
+        for req in admitting:
+            if not req.event.is_set():
                 req.error = err
                 req.done_at = time.monotonic()
                 req.stream.put(_STREAM_END)
@@ -546,24 +756,41 @@ class ContinuousBatchingModel(Model):
             self.engine.stop()
         self.ready = False
 
+    def _local_health(self) -> dict:
+        """Unsupervised readiness (a ServingSupervisor, when watching
+        this model, answers instead — with heartbeat/circuit/queue
+        detail)."""
+        if not self.ready:
+            return {"ok": False, "reason": "not loaded"}
+        eng = self.engine
+        if eng is None or not eng.alive:
+            return {"ok": False, "reason": "engine dead"}
+        return {"ok": True, "reason": "ok"}
+
     # -- request side ------------------------------------------------------
 
-    def _submit_all(self, prompts: Sequence[str],
-                    opts: Mapping[str, Any]) -> list[GenRequest]:
-        if self.engine is None or not self.ready:
-            raise RuntimeError("engine stopped")
+    def _submit_all(self, prompts: Sequence[str], opts: Mapping[str, Any],
+                    deadline: Optional[float] = None) -> list[GenRequest]:
+        # Snapshot the engine once: a supervisor restart thread swaps
+        # self.engine (briefly to None) concurrently, and a re-read
+        # mid-loop would turn that transient into an AttributeError 500
+        # instead of a retryable 503.
+        engine = self.engine
+        if engine is None or not self.ready:
+            raise RetryableError("engine stopped")
         tok = self.service.tokenizer
         reqs: list[GenRequest] = []
         try:
             for i, p in enumerate(prompts):
-                reqs.append(self.engine.submit(
+                reqs.append(engine.submit(
                     tok.encode(p),
                     max_new_tokens=max(1, min(int(opts["MAX_NEW_TOKENS"]),
                                               2048)),
                     temperature=float(opts["TEMPERATURE"]),
                     top_k=int(opts["TOP_K"]),
                     top_p=float(opts["TOP_P"]),
-                    seed=int(opts["SEED"]) + i))
+                    seed=int(opts["SEED"]) + i,
+                    deadline=deadline))
         except Exception:
             for r in reqs:  # don't orphan already-queued siblings
                 r.cancel()
@@ -588,13 +815,15 @@ class ContinuousBatchingModel(Model):
     def predict(self, payload: Mapping[str, Any]) -> dict:
         prompts = [instance_text(i) for i in parse_instances(payload)]
         opts = self.service.configure_request(payload)
-        reqs = self._submit_all(prompts, opts)
+        reqs = self._submit_all(prompts, opts,
+                                deadline=request_deadline(payload))
         return {"predictions": [self._finish(r, opts) for r in reqs]}
 
     def completion(self, payload: Mapping[str, Any]) -> dict:
         prompt = payload.get("prompt", "")
         opts = self.service.completion_options(payload)
-        req = self._submit_all([prompt], opts)[0]
+        req = self._submit_all([prompt], opts,
+                               deadline=request_deadline(payload))[0]
         return {"completion": self._finish(req, opts)["generated_text"]}
 
 

@@ -1,0 +1,51 @@
+"""Typed serving errors — the HTTP status vocabulary in one place.
+
+The data-plane contract the supervisor/probe layer relies on
+(`deploy/README.md` "Failure modes & recovery"): every failure a client
+can act on gets a *typed* exception, and :class:`~kubernetes_cloud_tpu.
+serve.server.ModelServer` maps types to statuses, not messages:
+
+* :class:`RetryableError` subtypes → **503**: the request itself was
+  fine, the pod transiently was not (queue full, engine restarting,
+  stream stalled, pod draining).  Knative/KServe retry these and the
+  autoscaler treats them as backpressure.
+* :class:`DeadlineExceededError` → **504**: the answer would arrive
+  after the caller stopped waiting.  Sheddable work, never retried
+  as-is (the retry would carry the same dead deadline).
+* ``ValueError`` → 400, anything else → 500 (a real fault).
+
+This module is dependency-free so every serving layer (batcher, engine,
+supervisor, server) can import it without cycles.
+``QueueFullError`` historically lived in :mod:`kubernetes_cloud_tpu.
+serve.batcher`; its canonical definition moved here and the batcher
+re-exports it, so existing imports stay valid.
+"""
+
+from __future__ import annotations
+
+
+class RetryableError(RuntimeError):
+    """Transient server-side condition; safe for the client to retry."""
+
+
+class QueueFullError(RetryableError):
+    """Backpressure: the request queue is at max_queue_size.  Mapped to
+    HTTP 503 by the server so clients/autoscalers can retry, unlike a
+    real fault's 500."""
+
+
+class EngineRestartedError(RetryableError):
+    """The supervisor restarted a hung/crashed engine out from under
+    this in-flight request.  State (the KV slot) is gone; a retry hits
+    the fresh engine."""
+
+
+class StreamTimeoutError(RetryableError):
+    """A token stream stalled: no token within the poll window, or the
+    engine died mid-stream.  Raised by ``GenRequest.iter_tokens``
+    instead of leaking a raw ``queue.Empty``."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline expired (or admission math proved it
+    will) before a result could be produced — HTTP 504."""

@@ -27,10 +27,12 @@ from kubernetes_cloud_tpu.parallel.sharding import (
     logical_to_physical,
     param_specs,
 )
+from kubernetes_cloud_tpu.serve.errors import DeadlineExceededError
 from kubernetes_cloud_tpu.serve.model import (
     Model,
     instance_text,
     parse_instances,
+    request_deadline,
 )
 from kubernetes_cloud_tpu.weights.tensorstream import load_pytree
 
@@ -192,6 +194,11 @@ class CausalLMService(Model):
                 for o in self.generate_outputs(prompts, opts)]
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
+        deadline = request_deadline(payload)
+        if deadline is not None and time.monotonic() > deadline:
+            # shed before compiling/generating — the one-shot path has
+            # no queue to age in, so only an already-dead budget sheds
+            raise DeadlineExceededError("deadline expired before start")
         prompts = [instance_text(i) for i in parse_instances(payload)]
         opts = self.configure_request(payload)
         return {"predictions": self.generate_outputs(prompts, opts)}

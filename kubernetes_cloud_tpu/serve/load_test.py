@@ -15,10 +15,15 @@ CLI::
     python -m kubernetes_cloud_tpu.serve.load_test \
         --url http://host/v1/models/m:predict --requests 100 \
         --concurrency 8 --payload '{"instances": [..]}' \
-        [--inputs prompts.txt]
+        [--inputs prompts.txt] [--deadline-ms 2000]
 
 ``--inputs`` cycles prompt lines into ``{"instances": [line]}`` payloads
-(the reference's ``benchmark/inputs.txt`` corpus).
+(the reference's ``benchmark/inputs.txt`` corpus).  ``--deadline-ms``
+attaches an ``X-Request-Deadline-Ms`` budget to every request, so the
+server's shedding behaviour (503 backpressure vs 504 deadline misses)
+becomes measurable: every run reports an ``outcomes`` breakdown
+(``2xx`` / ``503_shed`` / ``504_deadline`` / ``client_timeout`` /
+``4xx`` / ``5xx`` / ``error``).
 """
 
 from __future__ import annotations
@@ -28,9 +33,11 @@ import itertools
 import json
 import statistics
 import time
+import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from typing import Mapping, Optional
 
 
 @dataclass
@@ -45,6 +52,25 @@ class Result:
     @property
     def ok(self) -> bool:
         return self.status == 200 and not self.error
+
+    @property
+    def outcome(self) -> str:
+        """Per-run shedding breakdown bucket: distinguishes retryable
+        backpressure (503), shed deadline misses (504), and the client
+        giving up on a stalled stream (socket timeout)."""
+        if self.ok:
+            return "2xx"
+        if self.status == 503:
+            return "503_shed"
+        if self.status == 504:
+            return "504_deadline"
+        if self.status == 0 and "timed out" in self.error:
+            return "client_timeout"
+        if 400 <= self.status < 500:
+            return "4xx"
+        if self.status >= 500:
+            return "5xx"
+        return "error"
 
 
 @dataclass
@@ -63,6 +89,9 @@ class Summary:
     def stats(self) -> dict:
         lat = sorted(r.latency for r in self.results if r.ok)
         toks = sum(r.tokens_out for r in self.results if r.ok)
+        outcomes: dict[str, int] = {}
+        for r in self.results:
+            outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
 
         def pct(p: float):
             if not lat:
@@ -90,6 +119,8 @@ class Summary:
             # only meaningful for LM endpoints that report tokens_out
             "tokens_out_total": toks,
             "tokens_out_per_sec": round(toks / self.total_time, 4),
+            # shedding visibility: how every request ended
+            "outcomes": outcomes,
         }
 
 
@@ -105,40 +136,48 @@ def _count_tokens_out(body: bytes) -> int:
         return 0
 
 
-def _one_request(url: str, payload: bytes, timeout: float) -> Result:
+def _one_request(url: str, payload: bytes, timeout: float,
+                 headers: Optional[Mapping[str, str]] = None) -> Result:
     t0 = time.monotonic()
+    hdrs = {"Content-Type": "application/json", **(headers or {})}
     try:
-        req = urllib.request.Request(
-            url, data=payload, headers={"Content-Type": "application/json"})
+        req = urllib.request.Request(url, data=payload, headers=hdrs)
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             body = resp.read()
             return Result(time.monotonic() - t0, resp.status,
                           tokens_out=_count_tokens_out(body))
+    except urllib.error.HTTPError as e:
+        # keep the real status — the outcome breakdown needs to tell a
+        # 503 shed from a 504 deadline miss from a genuine 500
+        return Result(time.monotonic() - t0, e.code,
+                      e.reason or f"HTTP {e.code}")
     except Exception as e:  # noqa: BLE001 - goodput counts all failures
         return Result(time.monotonic() - t0, 0, str(e))
 
 
-def run_sync(url: str, payloads: list[bytes], *,
-             timeout: float = 300.0) -> Summary:
+def run_sync(url: str, payloads: list[bytes], *, timeout: float = 300.0,
+             headers: Optional[Mapping[str, str]] = None) -> Summary:
     t0 = time.monotonic()
-    results = [_one_request(url, p, timeout) for p in payloads]
+    results = [_one_request(url, p, timeout, headers) for p in payloads]
     return Summary(time.monotonic() - t0, results)
 
 
 def run_concurrent(url: str, payloads: list[bytes], *, concurrency: int = 8,
-                   timeout: float = 300.0) -> Summary:
+                   timeout: float = 300.0,
+                   headers: Optional[Mapping[str, str]] = None) -> Summary:
     """The async mode: ``concurrency`` in-flight requests until the payload
     list drains (thread pool; stats match the aiohttp original)."""
     t0 = time.monotonic()
     with ThreadPoolExecutor(max_workers=concurrency) as pool:
         results = list(pool.map(
-            lambda p: _one_request(url, p, timeout), payloads))
+            lambda p: _one_request(url, p, timeout, headers), payloads))
     return Summary(time.monotonic() - t0, results)
 
 
 def run_ramp(url: str, payload_pool: list[bytes], *,
              stages: list[int], stage_duration: float,
-             timeout: float = 300.0) -> dict:
+             timeout: float = 300.0,
+             headers: Optional[Mapping[str, str]] = None) -> dict:
     """Locust-style ramping profile (reference
     ``tensorizer-isvc/benchmark/locustfile.py``): each stage holds a
     concurrency level for ``stage_duration`` seconds — workers loop
@@ -154,7 +193,7 @@ def run_ramp(url: str, payload_pool: list[bytes], *,
         def worker():
             got = []
             while time.monotonic() < deadline:
-                got.append(_one_request(url, next(cycle), timeout))
+                got.append(_one_request(url, next(cycle), timeout, headers))
             return got
 
         t0 = time.monotonic()
@@ -187,6 +226,9 @@ def main(argv=None) -> dict:
     ap.add_argument("--inputs", default=None,
                     help="file of prompt lines cycled into payloads")
     ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="attach an X-Request-Deadline-Ms budget to "
+                         "every request (server sheds misses with 504)")
     ap.add_argument("--ramp-stages", default="1,2,4,8",
                     help="comma-separated concurrency levels (ramp mode)")
     ap.add_argument("--stage-duration", type=float, default=15.0,
@@ -194,17 +236,23 @@ def main(argv=None) -> dict:
     args = ap.parse_args(argv)
 
     payloads = build_payloads(args)
+    headers = None
+    if args.deadline_ms is not None:
+        headers = {"X-Request-Deadline-Ms": str(args.deadline_ms)}
     if args.mode == "ramp":
         stats = run_ramp(
             args.url, payloads,
             stages=[int(s) for s in args.ramp_stages.split(",") if s],
-            stage_duration=args.stage_duration, timeout=args.timeout)
+            stage_duration=args.stage_duration, timeout=args.timeout,
+            headers=headers)
     elif args.mode == "sync":
-        stats = run_sync(args.url, payloads, timeout=args.timeout).stats()
+        stats = run_sync(args.url, payloads, timeout=args.timeout,
+                         headers=headers).stats()
     else:
         stats = run_concurrent(args.url, payloads,
                                concurrency=args.concurrency,
-                               timeout=args.timeout).stats()
+                               timeout=args.timeout,
+                               headers=headers).stats()
     print(json.dumps(stats))
     return stats
 

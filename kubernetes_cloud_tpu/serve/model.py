@@ -13,7 +13,26 @@ up against the option dict).
 from __future__ import annotations
 
 import os
-from typing import Any, Mapping
+import time
+from typing import Any, Mapping, Optional
+
+
+def request_deadline(payload: Mapping[str, Any]) -> Optional[float]:
+    """Absolute monotonic deadline from the request's ``deadline_ms``
+    budget (set by the client in the payload, or injected by the server
+    from the ``X-Request-Deadline-Ms`` header).  None = no deadline.
+
+    The budget is relative so it survives serialization — clients and
+    pods don't share a clock; the serving pod anchors it at parse time.
+    """
+    ms = payload.get("deadline_ms")
+    if ms is None:
+        return None
+    ms = float(ms)
+    if not ms >= 0:  # rejects negatives AND NaN (which silently
+        # disables every shed comparison downstream)
+        raise ValueError("deadline_ms must be >= 0")
+    return time.monotonic() + ms / 1000.0
 
 
 def parse_instances(payload: Mapping[str, Any]) -> list:
@@ -40,6 +59,23 @@ class Model:
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
         raise NotImplementedError
+
+    # -- readiness ---------------------------------------------------------
+
+    def health(self) -> dict:
+        """The model's ``/readyz`` contribution: ``{"ok": bool,
+        "reason": str, ...}``.  Supervised models defer to their
+        :class:`~kubernetes_cloud_tpu.serve.supervisor.ServingSupervisor`
+        (heartbeat freshness, circuit state, queue depth); everything
+        else overrides :meth:`_local_health`."""
+        sup = getattr(self, "supervisor", None)
+        if sup is not None:
+            return sup.health(self)
+        return self._local_health()
+
+    def _local_health(self) -> dict:
+        return {"ok": self.ready,
+                "reason": "ok" if self.ready else "not loaded"}
 
     # -- option handling ---------------------------------------------------
 

@@ -32,10 +32,26 @@ _CSRC = os.path.join(os.path.dirname(os.path.dirname(
 # body is POINTER(c_char), NOT c_char_p: c_char_p would convert to a
 # NUL-terminated bytes copy, so string_at on a body with embedded NULs
 # would read past the truncated copy (out-of-bounds) instead of the real
-# C buffer.
+# C buffer.  (headers is a C string by construction: CRLF-terminated
+# header block, no NULs.)
 _HANDLER = ctypes.CFUNCTYPE(
-    None, ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char),
-    ctypes.c_long, ctypes.c_void_p)
+    None, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char), ctypes.c_long, ctypes.c_void_p)
+
+
+def _parse_headers(raw: bytes) -> dict:
+    """Raw header block → {Title-Cased-Name: value}.  Title-casing makes
+    lookups like ``headers.get("X-Request-Deadline-Ms")`` behave the
+    same as the stdlib front-end's case-insensitive email.Message."""
+    out: dict[str, str] = {}
+    lines = raw.decode("latin-1", errors="replace").split("\r\n")
+    # lines[0] is the request line ("POST /v1/models/m:predict HTTP/1.1")
+    # — a path with a colon would otherwise parse as a junk header
+    for line in lines[1:]:
+        name, sep, value = line.partition(":")
+        if sep:
+            out[name.strip().title()] = value.strip()
+    return out
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
@@ -100,11 +116,12 @@ class NativeModelServer(ModelServer):
         lib = _load()
 
         @_HANDLER
-        def on_request(method, path, body, body_len, resp):
+        def on_request(method, path, headers, body, body_len, resp):
             try:
                 status, obj = self.handle(
                     method.decode(), path.decode(),
-                    ctypes.string_at(body, body_len) if body_len else b"")
+                    ctypes.string_at(body, body_len) if body_len else b"",
+                    _parse_headers(headers or b""))
                 data = json.dumps(obj).encode()
             except Exception as e:  # noqa: BLE001 - never unwind into C
                 log.exception("native handler failure")
@@ -135,8 +152,12 @@ class NativeModelServer(ModelServer):
         self.load_all()
         self.start()
         try:
-            while True:
-                time.sleep(3600)
+            # Poll-wait on the native handle: a SIGTERM drain's stop()
+            # clears it, and serve_forever must then RETURN (so the
+            # process exits inside terminationGracePeriodSeconds rather
+            # than idling into the SIGKILL).
+            while self._native is not None:
+                time.sleep(0.5)
         except KeyboardInterrupt:
             pass
         finally:

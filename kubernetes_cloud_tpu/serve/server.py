@@ -5,13 +5,29 @@ the exact REST surface the reference's InferenceServices expose
 (``online-inference/tensorizer-isvc/README.md``; clients at
 ``image-classifier/service/predict_url.sh``):
 
-* ``GET  /``                         liveness (Knative probe target)
+* ``GET  /``, ``/healthz``           liveness: process alive — always
+  200, even with a wedged engine (killing a pod that holds streamed
+  weights is the supervisor's last resort, not the probe's first)
+* ``GET  /readyz``                   readiness: models loaded ∧ engine
+  heartbeat fresh ∧ circuit closed ∧ queue below shed threshold ∧ not
+  draining (each model's ``health()``; Knative routes on this)
 * ``GET  /v1/models``                model list
-* ``GET  /v1/models/<name>``         readiness
+* ``GET  /v1/models/<name>``         per-model readiness
 * ``POST /v1/models/<name>:predict`` prediction
 * ``POST /completion``               FastAPI-compatible completion route
   (``finetuner-workflow/finetuner/inference.py:80-96``) when the model
   implements ``completion()``
+
+Error mapping (:mod:`kubernetes_cloud_tpu.serve.errors`): ValueError →
+400, RetryableError (queue full / engine restarted / stream stalled /
+draining) → 503, DeadlineExceededError → 504, anything else → 500.
+Requests may carry a deadline as an ``X-Request-Deadline-Ms`` header or
+a ``deadline_ms`` payload field; expired work is shed, not computed.
+
+SIGTERM (:func:`ModelServer.drain`, installed by ``serve.boot``)
+follows the Knative pod-termination contract: readiness flips to 503
+and admission stops immediately, in-flight requests run to completion,
+self-batching workers drain their slots, then the listener closes.
 
 Concurrency: one lock per model — the reference's GPU services run with
 ``containerConcurrency: 1`` (``stable-diffusion/03-inference-service.yaml:7``)
@@ -26,13 +42,22 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Iterable
+from typing import Iterable, Mapping, Optional
 
-from kubernetes_cloud_tpu.serve.batcher import QueueFullError
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.serve.errors import (
+    DeadlineExceededError,
+    RetryableError,
+)
 from kubernetes_cloud_tpu.serve.model import Model
 
 log = logging.getLogger(__name__)
+
+#: relative deadline budget header (KServe/Knative have no standard one;
+#: gRPC's grpc-timeout plays this role on the other data plane)
+DEADLINE_HEADER = "X-Request-Deadline-Ms"
 
 
 class ModelServer:
@@ -42,6 +67,9 @@ class ModelServer:
         self.locks = {name: threading.Lock() for name in self.models}
         self.host, self.port = host, port
         self._httpd: ThreadingHTTPServer | None = None
+        self._draining = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
 
     def load_all(self) -> None:
         for model in self.models.values():
@@ -50,10 +78,20 @@ class ModelServer:
 
     # -- request handling --------------------------------------------------
 
-    def handle(self, method: str, path: str, body: bytes) -> tuple[int, dict]:
+    def handle(self, method: str, path: str, body: bytes,
+               headers: Optional[Mapping[str, str]] = None
+               ) -> tuple[int, dict]:
+        try:
+            faults.fire("server.handle")
+        except faults.FaultError as e:
+            return 500, {"error": str(e)}
         if method == "GET":
             if path in ("/", "/healthz"):
+                # process liveness only — unconditionally alive; engine
+                # trouble is /readyz's (and the supervisor's) business
                 return 200, {"status": "alive"}
+            if path == "/readyz":
+                return self._readyz()
             if path == "/v1/models":
                 return 200, {"models": sorted(self.models)}
             if path.startswith("/v1/models/"):
@@ -65,18 +103,46 @@ class ModelServer:
             return 404, {"error": "not found"}
 
         if method == "POST":
+            # admission control: count in-flight BEFORE the drain check
+            # so drain() observing _inflight == 0 proves no request can
+            # still slip past the flag
+            with self._inflight_lock:
+                self._inflight += 1
             try:
-                payload = json.loads(body or b"{}")
-            except json.JSONDecodeError as e:
-                return 400, {"error": f"invalid JSON: {e}"}
-            if path.endswith(":predict") and path.startswith("/v1/models/"):
-                name = path[len("/v1/models/"):-len(":predict")]
-                return self._predict(name, payload)
-            if path == "/completion":
-                return self._completion(payload)
-            return 404, {"error": "not found"}
+                if self._draining:
+                    return 503, {"error": "pod is draining; retry "
+                                          "against another replica"}
+                try:
+                    payload = json.loads(body or b"{}")
+                except json.JSONDecodeError as e:
+                    return 400, {"error": f"invalid JSON: {e}"}
+                if headers is not None and isinstance(payload, dict):
+                    budget = headers.get(DEADLINE_HEADER)
+                    if budget is not None:
+                        payload.setdefault("deadline_ms", budget)
+                if path.endswith(":predict") and path.startswith(
+                        "/v1/models/"):
+                    name = path[len("/v1/models/"):-len(":predict")]
+                    return self._predict(name, payload)
+                if path == "/completion":
+                    return self._completion(payload)
+                return 404, {"error": "not found"}
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
 
         return 405, {"error": "method not allowed"}
+
+    def _readyz(self) -> tuple[int, dict]:
+        if self._draining:
+            return 503, {"status": "draining"}
+        detail, ok = {}, True
+        for name, model in self.models.items():
+            h = model.health()
+            detail[name] = h
+            ok = ok and bool(h.get("ok"))
+        return (200 if ok else 503), {
+            "status": "ready" if ok else "unready", "models": detail}
 
     def _dispatch(self, model: Model, fn, payload: dict,
                   what: str) -> tuple[int, dict]:
@@ -91,7 +157,9 @@ class ModelServer:
                 return 200, fn(payload)
         except ValueError as e:  # request validation problems
             return 400, {"error": str(e)}
-        except QueueFullError as e:  # backpressure: retriable overload
+        except DeadlineExceededError as e:  # shed: nobody is waiting
+            return 504, {"error": str(e)}
+        except RetryableError as e:  # transient overload/restart: retry
             return 503, {"error": str(e)}
         except Exception as e:  # surface as a 500, keep serving
             log.exception("%s failed", what)
@@ -124,7 +192,8 @@ class ModelServer:
             def _respond(self, method):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                status, obj = server.handle(method, self.path, body)
+                status, obj = server.handle(method, self.path, body,
+                                            self.headers)
                 data = json.dumps(obj).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -165,3 +234,33 @@ class ModelServer:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Graceful SIGTERM sequence (the Knative/KServe pod-termination
+        contract): ``/readyz`` → 503 and admission stops immediately;
+        in-flight requests run to completion (bounded by ``timeout``);
+        self-batching workers drain their slots; the listener closes.
+        Idempotent; callable from any thread except an HTTP worker."""
+        t0 = time.monotonic()
+        self._draining = True  # readiness 503 + new POSTs rejected
+        while time.monotonic() - t0 < timeout:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
+        for model in self.models.values():
+            stop = getattr(model, "stop", None)
+            if callable(stop):
+                try:
+                    stop()  # engine/batcher slot drain
+                except Exception:  # noqa: BLE001 - drain best-effort
+                    log.exception("stopping %s during drain failed",
+                                  model.name)
+        with self._inflight_lock:
+            leftover = self._inflight
+        self.stop()
+        took = time.monotonic() - t0
+        log.info("drain complete in %.2fs (%d request(s) abandoned)",
+                 took, leftover)
+        return {"drained": leftover == 0, "inflight": leftover,
+                "took_s": round(took, 3)}

@@ -1,0 +1,400 @@
+"""Self-healing serving supervisor: watchdog, restarts, circuit breaker.
+
+The serving stack's workers — the continuous-batching engine's scheduler
+(:mod:`kubernetes_cloud_tpu.serve.continuous`) and the dynamic batcher's
+dispatcher (:mod:`kubernetes_cloud_tpu.serve.batcher`) — are single
+threads that own the device.  A wedged device call (driver hang,
+deadlocked collective) or a crashed loop strands every in-flight request
+and, before this module, required a human (or a Kubernetes liveness
+kill) to restart the whole pod, losing the warmed compile cache and the
+loaded weights.
+
+The supervisor keeps the pod alive through worker failure instead:
+
+1.  **Heartbeat watchdog.**  Every worker beats a :class:`Heartbeat`
+    once per scheduler iteration (including idle polls, so a fresh
+    heartbeat always means "the loop is turning").  The watchdog thread
+    polls each watched model: a dead worker thread is a *crash*, a
+    stale heartbeat on a live thread is a *hang*.
+2.  **Restart.**  On failure the old worker is abandoned (in-flight
+    requests fail with the retryable
+    :class:`~kubernetes_cloud_tpu.serve.errors.EngineRestartedError` →
+    HTTP 503), a fresh engine is built over the already-loaded weights
+    (fresh slot pool; the jit cache is module-level, so no recompile),
+    and requests that were still queued — admitted by nobody — are
+    re-admitted to the new engine untouched.
+3.  **Crash-loop circuit breaker.**  More than ``max_restarts`` inside
+    ``restart_window_s`` opens the circuit: the model is marked
+    permanently unready (``/readyz`` 503, Knative routes elsewhere /
+    the liveness probe's restart policy takes over), because restarting
+    a worker that immediately dies again just burns requests.
+4.  **Honest readiness.**  :meth:`ServingSupervisor.health` is what a
+    watched model's ``health()`` reports to ``/readyz``: worker alive ∧
+    heartbeat fresh ∧ circuit closed ∧ queue below the shed threshold.
+
+``/healthz`` (process liveness) stays unconditionally 200 — the whole
+point is that a wedged engine is the *supervisor's* problem, not a
+reason to kill a pod holding hundreds of GiB of streamed weights.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from typing import Iterable, Optional
+
+from kubernetes_cloud_tpu.serve.errors import EngineRestartedError
+
+log = logging.getLogger(__name__)
+
+
+class Heartbeat:
+    """Monotonic liveness pulse, beaten by worker loops, read by the
+    watchdog.  Lock-free: a float store is atomic under the GIL."""
+
+    __slots__ = ("_t",)
+
+    def __init__(self):
+        self._t = time.monotonic()
+
+    def beat(self) -> None:
+        self._t = time.monotonic()
+
+    @property
+    def age(self) -> float:
+        return time.monotonic() - self._t
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    poll_interval_s: float = 0.5   # watchdog wake cadence
+    hang_timeout_s: float = 10.0   # engine stale-heartbeat threshold
+    # (must exceed the slowest legitimate scheduler iteration)
+    max_restarts: int = 3          # inside restart_window_s, then …
+    restart_window_s: float = 60.0  # … the circuit opens
+    shed_queue_depth: Optional[int] = None  # readiness threshold;
+    # None = 90% of the worker's own queue bound
+    #: hang threshold for BatchingModel dispatchers.  None (default)
+    #: disables hang detection there — crash detection stays on — since
+    #: the batcher's heartbeat unit is a whole run-to-completion batch,
+    #: and one legitimate long batch (or a first-request XLA compile)
+    #: would read as a hang.  Opt in with a value sized above the
+    #: worst-case batch.
+    batcher_hang_timeout_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.poll_interval_s <= 0 or self.hang_timeout_s <= 0:
+            raise ValueError("intervals must be > 0")
+        if self.max_restarts < 1:
+            raise ValueError("max_restarts must be >= 1")
+
+
+class _EngineTarget:
+    """Adapter over ``ContinuousBatchingModel`` (duck-typed: anything
+    with ``.engine`` carrying heartbeat/abandon/requeue works)."""
+
+    def __init__(self, model):
+        self.model = model
+
+    @property
+    def name(self) -> str:
+        return self.model.name
+
+    def worker_alive(self) -> bool:
+        eng = self.model.engine
+        return eng is not None and eng.alive
+
+    def deliberately_stopped(self) -> bool:
+        # engine=None is NOT deliberate: with the model still ready it
+        # means a restart attempt failed (load() raised) — that must
+        # read as a crash so the watchdog retries and, failing
+        # repeatedly, opens the circuit instead of silently giving up.
+        eng = self.model.engine
+        return eng is not None and eng._stop.is_set()
+
+    def heartbeat_age(self) -> float:
+        eng = self.model.engine
+        return eng.heartbeat.age if eng is not None else 0.0
+
+    def queue_depth(self) -> int:
+        eng = self.model.engine
+        return eng.queue_depth() if eng is not None else 0
+
+    def queue_bound(self) -> int:
+        return self.model.cfg.max_queue_size
+
+    def hang_timeout(self, cfg: SupervisorConfig) -> Optional[float]:
+        # Floor at a few idle polls: an IDLE engine's heartbeat ages up
+        # to idle_wait_s (+ GIL jitter) between beats, so any timeout
+        # below that guarantees false hangs on a healthy idle pod.
+        eng = self.model.engine
+        floor = eng.ecfg.idle_wait_s * 4 if eng is not None else 0.0
+        return max(cfg.hang_timeout_s, floor)
+
+    def in_compile_grace(self) -> bool:
+        """A first-time prefill shape is compiling (engine raised
+        grace_until around the cold dispatch): the silence is XLA, not
+        a wedge.  A wedge DURING such a compile is still caught — at
+        grace expiry instead of hang_timeout."""
+        eng = self.model.engine
+        return (eng is not None
+                and time.monotonic() < getattr(eng, "grace_until", 0.0))
+
+    def restart(self, err: Exception) -> int:
+        old, self.model.engine = self.model.engine, None
+        queued = old.abandon(err) if old is not None else []
+        self.model.load()  # weights stay; fresh engine + slot pool
+        for req in queued:
+            self.model.engine.requeue(req)
+        return len(queued)
+
+    def shut_down(self, err: Exception) -> None:
+        old, self.model.engine = self.model.engine, None
+        if old is not None:
+            old.abandon(err)
+        self.model.ready = False
+
+
+class _BatcherTarget:
+    """Adapter over ``BatchingModel``: same contract, dispatcher
+    restarts happen in place (no device state to rebuild)."""
+
+    def __init__(self, model):
+        self.model = model
+
+    @property
+    def name(self) -> str:
+        return self.model.name
+
+    def worker_alive(self) -> bool:
+        t = self.model._thread
+        return t is not None and t.is_alive()
+
+    def deliberately_stopped(self) -> bool:
+        return self.model._thread is None or self.model._stop.is_set()
+
+    def heartbeat_age(self) -> float:
+        return self.model.heartbeat.age
+
+    def queue_depth(self) -> int:
+        return self.model._queue.qsize()
+
+    def queue_bound(self) -> int:
+        return self.model.cfg.max_queue_size
+
+    def hang_timeout(self, cfg: SupervisorConfig) -> Optional[float]:
+        return cfg.batcher_hang_timeout_s
+
+    def in_compile_grace(self) -> bool:
+        return False  # batcher hang detection is opt-in/pre-sized
+
+    def restart(self, err: Exception) -> int:
+        return self.model.restart_dispatcher(err)
+
+    def shut_down(self, err: Exception) -> None:
+        self.model.abandon_dispatcher(err)
+        self.model.ready = False
+
+
+class _Watched:
+    __slots__ = ("target", "restarts", "circuit_open", "restarting",
+                 "last_failure")
+
+    def __init__(self, target):
+        self.target = target
+        self.restarts: "collections.deque[float]" = collections.deque()
+        self.circuit_open = False
+        #: a restart (engine rebuild — a blocking device call) is in
+        #: flight on its own thread; health reports unready meanwhile
+        self.restarting = False
+        self.last_failure: Optional[str] = None
+
+
+class ServingSupervisor:
+    """One watchdog thread over any number of serving workers."""
+
+    def __init__(self, cfg: SupervisorConfig = SupervisorConfig()):
+        self.cfg = cfg
+        self._watched: list[_Watched] = []
+        self._by_model: dict[int, _Watched] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # serializes restart vs health
+        self.stats = {"restarts": 0, "hangs": 0, "crashes": 0,
+                      "circuit_opens": 0, "requeued": 0}
+
+    # -- registration ------------------------------------------------------
+
+    def watch(self, model) -> None:
+        """Supervise ``model``; picks the adapter by shape and installs
+        itself as ``model.supervisor`` (consulted by ``health()``)."""
+        if hasattr(model, "engine"):
+            target = _EngineTarget(model)
+        elif hasattr(model, "heartbeat") and hasattr(model, "_thread"):
+            target = _BatcherTarget(model)
+        else:
+            raise TypeError(
+                f"{type(model).__name__} has no supervisable worker "
+                "(need .engine or .heartbeat/._thread)")
+        w = _Watched(target)
+        self._watched.append(w)
+        self._by_model[id(model)] = w
+        model.supervisor = self
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-supervisor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.poll_interval_s):
+            try:
+                self.check_now()
+            except Exception:  # noqa: BLE001 - the watchdog never dies
+                log.exception("supervisor check failed")
+
+    # -- watchdog ----------------------------------------------------------
+
+    def check_now(self) -> None:
+        """One watchdog pass (the thread calls this every poll; tests
+        may call it synchronously)."""
+        for w in self._watched:
+            self._check(w)
+
+    def _check(self, w: _Watched) -> None:
+        # Diagnosis + budget bookkeeping happen under the lock; the
+        # restart itself does NOT — rebuilding an engine is a blocking
+        # device call, and on a genuinely wedged device it may never
+        # return.  It runs on its own thread so /readyz and the other
+        # watched models keep being served/supervised regardless.
+        with self._lock:
+            t = w.target
+            if (w.circuit_open or w.restarting
+                    or not getattr(t.model, "ready", False)):
+                return
+            if t.deliberately_stopped():
+                # stop()/drain in progress (or already finished): a dead
+                # thread is completion, a stale heartbeat is the final
+                # queue drain — neither is a failure, and "restarting"
+                # here would resurrect a worker mid-shutdown.
+                return
+            reason = None
+            if not t.worker_alive():
+                self.stats["crashes"] += 1
+                reason = "worker thread died"
+            else:
+                hang_timeout = t.hang_timeout(self.cfg)
+                if hang_timeout is not None and not t.in_compile_grace():
+                    age = t.heartbeat_age()
+                    if age > hang_timeout:
+                        self.stats["hangs"] += 1
+                        reason = (f"heartbeat stale for {age:.2f}s "
+                                  f"(> {hang_timeout}s)")
+            if reason is None:
+                return
+            w.last_failure = reason
+            now = time.monotonic()
+            while (w.restarts
+                   and now - w.restarts[0] > self.cfg.restart_window_s):
+                w.restarts.popleft()
+            err = EngineRestartedError(
+                f"{t.name}: engine restarted ({reason}); retry")
+            if len(w.restarts) >= self.cfg.max_restarts:
+                w.circuit_open = True
+                self.stats["circuit_opens"] += 1
+                log.error("%s: circuit OPEN after %d restarts in %.0fs "
+                          "(%s); marking permanently unready", t.name,
+                          len(w.restarts), self.cfg.restart_window_s,
+                          reason)
+                t.shut_down(err)  # fails work only; never touches device
+                return
+            w.restarts.append(now)
+            self.stats["restarts"] += 1
+            w.restarting = True
+        log.warning("%s: %s; restarting worker (restart %d/%d in window)",
+                    t.name, reason, len(w.restarts), self.cfg.max_restarts)
+        threading.Thread(target=self._do_restart, args=(w, err),
+                         daemon=True, name=f"restart-{t.name}").start()
+
+    def _do_restart(self, w: _Watched, err: Exception) -> None:
+        try:
+            requeued = w.target.restart(err)
+            with self._lock:
+                self.stats["requeued"] += requeued
+        except Exception:  # noqa: BLE001 - a failed restart = next check
+            log.exception("%s: restart failed", w.target.name)
+        finally:
+            with self._lock:
+                w.restarting = False
+
+    # -- readiness ---------------------------------------------------------
+
+    def _shed_threshold(self, t) -> int:
+        if self.cfg.shed_queue_depth is not None:
+            return self.cfg.shed_queue_depth
+        return max(1, int(t.queue_bound() * 0.9))
+
+    def health(self, model) -> dict:
+        """The model's ``/readyz`` contribution: ok ⇔ worker alive ∧
+        heartbeat fresh ∧ circuit closed ∧ queue below shed depth."""
+        w = self._by_model.get(id(model))
+        if w is None:
+            return {"ok": bool(getattr(model, "ready", False)),
+                    "reason": "unwatched"}
+        with self._lock:
+            t = w.target
+            if w.circuit_open:
+                return {"ok": False,
+                        "reason": f"circuit open ({w.last_failure})",
+                        "restarts": self.stats["restarts"]}
+            if w.restarting:
+                return {"ok": False,
+                        "reason": f"restarting ({w.last_failure})"}
+            if not model.ready:
+                return {"ok": False, "reason": "not loaded"}
+            if not t.worker_alive():
+                return {"ok": False, "reason": "worker dead"}
+            age = t.heartbeat_age()
+            hang_timeout = t.hang_timeout(self.cfg)
+            if (hang_timeout is not None and age > hang_timeout
+                    and not t.in_compile_grace()):
+                return {"ok": False,
+                        "reason": f"heartbeat stale ({age:.2f}s)"}
+            depth, shed = t.queue_depth(), self._shed_threshold(t)
+            if depth >= shed:
+                return {"ok": False,
+                        "reason": f"queue depth {depth} >= shed "
+                                  f"threshold {shed}"}
+            return {"ok": True, "reason": "ok",
+                    "queue_depth": depth, "heartbeat_age_s": round(age, 3),
+                    "restarts": len(w.restarts)}
+
+
+def supervise(models: Iterable, cfg: SupervisorConfig = SupervisorConfig()
+              ) -> Optional[ServingSupervisor]:
+    """Watch every supervisable model in ``models``; returns the started
+    supervisor, or None if nothing needed watching (one-shot services
+    have no worker thread to wedge)."""
+    sup = ServingSupervisor(cfg)
+    for m in models:
+        try:
+            sup.watch(m)
+        except TypeError:
+            continue
+    if not sup._watched:
+        return None
+    sup.start()
+    return sup

@@ -17,7 +17,13 @@ CLI::
 
     python scripts/bench_serving.py [--preset test-tiny] [--slots 8]
         [--stages 2,4,8] [--stage-duration 10]
-"""
+
+Recovery mode (``--inject hang|crash``) measures the self-healing
+supervisor instead of throughput: a deterministic fault wedges (or
+crashes) the decode loop mid-stream, and the benchmark reports how long
+the pod took to go unready → restarted engine → ``/readyz`` 200 →
+serving verified, as ``{"metric": "serving_recovery_s", ...}``
+(BENCHMARKS.md "Self-healing recovery")."""
 
 from __future__ import annotations
 
@@ -81,6 +87,130 @@ def _drive(model, pool, stages, stage_duration):
     }
 
 
+def _poll_readyz(url: str, want: int, timeout_s: float) -> float:
+    """Poll /readyz until it answers ``want``; returns seconds waited."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                status = r.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        except Exception:  # noqa: BLE001 - server mid-restart
+            status = 0
+        if status == want:
+            return time.monotonic() - t0
+        time.sleep(0.02)
+    raise TimeoutError(f"/readyz never returned {want} "
+                       f"within {timeout_s}s")
+
+
+def run_recovery(args) -> int:
+    """--inject: wedge/crash the decode loop mid-stream, time the
+    supervisor's detect → restart → ready-again sequence, verify the
+    recovered engine still generates."""
+    import threading
+    import time
+    import urllib.request
+
+    from kubernetes_cloud_tpu import faults
+    from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingModel,
+        EngineConfig,
+    )
+    from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+    from kubernetes_cloud_tpu.serve.server import ModelServer
+    from kubernetes_cloud_tpu.serve.supervisor import (
+        ServingSupervisor,
+        SupervisorConfig,
+    )
+
+    cfg = dataclasses.replace(PRESETS[args.preset], dtype=jnp.float32)
+    svc = CausalLMService("lm", cfg,
+                          params=init_params(cfg, jax.random.key(0)),
+                          dtype=jnp.float32)
+    svc.load()
+    model = ContinuousBatchingModel("lm", svc, EngineConfig(
+        slots=args.slots, max_len=args.pool_max_len))
+    model.load()
+    sup = ServingSupervisor(SupervisorConfig(
+        poll_interval_s=0.05, hang_timeout_s=args.hang_timeout))
+    sup.watch(model)
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    payload = json.dumps({
+        "instances": ["warm the decode path please"],
+        "parameters": {"max_new_tokens": 16, "temperature": 0.0},
+    }).encode()
+
+    def post():
+        req = urllib.request.Request(
+            base + "/v1/models/lm:predict", data=payload,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    try:
+        post()  # warm every compiled program before the clock starts
+        # watch only AFTER warm-up: a first-request prefill compile can
+        # outlast hang_timeout and read as a (false) hang — on real
+        # hardware the persistent compile cache + probe initialDelay
+        # play this role
+        sup.start()
+        _poll_readyz(base + "/readyz", 200, 30)
+        if args.inject == "hang":
+            spec = faults.FaultSpec("decode_step", mode="hang",
+                                    delay_s=600.0)
+        else:
+            spec = faults.FaultSpec("model_fn", mode="raise")
+        faults.install(faults.FaultInjector([spec]))
+        t_fault = time.monotonic()
+        # the victim request drives the scheduler into the armed fault
+        threading.Thread(target=lambda: _swallow(post), daemon=True).start()
+        # detection: the watchdog books the failure (the /readyz 503
+        # window between detection and the restart completing can be
+        # shorter than an HTTP poll interval, so count, don't poll)
+        while sup.stats["hangs"] + sup.stats["crashes"] == 0:
+            if time.monotonic() - t_fault > 60:
+                raise TimeoutError("supervisor never detected the fault")
+            time.sleep(0.005)
+        t_detect = time.monotonic() - t_fault
+        _poll_readyz(base + "/readyz", 200, 60)  # restarted & ready
+        recovery_s = time.monotonic() - t_fault
+        out = post()  # the recovered engine must actually serve
+        assert out["predictions"][0]["tokens_out"] == 16, out
+    finally:
+        faults.uninstall()
+        server.stop()
+        sup.stop()
+        model.stop()
+
+    print(json.dumps({
+        "metric": "serving_recovery_s",
+        "value": round(recovery_s, 3),
+        "unit": "s",
+        "inject": args.inject,
+        "detect_s": round(t_detect, 3),
+        "hang_timeout_s": args.hang_timeout,
+        "supervisor": sup.stats,
+        "preset": args.preset,
+    }))
+    return 0
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Exception:  # noqa: BLE001 - the victim request is sacrificial
+        pass
+
+
 def main(argv=None) -> int:
     from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
     from kubernetes_cloud_tpu.serve.batcher import BatcherConfig, BatchingModel
@@ -101,7 +231,17 @@ def main(argv=None) -> int:
                     help="payload pool size (cycled by the ramp)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--skip-baseline", action="store_true")
+    ap.add_argument("--inject", choices=("hang", "crash"), default=None,
+                    help="recovery mode: wedge (hang) or crash the "
+                         "decode loop and measure supervisor recovery "
+                         "time instead of throughput")
+    ap.add_argument("--hang-timeout", type=float, default=1.0,
+                    help="recovery mode: supervisor heartbeat-staleness "
+                         "threshold")
     args = ap.parse_args(argv)
+
+    if args.inject:
+        return run_recovery(args)
 
     rng = random.Random(args.seed)
     pool = _payload_pool(rng, args.requests)

@@ -119,6 +119,28 @@ def test_jobsets_are_symmetric():
             assert spec["parallelism"] == spec["completions"]
 
 
+def test_inference_services_wire_probes_and_drain():
+    """The KServe/Knative probe-and-drain contract (serve/server.py):
+    every online-inference InferenceService probes liveness at /healthz
+    (process alive, unconditional) and readiness at /readyz (the honest
+    serving state), and budgets terminationGracePeriodSeconds for the
+    SIGTERM drain."""
+    for path in (DEPLOY / "online-inference").rglob("*.yaml"):
+        for doc in _docs(path):
+            if doc.get("kind") != "InferenceService":
+                continue
+            pred = doc["spec"]["predictor"]
+            assert pred.get("terminationGracePeriodSeconds", 0) >= 60, (
+                f"{path}: no drain budget")
+            ctr = pred["containers"][0]
+            live = ctr.get("livenessProbe", {}).get("httpGet", {})
+            ready = ctr.get("readinessProbe", {}).get("httpGet", {})
+            assert live.get("path") == "/healthz", (
+                f"{path}: livenessProbe must target /healthz")
+            assert ready.get("path") == "/readyz", (
+                f"{path}: readinessProbe must target /readyz")
+
+
 def test_ready_sentinel_protocol_present():
     text = (DEPLOY / "online-inference" / "bloom-176b" /
             "01-download-job.yaml").read_text()

@@ -1,0 +1,127 @@
+"""Fault-injection framework unit tests: the chaos suites are only as
+trustworthy as the injector's determinism (same schedule every run)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.faults import FaultError, FaultInjector, FaultSpec
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Never leak an armed injector into (or out of) a test."""
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+class TestSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="mode"):
+            FaultSpec("x", mode="explode")
+        with pytest.raises(ValueError, match="1-based"):
+            FaultSpec("x", at=0)
+        with pytest.raises(ValueError, match="times"):
+            FaultSpec("x", times=0)
+
+    def test_due_window(self):
+        s = FaultSpec("x", at=3, times=2)
+        assert [s.due(h) for h in range(1, 7)] == [
+            False, False, True, True, False, False]
+        forever = FaultSpec("x", at=2, times=-1)
+        assert not forever.due(1)
+        assert all(forever.due(h) for h in range(2, 10))
+
+
+class TestInjector:
+    def test_deterministic_raise_at_nth_hit(self):
+        inj = FaultInjector([FaultSpec("site", mode="raise", at=3)])
+        assert inj.fire("site") is None
+        assert inj.fire("site") is None
+        with pytest.raises(FaultError, match="hit 3"):
+            inj.fire("site")
+        assert inj.fire("site") is None  # times=1: window closed
+        assert inj.hits("site") == 4
+        assert inj.fired == [("site", "raise", 3)]
+
+    def test_sites_are_independent(self):
+        inj = FaultInjector([FaultSpec("a", mode="drop", at=1)])
+        assert inj.fire("b") is None
+        assert inj.fire("a") == "drop"
+        assert inj.hits("a") == 1 and inj.hits("b") == 1
+
+    def test_slow_sleeps_for_delay(self):
+        inj = FaultInjector([FaultSpec("s", mode="slow", delay_s=0.05)])
+        t0 = time.monotonic()
+        assert inj.fire("s") == "slow"
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_hang_blocks_until_released(self):
+        inj = FaultInjector([FaultSpec("h", mode="hang", delay_s=30.0)])
+        done = threading.Event()
+
+        def victim():
+            inj.fire("h")
+            done.set()
+
+        threading.Thread(target=victim, daemon=True).start()
+        assert not done.wait(timeout=0.1)  # parked in the hang
+        inj.release()
+        assert done.wait(timeout=2.0)  # freed long before delay_s
+
+    def test_thread_safe_hit_counting(self):
+        inj = FaultInjector([FaultSpec("c", mode="drop", at=1, times=-1)])
+        threads = [threading.Thread(
+            target=lambda: [inj.fire("c") for _ in range(100)])
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert inj.hits("c") == 800
+        assert len(inj.fired) == 800
+
+
+class TestModuleGate:
+    def test_disarmed_fire_is_noop(self):
+        assert faults.active() is None
+        assert faults.fire("anything") is None
+
+    def test_inject_context_manager_scopes_arming(self):
+        with faults.inject(FaultSpec("x", mode="raise")) as inj:
+            assert faults.active() is inj
+            with pytest.raises(FaultError):
+                faults.fire("x")
+        assert faults.active() is None
+        assert faults.fire("x") is None
+
+    def test_uninstall_releases_hung_threads(self):
+        inj = faults.install(
+            FaultInjector([FaultSpec("h", mode="hang", delay_s=30.0)]))
+        done = threading.Event()
+        threading.Thread(target=lambda: (inj.fire("h"), done.set()),
+                         daemon=True).start()
+        assert not done.wait(timeout=0.05)
+        faults.uninstall()
+        assert done.wait(timeout=2.0)
+
+    def test_install_from_env(self, monkeypatch):
+        monkeypatch.setenv("KCT_FAULTS", '[{"site": "decode_step", '
+                           '"mode": "hang", "at": 5, "delay_s": 1.5}]')
+        inj = faults.install_from_env()
+        try:
+            assert inj is faults.active()
+            assert [inj.fire("decode_step") for _ in range(4)] == [None] * 4
+        finally:
+            faults.uninstall()
+        monkeypatch.setenv("KCT_FAULTS", "")
+        assert faults.install_from_env() is None
+
+    def test_parse_specs_rejects_non_list(self):
+        with pytest.raises(ValueError, match="JSON list"):
+            faults.parse_specs('{"site": "x"}')

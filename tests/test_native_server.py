@@ -15,7 +15,10 @@ from kubernetes_cloud_tpu.serve.native_server import NativeModelServer
 
 class Echo(Model):
     def predict(self, payload):
-        return {"predictions": payload.get("instances", [])}
+        out = {"predictions": payload.get("instances", [])}
+        if "deadline_ms" in payload:  # echoes the server header inject
+            out["deadline_ms"] = payload["deadline_ms"]
+        return out
 
     def completion(self, payload):
         return {"completion": payload.get("prompt", "") + "!"}
@@ -54,6 +57,23 @@ def test_v1_surface_parity(server):
         == (200, {"completion": "hi!"})
     assert _req(server.port, "/v1/models/nope:predict", {})[0] == 404
     assert _req(server.port, "/nope")[0] == 404
+
+
+def test_probes_and_deadline_header_cross_the_c_boundary(server):
+    """The C callback forwards the raw header block, so the native
+    front-end serves the same /readyz and X-Request-Deadline-Ms
+    contracts as the stdlib fallback (any header casing)."""
+    assert _req(server.port, "/healthz")[0] == 200
+    code, body = _req(server.port, "/readyz")
+    assert (code, body["status"]) == (200, "ready")
+    url = f"http://127.0.0.1:{server.port}/v1/models/echo:predict"
+    req = urllib.request.Request(
+        url, data=json.dumps({"instances": ["x"]}).encode(),
+        headers={"Content-Type": "application/json",
+                 "x-request-deadline-ms": "2500"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        out = json.loads(r.read())
+    assert float(out["deadline_ms"]) == 2500.0
 
 
 def test_keep_alive_and_concurrency(server):

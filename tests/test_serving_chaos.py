@@ -1,0 +1,487 @@
+"""Self-healing serving chaos: deterministic faults vs the supervisor.
+
+The proof for serve/supervisor.py + kubernetes_cloud_tpu/faults.py:
+a wedged decode loop is detected by heartbeat staleness, the engine is
+restarted (fresh slot pool, queued requests transplanted), /readyz
+returns to 200, and the recovered engine generates token-identically to
+one-shot ``generate``; a crash-looping engine trips the circuit breaker
+into permanent unreadiness while /healthz stays 200 throughout.
+Everything is CPU-host, inside the quick-lane budget, and deterministic
+(the injector fires on exact hit counts, never on timing dice).
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.models.generate import generate
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingEngine,
+    ContinuousBatchingModel,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.errors import (
+    DeadlineExceededError,
+    EngineRestartedError,
+    RetryableError,
+    StreamTimeoutError,
+)
+from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+from kubernetes_cloud_tpu.serve.server import ModelServer
+from kubernetes_cloud_tpu.serve.supervisor import (
+    ServingSupervisor,
+    SupervisorConfig,
+)
+
+pytestmark = pytest.mark.chaos
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def service(params):
+    svc = CausalLMService("lm", CFG, params=params, dtype=jnp.float32)
+    svc.load()
+    return svc
+
+
+def greedy_reference(params, prompt_ids, n):
+    out = np.asarray(generate(CFG, params,
+                              jnp.asarray([prompt_ids], jnp.int32),
+                              max_new_tokens=n, temperature=0.0,
+                              pad_token_id=0))
+    return out[0, len(prompt_ids):len(prompt_ids) + n].tolist()
+
+
+def make_engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    eng = ContinuousBatchingEngine(CFG, params, EngineConfig(**kw),
+                                   eos_token_id=None, pad_token_id=0)
+    eng.start()
+    return eng
+
+
+def warm(eng):
+    """Compile every program the scenario will hit BEFORE arming faults
+    or watchdogs: a first-iteration XLA compile is (correctly)
+    indistinguishable from a wedged device, and these tests are about
+    injected failures, not cold-start ones."""
+    eng.submit([1, 2, 3], max_new_tokens=2, temperature=0.0).wait()
+
+
+def _get_status(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def _predict(port, prompt, max_new, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/lm:predict",
+        data=json.dumps({
+            "instances": [prompt],
+            "parameters": {"max_new_tokens": max_new, "temperature": 0.0},
+        }).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _wait_until(cond, timeout=10.0, interval=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_watchdog_restarts_hung_engine_end_to_end(service):
+    """ISSUE acceptance: hang the decode loop mid-stream → the watchdog
+    detects it within the heartbeat window, restarts the engine, /readyz
+    returns to 200, and the next request is token-identical to one-shot
+    generate."""
+    model = ContinuousBatchingModel("lm", service,
+                                    EngineConfig(slots=2, max_len=96))
+    model.load()
+    warm(model.engine)
+    sup = ServingSupervisor(SupervisorConfig(poll_interval_s=0.05,
+                                             hang_timeout_s=0.3))
+    sup.watch(model)
+    sup.start()
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        assert _get_status(server.port, "/readyz") == 200
+        opts = {"MAX_NEW_TOKENS": 6, "TEMPERATURE": 0.0, "TOP_K": 0,
+                "TOP_P": 1.0, "SEED": 0, "ECHO_PROMPT": False}
+        want = service.generate_texts(["after the storm"], opts)[0]
+        _predict(server.port, "after the storm", 6)  # compile warm-up
+
+        # wedge the SECOND decode iteration: the victim request is
+        # mid-stream (one token out) when the loop stops turning
+        faults.install(faults.FaultInjector(
+            [FaultSpec("decode_step", mode="hang", at=2, delay_s=60.0)]))
+        victim: dict = {}
+
+        def doomed():
+            try:
+                victim["status"] = _predict(server.port, "after the storm",
+                                            6)[0]
+            except urllib.error.HTTPError as e:
+                victim["status"] = e.code
+
+        t = threading.Thread(target=doomed)
+        t.start()
+        _wait_until(lambda: sup.stats["hangs"] >= 1,
+                    what="watchdog hang detection")
+        _wait_until(lambda: _get_status(server.port, "/readyz") == 200,
+                    what="/readyz back to 200 after restart")
+        t.join(timeout=10)
+        # the stranded stream failed retryable, not hung
+        assert victim["status"] == 503
+        assert sup.stats["restarts"] == 1
+
+        faults.uninstall()  # frees the abandoned scheduler thread
+        status, out = _predict(server.port, "after the storm", 6)
+        assert status == 200
+        assert out["predictions"][0]["generated_text"] == want
+    finally:
+        server.stop()
+        sup.stop()
+        model.stop()
+
+
+def test_crashed_engine_unsupervised_readyz_503_healthz_200(service):
+    """Honest health split without a supervisor: a dead engine flips
+    /readyz to 503 (Knative stops routing) while /healthz stays 200
+    (the pod, its weights, and its compile cache survive)."""
+    model = ContinuousBatchingModel("lm", service,
+                                    EngineConfig(slots=2, max_len=96))
+    model.load()
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        assert _get_status(server.port, "/readyz") == 200
+        faults.install(faults.FaultInjector([FaultSpec("model_fn")]))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _predict(server.port, "crash me", 4)
+        assert e.value.code == 503  # retryable, not a hang or a 500
+        _wait_until(lambda: not model.engine.alive, what="engine death")
+        assert _get_status(server.port, "/readyz") == 503
+        assert _get_status(server.port, "/healthz") == 200
+        assert isinstance(model.engine.last_error, faults.FaultError)
+    finally:
+        server.stop()
+        model.stop()
+
+
+def test_circuit_breaker_goes_permanently_unready(service):
+    """ISSUE acceptance: repeated injected crashes exhaust the restart
+    budget and the circuit opens — the model is permanently unready
+    (readyz 503) rather than crash-looping, while /healthz stays 200."""
+    model = ContinuousBatchingModel("lm", service,
+                                    EngineConfig(slots=2, max_len=96))
+    model.load()
+    warm(model.engine)
+    sup = ServingSupervisor(SupervisorConfig(poll_interval_s=0.02,
+                                             hang_timeout_s=5.0,
+                                             max_restarts=1,
+                                             restart_window_s=60.0))
+    sup.watch(model)
+    sup.start()
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        # every model program call crashes, forever
+        faults.install(faults.FaultInjector(
+            [FaultSpec("model_fn", times=-1)]))
+
+        def crash_once():
+            try:
+                _predict(server.port, "doomed", 4, timeout=10)
+            except urllib.error.HTTPError:
+                pass
+
+        deadline = time.monotonic() + 15
+        while (sup.stats["circuit_opens"] == 0
+               and time.monotonic() < deadline):
+            crash_once()
+            time.sleep(0.05)
+        assert sup.stats["circuit_opens"] == 1
+        assert sup.stats["restarts"] == 1  # budget spent before the trip
+        assert model.ready is False
+        assert _get_status(server.port, "/readyz") == 503
+        assert _get_status(server.port, "/healthz") == 200
+        # permanently: further checks never resurrect it
+        time.sleep(0.1)
+        assert _get_status(server.port, "/readyz") == 503
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _predict(server.port, "still down", 2, timeout=10)
+        assert e.value.code == 503
+    finally:
+        server.stop()
+        sup.stop()
+        model.stop()
+
+
+def test_queued_request_transplanted_across_restart(params):
+    """Queued (never-admitted) requests survive an engine restart: the
+    supervisor re-admits them into the replacement engine and they
+    complete token-identically; only the in-flight request fails."""
+    class _Shim:
+        """Minimal engine-bearing model: exactly the duck-typed surface
+        _EngineTarget needs (engine / name / ready / cfg / load)."""
+
+        def __init__(self, engine):
+            self.engine = engine
+            self.name = "lm"
+            self.ready = True
+            self.cfg = engine.ecfg
+
+        def load(self):
+            self.engine = make_engine(params, slots=1)
+
+    shim = _Shim(make_engine(params, slots=1))
+    warm(shim.engine)
+    sup = ServingSupervisor(SupervisorConfig(poll_interval_s=0.05,
+                                             hang_timeout_s=0.25))
+    sup.watch(shim)
+    sup.start()
+    try:
+        prompt_a, prompt_b = list(range(1, 9)), [7, 8, 9]
+        want_b = greedy_reference(params, prompt_b, 4)
+        # wedge decode hit 3: A is mid-generation, B still queued
+        faults.install(faults.FaultInjector(
+            [FaultSpec("decode_step", mode="hang", at=3, delay_s=60.0)]))
+        req_a = shim.engine.submit(prompt_a, max_new_tokens=30,
+                                   temperature=0.0)
+        req_b = shim.engine.submit(prompt_b, max_new_tokens=4,
+                                   temperature=0.0)
+        with pytest.raises(EngineRestartedError):
+            req_a.wait()
+        assert req_b.wait() == want_b  # transplanted, then completed
+        assert sup.stats["requeued"] == 1
+        assert sup.stats["hangs"] == 1
+        assert req_b.engine is shim.engine  # follows the replacement
+    finally:
+        faults.uninstall()
+        sup.stop()
+        shim.engine.stop()
+
+
+def test_compile_grace_suppresses_hang_detection(params):
+    """A cold-shape prefill compile silences the heartbeat for tens of
+    seconds legitimately; the engine's grace window keeps the watchdog
+    from reading it as a hang (and from circuit-breaking a cold pod).
+    After the grace lifts, the same wedge is detected normally."""
+
+    class _Shim:
+        def __init__(self, engine):
+            self.engine = engine
+            self.name, self.ready = "lm", True
+            self.cfg = engine.ecfg
+
+        def load(self):
+            self.engine = make_engine(params, slots=1)
+
+    shim = _Shim(make_engine(params, slots=1))
+    warm(shim.engine)
+    sup = ServingSupervisor(SupervisorConfig(poll_interval_s=0.02,
+                                             hang_timeout_s=0.15))
+    sup.watch(shim)
+    sup.start()
+    try:
+        faults.install(faults.FaultInjector(
+            [FaultSpec("decode_step", mode="hang", delay_s=60.0)]))
+        eng = shim.engine
+        # stand in for a cold compile in flight: the wedged decode below
+        # is exactly as silent as a real first-shape XLA compile
+        eng.grace_until = time.monotonic() + 30.0
+        req = eng.submit([1, 2, 3], max_new_tokens=8, temperature=0.0)
+        time.sleep(0.6)  # 4x the hang timeout
+        assert sup.stats["hangs"] == 0  # grace held
+        assert sup.health(shim)["ok"] is True
+        eng.grace_until = 0.0  # "compile" over; now it IS a wedge
+        _wait_until(lambda: sup.stats["hangs"] == 1,
+                    what="hang detection after grace lifted")
+        with pytest.raises(EngineRestartedError):
+            req.wait()
+        # the restart runs on its own thread; wait for the replacement
+        _wait_until(lambda: shim.engine is not None and shim.engine.alive,
+                    what="replacement engine up")
+    finally:
+        faults.uninstall()
+        sup.stop()
+        if shim.engine is not None:
+            shim.engine.stop()
+
+
+def test_abandon_fails_requests_claimed_mid_admission(params):
+    """A wedge INSIDE prefill catches requests in the claimed-but-not-
+    yet-slotted window: abandon() must fail them too (they are in
+    neither the queue nor a slot), or their waiters would hang forever
+    against a live-but-wedged engine."""
+    eng = make_engine(params, slots=1, max_len=64)
+    try:
+        warm(eng)
+        faults.install(faults.FaultInjector(
+            [FaultSpec("model_fn", mode="hang", delay_s=60.0)]))
+        req = eng.submit([1, 2, 3, 4], max_new_tokens=4, temperature=0.0)
+        _wait_until(lambda: req.claimed and eng.queue_depth() == 0,
+                    what="request claimed by the wedged admission")
+        queued = eng.abandon(EngineRestartedError("restart"))
+        assert queued == []  # it was not transplantable from the queue
+        got = {}
+
+        def waiter():
+            try:
+                req.wait()
+            except Exception as e:  # noqa: BLE001
+                got["err"] = e
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        t.join(timeout=5)
+        assert isinstance(got.get("err"), EngineRestartedError)
+    finally:
+        faults.uninstall()
+        eng.stop()
+
+
+def test_deadline_shedding_and_admission_control(params):
+    """Deadlines: expired-at-submit → immediate 504-typed error;
+    expired-in-queue → shed at admission (no slot burned); queue-age
+    admission control refuses work the math proves will miss."""
+    eng = make_engine(params, slots=1, max_len=64)
+    try:
+        warm(eng)
+        # slow every iteration so the slot stays busy deterministically
+        faults.install(faults.FaultInjector(
+            [FaultSpec("iteration", mode="slow", delay_s=0.05, times=-1)]))
+        long_req = eng.submit(list(range(1, 9)), max_new_tokens=15,
+                              temperature=0.0)
+        with pytest.raises(DeadlineExceededError, match="before admission"):
+            eng.submit([1, 2], max_new_tokens=2,
+                       deadline=time.monotonic() - 0.001)
+        # queued behind ~0.75s of slow iterations with a 100ms budget
+        doomed = eng.submit([5, 6], max_new_tokens=2, temperature=0.0,
+                            deadline=time.monotonic() + 0.1)
+        # admission control: with the queue non-empty and a measured
+        # iteration time, a tiny budget is refused at the door
+        _wait_until(lambda: eng.iter_s is not None,
+                    what="iteration EWMA to be measured")
+        eng.iter_s = 0.5  # pin the estimate: determinism over realism
+        with pytest.raises(DeadlineExceededError, match="deadline miss"):
+            eng.submit([3, 4], max_new_tokens=2,
+                       deadline=time.monotonic() + 0.01)
+        with pytest.raises(DeadlineExceededError, match="expired in queue"):
+            doomed.wait()
+        assert eng.stats["deadline_shed"] == 1
+        assert len(long_req.wait()) == 15  # bystander unaffected
+    finally:
+        faults.uninstall()
+        eng.stop()
+
+
+def test_deadline_ms_payload_maps_504_over_http(service):
+    model = ContinuousBatchingModel("lm", service,
+                                    EngineConfig(slots=2, max_len=96))
+    model.load()
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/models/lm:predict",
+            data=json.dumps({"instances": ["x"],
+                             "parameters": {"max_new_tokens": 2},
+                             "deadline_ms": 0}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 504
+    finally:
+        server.stop()
+        model.stop()
+
+
+def test_dropped_stream_raises_typed_stream_timeout(params):
+    """ISSUE satellite: a stalled stream raises StreamTimeoutError (a
+    retryable error carrying engine liveness), never a raw
+    queue.Empty."""
+    eng = make_engine(params, slots=1, max_len=64)
+    try:
+        warm(eng)
+        faults.install(faults.FaultInjector([
+            # every token after the first is lost on the way out …
+            FaultSpec("stream", mode="drop", at=2, times=-1),
+            # … and iterations are slow enough that the client's window
+            # expires long before the generation finishes
+            FaultSpec("iteration", mode="slow", delay_s=0.03, times=-1),
+        ]))
+        req = eng.submit(list(range(1, 9)), max_new_tokens=20,
+                         temperature=0.0)
+        stream = req.iter_tokens(timeout=0.25)
+        first = next(stream)
+        with pytest.raises(StreamTimeoutError, match="engine alive"):
+            for _ in stream:
+                pass
+        assert isinstance(first, int)
+        # the engine itself is healthy: generation completed internally
+        assert len(req.wait()) == 20
+    finally:
+        faults.uninstall()
+        eng.stop()
+
+
+def test_dead_engine_fails_stream_within_one_poll(params):
+    """Engine death mid-stream surfaces in ≤ one 0.5s poll — the
+    liveness re-check the satellite asks for — instead of after the
+    client's full stream timeout."""
+    eng = make_engine(params, slots=1, max_len=64)
+    try:
+        warm(eng)
+        faults.install(faults.FaultInjector(
+            [FaultSpec("model_fn", at=3)]))  # crash on the 3rd program
+        req = eng.submit(list(range(1, 9)), max_new_tokens=20,
+                         temperature=0.0)
+        stream = req.iter_tokens(timeout=30.0)  # generous client window
+        next(stream)
+        t0 = time.monotonic()
+        with pytest.raises((StreamTimeoutError, EngineRestartedError,
+                            RetryableError)):
+            for _ in stream:
+                pass
+        assert time.monotonic() - t0 < 5.0  # not the 30s client window
+        assert not eng.alive
+    finally:
+        faults.uninstall()
+        eng.stop()

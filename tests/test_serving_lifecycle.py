@@ -1,0 +1,297 @@
+"""Server lifecycle + error-mapping contract (no accelerator needed):
+the typed-error → HTTP-status ladder, the honest /healthz vs /readyz
+split, deadline header propagation, and the SIGTERM graceful drain —
+the probe-and-drain behaviour `deploy/online-inference/` assumes."""
+
+import json
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.faults import FaultInjector, FaultSpec
+from kubernetes_cloud_tpu.serve import boot
+from kubernetes_cloud_tpu.serve.batcher import BatcherConfig, BatchingModel
+from kubernetes_cloud_tpu.serve.errors import (
+    DeadlineExceededError,
+    EngineRestartedError,
+    QueueFullError,
+    StreamTimeoutError,
+)
+from kubernetes_cloud_tpu.serve.load_test import run_sync
+from kubernetes_cloud_tpu.serve.model import Model, request_deadline
+from kubernetes_cloud_tpu.serve.server import ModelServer
+from kubernetes_cloud_tpu.serve.supervisor import (
+    ServingSupervisor,
+    SupervisorConfig,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+class ScriptedModel(Model):
+    """Predictor whose behaviour the payload scripts: raise a named
+    error, sleep, or echo — letting each status-mapping case drive the
+    real HTTP path without a real model."""
+
+    ERRORS = {
+        "queue_full": QueueFullError("request queue full"),
+        "deadline": DeadlineExceededError("deadline expired in queue"),
+        "restarted": EngineRestartedError("engine restarted; retry"),
+        "stream_timeout": StreamTimeoutError("no token within 1s; retry"),
+        "bad_request": ValueError("payload needs instances"),
+        "boom": RuntimeError("segfault-adjacent"),
+    }
+
+    def predict(self, payload):
+        raise_key = payload.get("raise")
+        if raise_key:
+            raise self.ERRORS[raise_key]
+        if payload.get("sleep"):
+            time.sleep(float(payload["sleep"]))
+        if payload.get("check_deadline"):
+            deadline = request_deadline(payload)
+            if deadline is not None and time.monotonic() > deadline:
+                raise DeadlineExceededError("deadline expired before start")
+        return {"predictions": [payload.get("echo", "ok")],
+                "deadline_ms": payload.get("deadline_ms")}
+
+
+@pytest.fixture
+def server():
+    srv = ModelServer([ScriptedModel("m")], host="127.0.0.1", port=0)
+    srv.load_all()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _post(server, payload, headers=None, path="/v1/models/m:predict"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(server, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}{path}", timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _status(server, payload, headers=None):
+    try:
+        return _post(server, payload, headers)[0]
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+class TestErrorMapping:
+    def test_typed_errors_map_to_contract_statuses(self, server):
+        # the full ladder: 400 / 503-retryable family / 504 / 500
+        assert _status(server, {"raise": "bad_request"}) == 400
+        assert _status(server, {"raise": "queue_full"}) == 503
+        assert _status(server, {"raise": "restarted"}) == 503
+        assert _status(server, {"raise": "stream_timeout"}) == 503
+        assert _status(server, {"raise": "deadline"}) == 504
+        assert _status(server, {"raise": "boom"}) == 500
+        assert _status(server, {"echo": "fine"}) == 200
+
+    def test_deadline_header_injected_into_payload(self, server):
+        _, out = _post(server, {"echo": "x"},
+                       headers={"X-Request-Deadline-Ms": "1500"})
+        assert float(out["deadline_ms"]) == 1500.0
+        # payload beats header (client set it explicitly)
+        _, out = _post(server, {"echo": "x", "deadline_ms": 3},
+                       headers={"X-Request-Deadline-Ms": "1500"})
+        assert float(out["deadline_ms"]) == 3
+
+    def test_expired_deadline_header_maps_504(self, server):
+        assert _status(server, {"check_deadline": True},
+                       headers={"X-Request-Deadline-Ms": "0"}) == 504
+
+
+class TestHealthModel:
+    def test_healthz_always_200_readyz_tracks_model_health(self, server):
+        assert _get(server, "/healthz")[0] == 200
+        code, body = _get(server, "/readyz")
+        assert code == 200 and body["status"] == "ready"
+        # model goes unhealthy: readyz flips, healthz must NOT — a sick
+        # engine is the supervisor's problem, not a reason to kill the
+        # pod holding the loaded weights
+        server.models["m"].ready = False
+        code, body = _get(server, "/readyz")
+        assert code == 503 and body["status"] == "unready"
+        assert body["models"]["m"]["ok"] is False
+        assert _get(server, "/healthz")[0] == 200
+        server.models["m"].ready = True
+        assert _get(server, "/readyz")[0] == 200
+
+
+class TestDrain:
+    def test_drain_completes_inflight_then_rejects_new(self, server):
+        results = {}
+
+        def slow_call():
+            results["slow"] = _post(server, {"sleep": 0.4, "echo": "done"})
+
+        t = threading.Thread(target=slow_call)
+        t.start()
+        time.sleep(0.1)  # the slow request is in flight
+        drained = {}
+
+        def do_drain():
+            drained.update(server.drain(timeout=10.0))
+
+        d = threading.Thread(target=do_drain)
+        d.start()
+        time.sleep(0.05)  # drain flag is up, slow request still running
+        assert _get(server, "/readyz")[0] == 503
+        assert _status(server, {"echo": "rejected"}) == 503
+        t.join(timeout=10)
+        d.join(timeout=10)
+        # the in-flight request completed despite the drain
+        assert results["slow"][0] == 200
+        assert results["slow"][1]["predictions"] == ["done"]
+        assert drained["drained"] is True and drained["inflight"] == 0
+
+    def test_sigterm_handler_triggers_drain(self):
+        srv = ModelServer([ScriptedModel("m")], host="127.0.0.1", port=0)
+        srv.load_all()
+        srv.start()
+        previous = signal.getsignal(signal.SIGTERM)
+        try:
+            assert boot.install_sigterm_drain(srv, drain_timeout=5.0)
+            signal.raise_signal(signal.SIGTERM)
+            deadline = time.monotonic() + 10
+            while not srv._draining and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert srv._draining
+            deadline = time.monotonic() + 10
+            while srv._httpd is not None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert srv._httpd is None  # listener closed after drain
+        finally:
+            signal.signal(signal.SIGTERM, previous)
+            srv.stop()
+
+
+class TestBatcherSupervision:
+    """The watchdog covers the dynamic batcher's dispatcher thread too
+    — same heartbeat/restart/health contract as the engine, no
+    accelerator required."""
+
+    def test_dispatcher_crash_detected_restarted_and_serving(self):
+        m = BatchingModel("b", lambda insts, params: [x * 2 for x in insts])
+        m.load()
+        sup = ServingSupervisor(SupervisorConfig(poll_interval_s=0.02,
+                                                 hang_timeout_s=5.0))
+        sup.watch(m)
+        sup.start()
+        try:
+            assert m.predict({"instances": [3]})["predictions"] == [6]
+            assert m.health()["ok"] is True
+            # kill the dispatcher the way a segfault-class failure
+            # would: the loop's fault site sits outside its try
+            faults.install(FaultInjector([FaultSpec("dispatch")]))
+            deadline = time.monotonic() + 10
+            while sup.stats["crashes"] == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sup.stats["crashes"] == 1
+            assert sup.stats["restarts"] == 1
+            # the replacement dispatcher serves the same queue
+            assert m.predict({"instances": [5]})["predictions"] == [10]
+            assert m.health()["ok"] is True
+        finally:
+            faults.uninstall()
+            sup.stop()
+            m.stop()
+
+    def test_abandon_dispatcher_fails_everyone_and_blocks_stragglers(self):
+        """Circuit-open shutdown: the executing batch, queued entries,
+        and any predict racing the drain all fail retryably — nobody
+        hangs on a queue no dispatcher will ever service again."""
+
+        def slow_inner(insts, params):
+            time.sleep(0.3)
+            return list(insts)
+
+        m = BatchingModel("b", slow_inner,
+                          BatcherConfig(max_batch_size=1))
+        m.load()
+        codes = {}
+
+        def call(key, inst):
+            try:
+                codes[key] = m.predict({"instances": [inst]})
+            except Exception as e:  # noqa: BLE001
+                codes[key] = e
+
+        t1 = threading.Thread(target=call, args=("executing", 1))
+        t1.start()
+        time.sleep(0.05)  # t1's batch is running in the dispatcher
+        t2 = threading.Thread(target=call, args=("queued", 2))
+        t2.start()
+        time.sleep(0.05)
+        m.abandon_dispatcher(QueueFullError("circuit open"))
+        t1.join(timeout=5)
+        t2.join(timeout=5)
+        assert isinstance(codes["executing"], QueueFullError)
+        assert isinstance(codes["queued"], QueueFullError)
+        assert m._stop.is_set()  # the straggler guards are armed
+        with pytest.raises(RuntimeError, match="stopped"):
+            m.predict({"instances": [3]})
+
+    def test_batcher_sheds_expired_queued_request(self):
+        def slow_inner(insts, params):
+            time.sleep(0.3)
+            return list(insts)
+
+        m = BatchingModel("b", slow_inner,
+                          BatcherConfig(max_batch_size=1))
+        m.load()
+        try:
+            got = {}
+            t = threading.Thread(target=lambda: got.update(
+                out=m.predict({"instances": [1]})))
+            t.start()
+            time.sleep(0.05)  # the slow batch is executing
+            # 50ms budget vs ~250ms left of the running batch: expired
+            # by the time the dispatcher reaches it → shed, not run
+            with pytest.raises(DeadlineExceededError,
+                               match="expired in queue"):
+                m.predict({"instances": [2], "deadline_ms": 50})
+            t.join(timeout=10)
+            assert got["out"]["predictions"] == [1]  # bystander fine
+            assert m.stats["deadline_shed"] == 1
+        finally:
+            m.stop()
+
+
+class TestLoadTestOutcomes:
+    def test_outcome_breakdown_and_deadline_header(self, server):
+        url = f"http://127.0.0.1:{server.port}/v1/models/m:predict"
+        payloads = [json.dumps(p).encode() for p in (
+            {"echo": "a"}, {"echo": "b"},
+            {"raise": "queue_full"},
+            {"raise": "deadline"},
+            {"raise": "boom"},
+            {"raise": "bad_request"},
+        )]
+        stats = run_sync(url, payloads).stats()
+        assert stats["outcomes"] == {"2xx": 2, "503_shed": 1,
+                                     "504_deadline": 1, "5xx": 1, "4xx": 1}
+        # --deadline-ms plumbs the header through the harness
+        stats = run_sync(url, [json.dumps(
+            {"check_deadline": True}).encode()],
+            headers={"X-Request-Deadline-Ms": "0"}).stats()
+        assert stats["outcomes"] == {"504_deadline": 1}
