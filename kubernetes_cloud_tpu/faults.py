@@ -28,6 +28,11 @@ Sites wired through ``serve/``:
                        (``raise`` or ``drop``) kills the dispatcher
                        thread with no drain
 ``server.handle``      HTTP routing layer — ``raise`` becomes a 500
+``metrics.render``     the ``GET /metrics`` exposition render — a
+                       ``raise`` 500s (only) the scrape, a ``hang``
+                       parks (only) the scrape's thread; the chaos
+                       suite proves a wedged/raising scrape can never
+                       take down the data plane or flip ``/readyz``
 =====================  ====================================================
 
 Determinism: every site counts its hits under a lock; a spec names the

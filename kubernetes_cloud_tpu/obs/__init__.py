@@ -1,0 +1,46 @@
+"""Unified telemetry: Prometheus-format metrics + request tracing.
+
+The observability plane the serving/workflow stack records into:
+
+* :mod:`~kubernetes_cloud_tpu.obs.metrics` — zero-dependency Counter /
+  Gauge / Histogram registry rendering Prometheus text exposition
+  (served at ``GET /metrics`` by both HTTP front-ends; scraped via the
+  ``prometheus.io/*`` pod annotations in ``deploy/online-inference``).
+* :mod:`~kubernetes_cloud_tpu.obs.tracing` — per-request lifecycle
+  spans (``queued → admitted → prefill → decode → first_token →
+  complete/shed/failed``) to the repo's shared JSONL sink.
+
+The metric catalog (names, types, labels) is documented in
+``deploy/README.md`` § Observability; this package is import-light (no
+jax) so the workflow orchestrator can use it from jax-free processes.
+"""
+
+from kubernetes_cloud_tpu.obs.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    REGISTRY,
+    Registry,
+    counter,
+    delta,
+    gauge,
+    histogram,
+    parse_text,
+    sample_value,
+)
+from kubernetes_cloud_tpu.obs import tracing  # noqa: F401
+from kubernetes_cloud_tpu.obs.tracing import (  # noqa: F401
+    REQUEST_ID_HEADER,
+    SPANS,
+    TERMINAL_SPANS,
+    RequestTracer,
+    new_request_id,
+    trace,
+)
+
+
+def render_text() -> str:
+    """Render the global registry (the ``/metrics`` response body)."""
+    return REGISTRY.render()

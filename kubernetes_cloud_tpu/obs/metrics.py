@@ -1,0 +1,482 @@
+"""Zero-dependency metrics registry with Prometheus text exposition.
+
+The serving stack (engine, batcher, HTTP front-ends, supervisor) and
+the workflow orchestrator record into one process-global
+:data:`REGISTRY`; ``GET /metrics`` on either HTTP front-end renders it
+in the Prometheus text exposition format (v0.0.4 — what a
+``prometheus.io/scrape`` pod annotation makes a cluster Prometheus
+pull).  No client library: the image must not grow a dependency for
+three metric types and a text format.
+
+Types (the Prometheus core set this repo needs):
+
+* :class:`Counter` — monotonically increasing (requests, tokens,
+  restarts).  Name them ``*_total`` per Prometheus convention.
+* :class:`Gauge` — point-in-time level (queue depth, active slots,
+  heartbeat age).
+* :class:`Histogram` — cumulative-bucket distribution (latency, batch
+  size) with configurable ``buckets``.
+
+Labels: declare ``labelnames`` at registration, then
+``metric.labels(model="lm").inc()``.  Children are created on first
+use and cached; repeated ``labels()`` calls are two dict lookups under
+a per-family lock, cheap enough for the engine's per-iteration hot
+path.  Registration is get-or-create so module reloads and repeated
+engine construction (supervisor restarts, tests) share one family.
+
+:func:`parse_text` is the strict parser the tests validate the
+exposition with (and ``load_test --check-metrics`` / ``bench_serving
+--metrics-snapshot`` scrape through) — it raises on any malformed
+line instead of skipping it.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Callable, Iterable, Mapping, Optional, Sequence
+
+#: Prometheus text exposition content type (both front-ends send it)
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: default latency buckets (seconds) — spans sub-ms host ops to the
+#: multi-second tail the serving p99 lives in
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample-value formatting: integers bare, floats via
+    repr (full precision), specials as +Inf/-Inf/NaN."""
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class _Metric:
+    """One metric family: name, help, label schema, and its children
+    (one per label-value combination; the unlabeled family is its own
+    single child)."""
+
+    type_name = "untyped"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = ()):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: dict[tuple[str, ...], object] = {}
+        if not self.labelnames:
+            self._children[()] = self._new_child()
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def labels(self, **labelvalues: str):
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels() needs exactly "
+                f"{self.labelnames}, got {tuple(labelvalues)}")
+        key = tuple(str(labelvalues[ln]) for ln in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_child()
+            return child
+
+    def _default(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} has labels {self.labelnames}; "
+                "call .labels(...) first")
+        return self._children[()]
+
+    # -- rendering ---------------------------------------------------------
+
+    def _label_str(self, key: tuple[str, ...],
+                   extra: str = "") -> str:
+        parts = [f'{ln}="{_escape(v)}"'
+                 for ln, v in zip(self.labelnames, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {_escape(self.help)}",
+                 f"# TYPE {self.name} {self.type_name}"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for key, child in children:
+            lines.extend(self._render_child(key, child))
+        return lines
+
+    def _render_child(self, key, child) -> list[str]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        # Zero children IN PLACE (never replace them): instrumented
+        # objects resolve .labels(...) once and cache the child, so a
+        # swapped-out child would keep absorbing their updates while
+        # rendering nothing — the silent-zero-metrics failure mode.
+        with self._lock:
+            for child in self._children.values():
+                child.reset()
+
+
+class _CounterChild:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Counter(_Metric):
+    type_name = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def _render_child(self, key, child):
+        return [f"{self.name}{self._label_str(key)} {_fmt(child.value)}"]
+
+
+class _GaugeChild:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge(_Metric):
+    type_name = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def _render_child(self, key, child):
+        return [f"{self.name}{self._label_str(key)} {_fmt(child.value)}"]
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)  # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.sum += value
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[i] += 1
+                    break
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counts = [0] * len(self.buckets)
+            self.sum = 0.0
+            self.count = 0
+
+
+class Histogram(_Metric):
+    type_name = "histogram"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = (), *,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError("histogram needs at least one bucket")
+        if bs != list(dict.fromkeys(bs)):
+            raise ValueError("histogram buckets must be unique")
+        # the implicit +Inf bucket catches everything above the largest
+        self.buckets = tuple(bs) + (math.inf,)
+        super().__init__(name, help, labelnames)
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    def _render_child(self, key, child):
+        lines = []
+        cum = 0
+        with child._lock:
+            counts, total, s = list(child.counts), child.count, child.sum
+        for b, n in zip(self.buckets, counts):
+            cum += n
+            le = "+Inf" if math.isinf(b) else _fmt(b)
+            labels = self._label_str(key, 'le="%s"' % le)
+            lines.append(f"{self.name}_bucket{labels} {cum}")
+        lines.append(f"{self.name}_sum{self._label_str(key)} {_fmt(s)}")
+        lines.append(f"{self.name}_count{self._label_str(key)} {total}")
+        return lines
+
+
+class Registry:
+    """Named metric families; get-or-create registration."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _register(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls
+                        or existing.labelnames != tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name} already registered as "
+                        f"{type(existing).__name__}"
+                        f"{existing.labelnames}")
+                return existing
+            metric = cls(name, help, labelnames, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str,
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str,
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str,
+                  labelnames: Sequence[str] = (), *,
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help, labelnames,
+                              buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        lines: list[str] = []
+        for _, metric in metrics:
+            lines.extend(metric.render())
+        return "\n".join(lines) + "\n" if lines else ""
+
+    def reset(self) -> None:
+        """Zero every family's samples (tests); registrations — and the
+        family objects instrumented modules hold — survive."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.reset()
+
+
+#: the process-global registry every instrumented layer records into
+REGISTRY = Registry()
+
+
+def counter(name: str, help: str, labelnames: Sequence[str] = ()
+            ) -> Counter:
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str, labelnames: Sequence[str] = ()) -> Gauge:
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str, labelnames: Sequence[str] = (), *,
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, help, labelnames, buckets=buckets)
+
+
+# ---------------------------------------------------------------------------
+# text-exposition parser (tests + scrape tooling)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?[0-9]+))?$")
+_LABEL_PAIR_RE = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"\s*(,|$)')
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) .*$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|histogram|summary|untyped)$")
+
+
+_UNESCAPES = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def _unescape(value: str) -> str:
+    # single left-to-right scan: chained str.replace would match the
+    # 'n' of an already-consumed escaped backslash (r'\\n' → '\' + '\n'
+    # instead of '\' + 'n')
+    return re.sub(r"\\(.)",
+                  lambda m: _UNESCAPES.get(m.group(1), m.group(1)), value)
+
+
+def _parse_value(raw: str) -> float:
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    if raw == "NaN":
+        return math.nan
+    return float(raw)  # raises on junk — strictness is the point
+
+
+def parse_text(text: str) -> list[tuple[str, dict, float]]:
+    """Strictly parse Prometheus text exposition into
+    ``[(name, labels, value), ...]``.  Raises ``ValueError`` on any
+    malformed line — this is the format validator the tests run over
+    both front-ends' ``/metrics``."""
+    samples: list[tuple[str, dict, float]] = []
+    typed: dict[str, str] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if _HELP_RE.match(line) or _TYPE_RE.match(line):
+                m = _TYPE_RE.match(line)
+                if m:
+                    typed[m.group(1)] = m.group(2)
+                continue
+            raise ValueError(f"line {lineno}: malformed comment: {line!r}")
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        labels: dict[str, str] = {}
+        raw = m.group("labels")
+        if raw:
+            pos = 0
+            while pos < len(raw):
+                lm = _LABEL_PAIR_RE.match(raw, pos)
+                if lm is None:
+                    raise ValueError(
+                        f"line {lineno}: malformed labels: {raw!r}")
+                labels[lm.group(1)] = _unescape(lm.group(2))
+                pos = lm.end()
+        samples.append((m.group("name"), labels,
+                        _parse_value(m.group("value"))))
+    return samples
+
+
+def sample_value(samples: Iterable[tuple[str, dict, float]], name: str,
+                 labels: Optional[Mapping[str, str]] = None,
+                 default: float = 0.0) -> float:
+    """Sum of samples matching ``name`` whose labels are a superset of
+    ``labels`` (scrape-side aggregation for tests and tooling)."""
+    want = dict(labels or {})
+    total, seen = 0.0, False
+    for n, ls, v in samples:
+        if n == name and all(ls.get(k) == v2 for k, v2 in want.items()):
+            total += v
+            seen = True
+    return total if seen else default
+
+
+def delta(before: Iterable[tuple[str, dict, float]],
+          after: Iterable[tuple[str, dict, float]],
+          prefix: str = "", *,
+          keep: Optional[Callable[[str], bool]] = None) -> dict[str, float]:
+    """Per-sample numeric delta between two scrapes, keyed by
+    ``name{label="v",...}`` — the ``--metrics-snapshot`` payload.  Only
+    changed samples are kept; ``prefix`` filters by metric name."""
+    def key(name: str, labels: dict) -> str:
+        if not labels:
+            return name
+        inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+        return f"{name}{{{inner}}}"
+
+    base = {key(n, ls): v for n, ls, v in before
+            if n.startswith(prefix) and (keep is None or keep(n))}
+    out: dict[str, float] = {}
+    for n, ls, v in after:
+        if not n.startswith(prefix) or (keep is not None and not keep(n)):
+            continue
+        k = key(n, ls)
+        d = v - base.get(k, 0.0)
+        if d:
+            out[k] = round(d, 9)
+    return out

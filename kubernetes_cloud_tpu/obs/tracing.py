@@ -1,0 +1,130 @@
+"""Request-lifecycle tracing: one id per request, JSONL span records.
+
+Every request entering the data plane is stamped with an id — an
+inbound ``X-Request-Id`` header is honored (so a trace joins the
+mesh/gateway's), otherwise one is minted — and the serving layers emit
+**span** records as the request moves through them:
+
+``queued → admitted → prefill → decode → first_token →
+complete | shed | failed | cancelled``
+
+(the continuous-batching engine's lifecycle; the dynamic batcher emits
+the subset ``queued → dispatched → complete | failed``).
+
+Records go to the same append-only JSONL sink the training metrics
+(:class:`kubernetes_cloud_tpu.train.metrics.JsonlWriter`) and workflow
+step events (:mod:`kubernetes_cloud_tpu.workflow.events`) use, so one
+reader chain consumes all three streams::
+
+    {"ts": 1722700000.123, "seq": 7, "request_id": "a1b2…",
+     "span": "first_token", "model": "lm"}
+
+Arming follows the :mod:`kubernetes_cloud_tpu.faults` pattern: a
+module-level active tracer, ``None`` (the production default unless
+``serve.boot --trace-log`` / ``KCT_TRACE_LOG`` is set) making every
+:func:`trace` call a single attribute check — the hot decode loop pays
+nothing when tracing is off.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+import uuid
+from typing import Any, Iterator, Optional
+
+#: inbound correlation header (mesh/gateway request id), honored by
+#: both HTTP front-ends
+REQUEST_ID_HEADER = "X-Request-Id"
+
+#: engine span vocabulary, in lifecycle order (terminal spans last)
+SPANS = ("queued", "admitted", "prefill", "decode", "first_token",
+         "dispatched", "complete", "shed", "failed", "cancelled")
+
+TERMINAL_SPANS = ("complete", "shed", "failed", "cancelled")
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class RequestTracer:
+    """Span recorder: an optional JSONL file plus a bounded in-memory
+    tail (tests and live debugging read the tail; operators read the
+    file).  Thread-safe — HTTP threads, the scheduler, and the
+    dispatcher all emit concurrently; ``seq`` totally orders records
+    even when ``ts`` ties at clock resolution."""
+
+    def __init__(self, path: Optional[str] = None, *, keep: int = 4096):
+        from kubernetes_cloud_tpu.train.metrics import JsonlWriter
+
+        self._writer = JsonlWriter(path) if path else None
+        self.path = path
+        self.records: "collections.deque[dict]" = collections.deque(
+            maxlen=keep)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def span(self, request_id: str, span: str, **fields: Any) -> None:
+        rec = {"ts": time.time(), "request_id": request_id, "span": span}
+        rec.update(fields)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self.records.append(rec)
+            if self._writer is not None:
+                self._writer.write(rec)
+
+    def spans_for(self, request_id: str) -> list[dict]:
+        with self._lock:
+            return [r for r in self.records
+                    if r["request_id"] == request_id]
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+_ACTIVE: Optional[RequestTracer] = None
+
+
+def active() -> Optional[RequestTracer]:
+    return _ACTIVE
+
+
+def install(tracer: RequestTracer) -> RequestTracer:
+    global _ACTIVE
+    _ACTIVE = tracer
+    return tracer
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+    _ACTIVE = None
+
+
+def trace(request_id: Optional[str], span: str, **fields: Any) -> None:
+    """The instrumentation call: free when disarmed or untagged."""
+    tr = _ACTIVE
+    if tr is None or not request_id:
+        return
+    tr.span(request_id, span, **fields)
+
+
+@contextlib.contextmanager
+def tracing(path: Optional[str] = None, **kw) -> Iterator[RequestTracer]:
+    """Scoped arming for tests::
+
+        with tracing() as tr:
+            ...
+            assert tr.spans_for(rid)[0]["span"] == "queued"
+    """
+    tr = install(RequestTracer(path, **kw))
+    try:
+        yield tr
+    finally:
+        uninstall()

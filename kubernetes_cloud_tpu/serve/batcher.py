@@ -30,7 +30,8 @@ import threading
 import time
 from typing import Any, Callable, Mapping, Optional, Sequence
 
-from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.obs.tracing import trace
 from kubernetes_cloud_tpu.serve.errors import (  # noqa: F401 - re-export
     DeadlineExceededError,
     QueueFullError,
@@ -44,6 +45,26 @@ from kubernetes_cloud_tpu.serve.model import (
 from kubernetes_cloud_tpu.serve.supervisor import Heartbeat
 
 log = logging.getLogger(__name__)
+
+# Dynamic-batcher metric families (the Triton scheduler counters, as a
+# Prometheus surface; the in-process stats dict below stays for tests)
+_M_BATCHES = obs.counter(
+    "kct_batcher_batches_total", "Batches dispatched to the device.",
+    ("model",))
+_M_REQUESTS = obs.counter(
+    "kct_batcher_requests_total", "Requests coalesced into batches.",
+    ("model",))
+_M_BATCH_SIZE = obs.histogram(
+    "kct_batcher_batch_size", "Instances per dispatched batch.",
+    ("model",), buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+_M_DISPATCH_S = obs.histogram(
+    "kct_batcher_dispatch_seconds",
+    "Wall time of one batched device dispatch.", ("model",))
+_M_SHED = obs.counter(
+    "kct_batcher_shed_total",
+    "Requests shed while queued (expired deadline).", ("model",))
+_M_QUEUE = obs.gauge(
+    "kct_batcher_queue_depth", "Pending-request queue depth.", ("model",))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,10 +102,11 @@ def load_model_config(model_dir: str) -> BatcherConfig:
 
 class _Pending:
     __slots__ = ("instances", "params", "event", "result", "error",
-                 "claimed", "deadline")
+                 "claimed", "deadline", "request_id")
 
     def __init__(self, instances: Sequence[Any], params: Mapping[str, Any],
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 request_id: Optional[str] = None):
         self.instances = list(instances)
         self.params = dict(params)
         self.event = threading.Event()
@@ -96,6 +118,8 @@ class _Pending:
         #: absolute monotonic deadline (None = wait forever); expired
         #: entries are shed by the dispatcher instead of batched
         self.deadline = deadline
+        #: correlation id for lifecycle spans (None = untraced)
+        self.request_id = request_id
 
 
 class BatchingModel(Model):
@@ -132,6 +156,14 @@ class BatchingModel(Model):
         # batching telemetry (the Triton metrics a load test reads)
         self.stats = {"requests": 0, "batches": 0, "batched_instances": 0,
                       "deadline_shed": 0}
+        # scrape-facing mirror, label-bound once per model
+        m = {"model": name}
+        self._m_batches = _M_BATCHES.labels(**m)
+        self._m_requests = _M_REQUESTS.labels(**m)
+        self._m_batch_size = _M_BATCH_SIZE.labels(**m)
+        self._m_dispatch_s = _M_DISPATCH_S.labels(**m)
+        self._m_shed = _M_SHED.labels(**m)
+        self._m_queue = _M_QUEUE.labels(**m)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -155,6 +187,8 @@ class BatchingModel(Model):
             except queue.Empty:
                 break
             stale.error = RetryableError("batcher restarted")
+            trace(stale.request_id, "failed", model=self.name,
+                  error="RetryableError")
             stale.event.set()
         self._thread = threading.Thread(target=self._safe_dispatch_loop,
                                         args=(self._gen,), daemon=True,
@@ -189,6 +223,8 @@ class BatchingModel(Model):
         held, self._held = self._held, None
         for p in batch + ([held] if held is not None else []):
             p.error = err
+            trace(p.request_id, "failed", model=self.name,
+                  error=type(err).__name__)
             p.event.set()
         self._stop.clear()
         self._thread = threading.Thread(target=self._safe_dispatch_loop,
@@ -217,6 +253,8 @@ class BatchingModel(Model):
                 break
         for p in leftovers:
             p.error = err
+            trace(p.request_id, "failed", model=self.name,
+                  error=type(err).__name__)
             p.event.set()
         self.ready = False
 
@@ -226,7 +264,9 @@ class BatchingModel(Model):
         t = self._thread
         if t is None or not t.is_alive():
             return {"ok": False, "reason": "dispatcher dead"}
-        return {"ok": True, "reason": "ok"}
+        return {"ok": True, "reason": "ok",
+                "heartbeat_age_s": round(self.heartbeat.age, 3),
+                "queue_depth": self._queue.qsize()}
 
     # -- request side ------------------------------------------------------
 
@@ -244,10 +284,17 @@ class BatchingModel(Model):
         if faults.fire("queue") == "drop":
             raise QueueFullError("request queue full (injected)")
         pending = _Pending(instances, payload.get("parameters") or {},
-                           deadline)
+                           deadline, request_id=payload.get("request_id"))
+        # trace BEFORE the enqueue: once the pending is visible the
+        # dispatcher may claim it immediately, and "dispatched" must
+        # never outrun "queued" in the span stream
+        trace(pending.request_id, "queued", model=self.name,
+              instances=len(instances))
         try:
             self._queue.put_nowait(pending)
         except queue.Full:
+            trace(pending.request_id, "shed", model=self.name,
+                  reason="queue_full")
             raise QueueFullError("request queue full") from None
         if self._stop.is_set():
             # lost the race with stop()/abandon_dispatcher: the final
@@ -260,6 +307,8 @@ class BatchingModel(Model):
                 except queue.Empty:
                     break
                 stale.error = RetryableError("batcher stopped")
+                trace(stale.request_id, "failed", model=self.name,
+                      error="RetryableError")
                 stale.event.set()
         # Bounded wait re-checking for shutdown: a request enqueued in the
         # race window after the dispatcher's final drain must not hang.
@@ -311,12 +360,16 @@ class BatchingModel(Model):
         a slot spent on it would produce an answer nobody reads."""
         if p.deadline is not None and time.monotonic() > p.deadline:
             self.stats["deadline_shed"] += 1
+            self._m_shed.inc()
+            trace(p.request_id, "shed", model=self.name,
+                  reason="deadline_queued")
             p.error = DeadlineExceededError("deadline expired in queue")
             p.event.set()
             return True
         return False
 
     def _dispatch_once(self) -> None:
+        self._m_queue.set(self._queue.qsize())
         delay_s = self.cfg.max_queue_delay_us / 1e6
         if self._held is not None:
             first, self._held = self._held, None
@@ -363,6 +416,8 @@ class BatchingModel(Model):
                 break
         for p in leftovers:
             p.error = RetryableError("batcher stopped")
+            trace(p.request_id, "failed", model=self.name,
+                  error="RetryableError")
             p.event.set()
 
     def _execute(self, batch: list[_Pending]) -> None:
@@ -370,6 +425,13 @@ class BatchingModel(Model):
         self.stats["requests"] += len(batch)
         self.stats["batches"] += 1
         self.stats["batched_instances"] += len(instances)
+        self._m_requests.inc(len(batch))
+        self._m_batches.inc()
+        self._m_batch_size.observe(len(instances))
+        for p in batch:
+            trace(p.request_id, "dispatched", model=self.name,
+                  batch_instances=len(instances))
+        t0 = time.monotonic()
         self._current_batch = batch
         try:
             faults.fire("model_fn")
@@ -397,5 +459,9 @@ class BatchingModel(Model):
             # strand that batch's waiters across the next restart.
             if self._current_batch is batch:
                 self._current_batch = []
+            self._m_dispatch_s.observe(time.monotonic() - t0)
             for p in batch:
+                trace(p.request_id,
+                      "complete" if p.error is None else "failed",
+                      model=self.name)
                 p.event.set()

@@ -43,6 +43,26 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
                     help="supervisor: engine heartbeat staleness that "
                          "counts as a hang (must exceed the slowest "
                          "legitimate scheduler iteration)")
+    ap.add_argument("--trace-log",
+                    default=os.environ.get("KCT_TRACE_LOG"),
+                    help="request-lifecycle trace JSONL path (spans "
+                         "queued→admitted→prefill→decode→first_token→"
+                         "complete per request id); unset disables "
+                         "tracing — /metrics stays on regardless")
+
+
+def install_tracer(args) -> None:
+    """Arm request-lifecycle tracing when ``--trace-log`` /
+    ``KCT_TRACE_LOG`` names a JSONL sink (off by default: span writes
+    are file I/O on the scheduler thread; the metrics registry, which
+    is pure memory, is always on)."""
+    path = getattr(args, "trace_log", None)
+    if not path:
+        return
+    from kubernetes_cloud_tpu.obs import tracing
+
+    tracing.install(tracing.RequestTracer(path))
+    log.info("request tracing to %s", path)
 
 
 def enable_compile_cache(args) -> None:
@@ -117,6 +137,7 @@ def serve(models: Iterable[Model], args) -> None:  # pragma: no cover - loop
 
     enable_compile_cache(args)
     faults.install_from_env()  # chaos drills: KCT_FAULTS json specs
+    install_tracer(args)  # request spans: --trace-log / KCT_TRACE_LOG
     models = list(models)  # iterated twice (server + supervisor); a
     # generator would leave the supervisor silently watching nothing
     server = make_server(models, args)

@@ -47,7 +47,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.obs.tracing import trace
 from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig
 from kubernetes_cloud_tpu.models.generate import (
     decode_step_slots,
@@ -72,6 +73,47 @@ from kubernetes_cloud_tpu.serve.supervisor import Heartbeat
 log = logging.getLogger(__name__)
 
 _STREAM_END = object()  # sentinel closing a request's token stream
+
+# Engine metric families (labels bound per engine via its model name).
+# The stats dict below stays — it is the zero-dependency in-process
+# telemetry the bench reads; these are the scrape-facing mirror with
+# latency distributions the dict can't carry.
+_M_ITERS = obs.counter(
+    "kct_engine_iterations_total", "Decode scheduler iterations.",
+    ("model",))
+_M_ITER_S = obs.histogram(
+    "kct_engine_iteration_seconds",
+    "Wall time of one decode_step_slots dispatch (= per-token latency "
+    "for every active request that iteration).", ("model",))
+_M_ADMITTED = obs.counter(
+    "kct_engine_admitted_total", "Requests admitted into slots.",
+    ("model",))
+_M_EVICTED = obs.counter(
+    "kct_engine_evicted_total",
+    "Slots freed (EOS / max-tokens / cancel / failure).", ("model",))
+_M_SHED = obs.counter(
+    "kct_engine_shed_total",
+    "Requests shed without decoding, by reason "
+    "(deadline_admission | deadline_queued | queue_full).",
+    ("model", "reason"))
+_M_CANCELLED = obs.counter(
+    "kct_engine_cancelled_total", "Requests cancelled by the client.",
+    ("model",))
+_M_TOKENS = obs.counter(
+    "kct_engine_tokens_total", "Completion tokens emitted.", ("model",))
+_M_TTFT = obs.histogram(
+    "kct_engine_ttft_seconds",
+    "Time from submit to the request's first emitted token.", ("model",))
+_M_ACTIVE = obs.gauge(
+    "kct_engine_active_slots", "Slots currently decoding.", ("model",))
+_M_SLOTS = obs.gauge(
+    "kct_engine_slots", "Configured slot-pool width.", ("model",))
+_M_QUEUE = obs.gauge(
+    "kct_engine_queue_depth", "Admission queue depth.", ("model",))
+_M_KV_UTIL = obs.gauge(
+    "kct_engine_kv_utilization",
+    "Fraction of the KV pool's token rows holding live context.",
+    ("model",))
 
 
 class RequestCancelled(RuntimeError):
@@ -113,11 +155,12 @@ class GenRequest:
     __slots__ = ("prompt_ids", "max_new_tokens", "temperature", "top_k",
                  "top_p", "rng", "tokens", "stream", "event", "error",
                  "claimed", "cancelled", "submitted_at", "first_token_at",
-                 "done_at", "deadline", "engine")
+                 "done_at", "deadline", "engine", "request_id")
 
     def __init__(self, prompt_ids: Sequence[int], *, max_new_tokens: int,
                  temperature: float, top_k: int, top_p: float, seed: int,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 request_id: Optional[str] = None):
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -142,6 +185,8 @@ class GenRequest:
         #: by ``requeue()`` when a supervisor transplants the queue to a
         #: replacement engine, so liveness re-checks follow the request
         self.engine: Optional["ContinuousBatchingEngine"] = None
+        #: correlation id for lifecycle spans (None = untraced)
+        self.request_id = request_id
 
     def cancel(self) -> None:
         """Mark the request dead (client gone).  The scheduler purges it
@@ -269,13 +314,16 @@ class ContinuousBatchingEngine:
     def __init__(self, cfg: CausalLMConfig, params: Any,
                  engine_cfg: EngineConfig = EngineConfig(), *,
                  eos_token_id: Optional[int] = None, pad_token_id: int = 0,
-                 mesh=None):
+                 mesh=None, name: str = "engine"):
         self.cfg = cfg
         self.params = params
         self.ecfg = engine_cfg
         self.eos = eos_token_id
         self.pad = pad_token_id
         self.mesh = mesh
+        #: metric/trace label (the serving model's name); restarts reuse
+        #: it, so a replacement engine continues the same time series
+        self.name = name
         self.pool: Optional[dict] = None
         self._slots: list[Optional[GenRequest]] = [None] * engine_cfg.slots
         # deque + lock rather than queue.Queue: cancelled requests must be
@@ -315,6 +363,20 @@ class ContinuousBatchingEngine:
         self.stats = {"iterations": 0, "admitted": 0, "emitted_tokens": 0,
                       "evictions": 0, "cancelled": 0, "active_slot_steps": 0,
                       "deadline_shed": 0}
+        # scrape-facing mirror: label-bound children resolved once so the
+        # per-iteration cost is attribute access, not dict lookups
+        m = {"model": self.name}
+        self._m_iters = _M_ITERS.labels(**m)
+        self._m_iter_s = _M_ITER_S.labels(**m)
+        self._m_admitted = _M_ADMITTED.labels(**m)
+        self._m_evicted = _M_EVICTED.labels(**m)
+        self._m_cancelled = _M_CANCELLED.labels(**m)
+        self._m_tokens = _M_TOKENS.labels(**m)
+        self._m_ttft = _M_TTFT.labels(**m)
+        self._m_active = _M_ACTIVE.labels(**m)
+        self._m_queue = _M_QUEUE.labels(**m)
+        self._m_kv_util = _M_KV_UTIL.labels(**m)
+        _M_SLOTS.labels(**m).set(engine_cfg.slots)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -411,8 +473,8 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt_ids: Sequence[int], *, max_new_tokens: int = 64,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-               seed: int = 0, deadline: Optional[float] = None
-               ) -> GenRequest:
+               seed: int = 0, deadline: Optional[float] = None,
+               request_id: Optional[str] = None) -> GenRequest:
         if not prompt_ids:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
@@ -435,24 +497,34 @@ class ContinuousBatchingEngine:
         if deadline is not None:
             now = time.monotonic()
             if deadline <= now:
+                self._shed(request_id, "deadline_admission")
                 raise DeadlineExceededError(
                     "deadline expired before admission")
             est = self.estimated_queue_delay()
             if now + est > deadline:
                 # shedding at the door beats burning a slot on an
                 # answer nobody is waiting for
+                self._shed(request_id, "deadline_admission")
                 raise DeadlineExceededError(
                     f"queue delay ~{est:.3f}s implies a deadline miss")
         if faults.fire("queue") == "drop":
+            self._shed(request_id, "queue_full")
             raise QueueFullError("request queue full (injected)")
         req = GenRequest(prompt_ids, max_new_tokens=max_new_tokens,
                          temperature=temperature, top_k=top_k, top_p=top_p,
-                         seed=seed, deadline=deadline)
+                         seed=seed, deadline=deadline,
+                         request_id=request_id)
         req.engine = self
         with self._qlock:
             if len(self._queue) >= self.ecfg.max_queue_size:
+                self._shed(request_id, "queue_full")
                 raise QueueFullError("request queue full")
             self._queue.append(req)
+            # trace INSIDE the lock: the scheduler pops under the same
+            # lock, so "admitted" can never outrun this record (span
+            # order queued → admitted is a documented invariant)
+            trace(request_id, "queued", model=self.name,
+                  prompt_tokens=len(req.prompt_ids))
         if self._stop.is_set():
             # lost the race with stop(): the scheduler may already have
             # run its final queue drain, so fail the stragglers here —
@@ -501,6 +573,7 @@ class ContinuousBatchingEngine:
             if self._abandoned:
                 return
             self.heartbeat.beat()
+            self._update_gauges()
             stopping = self._stop.is_set()
             if stopping:
                 self._fail_queued(RetryableError("engine stopped"))
@@ -519,6 +592,23 @@ class ContinuousBatchingEngine:
                 # transplants them to the replacement engine; without
                 # one, their waiters see the dead engine within a poll.
                 return
+
+    def _update_gauges(self) -> None:
+        """Scrape-facing levels, refreshed once per scheduler pass (idle
+        polls included, so a drained pool reads 0, not its last value)."""
+        used = active = 0
+        for req in self._slots:
+            if req is not None:
+                active += 1
+                used += min(len(req.prompt_ids) + len(req.tokens),
+                            self.ecfg.max_len)
+        self._m_active.set(active)
+        self._m_queue.set(self.queue_depth())
+        self._m_kv_util.set(used / (self.ecfg.slots * self.ecfg.max_len))
+
+    def _shed(self, request_id: Optional[str], reason: str) -> None:
+        _M_SHED.labels(model=self.name, reason=reason).inc()
+        trace(request_id, "shed", model=self.name, reason=reason)
 
     def _step(self, stopping: bool) -> None:
         faults.fire("iteration")
@@ -549,6 +639,8 @@ class ContinuousBatchingEngine:
             0.9 * self.iter_s + 0.1 * dt)
         self.stats["iterations"] += 1
         self.stats["active_slot_steps"] += len(active)
+        self._m_iters.inc()
+        self._m_iter_s.observe(dt)
         for i in active:
             self._emit(i, logits[i])
 
@@ -556,6 +648,7 @@ class ContinuousBatchingEngine:
         for i, req in enumerate(self._slots):
             if req is not None and req.cancelled:
                 self.stats["cancelled"] += 1
+                self._m_cancelled.inc()
                 self._finish_slot(i, error=RequestCancelled(
                     "request cancelled"))
         # Purge cancelled requests from anywhere in the queue, even with
@@ -569,6 +662,8 @@ class ContinuousBatchingEngine:
                 self._queue.extend(alive)
         for req in dead:
             self.stats["cancelled"] += 1
+            self._m_cancelled.inc()
+            trace(req.request_id, "cancelled", model=self.name)
             req.error = RequestCancelled("request cancelled")
             req.stream.put(_STREAM_END)
             req.event.set()
@@ -587,6 +682,8 @@ class ContinuousBatchingEngine:
                 break
             if req.cancelled:  # cancel landed after this step's purge
                 self.stats["cancelled"] += 1
+                self._m_cancelled.inc()
+                trace(req.request_id, "cancelled", model=self.name)
                 req.error = RequestCancelled("request cancelled")
                 req.stream.put(_STREAM_END)
                 req.event.set()
@@ -596,12 +693,14 @@ class ContinuousBatchingEngine:
                 # expired while queued: shed instead of spending prefill
                 # + decode on an answer nobody is waiting for
                 self.stats["deadline_shed"] += 1
+                self._shed(req.request_id, "deadline_queued")
                 req.error = DeadlineExceededError(
                     "deadline expired in queue")
                 req.stream.put(_STREAM_END)
                 req.event.set()
                 continue
             req.claimed = True
+            trace(req.request_id, "admitted", model=self.name)
             batch.append(req)
         # Claimed but not yet slotted: visible to the failure paths
         # until every group lands in _slots (cleared at the end; a
@@ -640,6 +739,13 @@ class ContinuousBatchingEngine:
             for r, (slot, req) in enumerate(zip(slots, group)):
                 self._slots[slot] = req
                 self.stats["admitted"] += 1
+                self._m_admitted.inc()
+                trace(req.request_id, "prefill", model=self.name,
+                      slot=slot, bucket=bucket)
+                # the slot now joins the persistent decode batch; emit
+                # BEFORE the first token so span order reads
+                # prefill → decode → first_token
+                trace(req.request_id, "decode", model=self.name, slot=slot)
                 self._emit(slot, logits[r])
         self._admitting = []
 
@@ -661,10 +767,14 @@ class ContinuousBatchingEngine:
                            top_k=req.top_k, top_p=req.top_p)
         if req.first_token_at is None:
             req.first_token_at = time.monotonic()
+            self._m_ttft.observe(req.first_token_at - req.submitted_at)
+            trace(req.request_id, "first_token", model=self.name,
+                  ttft_s=round(req.first_token_at - req.submitted_at, 6))
         req.tokens.append(tok)
         if faults.fire("stream") != "drop":  # "drop" loses the delivery
             req.stream.put(tok)
         self.stats["emitted_tokens"] += 1
+        self._m_tokens.inc()
         if ((self.eos is not None and tok == self.eos)
                 or len(req.tokens) >= req.max_new_tokens):
             self._finish_slot(slot)
@@ -674,12 +784,16 @@ class ContinuousBatchingEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self.stats["evictions"] += 1
+        self._m_evicted.inc()
         # Reset the freed row's length so the frozen-slot K/V write in
         # decode_step_slots stays at position 0 until the next admission.
         self.pool = dict(self.pool)
         self.pool["length"] = self.pool["length"].at[slot].set(0)
         req.error = error
         req.done_at = time.monotonic()
+        trace(req.request_id, _terminal_span(error), model=self.name,
+              tokens=len(req.tokens),
+              duration_s=round(req.done_at - req.submitted_at, 6))
         req.stream.put(_STREAM_END)
         req.event.set()
 
@@ -689,6 +803,8 @@ class ContinuousBatchingEngine:
             self._queue.clear()
         for req in drained:
             req.error = err
+            trace(req.request_id, "failed", model=self.name,
+                  error=type(err).__name__)
             req.stream.put(_STREAM_END)
             req.event.set()
 
@@ -698,6 +814,8 @@ class ContinuousBatchingEngine:
                 self._slots[i] = None
                 req.error = err
                 req.done_at = time.monotonic()
+                trace(req.request_id, "failed", model=self.name,
+                      error=type(err).__name__)
                 req.stream.put(_STREAM_END)
                 req.event.set()
         # Requests claimed by a mid-flight _admit (popped from the
@@ -709,8 +827,21 @@ class ContinuousBatchingEngine:
             if not req.event.is_set():
                 req.error = err
                 req.done_at = time.monotonic()
+                trace(req.request_id, "failed", model=self.name,
+                      error=type(err).__name__)
                 req.stream.put(_STREAM_END)
                 req.event.set()
+
+
+def _terminal_span(error: Optional[Exception]) -> str:
+    """Map a slot's final state onto the trace span vocabulary."""
+    if error is None:
+        return "complete"
+    if isinstance(error, RequestCancelled):
+        return "cancelled"
+    if isinstance(error, DeadlineExceededError):
+        return "shed"
+    return "failed"
 
 
 class ContinuousBatchingModel(Model):
@@ -747,7 +878,7 @@ class ContinuousBatchingModel(Model):
                 self.service.cfg, self.service.params, self.cfg,
                 eos_token_id=getattr(tok, "eos_token_id", None),
                 pad_token_id=getattr(tok, "pad_token_id", 0) or 0,
-                mesh=self.service.mesh)
+                mesh=self.service.mesh, name=self.name)
             self.engine.start()
         self.ready = True
 
@@ -765,12 +896,15 @@ class ContinuousBatchingModel(Model):
         eng = self.engine
         if eng is None or not eng.alive:
             return {"ok": False, "reason": "engine dead"}
-        return {"ok": True, "reason": "ok"}
+        return {"ok": True, "reason": "ok",
+                "heartbeat_age_s": round(eng.heartbeat.age, 3),
+                "queue_depth": eng.queue_depth()}
 
     # -- request side ------------------------------------------------------
 
     def _submit_all(self, prompts: Sequence[str], opts: Mapping[str, Any],
-                    deadline: Optional[float] = None) -> list[GenRequest]:
+                    deadline: Optional[float] = None,
+                    request_id: Optional[str] = None) -> list[GenRequest]:
         # Snapshot the engine once: a supervisor restart thread swaps
         # self.engine (briefly to None) concurrently, and a re-read
         # mid-loop would turn that transient into an AttributeError 500
@@ -782,6 +916,10 @@ class ContinuousBatchingModel(Model):
         reqs: list[GenRequest] = []
         try:
             for i, p in enumerate(prompts):
+                # one span stream per prompt: the HTTP-level id for a
+                # single-instance request, suffixed for multi-instance
+                rid = (request_id if request_id and len(prompts) == 1
+                       else f"{request_id}-{i}" if request_id else None)
                 reqs.append(engine.submit(
                     tok.encode(p),
                     max_new_tokens=max(1, min(int(opts["MAX_NEW_TOKENS"]),
@@ -790,7 +928,7 @@ class ContinuousBatchingModel(Model):
                     top_k=int(opts["TOP_K"]),
                     top_p=float(opts["TOP_P"]),
                     seed=int(opts["SEED"]) + i,
-                    deadline=deadline))
+                    deadline=deadline, request_id=rid))
         except Exception:
             for r in reqs:  # don't orphan already-queued siblings
                 r.cancel()
@@ -809,21 +947,28 @@ class ContinuousBatchingModel(Model):
             # CausalLMService.generate_outputs for any tokenizer
             out_ids = [t for t in req.prompt_ids
                        if t != pad and t != eos] + kept
-        return {"generated_text": tok.decode(out_ids),
-                "tokens_out": len(kept)}
+        out = {"generated_text": tok.decode(out_ids),
+               "tokens_out": len(kept)}
+        if req.first_token_at is not None:
+            # client-visible TTFT (load_test reports its distribution
+            # and checks it against the server-side histogram)
+            out["ttft_s"] = round(req.first_token_at - req.submitted_at, 6)
+        return out
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
         prompts = [instance_text(i) for i in parse_instances(payload)]
         opts = self.service.configure_request(payload)
         reqs = self._submit_all(prompts, opts,
-                                deadline=request_deadline(payload))
+                                deadline=request_deadline(payload),
+                                request_id=payload.get("request_id"))
         return {"predictions": [self._finish(r, opts) for r in reqs]}
 
     def completion(self, payload: Mapping[str, Any]) -> dict:
         prompt = payload.get("prompt", "")
         opts = self.service.completion_options(payload)
         req = self._submit_all([prompt], opts,
-                               deadline=request_deadline(payload))[0]
+                               deadline=request_deadline(payload),
+                               request_id=payload.get("request_id"))[0]
         return {"completion": self._finish(req, opts)["generated_text"]}
 
 

@@ -24,6 +24,14 @@ server's shedding behaviour (503 backpressure vs 504 deadline misses)
 becomes measurable: every run reports an ``outcomes`` breakdown
 (``2xx`` / ``503_shed`` / ``504_deadline`` / ``client_timeout`` /
 ``4xx`` / ``5xx`` / ``error``).
+
+LM endpoints that attach per-prediction ``ttft_s`` (the continuous-
+batching engine) additionally get a client-observed TTFT distribution
+(``ttft_mean_s`` / ``ttft_p50_s`` / ``ttft_p95_s``).  ``--check-metrics``
+scrapes the server's ``GET /metrics`` before and after the run and
+asserts the ``kct_server_request_seconds`` histogram's count delta for
+the driven route equals the number of requests this client sent — the
+client-vs-server bookkeeping cross-check (exit code 2 on disagreement).
 """
 
 from __future__ import annotations
@@ -48,6 +56,10 @@ class Result:
     #: generated tokens reported by the response (LM endpoints attach
     #: ``tokens_out`` per prediction); 0 for non-LM payloads
     tokens_out: int = 0
+    #: time to first streamed token reported by the response (the
+    #: continuous-batching engine attaches ``ttft_s`` per prediction);
+    #: None when the endpoint doesn't report it
+    ttft: Optional[float] = None
 
     @property
     def ok(self) -> bool:
@@ -89,14 +101,17 @@ class Summary:
     def stats(self) -> dict:
         lat = sorted(r.latency for r in self.results if r.ok)
         toks = sum(r.tokens_out for r in self.results if r.ok)
+        ttfts = sorted(r.ttft for r in self.results
+                       if r.ok and r.ttft is not None)
         outcomes: dict[str, int] = {}
         for r in self.results:
             outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
 
-        def pct(p: float):
-            if not lat:
+        def pct(p: float, values=lat):
+            if not values:
                 return None
-            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 4)
+            return round(values[min(len(values) - 1,
+                                    int(p * len(values)))], 4)
 
         return {
             "requests": self.n,
@@ -119,21 +134,31 @@ class Summary:
             # only meaningful for LM endpoints that report tokens_out
             "tokens_out_total": toks,
             "tokens_out_per_sec": round(toks / self.total_time, 4),
+            # time-to-first-token as the CLIENT sees it (the serving
+            # metric autoscaling and interactivity SLOs are set on);
+            # None for endpoints that don't report ttft_s
+            "ttft_mean_s": round(statistics.mean(ttfts), 4)
+            if ttfts else None,
+            "ttft_p50_s": pct(0.50, ttfts),
+            "ttft_p95_s": pct(0.95, ttfts),
             # shedding visibility: how every request ended
             "outcomes": outcomes,
         }
 
 
-def _count_tokens_out(body: bytes) -> int:
-    """Sum ``tokens_out`` fields from a V1 response body (LM endpoints
-    attach one per prediction); 0 for any other response shape."""
+def _parse_response(body: bytes) -> tuple[int, Optional[float]]:
+    """Extract (tokens_out sum, first ttft_s) from a V1 response body
+    (LM endpoints attach both per prediction); (0, None) otherwise."""
     try:
         obj = json.loads(body)
-        return sum(int(p.get("tokens_out", 0))
-                   for p in obj.get("predictions", [])
-                   if isinstance(p, dict))
+        preds = [p for p in obj.get("predictions", [])
+                 if isinstance(p, dict)]
+        toks = sum(int(p.get("tokens_out", 0)) for p in preds)
+        ttft = next((float(p["ttft_s"]) for p in preds
+                     if p.get("ttft_s") is not None), None)
+        return toks, ttft
     except (ValueError, TypeError, AttributeError):
-        return 0
+        return 0, None
 
 
 def _one_request(url: str, payload: bytes, timeout: float,
@@ -144,8 +169,9 @@ def _one_request(url: str, payload: bytes, timeout: float,
         req = urllib.request.Request(url, data=payload, headers=hdrs)
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             body = resp.read()
+            toks, ttft = _parse_response(body)
             return Result(time.monotonic() - t0, resp.status,
-                          tokens_out=_count_tokens_out(body))
+                          tokens_out=toks, ttft=ttft)
     except urllib.error.HTTPError as e:
         # keep the real status — the outcome breakdown needs to tell a
         # 503 shed from a 504 deadline miss from a genuine 500
@@ -205,6 +231,52 @@ def run_ramp(url: str, payload_pool: list[bytes], *,
     return {"stages": out}
 
 
+def scrape_metrics(metrics_url: str, timeout: float = 10.0) -> list:
+    """GET /metrics and strictly parse the exposition (raises on a
+    malformed or unreachable scrape)."""
+    from kubernetes_cloud_tpu import obs
+
+    with urllib.request.urlopen(metrics_url, timeout=timeout) as resp:
+        return obs.parse_text(resp.read().decode())
+
+
+def metrics_endpoint(target_url: str) -> str:
+    """Derive ``scheme://host:port/metrics`` from the driven URL."""
+    import urllib.parse
+
+    parts = urllib.parse.urlsplit(target_url)
+    return urllib.parse.urlunsplit(
+        (parts.scheme, parts.netloc, "/metrics", "", ""))
+
+
+def check_metrics(before: list, after: list, target_url: str,
+                  client_count: int,
+                  client_responded: Optional[int] = None) -> dict:
+    """Client-vs-server bookkeeping cross-check: every request that got
+    an HTTP response was definitely counted by the server's per-route
+    histogram, so its count delta must cover at least those; requests
+    the client gave up on (timeout / transport error) may still be
+    mid-``handle()`` at the after-scrape — or may never have reached
+    the server at all — so the delta may exceed ``client_responded``
+    but never the total attempted.  ``client_responded=None`` demands
+    exact equality (every request answered — the common case)."""
+    from kubernetes_cloud_tpu import obs
+    from kubernetes_cloud_tpu.serve.server import route_label
+
+    import urllib.parse
+
+    # the server's own vocabulary — one source of truth for the label
+    route = route_label(urllib.parse.urlsplit(target_url).path)
+    name = "kct_server_request_seconds_count"
+    server_n = int(obs.sample_value(after, name, {"route": route})
+                   - obs.sample_value(before, name, {"route": route}))
+    lo = client_count if client_responded is None else client_responded
+    return {"route": route, "client_requests": client_count,
+            "client_responded": lo,
+            "server_requests": server_n,
+            "ok": lo <= server_n <= client_count}
+
+
 def build_payloads(args) -> list[bytes]:
     if args.inputs:
         with open(args.inputs) as f:
@@ -233,27 +305,51 @@ def main(argv=None) -> dict:
                     help="comma-separated concurrency levels (ramp mode)")
     ap.add_argument("--stage-duration", type=float, default=15.0,
                     help="seconds per ramp stage")
+    ap.add_argument("--check-metrics", action="store_true",
+                    help="scrape GET /metrics before/after and assert "
+                         "the server's request histogram count delta "
+                         "matches this client's request count (exit 2 "
+                         "on disagreement)")
     args = ap.parse_args(argv)
 
     payloads = build_payloads(args)
     headers = None
     if args.deadline_ms is not None:
         headers = {"X-Request-Deadline-Ms": str(args.deadline_ms)}
+    before = (scrape_metrics(metrics_endpoint(args.url))
+              if args.check_metrics else None)
     if args.mode == "ramp":
         stats = run_ramp(
             args.url, payloads,
             stages=[int(s) for s in args.ramp_stages.split(",") if s],
             stage_duration=args.stage_duration, timeout=args.timeout,
             headers=headers)
+        client_n = sum(s["requests"] for s in stats["stages"])
+        # requests with a real HTTP status (status != 0) definitely
+        # reached — and were counted by — the server
+        responded = client_n - sum(
+            s["outcomes"].get("client_timeout", 0)
+            + s["outcomes"].get("error", 0) for s in stats["stages"])
     elif args.mode == "sync":
-        stats = run_sync(args.url, payloads, timeout=args.timeout,
-                         headers=headers).stats()
+        summary = run_sync(args.url, payloads, timeout=args.timeout,
+                           headers=headers)
+        stats, client_n = summary.stats(), summary.n
+        responded = sum(1 for r in summary.results if r.status != 0)
     else:
-        stats = run_concurrent(args.url, payloads,
-                               concurrency=args.concurrency,
-                               timeout=args.timeout,
-                               headers=headers).stats()
+        summary = run_concurrent(args.url, payloads,
+                                 concurrency=args.concurrency,
+                                 timeout=args.timeout,
+                                 headers=headers)
+        stats, client_n = summary.stats(), summary.n
+        responded = sum(1 for r in summary.results if r.status != 0)
+    if args.check_metrics:
+        after = scrape_metrics(metrics_endpoint(args.url))
+        stats["metrics_check"] = check_metrics(
+            before, after, args.url, client_n,
+            client_responded=responded)
     print(json.dumps(stats))
+    if args.check_metrics and not stats["metrics_check"]["ok"]:
+        raise SystemExit(2)  # server lost (or double-counted) requests
     return stats
 
 

@@ -22,7 +22,7 @@ import subprocess
 from typing import Iterable, Optional
 
 from kubernetes_cloud_tpu.serve.model import Model
-from kubernetes_cloud_tpu.serve.server import ModelServer
+from kubernetes_cloud_tpu.serve.server import ModelServer, TextResponse
 
 log = logging.getLogger(__name__)
 
@@ -117,17 +117,22 @@ class NativeModelServer(ModelServer):
 
         @_HANDLER
         def on_request(method, path, headers, body, body_len, resp):
+            ctype = b"application/json"
             try:
                 status, obj = self.handle(
                     method.decode(), path.decode(),
                     ctypes.string_at(body, body_len) if body_len else b"",
                     _parse_headers(headers or b""))
-                data = json.dumps(obj).encode()
+                if isinstance(obj, TextResponse):
+                    # /metrics: Prometheus text exposition, not JSON
+                    data = obj.body.encode()
+                    ctype = obj.content_type.encode()
+                else:
+                    data = json.dumps(obj).encode()
             except Exception as e:  # noqa: BLE001 - never unwind into C
                 log.exception("native handler failure")
                 status, data = 500, json.dumps({"error": str(e)}).encode()
-            lib.hs_respond(resp, status, b"application/json", data,
-                           len(data))
+            lib.hs_respond(resp, status, ctype, data, len(data))
 
         return on_request
 

@@ -17,6 +17,10 @@ the exact REST surface the reference's InferenceServices expose
 * ``POST /completion``               FastAPI-compatible completion route
   (``finetuner-workflow/finetuner/inference.py:80-96``) when the model
   implements ``completion()``
+* ``GET  /metrics``                  Prometheus text exposition of the
+  process-global registry (:mod:`kubernetes_cloud_tpu.obs`) — engine,
+  batcher, supervisor, server, and workflow families; the target of the
+  ``prometheus.io/scrape`` pod annotations in ``deploy/``
 
 Error mapping (:mod:`kubernetes_cloud_tpu.serve.errors`): ValueError →
 400, RetryableError (queue full / engine restarted / stream stalled /
@@ -39,6 +43,7 @@ lock: they coalesce concurrent requests themselves.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import threading
@@ -46,7 +51,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterable, Mapping, Optional
 
-from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.obs import tracing
 from kubernetes_cloud_tpu.serve.errors import (
     DeadlineExceededError,
     RetryableError,
@@ -58,6 +64,42 @@ log = logging.getLogger(__name__)
 #: relative deadline budget header (KServe/Knative have no standard one;
 #: gRPC's grpc-timeout plays this role on the other data plane)
 DEADLINE_HEADER = "X-Request-Deadline-Ms"
+
+# HTTP-layer metric families (labels bound per request; the label space
+# is the fixed route vocabulary below — never the raw path, whose model
+# names would otherwise make cardinality unbounded)
+_M_REQUESTS = obs.counter(
+    "kct_server_requests_total", "HTTP requests by route/method/status.",
+    ("route", "method", "status"))
+_M_LATENCY = obs.histogram(
+    "kct_server_request_seconds", "HTTP request wall time by route.",
+    ("route",))
+
+
+def route_label(path: str) -> str:
+    """Bounded route vocabulary for metric labels."""
+    if path in ("/", "/healthz"):
+        return "healthz"
+    if path == "/readyz":
+        return "readyz"
+    if path == "/metrics":
+        return "metrics"
+    if path == "/completion":
+        return "completion"
+    if path.endswith(":predict"):
+        return "predict"
+    if path.startswith("/v1/models"):
+        return "models"
+    return "other"
+
+
+@dataclasses.dataclass
+class TextResponse:
+    """A non-JSON ``handle()`` body (the ``/metrics`` exposition); both
+    front-ends serialize it verbatim with its content type."""
+
+    body: str
+    content_type: str = obs.CONTENT_TYPE
 
 
 class ModelServer:
@@ -80,7 +122,25 @@ class ModelServer:
 
     def handle(self, method: str, path: str, body: bytes,
                headers: Optional[Mapping[str, str]] = None
-               ) -> tuple[int, dict]:
+               ) -> tuple[int, dict | TextResponse]:
+        t0 = time.monotonic()
+        status, obj = self._route(method, path, body, headers)
+        try:  # instrumentation must never turn a served answer into a 500
+            route = route_label(path)
+            # clamp the method like the route: the native front-end
+            # forwards the client's raw token, and a label value per
+            # invented method would grow the registry without bound
+            meth = method if method in ("GET", "POST") else "other"
+            _M_REQUESTS.labels(route=route, method=meth,
+                               status=str(status)).inc()
+            _M_LATENCY.labels(route=route).observe(time.monotonic() - t0)
+        except Exception:  # noqa: BLE001 - pragma: no cover
+            log.exception("request metrics recording failed")
+        return status, obj
+
+    def _route(self, method: str, path: str, body: bytes,
+               headers: Optional[Mapping[str, str]] = None
+               ) -> tuple[int, dict | TextResponse]:
         try:
             faults.fire("server.handle")
         except faults.FaultError as e:
@@ -92,6 +152,8 @@ class ModelServer:
                 return 200, {"status": "alive"}
             if path == "/readyz":
                 return self._readyz()
+            if path == "/metrics":
+                return self._metrics()
             if path == "/v1/models":
                 return 200, {"models": sorted(self.models)}
             if path.startswith("/v1/models/"):
@@ -116,10 +178,18 @@ class ModelServer:
                     payload = json.loads(body or b"{}")
                 except json.JSONDecodeError as e:
                     return 400, {"error": f"invalid JSON: {e}"}
-                if headers is not None and isinstance(payload, dict):
-                    budget = headers.get(DEADLINE_HEADER)
-                    if budget is not None:
-                        payload.setdefault("deadline_ms", budget)
+                if isinstance(payload, dict):
+                    if headers is not None:
+                        budget = headers.get(DEADLINE_HEADER)
+                        if budget is not None:
+                            payload.setdefault("deadline_ms", budget)
+                        rid = headers.get(tracing.REQUEST_ID_HEADER)
+                        if rid:
+                            payload.setdefault("request_id", rid)
+                    # stamp every request exactly once at the door — the
+                    # id ties HTTP, engine spans, and the client together
+                    payload.setdefault("request_id",
+                                       tracing.new_request_id())
                 if path.endswith(":predict") and path.startswith(
                         "/v1/models/"):
                     name = path[len("/v1/models/"):-len(":predict")]
@@ -132,6 +202,18 @@ class ModelServer:
                     self._inflight -= 1
 
         return 405, {"error": "method not allowed"}
+
+    def _metrics(self) -> tuple[int, dict | TextResponse]:
+        """Render the registry.  Failure is CONTAINED: a raising (or,
+        with the thread-per-request front-ends, hanging) scrape answers
+        this request only — the data plane and /readyz never route
+        through here (chaos-locked by tests/test_obs.py)."""
+        try:
+            faults.fire("metrics.render")
+            return 200, TextResponse(obs.render_text())
+        except Exception as e:  # noqa: BLE001 - scrape must stay isolated
+            log.exception("metrics render failed")
+            return 500, {"error": f"metrics unavailable: {e}"}
 
     def _readyz(self) -> tuple[int, dict]:
         if self._draining:
@@ -194,9 +276,13 @@ class ModelServer:
                 body = self.rfile.read(length) if length else b""
                 status, obj = server.handle(method, self.path, body,
                                             self.headers)
-                data = json.dumps(obj).encode()
+                if isinstance(obj, TextResponse):
+                    data, ctype = obj.body.encode(), obj.content_type
+                else:
+                    data, ctype = json.dumps(obj).encode(), \
+                        "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

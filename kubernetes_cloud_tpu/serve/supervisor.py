@@ -46,9 +46,29 @@ import threading
 import time
 from typing import Iterable, Optional
 
+from kubernetes_cloud_tpu import obs
 from kubernetes_cloud_tpu.serve.errors import EngineRestartedError
 
 log = logging.getLogger(__name__)
+
+# Supervisor metric families — restart behaviour was previously only
+# log lines; these are what an operator alerts on
+_M_RESTARTS = obs.counter(
+    "kct_supervisor_restarts_total",
+    "Worker restarts by cause (hang = stale heartbeat on a live "
+    "thread, crash = dead worker thread).", ("model", "cause"))
+_M_HEARTBEAT = obs.gauge(
+    "kct_supervisor_heartbeat_age_seconds",
+    "Watched worker heartbeat age at the last watchdog pass.",
+    ("model",))
+_M_CIRCUIT = obs.gauge(
+    "kct_supervisor_circuit_open",
+    "1 while the crash-loop circuit is open (model permanently "
+    "unready).", ("model",))
+_M_REQUEUED = obs.counter(
+    "kct_supervisor_requeued_total",
+    "Queued requests transplanted into a replacement engine.",
+    ("model",))
 
 
 class Heartbeat:
@@ -201,7 +221,7 @@ class _BatcherTarget:
 
 class _Watched:
     __slots__ = ("target", "restarts", "circuit_open", "restarting",
-                 "last_failure")
+                 "last_failure", "total_restarts")
 
     def __init__(self, target):
         self.target = target
@@ -211,6 +231,9 @@ class _Watched:
         #: flight on its own thread; health reports unready meanwhile
         self.restarting = False
         self.last_failure: Optional[str] = None
+        #: lifetime restart count (the windowed deque above is the
+        #: circuit budget; /readyz reports this one)
+        self.total_restarts = 0
 
 
 class ServingSupervisor:
@@ -282,6 +305,12 @@ class ServingSupervisor:
         # watched models keep being served/supervised regardless.
         with self._lock:
             t = w.target
+            try:  # scrape-facing levels, refreshed every watchdog pass
+                _M_HEARTBEAT.labels(model=t.name).set(t.heartbeat_age())
+                _M_CIRCUIT.labels(model=t.name).set(
+                    1.0 if w.circuit_open else 0.0)
+            except Exception:  # noqa: BLE001 - telemetry never blocks
+                log.exception("supervisor gauge update failed")
             if (w.circuit_open or w.restarting
                     or not getattr(t.model, "ready", False)):
                 return
@@ -291,10 +320,10 @@ class ServingSupervisor:
                 # queue drain — neither is a failure, and "restarting"
                 # here would resurrect a worker mid-shutdown.
                 return
-            reason = None
+            reason = cause = None
             if not t.worker_alive():
                 self.stats["crashes"] += 1
-                reason = "worker thread died"
+                reason, cause = "worker thread died", "crash"
             else:
                 hang_timeout = t.hang_timeout(self.cfg)
                 if hang_timeout is not None and not t.in_compile_grace():
@@ -303,6 +332,7 @@ class ServingSupervisor:
                         self.stats["hangs"] += 1
                         reason = (f"heartbeat stale for {age:.2f}s "
                                   f"(> {hang_timeout}s)")
+                        cause = "hang"
             if reason is None:
                 return
             w.last_failure = reason
@@ -315,6 +345,7 @@ class ServingSupervisor:
             if len(w.restarts) >= self.cfg.max_restarts:
                 w.circuit_open = True
                 self.stats["circuit_opens"] += 1
+                _M_CIRCUIT.labels(model=t.name).set(1.0)
                 log.error("%s: circuit OPEN after %d restarts in %.0fs "
                           "(%s); marking permanently unready", t.name,
                           len(w.restarts), self.cfg.restart_window_s,
@@ -323,6 +354,8 @@ class ServingSupervisor:
                 return
             w.restarts.append(now)
             self.stats["restarts"] += 1
+            w.total_restarts += 1
+            _M_RESTARTS.labels(model=t.name, cause=cause).inc()
             w.restarting = True
         log.warning("%s: %s; restarting worker (restart %d/%d in window)",
                     t.name, reason, len(w.restarts), self.cfg.max_restarts)
@@ -334,6 +367,8 @@ class ServingSupervisor:
             requeued = w.target.restart(err)
             with self._lock:
                 self.stats["requeued"] += requeued
+            if requeued:
+                _M_REQUEUED.labels(model=w.target.name).inc(requeued)
         except Exception:  # noqa: BLE001 - a failed restart = next check
             log.exception("%s: restart failed", w.target.name)
         finally:
@@ -349,38 +384,47 @@ class ServingSupervisor:
 
     def health(self, model) -> dict:
         """The model's ``/readyz`` contribution: ok ⇔ worker alive ∧
-        heartbeat fresh ∧ circuit closed ∧ queue below shed depth."""
+        heartbeat fresh ∧ circuit closed ∧ queue below shed depth.
+
+        Every verdict — healthy or not — carries the diagnostic state
+        (heartbeat age, circuit, restart count, queue depth), so a human
+        with curl can tell a wedged engine from a crash-looped one from
+        a saturated queue without reading pod logs."""
         w = self._by_model.get(id(model))
         if w is None:
             return {"ok": bool(getattr(model, "ready", False)),
                     "reason": "unwatched"}
         with self._lock:
             t = w.target
-            if w.circuit_open:
-                return {"ok": False,
-                        "reason": f"circuit open ({w.last_failure})",
-                        "restarts": self.stats["restarts"]}
-            if w.restarting:
-                return {"ok": False,
-                        "reason": f"restarting ({w.last_failure})"}
-            if not model.ready:
-                return {"ok": False, "reason": "not loaded"}
-            if not t.worker_alive():
-                return {"ok": False, "reason": "worker dead"}
             age = t.heartbeat_age()
+            depth = t.queue_depth()
+            detail = {
+                "heartbeat_age_s": round(age, 3),
+                "circuit": "open" if w.circuit_open else "closed",
+                "restarts": w.total_restarts,
+                "queue_depth": depth,
+            }
+
+            def verdict(ok: bool, reason: str) -> dict:
+                return {"ok": ok, "reason": reason, **detail}
+
+            if w.circuit_open:
+                return verdict(False, f"circuit open ({w.last_failure})")
+            if w.restarting:
+                return verdict(False, f"restarting ({w.last_failure})")
+            if not model.ready:
+                return verdict(False, "not loaded")
+            if not t.worker_alive():
+                return verdict(False, "worker dead")
             hang_timeout = t.hang_timeout(self.cfg)
             if (hang_timeout is not None and age > hang_timeout
                     and not t.in_compile_grace()):
-                return {"ok": False,
-                        "reason": f"heartbeat stale ({age:.2f}s)"}
-            depth, shed = t.queue_depth(), self._shed_threshold(t)
+                return verdict(False, f"heartbeat stale ({age:.2f}s)")
+            shed = self._shed_threshold(t)
             if depth >= shed:
-                return {"ok": False,
-                        "reason": f"queue depth {depth} >= shed "
-                                  f"threshold {shed}"}
-            return {"ok": True, "reason": "ok",
-                    "queue_depth": depth, "heartbeat_age_s": round(age, 3),
-                    "restarts": len(w.restarts)}
+                return verdict(False, f"queue depth {depth} >= shed "
+                                      f"threshold {shed}")
+            return verdict(True, "ok")
 
 
 def supervise(models: Iterable, cfg: SupervisorConfig = SupervisorConfig()

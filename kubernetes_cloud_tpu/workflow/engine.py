@@ -26,6 +26,7 @@ import random
 import time
 from typing import Any, Mapping, Optional
 
+from kubernetes_cloud_tpu import obs
 from kubernetes_cloud_tpu.workflow.events import EVENT_LOG, WorkflowEventLog
 from kubernetes_cloud_tpu.workflow.executors import LocalExecutor, StepResult
 from kubernetes_cloud_tpu.workflow.spec import (
@@ -47,6 +48,21 @@ UPSTREAM_FAILED = "upstream_failed"
 
 _DONE_OK = (SUCCEEDED, SKIPPED)
 _TERMINAL_BAD = (FAILED, UPSTREAM_FAILED)
+
+# Orchestrator metric families — the same signals the JSONL event log
+# records, as a scrapeable surface (Argo's workflow-controller exposes
+# the equivalent ones).  Step names are a bounded label space: they
+# come from authored WorkflowSpecs, not request traffic.
+_M_STEP_S = obs.histogram(
+    "kct_workflow_step_seconds", "Step execution wall time.",
+    ("workflow", "step"),
+    buckets=(0.1, 0.5, 1, 5, 15, 60, 300, 1800, 7200))
+_M_RETRIES = obs.counter(
+    "kct_workflow_step_retries_total", "Step retry attempts.",
+    ("workflow", "step"))
+_M_TRANSITIONS = obs.counter(
+    "kct_workflow_transitions_total",
+    "Step state transitions by resulting state.", ("workflow", "state"))
 
 
 def load_state(workdir: str) -> dict:
@@ -169,21 +185,33 @@ class WorkflowRun:
                 self.events.emit("step_finish", step.name, status=SUCCEEDED,
                                  attempt=attempt, rc=result.rc,
                                  duration=round(result.duration, 4))
+                self._observe_step(step.name, result.duration)
                 return result
             if attempt >= step.retry.limit:
                 self.events.emit("step_finish", step.name, status=FAILED,
                                  attempt=attempt, rc=result.rc,
                                  duration=round(result.duration, 4),
                                  stderr=result.stderr[-2000:])
+                self._observe_step(step.name, result.duration)
                 return result
             delay = step.retry.delay(attempt, self._rng)
             self.events.emit("step_retry", step.name, attempt=attempt,
                              rc=result.rc, delay=round(delay, 4))
+            _M_RETRIES.labels(workflow=self.spec.name,
+                              step=step.name).inc()
             self._sleep(delay)
             attempt += 1
 
+    def _observe_step(self, step_name: str, duration: float) -> None:
+        _M_STEP_S.labels(workflow=self.spec.name,
+                         step=step_name).observe(duration)
+
+    def _transition(self, name: str, state: str) -> None:
+        self._status[name] = state
+        _M_TRANSITIONS.labels(workflow=self.spec.name, state=state).inc()
+
     def _skip(self, name: str, reason: str) -> None:
-        self._status[name] = SKIPPED
+        self._transition(name, SKIPPED)
         # a skipped step has no captured stdout; downstream
         # {{steps.<name>.outputs.result}} references resolve to ""
         self._outputs.setdefault(name, "")
@@ -237,7 +265,7 @@ class WorkflowRun:
                         step = self.spec.step(name)
                         deps = self._deps_state(step)
                         if deps == "failed":
-                            self._status[name] = UPSTREAM_FAILED
+                            self._transition(name, UPSTREAM_FAILED)
                             self.events.emit("step_finish", name,
                                              status=UPSTREAM_FAILED)
                             progressed = True
@@ -251,7 +279,7 @@ class WorkflowRun:
                             except Exception as e:  # noqa: BLE001
                                 # bad when/artifact template: fail the step,
                                 # not the engine
-                                self._status[name] = FAILED
+                                self._transition(name, FAILED)
                                 self.events.emit(
                                     "step_finish", name, status=FAILED,
                                     rc=-1,
@@ -268,7 +296,7 @@ class WorkflowRun:
                                 self._skip(name, "sentinel-complete")
                                 progressed = True
                             else:
-                                self._status[name] = RUNNING
+                                self._transition(name, RUNNING)
                                 futures[name] = pool.submit(
                                     self._run_step, step)
                     if progressed:
@@ -281,10 +309,10 @@ class WorkflowRun:
                 for name in [n for n, f in futures.items() if f in done]:
                     result = futures.pop(name).result()
                     if result.ok:
-                        self._status[name] = SUCCEEDED
+                        self._transition(name, SUCCEEDED)
                         self._outputs[name] = result.output
                     else:
-                        self._status[name] = FAILED
+                        self._transition(name, FAILED)
                         failed_fast = True
                 self._save_state()
 
@@ -298,7 +326,7 @@ class WorkflowRun:
                 if self._status[name] != PENDING:
                     continue
                 if self._deps_state(self.spec.step(name)) == "failed":
-                    self._status[name] = UPSTREAM_FAILED
+                    self._transition(name, UPSTREAM_FAILED)
                     self.events.emit("step_finish", name,
                                      status=UPSTREAM_FAILED)
                     changed = True

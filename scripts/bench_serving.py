@@ -57,8 +57,9 @@ def _payload_pool(rng: random.Random, n: int) -> list[bytes]:
     return pool
 
 
-def _drive(model, pool, stages, stage_duration):
-    from kubernetes_cloud_tpu.serve.load_test import run_ramp
+def _drive(model, pool, stages, stage_duration, metrics_snapshot=False):
+    from kubernetes_cloud_tpu import obs
+    from kubernetes_cloud_tpu.serve.load_test import run_ramp, scrape_metrics
     from kubernetes_cloud_tpu.serve.server import ModelServer
 
     model.load()
@@ -69,8 +70,13 @@ def _drive(model, pool, stages, stage_duration):
         # warmup: compile every (prompt-bucket, max_new) program before
         # the clock starts
         run_ramp(url, pool[:24], stages=[4], stage_duration=4.0)
+        # --metrics-snapshot: bracket the measured window with /metrics
+        # scrapes (after warmup, so the delta is the run itself)
+        metrics_url = f"http://127.0.0.1:{server.port}/metrics"
+        before = scrape_metrics(metrics_url) if metrics_snapshot else None
         out = run_ramp(url, pool, stages=stages,
                        stage_duration=stage_duration)
+        after = scrape_metrics(metrics_url) if metrics_snapshot else None
     finally:
         server.stop()
         model.stop()
@@ -78,13 +84,20 @@ def _drive(model, pool, stages, stage_duration):
     # contract cares about); per-stage detail goes to stderr
     print(json.dumps(out), file=sys.stderr)
     best = max(out["stages"], key=lambda s: s["tokens_out_per_sec"])
-    return {
+    result = {
         "tokens_out_per_sec": best["tokens_out_per_sec"],
         "p50_s": best["latency_p50_s"],
         "p95_s": best["latency_p95_s"],
         "goodput_rps": best["goodput_rps"],
         "concurrency": best["concurrency"],
     }
+    if metrics_snapshot:
+        # counter/sum/count deltas over the measured window (buckets
+        # elided: per-le rows would swamp the one-line JSON record)
+        result["metrics_delta"] = obs.delta(
+            before, after, "kct_",
+            keep=lambda n: not n.endswith("_bucket"))
+    return result
 
 
 def _poll_readyz(url: str, want: int, timeout_s: float) -> float:
@@ -231,6 +244,11 @@ def main(argv=None) -> int:
                     help="payload pool size (cycled by the ramp)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--skip-baseline", action="store_true")
+    ap.add_argument("--metrics-snapshot", action="store_true",
+                    help="scrape GET /metrics before/after each "
+                         "measured ramp and attach the counter deltas "
+                         "to the benchmark JSON (instrumentation-"
+                         "overhead audits read this)")
     ap.add_argument("--inject", choices=("hang", "crash"), default=None,
                     help="recovery mode: wedge (hang) or crash the "
                          "decode loop and measure supervisor recovery "
@@ -258,12 +276,14 @@ def main(argv=None) -> int:
         baseline = _drive(
             BatchingModel("lm", svc,
                           BatcherConfig(max_batch_size=args.slots)),
-            pool, stages, args.stage_duration)
+            pool, stages, args.stage_duration,
+            metrics_snapshot=args.metrics_snapshot)
 
     cb = _drive(
         ContinuousBatchingModel("lm", svc, EngineConfig(
             slots=args.slots, max_len=args.pool_max_len)),
-        pool, stages, args.stage_duration)
+        pool, stages, args.stage_duration,
+        metrics_snapshot=args.metrics_snapshot)
 
     record = {
         "metric": "serving_decode_tokens_per_sec",
@@ -275,6 +295,8 @@ def main(argv=None) -> int:
         "preset": args.preset,
         "slots": args.slots,
     }
+    if args.metrics_snapshot:
+        record["metrics_delta"] = cb.get("metrics_delta")
     if baseline is not None:
         record["baseline"] = baseline
         if baseline["tokens_out_per_sec"]:

@@ -141,6 +141,28 @@ def test_inference_services_wire_probes_and_drain():
                 f"{path}: readinessProbe must target /readyz")
 
 
+def test_inference_services_opt_into_prometheus_scraping():
+    """The metrics plane (kubernetes_cloud_tpu/obs + GET /metrics on
+    both serving front-ends) is only useful if the cluster Prometheus
+    actually pulls it: every online-inference InferenceService must
+    carry the scrape annotations, pointed at the serving port's
+    /metrics."""
+    seen = 0
+    for path in (DEPLOY / "online-inference").rglob("*.yaml"):
+        for doc in _docs(path):
+            if doc.get("kind") != "InferenceService":
+                continue
+            seen += 1
+            ann = doc["metadata"].get("annotations") or {}
+            assert ann.get("prometheus.io/scrape") == "true", (
+                f"{path}: missing prometheus.io/scrape annotation")
+            assert ann.get("prometheus.io/port") == "8080", (
+                f"{path}: prometheus.io/port must be the serving port")
+            assert ann.get("prometheus.io/path") == "/metrics", (
+                f"{path}: prometheus.io/path must be /metrics")
+    assert seen >= 8  # the whole serving catalog is covered
+
+
 def test_ready_sentinel_protocol_present():
     text = (DEPLOY / "online-inference" / "bloom-176b" /
             "01-download-job.yaml").read_text()

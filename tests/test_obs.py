@@ -1,0 +1,479 @@
+"""Telemetry layer: registry/exposition-format units, request tracing,
+HTTP /metrics on the stdlib front-end, the enriched /readyz body, the
+workflow engine's metric families, and the chaos proof that a
+wedged/raising metrics scrape can never take down the data plane or
+flip /readyz.  Everything here is jax-free and quick-lane."""
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.obs import tracing
+from kubernetes_cloud_tpu.obs.metrics import Registry
+from kubernetes_cloud_tpu.serve import load_test
+from kubernetes_cloud_tpu.serve.batcher import BatcherConfig, BatchingModel
+from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.server import ModelServer
+from kubernetes_cloud_tpu.serve.supervisor import (
+    ServingSupervisor,
+    SupervisorConfig,
+)
+from kubernetes_cloud_tpu.train.metrics import read_jsonl
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.uninstall()
+    tracing.uninstall()
+    obs.REGISTRY.reset()
+    yield
+    faults.uninstall()
+    tracing.uninstall()
+    obs.REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry + exposition format
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_roundtrip():
+    reg = Registry()
+    c = reg.counter("t_requests_total", "Requests.", ("route", "status"))
+    c.labels(route="predict", status="200").inc()
+    c.labels(route="predict", status="200").inc(2)
+    c.labels(route="predict", status="503").inc()
+    g = reg.gauge("t_depth", "Depth.")
+    g.set(7)
+    g.inc(2)
+    g.dec()
+    h = reg.histogram("t_lat_seconds", "Latency.", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+
+    samples = obs.parse_text(reg.render())
+    assert obs.sample_value(samples, "t_requests_total",
+                            {"route": "predict", "status": "200"}) == 3
+    assert obs.sample_value(samples, "t_requests_total",
+                            {"route": "predict"}) == 4  # summed
+    assert obs.sample_value(samples, "t_depth") == 8
+    assert obs.sample_value(samples, "t_lat_seconds_count") == 3
+    assert obs.sample_value(samples, "t_lat_seconds_sum") == pytest.approx(
+        5.55)
+    # cumulative buckets: le=0.1 → 1, le=1.0 → 2, +Inf → 3
+    assert obs.sample_value(samples, "t_lat_seconds_bucket",
+                            {"le": "0.1"}) == 1
+    assert obs.sample_value(samples, "t_lat_seconds_bucket",
+                            {"le": "1"}) == 2
+    assert obs.sample_value(samples, "t_lat_seconds_bucket",
+                            {"le": "+Inf"}) == 3
+
+
+def test_registration_is_get_or_create_and_type_checked():
+    reg = Registry()
+    a = reg.counter("t_total", "x", ("m",))
+    assert reg.counter("t_total", "x", ("m",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("t_total", "x", ("m",))  # type clash
+    with pytest.raises(ValueError):
+        reg.counter("t_total", "x", ("other",))  # label-schema clash
+    with pytest.raises(ValueError):
+        a.labels(wrong="x")
+    with pytest.raises(ValueError):
+        a.inc()  # labeled family has no default child
+    with pytest.raises(ValueError):
+        reg.counter("bad name", "x")
+
+
+def test_label_values_escape_and_histogram_consistency():
+    reg = Registry()
+    c = reg.counter("t_weird_total", "Weird.", ("p",))
+    c.labels(p='a"b\\c\nd').inc()
+    text = reg.render()
+    samples = obs.parse_text(text)  # the strict parser must accept it
+    (name, labels, value), = samples
+    assert labels["p"] == 'a"b\\c\nd' and value == 1
+
+    h = reg.histogram("t_h_seconds", "H.", ("m",), buckets=(1, 2))
+    h.labels(m="x").observe(1.5)
+    samples = obs.parse_text(reg.render())
+    # _count always equals the +Inf bucket (scrape-consistency invariant)
+    assert obs.sample_value(samples, "t_h_seconds_count", {"m": "x"}) \
+        == obs.sample_value(samples, "t_h_seconds_bucket",
+                            {"m": "x", "le": "+Inf"})
+
+
+def test_parser_rejects_malformed_exposition():
+    for bad in ("no_value_here\n", "1leading_digit 3\n",
+                'm{unterminated="x 1\n', "# BOGUS comment\n",
+                "m notanumber\n"):
+        with pytest.raises(ValueError):
+            obs.parse_text(bad)
+
+
+def test_registry_reset_zeroes_but_keeps_families():
+    reg = Registry()
+    c = reg.counter("t_total", "x")
+    c.inc(5)
+    reg.reset()
+    assert reg.counter("t_total", "x") is c
+    assert c.value == 0
+
+
+def test_reset_preserves_cached_label_children():
+    # instrumented objects (engine, batcher) resolve .labels() once and
+    # keep the child; reset() must zero it IN PLACE, not orphan it
+    reg = Registry()
+    child = reg.counter("t_cached_total", "x", ("m",)).labels(m="lm")
+    hchild = reg.histogram("t_cached_s", "x", ("m",),
+                           buckets=(1,)).labels(m="lm")
+    child.inc(3)
+    hchild.observe(0.5)
+    reg.reset()
+    child.inc()  # the cached reference must still feed the render
+    hchild.observe(0.5)
+    samples = obs.parse_text(reg.render())
+    assert obs.sample_value(samples, "t_cached_total", {"m": "lm"}) == 1
+    assert obs.sample_value(samples, "t_cached_s_count", {"m": "lm"}) == 1
+
+
+def test_unescape_backslash_then_n_roundtrips():
+    reg = Registry()
+    # literal backslash followed by literal 'n' — renders as \\n, which
+    # a naive chained-replace unescape corrupts into backslash+newline
+    reg.counter("t_esc_total", "x", ("p",)).labels(p="a\\nb").inc()
+    (name, labels, value), = obs.parse_text(reg.render())
+    assert labels["p"] == "a\\nb"
+
+
+def test_render_values_formats():
+    reg = Registry()
+    g = reg.gauge("t_g", "g")
+    g.set(0.25)
+    samples = obs.parse_text(reg.render())
+    assert obs.sample_value(samples, "t_g") == 0.25
+    g.set(math.inf)
+    samples = obs.parse_text(reg.render())
+    assert obs.sample_value(samples, "t_g") == math.inf
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_writes_ordered_jsonl(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    with tracing.tracing(path) as tr:
+        tracing.trace("r1", "queued", model="m")
+        tracing.trace("r2", "queued", model="m")
+        tracing.trace("r1", "complete", tokens=3)
+        assert [r["span"] for r in tr.spans_for("r1")] \
+            == ["queued", "complete"]
+        seqs = [r["seq"] for r in tr.records]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 3
+    records = read_jsonl(path)  # same reader chain as train/workflow
+    assert [r["span"] for r in records if r["request_id"] == "r1"] \
+        == ["queued", "complete"]
+    assert records[-1]["tokens"] == 3
+
+
+def test_trace_is_noop_when_disarmed():
+    tracing.trace("r1", "queued")  # must not raise, nothing installed
+    assert tracing.active() is None
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer: /metrics endpoint, route metrics, request-id stamping
+# ---------------------------------------------------------------------------
+
+
+class Echo(Model):
+    def predict(self, payload):
+        return {"predictions": payload.get("instances", []),
+                "request_id": payload.get("request_id")}
+
+
+@pytest.fixture
+def server():
+    srv = ModelServer([Echo("m")], host="127.0.0.1", port=0)
+    srv.load_all()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(server, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}{path}", timeout=10) as r:
+            return r.status, r.headers.get("Content-Type"), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type"), e.read()
+
+
+def _post(server, payload, headers=None, path="/v1/models/m:predict"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_metrics_endpoint_serves_valid_exposition(server):
+    _post(server, {"instances": ["a"]})
+    _get(server, "/readyz")
+    status, ctype, body = _get(server, "/metrics")
+    assert status == 200
+    assert ctype == obs.CONTENT_TYPE
+    samples = obs.parse_text(body.decode())  # strict format validation
+    assert obs.sample_value(samples, "kct_server_requests_total",
+                            {"route": "predict", "method": "POST",
+                             "status": "200"}) == 1
+    assert obs.sample_value(samples, "kct_server_requests_total",
+                            {"route": "readyz"}) == 1
+    assert obs.sample_value(samples, "kct_server_request_seconds_count",
+                            {"route": "predict"}) == 1
+    # the scrape itself is counted too (visible on the NEXT scrape)
+    _, _, body2 = _get(server, "/metrics")
+    samples2 = obs.parse_text(body2.decode())
+    assert obs.sample_value(samples2, "kct_server_requests_total",
+                            {"route": "metrics"}) >= 1
+
+
+def test_inbound_request_id_header_honored(server):
+    with tracing.tracing():
+        code, body = _post(server, {"instances": ["a"]},
+                           headers={"X-Request-Id": "corr-123"})
+    assert code == 200
+    assert body["request_id"] == "corr-123"
+    # without the header an id is minted
+    code, body = _post(server, {"instances": ["a"]})
+    assert body["request_id"]
+
+
+def test_error_statuses_are_counted(server):
+    _post(server, {"instances": ["a"]}, path="/v1/models/nope:predict")
+    _, _, body = _get(server, "/metrics")
+    samples = obs.parse_text(body.decode())
+    assert obs.sample_value(samples, "kct_server_requests_total",
+                            {"route": "predict", "status": "404"}) == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: a broken scrape never hurts the data plane or readiness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_raising_metrics_render_is_contained(server):
+    with faults.inject(FaultSpec("metrics.render", mode="raise",
+                                 times=-1)):
+        status, _, body = _get(server, "/metrics")
+        assert status == 500
+        assert b"metrics unavailable" in body
+        # the data plane and readiness are untouched
+        assert _post(server, {"instances": ["a"]})[0] == 200
+        assert _get(server, "/readyz")[0] == 200
+    assert _get(server, "/metrics")[0] == 200  # recovers when disarmed
+
+
+@pytest.mark.chaos
+def test_hanging_metrics_render_is_contained(server):
+    with faults.inject(FaultSpec("metrics.render", mode="hang",
+                                 delay_s=30.0)) as inj:
+        scrape_done = threading.Event()
+
+        def scrape():
+            _get(server, "/metrics")
+            scrape_done.set()
+
+        t = threading.Thread(target=scrape, daemon=True)
+        t.start()
+        time.sleep(0.05)  # scrape thread is now parked in the hang
+        assert not scrape_done.is_set()
+        # readiness and the data plane answer while the scrape hangs
+        assert _get(server, "/readyz")[0] == 200
+        assert _post(server, {"instances": ["a"]})[0] == 200
+        inj.release()
+        t.join(timeout=10)
+        assert scrape_done.is_set()
+
+
+# ---------------------------------------------------------------------------
+# /readyz diagnostic body (supervised batcher; no accelerator needed)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_readyz_body_carries_diagnostics():
+    m = BatchingModel("bm", lambda insts, params: list(insts),
+                      BatcherConfig(max_batch_size=2, max_queue_size=8))
+    m.load()
+    sup = ServingSupervisor(SupervisorConfig(poll_interval_s=0.05))
+    sup.watch(m)
+    srv = ModelServer([m], host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        _, _, body = _get(srv, "/readyz")
+        detail = json.loads(body)["models"]["bm"]
+        assert detail["ok"] is True
+        assert detail["circuit"] == "closed"
+        assert detail["restarts"] == 0
+        assert detail["queue_depth"] == 0
+        assert isinstance(detail["heartbeat_age_s"], float)
+
+        # kill the dispatcher via fault injection → supervisor restarts
+        # it; the restart count must surface in the body
+        with faults.inject(FaultSpec("dispatch", mode="raise")):
+            time.sleep(0.1)  # dispatcher hits the armed site and dies
+            sup.check_now()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            _, _, body = _get(srv, "/readyz")
+            detail = json.loads(body)["models"]["bm"]
+            if detail["ok"] and detail["restarts"] == 1:
+                break
+            time.sleep(0.02)
+        assert detail["restarts"] == 1
+        assert detail["circuit"] == "closed"
+        # …and in the supervisor metric family, by cause
+        samples = obs.parse_text(obs.render_text())
+        assert obs.sample_value(samples, "kct_supervisor_restarts_total",
+                                {"model": "bm", "cause": "crash"}) == 1
+    finally:
+        srv.stop()
+        sup.stop()
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# batcher + workflow metric families
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_records_batch_metrics():
+    m = BatchingModel("bb", lambda insts, params: list(insts),
+                      BatcherConfig(max_batch_size=4))
+    m.load()
+    try:
+        with tracing.tracing() as tr:
+            out = m.predict({"instances": ["a", "b"],
+                             "request_id": "bat-1"})
+        assert out["predictions"] == ["a", "b"]
+        assert [r["span"] for r in tr.spans_for("bat-1")] \
+            == ["queued", "dispatched", "complete"]
+    finally:
+        m.stop()
+    samples = obs.parse_text(obs.render_text())
+    assert obs.sample_value(samples, "kct_batcher_batches_total",
+                            {"model": "bb"}) == 1
+    assert obs.sample_value(samples, "kct_batcher_requests_total",
+                            {"model": "bb"}) == 1
+    assert obs.sample_value(samples, "kct_batcher_batch_size_sum",
+                            {"model": "bb"}) == 2
+    assert obs.sample_value(samples, "kct_batcher_dispatch_seconds_count",
+                            {"model": "bb"}) == 1
+
+
+def test_workflow_engine_records_step_metrics(tmp_path):
+    from kubernetes_cloud_tpu.workflow.engine import WorkflowRun
+    from kubernetes_cloud_tpu.workflow.spec import (
+        RetryStrategy,
+        Step,
+        WorkflowSpec,
+    )
+
+    spec = WorkflowSpec(name="obs-wf", steps=[
+        Step(name="ok", command=["true"]),
+        Step(name="flaky", command=["false"], deps=["ok"],
+             retry=RetryStrategy(limit=1, backoff=0.0)),
+    ])
+    run = WorkflowRun(spec, str(tmp_path / "wf"), sleep=lambda s: None)
+    out = run.run()
+    assert out["status"] == "failed"
+    samples = obs.parse_text(obs.render_text())
+    assert obs.sample_value(samples, "kct_workflow_step_seconds_count",
+                            {"workflow": "obs-wf", "step": "ok"}) == 1
+    assert obs.sample_value(samples, "kct_workflow_step_retries_total",
+                            {"workflow": "obs-wf", "step": "flaky"}) == 1
+    assert obs.sample_value(samples, "kct_workflow_transitions_total",
+                            {"workflow": "obs-wf",
+                             "state": "succeeded"}) == 1
+    assert obs.sample_value(samples, "kct_workflow_transitions_total",
+                            {"workflow": "obs-wf", "state": "failed"}) == 1
+
+
+# ---------------------------------------------------------------------------
+# load_test: TTFT stats + client-vs-server metrics cross-check
+# ---------------------------------------------------------------------------
+
+
+class TtftEcho(Model):
+    def predict(self, payload):
+        return {"predictions": [
+            {"generated_text": "x", "tokens_out": 4, "ttft_s": 0.025}
+            for _ in payload.get("instances", [])]}
+
+
+def test_load_test_reports_ttft_and_checks_metrics(capsys):
+    srv = ModelServer([TtftEcho("m")], host="127.0.0.1", port=0)
+    srv.load_all()
+    srv.start()
+    try:
+        stats = load_test.main([
+            "--url", f"http://127.0.0.1:{srv.port}/v1/models/m:predict",
+            "--requests", "6", "--concurrency", "3", "--check-metrics"])
+    finally:
+        srv.stop()
+    assert stats["successful"] == 6
+    assert stats["ttft_mean_s"] == pytest.approx(0.025)
+    assert stats["ttft_p95_s"] == pytest.approx(0.025)
+    assert stats["tokens_out_total"] == 24
+    check = stats["metrics_check"]
+    assert check == {"route": "predict", "client_requests": 6,
+                     "client_responded": 6, "server_requests": 6,
+                     "ok": True}
+
+
+def test_load_test_metrics_check_fails_loudly():
+    # a server whose histogram disagrees with the client count must
+    # exit 2 — silent bookkeeping drift is the failure mode the flag
+    # exists to catch
+    srv = ModelServer([TtftEcho("m")], host="127.0.0.1", port=0)
+    srv.load_all()
+    srv.start()
+    url = f"http://127.0.0.1:{srv.port}/v1/models/m:predict"
+    try:
+        # prime one request BETWEEN the scrapes via a side channel the
+        # client doesn't count: monkey-level — issue it inside the run
+        # window by running a first request before the pre-scrape…
+        before = load_test.scrape_metrics(
+            load_test.metrics_endpoint(url))
+        # …then two requests the "client" claims as one
+        load_test._one_request(url, b'{"instances": ["a"]}', 10.0)
+        load_test._one_request(url, b'{"instances": ["a"]}', 10.0)
+        after = load_test.scrape_metrics(load_test.metrics_endpoint(url))
+        check = load_test.check_metrics(before, after, url,
+                                        client_count=1)
+        assert check["ok"] is False
+        assert check["server_requests"] == 2
+        # with timeouts excused, a server count INSIDE the
+        # [responded, attempted] window passes
+        tolerant = load_test.check_metrics(before, after, url,
+                                           client_count=2,
+                                           client_responded=1)
+        assert tolerant["ok"] is True
+    finally:
+        srv.stop()

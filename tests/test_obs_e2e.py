@@ -1,0 +1,273 @@
+"""End-to-end telemetry over the real serving stack (acceptance lock
+for the unified telemetry layer): generate requests — including one
+shed and one supervisor restart driven by deterministic fault
+injection — flow through HTTP → engine → supervisor while the
+process-global registry and the request tracer record them; /metrics
+(on BOTH front-ends) renders valid Prometheus exposition covering
+every family, and the trace JSONL carries correctly ordered spans."""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.obs import tracing
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingModel,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+from kubernetes_cloud_tpu.serve.server import ModelServer
+from kubernetes_cloud_tpu.serve.supervisor import (
+    ServingSupervisor,
+    SupervisorConfig,
+)
+
+pytestmark = pytest.mark.chaos
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.uninstall()
+    tracing.uninstall()
+    obs.REGISTRY.reset()
+    yield
+    faults.uninstall()
+    tracing.uninstall()
+    obs.REGISTRY.reset()
+
+
+@pytest.fixture(scope="module")
+def service():
+    svc = CausalLMService("lm", CFG,
+                          params=init_params(CFG, jax.random.key(0)),
+                          dtype=jnp.float32)
+    svc.load()
+    return svc
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _predict(port, prompt, max_new=4, headers=None, deadline_ms=None,
+             timeout=60):
+    payload = {"instances": [prompt],
+               "parameters": {"max_new_tokens": max_new,
+                              "temperature": 0.0}}
+    if deadline_ms is not None:
+        payload["deadline_ms"] = deadline_ms
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/lm:predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _wait(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_full_lifecycle_metrics_and_spans(service, tmp_path):
+    trace_path = str(tmp_path / "trace.jsonl")
+    tracing.install(tracing.RequestTracer(trace_path))
+    model = ContinuousBatchingModel("lm", service, EngineConfig(
+        slots=2, max_len=64))
+    model.load()
+    sup = ServingSupervisor(SupervisorConfig(poll_interval_s=0.05,
+                                             hang_timeout_s=5.0))
+    sup.watch(model)
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    port = server.port
+    try:
+        # 1. a successful generate with a client correlation id
+        code, body = _predict(port, "hello telemetry", max_new=4,
+                              headers={"X-Request-Id": "req-e2e-1"})
+        assert code == 200
+        pred = body["predictions"][0]
+        assert pred["tokens_out"] == 4
+        assert pred["ttft_s"] > 0  # client-visible TTFT attached
+
+        # 2. one shed: an already-expired deadline is refused at
+        # admission with 504 and lands in the shed counter + spans
+        code, body = _predict(port, "shed me", deadline_ms=0,
+                              headers={"X-Request-Id": "req-e2e-shed"})
+        assert code == 504
+
+        # 3. one supervisor restart: crash the decode loop via fault
+        # injection; the victim 503s, the watchdog rebuilds the engine
+        sup.start()
+        faults.install(faults.FaultInjector(
+            [FaultSpec("model_fn", mode="raise")]))
+        code, _ = _predict(port, "crash victim", max_new=4,
+                           headers={"X-Request-Id": "req-e2e-crash"})
+        assert code == 503  # retryable EngineRestartedError
+        faults.uninstall()
+        _wait(lambda: sup.stats["restarts"] == 1, what="restart booked")
+        _wait(lambda: _get(port, "/readyz")[0] == 200,
+              what="readyz recovered")
+        code, _ = _predict(port, "after restart", max_new=2)
+        assert code == 200  # the replacement engine serves
+
+        # -- /metrics: valid exposition covering every serving family --
+        status, text = _get(port, "/metrics")
+        assert status == 200
+        samples = obs.parse_text(text.decode())
+        lm = {"model": "lm"}
+        assert obs.sample_value(samples, "kct_engine_iterations_total",
+                                lm) > 0
+        assert obs.sample_value(samples, "kct_engine_tokens_total",
+                                lm) >= 6
+        # 2 requests reached slots (the crash victim died inside its
+        # prefill, before the admitted counter — which counts requests
+        # that actually entered the slot pool)
+        assert obs.sample_value(samples, "kct_engine_admitted_total",
+                                lm) == 2
+        assert obs.sample_value(samples, "kct_engine_shed_total",
+                                {"model": "lm",
+                                 "reason": "deadline_admission"}) == 1
+        assert obs.sample_value(samples, "kct_engine_ttft_seconds_count",
+                                lm) >= 2
+        assert obs.sample_value(samples, "kct_engine_slots", lm) == 2
+        assert obs.sample_value(samples,
+                                "kct_engine_iteration_seconds_count",
+                                lm) > 0
+        assert obs.sample_value(samples, "kct_supervisor_restarts_total",
+                                {"model": "lm", "cause": "crash"}) == 1
+        assert obs.sample_value(samples, "kct_supervisor_circuit_open",
+                                lm) == 0
+        assert obs.sample_value(samples, "kct_server_requests_total",
+                                {"route": "predict", "status": "200"}) >= 2
+        assert obs.sample_value(samples, "kct_server_requests_total",
+                                {"route": "predict", "status": "504"}) == 1
+        assert obs.sample_value(samples, "kct_server_requests_total",
+                                {"route": "predict", "status": "503"}) == 1
+        # histograms internally consistent: count == +Inf bucket
+        assert obs.sample_value(
+            samples, "kct_engine_ttft_seconds_count", lm) \
+            == obs.sample_value(samples, "kct_engine_ttft_seconds_bucket",
+                                {"model": "lm", "le": "+Inf"})
+    finally:
+        server.stop()
+        sup.stop()
+        model.stop()
+
+    # -- trace spans: ordering + terminal states, read from the JSONL --
+    from kubernetes_cloud_tpu.train.metrics import read_jsonl
+
+    records = read_jsonl(trace_path)
+    by_id = {}
+    for r in records:
+        by_id.setdefault(r["request_id"], []).append(r["span"])
+    assert by_id["req-e2e-1"] == [
+        "queued", "admitted", "prefill", "decode", "first_token",
+        "complete"]
+    assert by_id["req-e2e-shed"] == ["shed"]
+    # the crash victim was queued (maybe admitted) then failed — its
+    # stream must terminate in "failed", never "complete"
+    crash = by_id["req-e2e-crash"]
+    assert crash[0] == "queued" and crash[-1] == "failed"
+    assert "complete" not in crash
+    # per-id seq strictly increases (total order across threads)
+    seqs = [r["seq"] for r in records if r["request_id"] == "req-e2e-1"]
+    assert seqs == sorted(seqs)
+    # terminal record carries the outcome detail
+    done = [r for r in records if r["request_id"] == "req-e2e-1"][-1]
+    assert done["tokens"] == 4 and done["duration_s"] > 0
+
+
+def test_queued_deadline_shed_traces_and_counts(service):
+    """A request whose deadline expires while QUEUED (not at admission)
+    is shed by the scheduler with the deadline_queued reason."""
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingEngine,
+    )
+    from kubernetes_cloud_tpu.serve.errors import DeadlineExceededError
+
+    eng = ContinuousBatchingEngine(
+        CFG, service.params, EngineConfig(slots=1, max_len=64),
+        pad_token_id=0, name="lm")
+    eng.start()
+    try:
+        with tracing.tracing() as tr:
+            # occupy the single slot with a long generation…
+            long = eng.submit([1, 2, 3], max_new_tokens=60,
+                              temperature=0.0)
+            # …so the short-deadline request expires in the queue (1 ms
+            # vs 60 decode iterations — expiry is certain, not a race)
+            doomed = eng.submit([4, 5], max_new_tokens=2, temperature=0.0,
+                                deadline=time.monotonic() + 0.001,
+                                request_id="doomed")
+            with pytest.raises(DeadlineExceededError):
+                doomed.wait(eng)
+            long.wait(eng)
+        assert [r["span"] for r in tr.spans_for("doomed")] \
+            == ["queued", "shed"]
+        assert tr.spans_for("doomed")[-1]["reason"] == "deadline_queued"
+    finally:
+        eng.stop()
+    samples = obs.parse_text(obs.render_text())
+    assert obs.sample_value(samples, "kct_engine_shed_total",
+                            {"model": "lm",
+                             "reason": "deadline_queued"}) == 1
+    # KV-utilization gauge returned to 0 after the drain
+    assert obs.sample_value(samples, "kct_engine_kv_utilization",
+                            {"model": "lm"}) == 0
+
+
+def test_native_frontend_serves_metrics(service):
+    """The C++ front-end returns the same valid exposition with the
+    Prometheus content type (wired through the raw-header ABI's
+    hs_respond content-type argument)."""
+    from kubernetes_cloud_tpu.serve import native_server
+
+    if not native_server.available():
+        pytest.skip("no C++ toolchain")
+    model = ContinuousBatchingModel("lm", service, EngineConfig(
+        slots=2, max_len=64))
+    model.load()
+    srv = native_server.NativeModelServer([model], host="127.0.0.1",
+                                          port=0)
+    srv.start()
+    try:
+        code, body = _predict(srv.port, "native telemetry", max_new=3)
+        assert code == 200
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers.get("Content-Type") == obs.CONTENT_TYPE
+            samples = obs.parse_text(r.read().decode())
+        assert obs.sample_value(samples, "kct_engine_tokens_total",
+                                {"model": "lm"}) >= 3
+        assert obs.sample_value(samples, "kct_server_requests_total",
+                                {"route": "predict", "status": "200"}) == 1
+    finally:
+        srv.stop()
+        model.stop()
