@@ -1,0 +1,48 @@
+"""kct-lint — repo-native static analysis for hand-maintained invariants.
+
+The serving stack carries four layers of invariants that no type system
+enforces: lock-protected engine state must never block while holding the
+lock, jitted device programs must stay trace-pure, the fault-site /
+metric-family / trace-span vocabularies must match their declared
+registries and the operator docs, errors raised on the data plane must
+come from the typed ladder in :mod:`kubernetes_cloud_tpu.serve.errors`,
+and the ``deploy/`` manifests must keep the probe/drain/scrape contract
+the supervisor relies on.  Each was previously review-checked (or locked
+by a one-off test); this package machine-checks them at the source
+level, purely from the AST — importing it never imports jax, so the
+whole-repo run stays in the sub-second range and works on jax-free CI
+boxes.
+
+Usage::
+
+    python -m kubernetes_cloud_tpu.analysis [--format text|json]
+    kct-lint --list-rules            # rule catalog with rationale
+
+Findings carry a rule id, ``file:line``, and a message.  Pre-existing
+debt lives in the committed ``analysis-baseline.json``: baselined
+findings don't fail the run, and a baseline entry whose finding no
+longer fires is reported as *stale* (distinct exit code) so the file
+only ever shrinks.  One-off exceptions are annotated in the source with
+``# kct-lint: ignore[RULE-ID] - reason``.
+
+Rule families (see ``deploy/README.md`` § Static analysis):
+
+=============  ==========================================================
+``KCT-LOCK``   no blocking work / fault points while holding a lock
+``KCT-JIT``    trace purity + donation discipline inside jitted programs
+``KCT-REG``    fault-site / metric / span registry + docs-catalog drift
+``KCT-ERR``    typed error taxonomy on the serving data plane
+``KCT-MAN``    declarative rules over the ``deploy/**/*.yaml`` surface
+=============  ==========================================================
+"""
+
+from kubernetes_cloud_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    Repo,
+    Rule,
+    all_rules,
+    apply_baseline,
+    load_baseline,
+    run,
+    write_baseline,
+)

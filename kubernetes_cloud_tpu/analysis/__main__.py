@@ -1,0 +1,8 @@
+"""``python -m kubernetes_cloud_tpu.analysis`` — the kct-lint CLI."""
+
+import sys
+
+from kubernetes_cloud_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

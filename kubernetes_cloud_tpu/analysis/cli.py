@@ -1,0 +1,158 @@
+"""kct-lint command line — text/json output, baseline diff, exit codes.
+
+Exit codes (CI contract):
+
+* ``0`` — clean modulo the baseline
+* ``1`` — new findings (not baselined, not inline-suppressed)
+* ``2`` — NO new findings but stale baseline suppressions: a
+  suppressed finding no longer fires, so the entry must be deleted
+  (the baseline only ever shrinks)
+* ``3`` — usage/internal error
+
+``python -m kubernetes_cloud_tpu.analysis``, the ``kct-lint`` console
+script, and ``scripts/lint.py`` all enter here, so CI and humans can
+never disagree about what the engine saw.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Optional, Sequence
+
+from kubernetes_cloud_tpu.analysis.engine import (
+    BASELINE_FILE,
+    all_rules,
+    apply_baseline,
+    load_baseline,
+    run,
+    write_baseline,
+)
+
+
+def find_root(start: Optional[str] = None) -> pathlib.Path:
+    """Walk up from ``start`` (default cwd) to the repo root — the
+    directory holding both the package and pyproject.toml."""
+    cur = pathlib.Path(start or ".").resolve()
+    for candidate in (cur, *cur.parents):
+        if (candidate / "kubernetes_cloud_tpu" / "__init__.py").is_file() \
+                and (candidate / "pyproject.toml").is_file():
+            return candidate
+    return cur
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kct-lint",
+        description="Repo-native static analysis: lock discipline, JAX "
+                    "trace purity, registry drift, error taxonomy, "
+                    "manifest rules.")
+    p.add_argument("--root", default=None,
+                   help="repository root (default: auto-detected from "
+                        "the working directory)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline suppressions file (default: "
+                        f"<root>/{BASELINE_FILE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write all current findings to the baseline "
+                        "file and exit 0")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids or family prefixes "
+                        "(e.g. KCT-LOCK,KCT-MAN-004)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog with rationale")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # `kct-lint | head` closing the pipe early is not an error
+        return 0
+
+
+def _main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.title}")
+            print(f"    {rule.rationale}")
+        return 0
+
+    root = pathlib.Path(args.root).resolve() if args.root else find_root()
+    if not (root / "kubernetes_cloud_tpu").is_dir():
+        print(f"kct-lint: no kubernetes_cloud_tpu package under {root}",
+              file=sys.stderr)
+        return 3
+
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    if args.write_baseline and select:
+        # a family-scoped run only sees its own findings; writing that
+        # subset would silently delete every other family's committed
+        # suppressions
+        print("kct-lint: --write-baseline cannot be combined with "
+              "--select (it would truncate the baseline to the "
+              "selected family)", file=sys.stderr)
+        return 3
+    findings = run(root, select=select)
+
+    baseline_path = pathlib.Path(args.baseline) if args.baseline \
+        else root / BASELINE_FILE
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"wrote {len(findings)} suppression(s) to {baseline_path}")
+        return 0
+
+    try:
+        entries = [] if args.no_baseline else load_baseline(baseline_path)
+    except (ValueError, OSError) as e:
+        # a corrupt baseline is an internal error (3), NOT "new
+        # findings" (1) — CI keys behavior off the exit-code contract
+        print(f"kct-lint: unreadable baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 3
+    if select:
+        # a --select run only sees selected findings, so only selected
+        # baseline entries can meaningfully be stale
+        entries = [e for e in entries
+                   if any(e["rule"] == s or e["rule"].startswith(s)
+                          for s in select)]
+    new, stale = apply_baseline(findings, entries)
+
+    if args.format == "json":
+        print(json.dumps({
+            "root": str(root),
+            "findings": [f.to_dict() for f in new],
+            "baselined": len(findings) - len(new),
+            "stale_suppressions": stale,
+            "summary": {"new": len(new), "stale": len(stale),
+                        "total": len(findings)},
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        for e in stale:
+            print(f"stale suppression: {e['rule']} {e['path']}: "
+                  f"{e['message']} (no longer fires — delete the "
+                  "baseline entry)")
+        baselined = len(findings) - len(new)
+        print(f"kct-lint: {len(new)} new finding(s), {baselined} "
+              f"baselined, {len(stale)} stale suppression(s)")
+
+    if new:
+        return 1
+    if stale:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

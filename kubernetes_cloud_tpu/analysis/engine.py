@@ -1,0 +1,340 @@
+"""Rule engine: repo model, findings, inline suppressions, baseline.
+
+Checkers are plain functions ``check(repo) -> Iterator[Finding]`` over a
+shared :class:`Repo` (parsed-once ASTs for every package module, raw
+text, and the ``deploy/**/*.yaml`` paths).  The engine owns everything
+rule-independent: collecting sources, dropping findings suppressed
+inline (``# kct-lint: ignore[RULE-ID]``), diffing against the committed
+baseline, and stable ordering.  Rules never read files themselves — one
+parse per file per run keeps the whole-repo pass well under a second.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from collections import Counter
+from typing import Callable, Iterable, Iterator, Optional, Sequence
+
+#: inline-suppression marker; applies to its own line and the next
+#: (so a comment-only marker line can precede the offending statement)
+SUPPRESS_RE = re.compile(
+    r"kct-lint:\s*ignore(?:\[([A-Za-z0-9_\-, ]+)\])?")
+
+#: suppress-everything sentinel for a bare ``kct-lint: ignore``
+ALL_RULES = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One checkable invariant: id, short title, and the rationale the
+    docs/--list-rules surface."""
+
+    id: str
+    title: str
+    rationale: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str        # repo-relative posix path
+    line: int
+    message: str
+    severity: str = "error"
+
+    @property
+    def fingerprint(self) -> str:
+        """Baseline identity: rule + path + message, line excluded so
+        unrelated edits moving code don't invalidate suppressions."""
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class PyModule:
+    """One parsed package module: AST + raw lines + suppression map."""
+
+    def __init__(self, root: pathlib.Path, rel: str):
+        self.rel = rel
+        self.text = (root / rel).read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=rel)
+        self.suppressions = scan_suppressions(self.lines)
+        self._defs: Optional[dict[str, ast.FunctionDef]] = None
+        self._import_sources: Optional[dict[str, str]] = None
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def defs_by_name(self) -> dict[str, ast.FunctionDef]:
+        """Every function def in the module (any nesting), by name;
+        later defs win — an approximation matching this repo's idioms."""
+        if self._defs is None:
+            self._defs = {n.name: n for n in ast.walk(self.tree)
+                          if isinstance(n, ast.FunctionDef)}
+        return self._defs
+
+    def import_sources(self) -> dict[str, str]:
+        if self._import_sources is None:
+            self._import_sources = import_sources(self.tree)
+        return self._import_sources
+
+    def imported_from(self, from_module: str) -> set[str]:
+        return {name for name, src in self.import_sources().items()
+                if src == from_module}
+
+
+def scan_suppressions(lines: Sequence[str]) -> dict[int, set[str]]:
+    """Map line number -> suppressed rule ids (or {ALL_RULES}).
+
+    A comment-only marker line suppresses the statement below it; a
+    trailing marker (code before the ``#``) suppresses its own line
+    ONLY — it must not silently mask an adjacent violation on the next
+    line."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(lines, 1):
+        m = SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        ids = ({ALL_RULES} if m.group(1) is None else
+               {r.strip() for r in m.group(1).split(",") if r.strip()})
+        comment_only = line.strip().startswith("#")
+        targets = (i, i + 1) if comment_only else (i,)
+        for target in targets:
+            out.setdefault(target, set()).update(ids)
+    return out
+
+
+class Repo:
+    """Lazily-built, parse-once view of the repository under analysis."""
+
+    PACKAGE = "kubernetes_cloud_tpu"
+    DEPLOY = "deploy"
+
+    def __init__(self, root: str | pathlib.Path):
+        self.root = pathlib.Path(root).resolve()
+        self._modules: Optional[dict[str, PyModule]] = None
+        self._parse_failures: list[Finding] = []
+        self._texts: dict[str, Optional[str]] = {}
+
+    # -- python ------------------------------------------------------------
+
+    def py_modules(self) -> dict[str, PyModule]:
+        if self._modules is None:
+            self._modules = {}
+            pkg = self.root / self.PACKAGE
+            for path in sorted(pkg.rglob("*.py")):
+                rel = path.relative_to(self.root).as_posix()
+                if "__pycache__" in rel:
+                    continue
+                try:
+                    self._modules[rel] = PyModule(self.root, rel)
+                except SyntaxError as e:
+                    self._parse_failures.append(Finding(
+                        "KCT-AST-001", rel, e.lineno or 1,
+                        f"file does not parse: {e.msg}"))
+        return self._modules
+
+    def module(self, rel: str) -> Optional[PyModule]:
+        return self.py_modules().get(rel)
+
+    def parse_failures(self) -> list[Finding]:
+        self.py_modules()
+        return list(self._parse_failures)
+
+    # -- non-python --------------------------------------------------------
+
+    def yaml_paths(self) -> list[str]:
+        deploy = self.root / self.DEPLOY
+        if not deploy.is_dir():
+            return []
+        return sorted(p.relative_to(self.root).as_posix()
+                      for p in deploy.rglob("*.yaml"))
+
+    def text(self, rel: str) -> Optional[str]:
+        if rel not in self._texts:
+            path = self.root / rel
+            self._texts[rel] = (path.read_text() if path.is_file()
+                                else None)
+        return self._texts[rel]
+
+    def suppressions_for(self, rel: str) -> dict[int, set[str]]:
+        mod = self.py_modules().get(rel)
+        if mod is not None:
+            return mod.suppressions
+        text = self.text(rel)
+        if text is None:
+            return {}
+        return scan_suppressions(text.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the rule modules
+# ---------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Best-effort dotted name of an expression (``self._lock`` ->
+    ``"self._lock"``); None for anything non-name-shaped."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return None
+
+
+def walk_stopping_at_functions(nodes: Iterable[ast.AST]
+                               ) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class
+    definitions (their bodies execute later, outside the current
+    context — e.g. outside the lock being held right now)."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def imported_names(tree: ast.Module, from_module: str) -> set[str]:
+    """Local names bound by ``from <from_module> import ...``."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == from_module:
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
+    return names
+
+
+def import_sources(tree: ast.Module) -> dict[str, str]:
+    """Map of local name -> defining module for ``from X import name``
+    (package-internal resolution for cross-module rules)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                out[alias.asname or alias.name] = node.module
+    return out
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def all_rules() -> list[Rule]:
+    from kubernetes_cloud_tpu.analysis.rules import ALL_RULE_DEFS
+
+    return list(ALL_RULE_DEFS)
+
+
+def run(root: str | pathlib.Path,
+        select: Optional[Sequence[str]] = None) -> list[Finding]:
+    """Run checkers over ``root``; returns inline-suppression-filtered
+    findings in (path, line, rule) order.  ``select`` filters by rule
+    id or id prefix (``KCT-LOCK`` selects the family) — only the
+    selected families' checkers run, so ``--select KCT-MAN`` doesn't
+    pay for a whole-package AST rule pass."""
+    from kubernetes_cloud_tpu.analysis.rules import CHECKS_BY_FAMILY
+
+    def family_selected(family: str) -> bool:
+        if not select:
+            return True
+        return any(s.startswith(family) or family.startswith(s)
+                   for s in select)
+
+    repo = Repo(root)
+    findings: list[Finding] = []
+    for family, check in CHECKS_BY_FAMILY.items():
+        if family_selected(family):
+            findings.extend(check(repo))
+    findings.extend(repo.parse_failures())  # KCT-AST: always reported
+    if select:
+        findings = [f for f in findings
+                    if f.rule.startswith("KCT-AST")
+                    or any(f.rule == s or f.rule.startswith(s)
+                           for s in select)]
+    kept = []
+    for f in findings:
+        sup = repo.suppressions_for(f.path).get(f.line, ())
+        if ALL_RULES in sup or f.rule in sup:
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# baseline: committed debt that must only ever shrink
+# ---------------------------------------------------------------------------
+
+BASELINE_FILE = "analysis-baseline.json"
+
+
+def load_baseline(path: str | pathlib.Path) -> list[dict]:
+    p = pathlib.Path(path)
+    if not p.is_file():
+        return []
+    data = json.loads(p.read_text())
+    entries = data.get("suppressions", [])
+    for e in entries:
+        if not {"rule", "path", "message"} <= set(e):
+            raise ValueError(
+                f"baseline entry needs rule/path/message: {e}")
+    return entries
+
+
+def write_baseline(path: str | pathlib.Path,
+                   findings: Sequence[Finding]) -> None:
+    entries = [{"rule": f.rule, "path": f.path, "message": f.message}
+               for f in findings]
+    pathlib.Path(path).write_text(json.dumps(
+        {"version": 1,
+         "comment": ("Pre-existing kct-lint debt. Entries match on "
+                     "rule+path+message (line-independent). Fix the "
+                     "finding, then delete its entry — stale entries "
+                     "fail the run with exit code 2."),
+         "suppressions": entries}, indent=2) + "\n")
+
+
+def apply_baseline(findings: Sequence[Finding], entries: Sequence[dict]
+                   ) -> tuple[list[Finding], list[dict]]:
+    """Split findings into (new, stale-suppressions).  Matching is a
+    multiset diff on fingerprints: N baseline entries absorb at most N
+    identical findings; leftovers on either side surface."""
+    budget = Counter(f"{e['rule']}|{e['path']}|{e['message']}"
+                     for e in entries)
+    new: list[Finding] = []
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+        else:
+            new.append(f)
+    stale = []
+    for e in entries:
+        key = f"{e['rule']}|{e['path']}|{e['message']}"
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            stale.append(dict(e))
+    return new, stale
+
+
+Check = Callable[[Repo], Iterator[Finding]]

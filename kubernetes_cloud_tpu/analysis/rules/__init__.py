@@ -1,0 +1,31 @@
+"""Rule modules, one per invariant family.
+
+Each module exports ``RULES`` (the :class:`~kubernetes_cloud_tpu.
+analysis.engine.Rule` definitions) and ``check(repo)`` yielding
+findings.  Registration is this explicit list — no decorator magic, so
+``--list-rules`` and the docs catalog are trivially derivable and a
+rule can't exist without a rationale.
+"""
+
+from kubernetes_cloud_tpu.analysis.rules import (
+    drift,
+    locks,
+    manifests,
+    purity,
+    taxonomy,
+)
+
+_MODULES = (locks, purity, drift, taxonomy, manifests)
+
+ALL_RULE_DEFS = [r for mod in _MODULES for r in mod.RULES]
+ALL_CHECKS = [mod.check for mod in _MODULES]
+
+#: family-prefix -> checker, so a --select run only executes the
+#: selected families (a manifest-only run skips the package AST rules)
+CHECKS_BY_FAMILY = {
+    "KCT-LOCK": locks.check,
+    "KCT-JIT": purity.check,
+    "KCT-REG": drift.check,
+    "KCT-ERR": taxonomy.check,
+    "KCT-MAN": manifests.check,
+}

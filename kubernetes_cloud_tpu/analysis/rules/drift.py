@@ -1,0 +1,324 @@
+"""KCT-REG — registry drift: sites, metric families, spans, label hygiene.
+
+Three vocabularies are load-bearing for operations and must never drift
+from their declared registries or from the operator docs:
+
+* **fault sites** — every ``faults.fire("<site>")`` literal must exist
+  in :data:`kubernetes_cloud_tpu.faults.SITES` and be documented in the
+  ``deploy/README.md`` chaos-drill catalog, and every registered site
+  must actually be fired somewhere (a dead site is a chaos drill that
+  silently tests nothing).
+* **metric families** — every ``obs.counter/gauge/histogram("name", …)``
+  registration must exist in :data:`kubernetes_cloud_tpu.obs.catalog.
+  METRIC_FAMILIES` and in the README metric catalog (the PR-4 failure
+  mode: an instrumented-but-undocumented family no dashboard ever
+  graphs), and every cataloged family must be registered somewhere.
+* **trace spans** — literal span names passed to ``trace()`` must be in
+  :data:`kubernetes_cloud_tpu.obs.tracing.SPANS`.
+
+Label hygiene: metric label VALUES must be bounded — an f-string /
+``%`` / ``.format()`` label value manufactures unbounded time series
+(one child per distinct string) and eventually OOMs the registry and
+the Prometheus server scraping it.
+
+Everything is read from the AST — the registries are parsed, not
+imported, so this check runs without jax on any box.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from kubernetes_cloud_tpu.analysis.engine import (
+    Finding,
+    Repo,
+    Rule,
+    const_str,
+    dotted,
+    walk_stopping_at_functions,
+)
+
+RULES = [
+    Rule("KCT-REG-001", "fired fault sites must be registered",
+         "a faults.fire() site missing from faults.SITES is invisible "
+         "to operators choosing chaos drills and to KCT_FAULTS "
+         "validation."),
+    Rule("KCT-REG-002", "registered fault sites must be fired",
+         "a SITES entry nothing fires is a chaos drill that silently "
+         "tests nothing."),
+    Rule("KCT-REG-003", "fault sites must be string literals",
+         "a computed site name defeats static registry checking and "
+         "grows the hit-counter map without bound."),
+    Rule("KCT-REG-004", "fault sites must be documented",
+         "deploy/README.md's chaos-drill catalog is the operator "
+         "surface; an undocumented site can't be drilled."),
+    Rule("KCT-REG-005", "registered metric families must be cataloged",
+         "a family missing from obs.catalog.METRIC_FAMILIES is the "
+         "instrumented-but-undocumented drift the telemetry PR hit."),
+    Rule("KCT-REG-006", "cataloged metric families must be documented",
+         "deploy/README.md's metric catalog is what dashboards and "
+         "alerts are built from."),
+    Rule("KCT-REG-007", "cataloged metric families must be registered",
+         "a catalog entry nothing registers documents a metric that "
+         "doesn't exist."),
+    Rule("KCT-REG-008", "metric names must be string literals",
+         "computed family names defeat the catalog check and risk "
+         "unbounded registry growth."),
+    Rule("KCT-REG-009", "metric label values must be bounded literals",
+         "an f-string/%%/.format() label value mints one time series "
+         "per distinct string — unbounded cardinality OOMs the "
+         "registry and Prometheus."),
+    Rule("KCT-REG-010", "trace spans must come from the declared "
+         "vocabulary",
+         "readers join on the span vocabulary in obs.tracing.SPANS; "
+         "an off-vocabulary literal breaks every consumer silently."),
+]
+
+FAULTS_MODULE = "kubernetes_cloud_tpu/faults.py"
+CATALOG_MODULE = "kubernetes_cloud_tpu/obs/catalog.py"
+TRACING_MODULE = "kubernetes_cloud_tpu/obs/tracing.py"
+README = "deploy/README.md"
+
+#: modules whose internal fire()/registration plumbing is the
+#: implementation, not a use site
+_EXCLUDE = (FAULTS_MODULE, CATALOG_MODULE,
+            "kubernetes_cloud_tpu/obs/metrics.py")
+
+_REG_FUNCS = ("counter", "gauge", "histogram")
+
+
+def _dict_literal_keys(mod, var: str) -> Optional[dict[str, int]]:
+    """String keys (with line numbers) of a module-level ``VAR = {…}``."""
+    if mod is None:
+        return None
+    for node in mod.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == var
+                   for t in targets):
+            continue
+        value = node.value
+        if isinstance(value, ast.Dict):
+            out = {}
+            for k in value.keys:
+                s = const_str(k)
+                if s is not None:
+                    out[s] = k.lineno
+            return out
+    return None
+
+
+def _tuple_literal_values(mod, var: str) -> Optional[set[str]]:
+    if mod is None:
+        return None
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == var
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+                return {e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+    return None
+
+
+def _is_unbounded_value(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.JoinedStr):
+        return "f-string"
+    if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                 (ast.Mod, ast.Add)):
+        return "string concatenation/%-format"
+    if isinstance(node, ast.Call):
+        name = dotted(node.func)
+        if name is not None and name.endswith(".format"):
+            return ".format() call"
+    return None
+
+
+def _label_findings(rel: str, tree: ast.Module) -> Iterator[Finding]:
+    """KCT-REG-009 over every scope.  The repo's dominant pattern is
+    ``m = {"model": self.name}`` … ``.labels(**m)``, so the ``**``
+    form must be checked too: a dict literal inline or bound to a
+    same-scope name has its VALUES checked like direct keywords."""
+    scopes: list[list[ast.stmt]] = [tree.body]
+    scopes.extend(n.body for n in ast.walk(tree)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)))
+    for body in scopes:
+        dict_literals: dict[str, ast.Dict] = {}
+        for stmt in body:
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Dict)):
+                dict_literals[stmt.targets[0].id] = stmt.value
+            # stop at nested defs: each function body is its own scope
+            # entry, so walking into it here would double-report
+            for node in walk_stopping_at_functions([stmt]):
+                if not (isinstance(node, ast.Call)
+                        and (dotted(node.func) or "").endswith(
+                            ".labels")):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        why = _is_unbounded_value(kw.value)
+                        if why is not None:
+                            yield Finding(
+                                "KCT-REG-009", rel, node.lineno,
+                                f'label "{kw.arg}" built from {why} — '
+                                "unbounded label cardinality")
+                        continue
+                    # **kwargs form: resolve an inline or same-scope
+                    # dict literal and check its values
+                    d = kw.value
+                    if isinstance(d, ast.Name):
+                        d = dict_literals.get(d.id)
+                    if not isinstance(d, ast.Dict):
+                        continue
+                    for key, value in zip(d.keys, d.values):
+                        why = _is_unbounded_value(value)
+                        if why is not None:
+                            label = const_str(key) or "<computed>"
+                            yield Finding(
+                                "KCT-REG-009", rel, node.lineno,
+                                f'label "{label}" (via **kwargs) '
+                                f"built from {why} — unbounded label "
+                                "cardinality")
+
+
+def check(repo: Repo) -> Iterator[Finding]:
+    readme = repo.text(README) or ""
+
+    # ---- fault sites ------------------------------------------------------
+    sites = _dict_literal_keys(repo.module(FAULTS_MODULE), "SITES")
+    if sites is None:
+        yield Finding("KCT-REG-001", FAULTS_MODULE, 1,
+                      "no SITES registry (module-level dict literal) "
+                      "found in the faults module")
+        sites = {}
+    fired: dict[str, tuple[str, int]] = {}
+    for rel, mod in repo.py_modules().items():
+        if rel in _EXCLUDE or rel.startswith(
+                "kubernetes_cloud_tpu/analysis/"):
+            continue
+        fire_local = mod.imported_from("kubernetes_cloud_tpu.faults")
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            is_fire = (name == "faults.fire"
+                       or name.endswith(".faults.fire")
+                       or (name == "fire" and "fire" in fire_local))
+            if not is_fire:
+                continue
+            if not node.args:
+                continue
+            site = const_str(node.args[0])
+            if site is None:
+                yield Finding(
+                    "KCT-REG-003", rel, node.lineno,
+                    "fault site must be a string literal, not a "
+                    "computed expression")
+                continue
+            fired.setdefault(site, (rel, node.lineno))
+            if site not in sites:
+                yield Finding(
+                    "KCT-REG-001", rel, node.lineno,
+                    f'fault site "{site}" is not declared in '
+                    "faults.SITES")
+    for site, lineno in sites.items():
+        if site not in fired:
+            yield Finding(
+                "KCT-REG-002", FAULTS_MODULE, lineno,
+                f'registered fault site "{site}" is never fired')
+        if f"`{site}`" not in readme:
+            yield Finding(
+                "KCT-REG-004", FAULTS_MODULE, lineno,
+                f'fault site "{site}" is missing from the '
+                f"{README} chaos-drill catalog")
+
+    # ---- metric families --------------------------------------------------
+    catalog = _dict_literal_keys(repo.module(CATALOG_MODULE),
+                                 "METRIC_FAMILIES")
+    if catalog is None:
+        yield Finding("KCT-REG-005", CATALOG_MODULE, 1,
+                      "no METRIC_FAMILIES registry (module-level dict "
+                      "literal) found in obs/catalog.py")
+        catalog = {}
+    registered: dict[str, tuple[str, int]] = {}
+    for rel, mod in repo.py_modules().items():
+        if rel in _EXCLUDE or rel.startswith(
+                "kubernetes_cloud_tpu/analysis/"):
+            continue
+        reg_local = (mod.imported_from("kubernetes_cloud_tpu.obs")
+                     | mod.imported_from("kubernetes_cloud_tpu.obs.metrics"))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            terminal = name.rsplit(".", 1)[-1]
+            if terminal not in _REG_FUNCS:
+                continue
+            is_reg = (name.startswith(("obs.", "metrics."))
+                      or name.endswith((".obs." + terminal,
+                                        ".metrics." + terminal))
+                      or (name == terminal and terminal in reg_local))
+            if not is_reg or not node.args:
+                continue
+            family = const_str(node.args[0])
+            if family is None:
+                yield Finding(
+                    "KCT-REG-008", rel, node.lineno,
+                    "metric family name must be a string literal")
+                continue
+            registered.setdefault(family, (rel, node.lineno))
+            if family not in catalog:
+                yield Finding(
+                    "KCT-REG-005", rel, node.lineno,
+                    f'metric family "{family}" is not declared in '
+                    "obs.catalog.METRIC_FAMILIES")
+    for family, lineno in catalog.items():
+        if family not in registered:
+            yield Finding(
+                "KCT-REG-007", CATALOG_MODULE, lineno,
+                f'cataloged metric family "{family}" is never '
+                "registered")
+        if f"`{family}`" not in readme:
+            yield Finding(
+                "KCT-REG-006", CATALOG_MODULE, lineno,
+                f'metric family "{family}" is missing from the '
+                f"{README} metric catalog")
+
+    # ---- label hygiene + trace spans -------------------------------------
+    spans = _tuple_literal_values(repo.module(TRACING_MODULE), "SPANS")
+    for rel, mod in repo.py_modules().items():
+        if rel.startswith("kubernetes_cloud_tpu/analysis/"):
+            continue
+        yield from _label_findings(rel, mod.tree)
+        trace_local = (mod.imported_from("kubernetes_cloud_tpu.obs.tracing")
+                       | mod.imported_from("kubernetes_cloud_tpu.obs"))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            is_trace = (name == "tracing.trace"
+                        or name.endswith(".tracing.trace")
+                        or (name == "trace" and "trace" in trace_local))
+            if (is_trace and spans is not None and rel != TRACING_MODULE
+                    and len(node.args) >= 2):
+                span = const_str(node.args[1])
+                if span is not None and span not in spans:
+                    yield Finding(
+                        "KCT-REG-010", rel, node.lineno,
+                        f'trace span "{span}" is not in the declared '
+                        "tracing.SPANS vocabulary")

@@ -1,0 +1,133 @@
+"""KCT-LOCK — lock discipline: nothing slow happens while holding a lock.
+
+The engine scheduler, batcher dispatcher, supervisor watchdog, and every
+HTTP thread contend on a handful of locks (``_qlock``, the supervisor
+``_lock``, the metrics family locks).  One blocking call inside a
+``with <lock>:`` body — a sleep, an unbounded ``queue.get``, file or
+network I/O, a ``join`` — stalls every other thread that needs the lock,
+and a *fault point* under a lock is worse: an armed ``hang`` spec parks
+the holder for ``delay_s`` and freezes the whole data plane, turning a
+one-site chaos drill into a process-wide outage the drill never meant
+to model.
+
+A lock whose only job is serializing the blocking operation itself
+(e.g. a dedicated file-writer lock) is legitimate — annotate it with
+``# kct-lint: ignore[KCT-LOCK-001] - reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from kubernetes_cloud_tpu.analysis.engine import (
+    Finding,
+    Repo,
+    Rule,
+    dotted,
+    walk_stopping_at_functions,
+)
+
+RULES = [
+    Rule("KCT-LOCK-001", "no blocking work under a lock",
+         "A sleep / unbounded get / join / I-O call inside a `with "
+         "<lock>:` body stalls every thread contending on that lock "
+         "(HTTP workers, the scheduler, the watchdog)."),
+    Rule("KCT-LOCK-002", "no fault points under a lock",
+         "faults.fire() inside a lock body lets an armed hang-mode "
+         "spec park the lock holder, freezing the whole data plane "
+         "instead of the one site the chaos drill targets."),
+]
+
+#: with-item names that denote a lock (``self._qlock``, ``lock``, …)
+_LOCKY = ("lock", "mutex")
+
+#: fully/suffix-dotted calls that block
+_BLOCKING_DOTTED = ("time.sleep", "os.system", "socket.create_connection",
+                    "urllib.request.urlopen")
+_BLOCKING_PREFIXES = ("subprocess.", "requests.", "http.client.")
+#: zero-positional-arg methods that block forever without a timeout
+_UNBOUNDED_METHODS = ("get", "wait", "acquire", "join")
+#: raw-I/O methods (file/socket) — slow and fsync-unbounded
+_IO_METHODS = ("write", "flush", "read", "readline", "recv", "sendall")
+
+
+def _lock_name(with_node: ast.With) -> Optional[str]:
+    for item in with_node.items:
+        name = dotted(item.context_expr)
+        if name is None:
+            continue
+        terminal = name.rsplit(".", 1)[-1].lower()
+        if any(tag in terminal for tag in _LOCKY):
+            return name
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    name = dotted(call.func)
+    if name is None:
+        return None
+    if name == "open":
+        return "file I/O (open)"
+    if name == "sleep" or any(name == d or name.endswith("." + d)
+                              for d in _BLOCKING_DOTTED):
+        return f"blocking call {name}()"
+    if any(name.startswith(p) for p in _BLOCKING_PREFIXES):
+        return f"blocking I/O call {name}()"
+    terminal = name.rsplit(".", 1)[-1]
+    if "." in name and terminal in _UNBOUNDED_METHODS:
+        # str.join / dict.get take positional args; the unbounded
+        # thread/queue/event forms are the zero-positional-arg calls
+        # with no timeout= bound
+        if not call.args and not _has_timeout(call):
+            return f"unbounded blocking call {name}() (no timeout)"
+        return None
+    if "." in name and terminal in _IO_METHODS and call.args:
+        return f"I/O call {name}(...)"
+    return None
+
+
+def _is_fault_fire(call: ast.Call, fire_aliases: set[str]) -> bool:
+    name = dotted(call.func)
+    if name is None:
+        return False
+    return (name == "faults.fire" or name.endswith(".faults.fire")
+            or name in fire_aliases)
+
+
+def check(repo: Repo) -> Iterator[Finding]:
+    for rel, mod in repo.py_modules().items():
+        fire_aliases = {n for n in mod.imported_from(
+            "kubernetes_cloud_tpu.faults") if n == "fire"}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            lock = _lock_name(node)
+            if lock is None:
+                continue
+            for inner in walk_stopping_at_functions(node.body):
+                if isinstance(inner, ast.With):
+                    nested = _lock_name(inner)
+                    if nested is not None and nested != lock:
+                        yield Finding(
+                            "KCT-LOCK-001", rel, inner.lineno,
+                            f"acquires `{nested}` while holding "
+                            f"`{lock}` (lock-ordering deadlock risk)")
+                if not isinstance(inner, ast.Call):
+                    continue
+                if _is_fault_fire(inner, fire_aliases):
+                    yield Finding(
+                        "KCT-LOCK-002", rel, inner.lineno,
+                        f"fault point fired while holding `{lock}`: an "
+                        "armed hang would freeze every thread needing "
+                        "the lock")
+                    continue
+                reason = _blocking_reason(inner)
+                if reason is not None:
+                    yield Finding(
+                        "KCT-LOCK-001", rel, inner.lineno,
+                        f"{reason} while holding `{lock}`")

@@ -1,0 +1,187 @@
+"""KCT-MAN — declarative rules over the ``deploy/**/*.yaml`` surface.
+
+The manifest library is the L5/L6 public interface; these rules are the
+generalized form of the assertions ``tests/test_deploy_manifests.py``
+used to hardcode, so a new InferenceService (or a new directory of
+them) is checked the day it lands instead of when someone remembers to
+extend the test:
+
+* every file parses and every document carries kind/apiVersion;
+* no GPU-era scheduling leftovers (``nvidia.com/gpu``, ``rdma/ib``);
+* a ``google.com/tpu`` limit must pair BOTH ``gke-tpu-accelerator``
+  and ``gke-tpu-topology`` nodeSelectors — TPU slices schedule by
+  topology, an accelerator selector alone lands on the wrong slice
+  shape;
+* InferenceServices must wire the probe-and-drain contract
+  (liveness ``/healthz``, readiness ``/readyz``,
+  ``terminationGracePeriodSeconds`` ≥ 60 — serve/server.py semantics);
+* online-inference InferenceServices must opt into Prometheus scraping
+  (``prometheus.io/scrape|port|path``) — the metrics plane is dead
+  weight if the cluster Prometheus never pulls it;
+* every predictor container must declare cpu+memory requests — a
+  request-less serving pod is the first evicted under node pressure.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from kubernetes_cloud_tpu.analysis.engine import Finding, Repo, Rule
+
+RULES = [
+    Rule("KCT-MAN-001", "manifests must parse with kind/apiVersion",
+         "an unloadable or kind-less document is invisible to kubectl "
+         "apply -f and to every other rule here."),
+    Rule("KCT-MAN-002", "no GPU-era scheduling leftovers",
+         "nvidia.com/gpu / rdma-ib requests are unschedulable on a "
+         "TPU fleet and mark an incomplete port."),
+    Rule("KCT-MAN-003", "TPU limits pair accelerator+topology selectors",
+         "TPU slices are scheduled by (accelerator, topology); a "
+         "google.com/tpu limit without both nodeSelectors lands on "
+         "the wrong slice shape or never schedules."),
+    Rule("KCT-MAN-004", "InferenceServices wire probes and drain budget",
+         "liveness /healthz + readiness /readyz + "
+         "terminationGracePeriodSeconds >= 60 is the supervisor's "
+         "probe-and-drain contract (serve/server.py)."),
+    Rule("KCT-MAN-005", "online-inference services opt into scraping",
+         "without prometheus.io/scrape|port|path annotations the "
+         "cluster Prometheus never pulls GET /metrics."),
+    Rule("KCT-MAN-006", "predictor containers declare resource requests",
+         "a request-less serving container is BestEffort QoS — first "
+         "evicted under node pressure, mid-decode."),
+]
+
+_DRAIN_FLOOR = 60
+
+
+def _stripped(text: str) -> str:
+    return "\n".join(line for line in text.splitlines()
+                     if not line.lstrip().startswith("#"))
+
+
+def _line_of(text: str, needle: str) -> int:
+    for i, line in enumerate(text.splitlines(), 1):
+        if needle in line:
+            return i
+    return 1
+
+
+def _docs(text: str):
+    import yaml
+
+    return [d for d in yaml.safe_load_all(text) if d is not None]
+
+
+def _doc_line(text: str, doc: dict) -> int:
+    name = ((doc.get("metadata") or {}).get("name")
+            if isinstance(doc.get("metadata"), dict) else None)
+    if name:
+        return _line_of(text, str(name))
+    return 1
+
+
+def _isvc_findings(rel: str, text: str, doc: dict) -> Iterator[Finding]:
+    line = _doc_line(text, doc)
+    ident = (doc.get("metadata") or {}).get("name", "<unnamed>")
+    pred = (doc.get("spec") or {}).get("predictor")
+    if not isinstance(pred, dict):
+        yield Finding("KCT-MAN-004", rel, line,
+                      f"InferenceService {ident}: no spec.predictor")
+        return
+    grace = pred.get("terminationGracePeriodSeconds", 0) or 0
+    if grace < _DRAIN_FLOOR:
+        yield Finding(
+            "KCT-MAN-004", rel, line,
+            f"InferenceService {ident}: terminationGracePeriodSeconds "
+            f"{grace} < {_DRAIN_FLOOR} (SIGTERM drain budget)")
+    containers = pred.get("containers") or []
+    if not containers:
+        yield Finding("KCT-MAN-004", rel, line,
+                      f"InferenceService {ident}: no predictor "
+                      "containers")
+        return
+    ctr = containers[0]
+    live = ((ctr.get("livenessProbe") or {}).get("httpGet")
+            or {}).get("path")
+    ready = ((ctr.get("readinessProbe") or {}).get("httpGet")
+             or {}).get("path")
+    if live != "/healthz":
+        yield Finding(
+            "KCT-MAN-004", rel, line,
+            f"InferenceService {ident}: livenessProbe must target "
+            f"/healthz (process liveness), got {live!r}")
+    if ready != "/readyz":
+        yield Finding(
+            "KCT-MAN-004", rel, line,
+            f"InferenceService {ident}: readinessProbe must target "
+            f"/readyz (honest serving state), got {ready!r}")
+    for c in containers:
+        requests = ((c.get("resources") or {}).get("requests")) or {}
+        missing = [k for k in ("cpu", "memory") if k not in requests]
+        if missing:
+            yield Finding(
+                "KCT-MAN-006", rel, line,
+                f"InferenceService {ident} container "
+                f"{c.get('name', '<unnamed>')}: no resource requests "
+                f"for {'/'.join(missing)} (BestEffort QoS)")
+
+
+def _scrape_findings(rel: str, text: str, doc: dict) -> Iterator[Finding]:
+    line = _doc_line(text, doc)
+    ident = (doc.get("metadata") or {}).get("name", "<unnamed>")
+    ann = ((doc.get("metadata") or {}).get("annotations")) or {}
+    expected = {"prometheus.io/scrape": "true",
+                "prometheus.io/port": "8080",
+                "prometheus.io/path": "/metrics"}
+    for key, want in expected.items():
+        if ann.get(key) != want:
+            yield Finding(
+                "KCT-MAN-005", rel, line,
+                f'InferenceService {ident}: annotation {key} must be '
+                f'"{want}", got {ann.get(key)!r}')
+
+
+def check(repo: Repo) -> Iterator[Finding]:
+    import yaml
+
+    for rel in repo.yaml_paths():
+        text = repo.text(rel) or ""
+        try:
+            docs = _docs(text)
+        except yaml.YAMLError as e:
+            mark = getattr(e, "problem_mark", None)
+            yield Finding("KCT-MAN-001", rel,
+                          (mark.line + 1) if mark else 1,
+                          f"YAML does not parse: {e}")
+            continue
+        if not docs:
+            yield Finding("KCT-MAN-001", rel, 1, "no YAML documents")
+            continue
+        body = _stripped(text)
+        for forbidden in ("nvidia.com/gpu", "rdma/ib"):
+            if forbidden in body:
+                yield Finding(
+                    "KCT-MAN-002", rel, _line_of(text, forbidden),
+                    f"GPU-era scheduling leftover: {forbidden}")
+        if "google.com/tpu" in body:
+            for selector in ("gke-tpu-accelerator", "gke-tpu-topology"):
+                if selector not in body:
+                    yield Finding(
+                        "KCT-MAN-003", rel,
+                        _line_of(text, "google.com/tpu"),
+                        f"google.com/tpu limit without a {selector} "
+                        "nodeSelector")
+        for doc in docs:
+            if not isinstance(doc, dict):
+                yield Finding("KCT-MAN-001", rel, 1,
+                              "non-mapping YAML document")
+                continue
+            if "kind" not in doc or "apiVersion" not in doc:
+                yield Finding(
+                    "KCT-MAN-001", rel, _doc_line(text, doc),
+                    "document missing kind/apiVersion")
+                continue
+            if doc.get("kind") == "InferenceService":
+                yield from _isvc_findings(rel, text, doc)
+                if rel.startswith("deploy/online-inference/"):
+                    yield from _scrape_findings(rel, text, doc)

@@ -1,0 +1,361 @@
+"""KCT-JIT — trace purity and donation discipline in device programs.
+
+Functions staged by ``jax.jit`` / ``pjit`` / ``shard_map`` / Pallas run
+ONCE at trace time; host-side effects inside them (wall clocks, numpy
+RNG, ``print``) either bake a single stale value into the compiled
+program or silently do nothing per step — the classic "my timestamps
+never change" / "my noise is identical every batch" bug class.  Host
+materialization (``.item()``, ``float(arg)`` on a traced argument)
+raises ``TracerArrayConversionError`` at trace time on device but can
+hide for months behind CPU test paths that never stage the function.
+
+Donation (``donate_argnums``) invalidates the caller's buffer the
+moment the call is issued; reading the donated array afterwards
+returns deleted-buffer garbage (or an error on TPU).  An out-of-range
+donate/static argnum is a latent TypeError that only fires when the
+call site finally executes.
+
+Jit targets are resolved statically: decorator forms (``@jax.jit``,
+``@partial(jax.jit, …)``), call forms over local or package-imported
+function names, ``shard_map``/``pallas_call`` first arguments
+(including through ``functools.partial``), and inline lambdas.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional, Union
+
+from kubernetes_cloud_tpu.analysis.engine import (
+    Finding,
+    Repo,
+    Rule,
+    dotted,
+)
+
+RULES = [
+    Rule("KCT-JIT-001", "no host side effects inside jitted code",
+         "time.*/np.random.*/print/stdlib-random inside a staged "
+         "function runs once at trace time: the compiled program "
+         "replays a constant instead of the effect."),
+    Rule("KCT-JIT-002", "no host materialization of traced values",
+         ".item()/float()/int()/np.asarray() on a traced argument "
+         "forces a host sync and raises TracerArrayConversionError "
+         "under jit on device."),
+    Rule("KCT-JIT-003", "no reuse of donated arguments",
+         "donate_argnums invalidates the caller's buffer at the call; "
+         "reading the donated array afterwards is use-after-free."),
+    Rule("KCT-JIT-004", "donate/static argnums must be in range",
+         "an argnum past the wrapped function's positional parameters "
+         "is a latent TypeError that only fires at the call site."),
+]
+
+_JIT_CALLS = ("jax.jit", "jit", "pjit", "jax.pjit")
+_WRAP_CALLS = ("shard_map", "pallas_call")
+_PARTIAL = ("functools.partial", "partial")
+
+#: host-effect call names (dotted suffix match)
+_EFFECT_DOTTED = ("time.time", "time.monotonic", "time.perf_counter",
+                  "time.sleep", "time.process_time")
+_EFFECT_PREFIXES = ("np.random.", "numpy.random.", "random.")
+_EFFECT_NAMES = ("print", "input", "breakpoint", "open")
+#: host-materialization wrappers applied to traced parameters
+_MATERIALIZE_NAMES = ("float", "int", "bool", "complex")
+_MATERIALIZE_DOTTED = ("np.asarray", "np.array", "numpy.asarray",
+                       "numpy.array")
+
+
+def _is_jit_name(name: Optional[str]) -> bool:
+    return name is not None and (
+        name in _JIT_CALLS
+        or any(name.endswith("." + j) for j in ("jit", "pjit")))
+
+
+def _is_wrap_name(name: Optional[str]) -> bool:
+    return name is not None and (
+        name in _WRAP_CALLS
+        or any(name.endswith("." + w) for w in _WRAP_CALLS))
+
+
+def _int_tuple(node: ast.AST) -> Optional[tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _str_tuple(node: ast.AST) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One staging call: where, what it wraps, and its argnum config."""
+
+    rel: str
+    line: int
+    target: Union[ast.FunctionDef, ast.Lambda, None]
+    target_rel: Optional[str]        # module the target def lives in
+    static_argnums: tuple[int, ...] = ()
+    donate_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+
+
+def _module_rel_for(repo: Repo, module_dotted: str) -> Optional[str]:
+    rel = module_dotted.replace(".", "/") + ".py"
+    if repo.module(rel) is not None:
+        return rel
+    rel = module_dotted.replace(".", "/") + "/__init__.py"
+    if repo.module(rel) is not None:
+        return rel
+    return None
+
+
+def _resolve_target(repo: Repo, rel: str, node: ast.AST
+                    ) -> tuple[Union[ast.FunctionDef, ast.Lambda, None],
+                               Optional[str]]:
+    """Resolve a staging call's function argument to a def (possibly in
+    another package module) or an inline lambda."""
+    if isinstance(node, ast.Lambda):
+        return node, rel
+    if isinstance(node, ast.Call):  # functools.partial(f, ...)
+        name = dotted(node.func)
+        if name in _PARTIAL and node.args:
+            return _resolve_target(repo, rel, node.args[0])
+        return None, None
+    if not isinstance(node, ast.Name):
+        return None, None
+    mod = repo.module(rel)
+    local = mod.defs_by_name().get(node.id)
+    if local is not None:
+        return local, rel
+    src = mod.import_sources().get(node.id)
+    if src and src.startswith(Repo.PACKAGE):
+        target_rel = _module_rel_for(repo, src)
+        if target_rel is not None:
+            target_mod = repo.module(target_rel)
+            return target_mod.defs_by_name().get(node.id), target_rel
+    return None, None
+
+
+def _collect_sites(repo: Repo) -> list[JitSite]:
+    sites: list[JitSite] = []
+    for rel, mod in repo.py_modules().items():
+        for node in ast.walk(mod.tree):
+            # decorator form: @jax.jit / @partial(jax.jit, ...)
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    site = _site_from_decorator(rel, node, dec)
+                    if site is not None:
+                        sites.append(site)
+            # call form: jax.jit(f, ...) / shard_map(f, ...) / pallas
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if not (_is_jit_name(name) or _is_wrap_name(name)):
+                    continue
+                if not node.args:
+                    continue
+                target, target_rel = _resolve_target(repo, rel,
+                                                     node.args[0])
+                site = JitSite(rel, node.lineno, target, target_rel)
+                if _is_jit_name(name):
+                    _read_argnums(node, site)
+                if target is not None or site.donate_argnums \
+                        or site.static_argnums:
+                    sites.append(site)
+    return sites
+
+
+def _read_argnums(call: ast.Call, site: JitSite) -> None:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            site.static_argnums = _int_tuple(kw.value) or ()
+        elif kw.arg == "donate_argnums":
+            site.donate_argnums = _int_tuple(kw.value) or ()
+        elif kw.arg == "static_argnames":
+            site.static_argnames = _str_tuple(kw.value)
+
+
+def _site_from_decorator(rel: str, fn: ast.FunctionDef,
+                         dec: ast.AST) -> Optional[JitSite]:
+    name = dotted(dec)
+    if _is_jit_name(name):
+        return JitSite(rel, fn.lineno, fn, rel)
+    if isinstance(dec, ast.Call):
+        dec_name = dotted(dec.func)
+        if _is_jit_name(dec_name):
+            site = JitSite(rel, fn.lineno, fn, rel)
+            _read_argnums(dec, site)
+            return site
+        if dec_name in _PARTIAL and dec.args \
+                and _is_jit_name(dotted(dec.args[0])):
+            site = JitSite(rel, fn.lineno, fn, rel)
+            _read_argnums(dec, site)
+            return site
+    return None
+
+
+def _positional_params(fn: Union[ast.FunctionDef, ast.Lambda]
+                       ) -> list[str]:
+    args = fn.args
+    return [a.arg for a in (*args.posonlyargs, *args.args)]
+
+
+def _check_body(site: JitSite, fn: Union[ast.FunctionDef, ast.Lambda],
+                rel: str) -> Iterator[Finding]:
+    params = _positional_params(fn)
+    statics = {params[i] for i in site.static_argnums
+               if 0 <= i < len(params)}
+    statics.update(site.static_argnames)
+    traced = [p for p in params if p not in statics and p != "self"]
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name is None:
+            continue
+        if (name in _EFFECT_NAMES
+                or any(name == d or name.endswith("." + d)
+                       for d in _EFFECT_DOTTED)
+                or any(name.startswith(p) for p in _EFFECT_PREFIXES)):
+            yield Finding(
+                "KCT-JIT-001", rel, node.lineno,
+                f"host side effect {name}(...) inside jitted "
+                f"function `{getattr(fn, 'name', '<lambda>')}` "
+                "(runs once at trace time, not per step)")
+            continue
+        if name.endswith(".item") and not node.args:
+            yield Finding(
+                "KCT-JIT-002", rel, node.lineno,
+                f"host sync {name}() inside jitted function "
+                f"`{getattr(fn, 'name', '<lambda>')}`")
+            continue
+        if ((name in _MATERIALIZE_NAMES or name in _MATERIALIZE_DOTTED)
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in traced):
+            yield Finding(
+                "KCT-JIT-002", rel, node.lineno,
+                f"{name}({node.args[0].id}) materializes traced "
+                f"argument `{node.args[0].id}` on the host inside "
+                f"jitted function `{getattr(fn, 'name', '<lambda>')}`")
+
+
+def _check_argnum_ranges(site: JitSite) -> Iterator[Finding]:
+    fn = site.target
+    if fn is None:
+        return
+    n = len(_positional_params(fn))
+    for kind, nums in (("static_argnums", site.static_argnums),
+                       ("donate_argnums", site.donate_argnums)):
+        for i in nums:
+            if i >= n or i < -n:
+                yield Finding(
+                    "KCT-JIT-004", site.rel, site.line,
+                    f"{kind} {i} out of range for "
+                    f"`{getattr(fn, 'name', '<lambda>')}` "
+                    f"({n} positional parameters)")
+
+
+def _check_donated_reuse(repo: Repo) -> Iterator[Finding]:
+    """Straight-line, per-scope scan: a name bound to ``jax.jit(f,
+    donate_argnums=…)`` and called marks its donated positional args;
+    loading a donated name afterwards (before rebinding) is flagged."""
+    for rel, mod in repo.py_modules().items():
+        scopes: list[list[ast.stmt]] = [mod.tree.body]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            yield from _scan_scope(rel, body)
+
+
+def _donating_call(call: ast.Call,
+                   jitvars: dict[str, tuple[int, ...]]
+                   ) -> Optional[list[str]]:
+    """Names donated by this call, if it invokes a donating jit fn."""
+    idxs: Optional[tuple[int, ...]] = None
+    if isinstance(call.func, ast.Name) and call.func.id in jitvars:
+        idxs = jitvars[call.func.id]
+    elif isinstance(call.func, ast.Call):  # jax.jit(f, donate=…)(args)
+        name = dotted(call.func.func)
+        if _is_jit_name(name):
+            probe = JitSite("", 0, None, None)
+            _read_argnums(call.func, probe)
+            idxs = probe.donate_argnums or None
+    if not idxs:
+        return None
+    return [call.args[i].id for i in idxs
+            if 0 <= i < len(call.args)
+            and isinstance(call.args[i], ast.Name)]
+
+
+def _scan_scope(rel: str, body: list[ast.stmt]) -> Iterator[Finding]:
+    jitvars: dict[str, tuple[int, ...]] = {}
+    donated: dict[str, int] = {}  # name -> donation line
+    for stmt in body:
+        # 1. loads of already-donated names anywhere in this statement
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in donated):
+                yield Finding(
+                    "KCT-JIT-003", rel, node.lineno,
+                    f"`{node.id}` used after being donated at line "
+                    f"{donated[node.id]} (donation invalidates the "
+                    "buffer)")
+        # 2. donations made by this statement
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            names = _donating_call(node, jitvars)
+            if names:
+                for n in names:
+                    donated[n] = node.lineno
+        # 3. rebinding clears the donation (`x = jfn(x)` is the
+        #    canonical donate-and-replace: donated then stored = fresh)
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                donated.pop(node.id, None)
+                jitvars.pop(node.id, None)
+        # 4. new jit bindings — AFTER the store-clear so the binding
+        #    assignment doesn't immediately unregister itself
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Call) \
+                and _is_jit_name(dotted(stmt.value.func)):
+            probe = JitSite("", 0, None, None)
+            _read_argnums(stmt.value, probe)
+            if probe.donate_argnums:
+                jitvars[stmt.targets[0].id] = probe.donate_argnums
+    return
+
+
+def check(repo: Repo) -> Iterator[Finding]:
+    seen: set[tuple[str, int, str]] = set()  # dedup multi-site targets
+    for site in _collect_sites(repo):
+        yield from _check_argnum_ranges(site)
+        if site.target is None or site.target_rel is None:
+            continue
+        for f in _check_body(site, site.target, site.target_rel):
+            key = (f.path, f.line, f.rule)
+            if key not in seen:
+                seen.add(key)
+                yield f
+    yield from _check_donated_reuse(repo)

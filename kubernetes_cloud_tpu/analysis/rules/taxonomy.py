@@ -1,0 +1,106 @@
+"""KCT-ERR — typed error taxonomy on the serving/workflow planes.
+
+The HTTP status contract (``serve/errors.py``) only works if failures
+are *typed*: ``ModelServer`` maps exception classes — never messages —
+onto 400/503/504/500, and the supervisor/probe layer keys retry
+behavior off :class:`~kubernetes_cloud_tpu.serve.errors.RetryableError`.
+A bare ``except:`` swallows ``KeyboardInterrupt``/``SystemExit`` and
+turns drains into hangs; ``raise Exception`` / ``raise RuntimeError``
+is untyped — clients get a 500 for conditions that were actually
+retryable, and Knative hammers a pod that asked to be left alone.
+
+Broad ``except Exception`` is sometimes right (watchdogs, telemetry,
+best-effort drains) but must be *annotated deliberate* with the repo's
+``# noqa: BLE001 - reason`` convention so reviewers can tell a
+considered catch-all from a swallowed bug.
+
+Deliberate 500s (programmer-error guards) are annotated inline with
+``# kct-lint: ignore[KCT-ERR-004] - reason`` or carried in the
+committed baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kubernetes_cloud_tpu.analysis.engine import (
+    Finding,
+    Repo,
+    Rule,
+    dotted,
+)
+
+RULES = [
+    Rule("KCT-ERR-001", "no bare except",
+         "`except:` swallows KeyboardInterrupt/SystemExit — SIGTERM "
+         "drains and Ctrl-C turn into hangs."),
+    Rule("KCT-ERR-002", "no raise Exception / except BaseException",
+         "an untyped Exception can't be mapped onto the HTTP status "
+         "ladder; BaseException catches interpreter shutdown."),
+    Rule("KCT-ERR-003", "broad except Exception must be annotated",
+         "a catch-all without the repo's `# noqa: BLE001 - reason` "
+         "annotation is indistinguishable from a swallowed bug."),
+    Rule("KCT-ERR-004", "serving errors must be typed",
+         "`raise RuntimeError` on the serving plane bypasses the "
+         "serve/errors.py ladder: retryable conditions surface as "
+         "500s instead of 503/504."),
+]
+
+#: the data-plane scope the taxonomy applies to
+_SCOPES = ("kubernetes_cloud_tpu/serve/", "kubernetes_cloud_tpu/workflow/")
+
+_UNTYPED = ("Exception", "BaseException")
+
+
+def _in_scope(rel: str) -> bool:
+    return rel.startswith(_SCOPES)
+
+
+def check(repo: Repo) -> Iterator[Finding]:
+    for rel, mod in repo.py_modules().items():
+        if not _in_scope(rel):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler):
+                if node.type is None:
+                    yield Finding(
+                        "KCT-ERR-001", rel, node.lineno,
+                        "bare `except:` (catches KeyboardInterrupt/"
+                        "SystemExit); catch Exception at most — "
+                        "annotated")
+                    continue
+                names = []
+                types = (node.type.elts
+                         if isinstance(node.type, ast.Tuple)
+                         else [node.type])
+                for t in types:
+                    names.append(dotted(t) or "")
+                if "BaseException" in names:
+                    yield Finding(
+                        "KCT-ERR-002", rel, node.lineno,
+                        "`except BaseException` catches interpreter "
+                        "shutdown; catch Exception at most")
+                elif "Exception" in names and \
+                        "BLE001" not in mod.line(node.lineno):
+                    yield Finding(
+                        "KCT-ERR-003", rel, node.lineno,
+                        "broad `except Exception` without a "
+                        "`# noqa: BLE001 - reason` annotation")
+            if isinstance(node, ast.Raise) and node.exc is not None:
+                target = node.exc
+                if isinstance(target, ast.Call):
+                    target = target.func
+                name = dotted(target)
+                if name in _UNTYPED:
+                    yield Finding(
+                        "KCT-ERR-002", rel, node.lineno,
+                        f"`raise {name}` is untyped; raise a class "
+                        "from the serve/errors.py ladder (or a typed "
+                        "local subclass)")
+                elif name == "RuntimeError":
+                    yield Finding(
+                        "KCT-ERR-004", rel, node.lineno,
+                        "`raise RuntimeError` on the serving plane; "
+                        "use the typed ladder in serve/errors.py so "
+                        "the server maps it to the right status")

@@ -64,6 +64,29 @@ from typing import Iterator, Optional, Sequence
 #: asks ``fired`` and suppresses its own side effect (e.g. the token put)
 MODES = ("raise", "hang", "slow", "drop")
 
+#: The declared fault-site registry — the single source of truth the
+#: static analysis (``kct-lint`` KCT-REG-001/002/004) reconciles
+#: against: every ``faults.fire("<site>")`` call in the tree must name
+#: a key here, every key must be fired somewhere, and every key must
+#: appear in the deploy/README.md chaos-drill catalog.  Adding an
+#: injection site == adding its entry here + documenting it.
+SITES = {
+    "model_fn": "engine/batcher device-call path (raise = crashed "
+                "model program)",
+    "decode_step": "before the engine's decode dispatch (hang = "
+                   "wedged device/driver)",
+    "iteration": "once per engine scheduler iteration (slow = "
+                 "straggler/preempted host)",
+    "stream": "per emitted token (drop = token lost on the way to "
+              "the client)",
+    "queue": "admission (drop short-circuits into QueueFullError)",
+    "dispatch": "once per batcher dispatch cycle (any firing kills "
+                "the dispatcher thread, no drain)",
+    "server.handle": "HTTP routing layer (raise becomes a 500)",
+    "metrics.render": "GET /metrics exposition render (failure must "
+                      "stay contained to the scrape)",
+}
+
 
 class FaultError(RuntimeError):
     """An injected failure (the ``raise`` mode's default exception)."""

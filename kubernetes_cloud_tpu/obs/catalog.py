@@ -1,0 +1,82 @@
+"""The declared metric-family registry — one name, one owner, one doc.
+
+Every Prometheus family the repo registers (``obs.counter`` /
+``obs.gauge`` / ``obs.histogram`` call sites in serving, supervision,
+and workflow code) must have an entry here, and every entry must be
+registered somewhere and documented in the ``deploy/README.md`` metric
+catalog.  The static analysis (``kct-lint`` KCT-REG-005/006/007)
+reconciles all three, which kills the telemetry-PR failure mode of an
+instrumented-but-undocumented family no dashboard ever graphs — and the
+reverse: catalog entries that outlive their instrumentation.
+
+This module is data-only (no jax, no registry import) so the AST-based
+checker and jax-free processes can read it for free.  Adding a metric
+family == registering it + adding its entry here + one row in the
+README catalog.
+"""
+
+from __future__ import annotations
+
+#: family name -> one-line meaning (the README table carries the full
+#: type/label detail; this is the machine-checked membership list)
+METRIC_FAMILIES = {
+    # HTTP front-ends (serve/server.py)
+    "kct_server_requests_total":
+        "HTTP requests by bounded route/method/status vocabulary",
+    "kct_server_request_seconds":
+        "HTTP request wall time by route",
+    # continuous-batching engine (serve/continuous.py)
+    "kct_engine_iterations_total":
+        "decode scheduler iterations",
+    "kct_engine_iteration_seconds":
+        "one decode_step_slots dispatch (= per-token latency)",
+    "kct_engine_admitted_total":
+        "requests admitted into slots",
+    "kct_engine_evicted_total":
+        "slots freed (EOS / max-tokens / cancel / failure)",
+    "kct_engine_shed_total":
+        "requests shed without decoding, by reason",
+    "kct_engine_cancelled_total":
+        "client-cancelled requests",
+    "kct_engine_tokens_total":
+        "completion tokens emitted",
+    "kct_engine_ttft_seconds":
+        "submit to first emitted token",
+    "kct_engine_active_slots":
+        "slots currently decoding",
+    "kct_engine_slots":
+        "configured slot-pool width",
+    "kct_engine_queue_depth":
+        "admission queue depth",
+    "kct_engine_kv_utilization":
+        "live fraction of KV-pool token rows",
+    # dynamic batcher (serve/batcher.py)
+    "kct_batcher_batches_total":
+        "batches dispatched to the device",
+    "kct_batcher_requests_total":
+        "requests coalesced into batches",
+    "kct_batcher_batch_size":
+        "instances per dispatched batch",
+    "kct_batcher_dispatch_seconds":
+        "batched device dispatch wall time",
+    "kct_batcher_shed_total":
+        "expired-deadline sheds while queued",
+    "kct_batcher_queue_depth":
+        "pending-request queue depth",
+    # serving supervisor (serve/supervisor.py)
+    "kct_supervisor_restarts_total":
+        "worker restarts by cause (hang | crash)",
+    "kct_supervisor_heartbeat_age_seconds":
+        "watched heartbeat age at the last watchdog pass",
+    "kct_supervisor_circuit_open":
+        "1 while the crash-loop circuit is open",
+    "kct_supervisor_requeued_total":
+        "queued requests transplanted into a replacement engine",
+    # workflow orchestrator (workflow/engine.py)
+    "kct_workflow_step_seconds":
+        "step execution wall time",
+    "kct_workflow_step_retries_total":
+        "step retry attempts",
+    "kct_workflow_transitions_total":
+        "step state transitions by resulting state",
+}

@@ -74,8 +74,15 @@ class RequestTracer:
             self._seq += 1
             rec["seq"] = self._seq
             self.records.append(rec)
-            if self._writer is not None:
-                self._writer.write(rec)
+            writer = self._writer
+        # File I/O stays OUTSIDE the hot lock (kct-lint KCT-LOCK-001):
+        # HTTP threads, the scheduler, and the dispatcher all contend on
+        # it per span, and a slow fsync would stall them all.  The
+        # JsonlWriter serializes whole lines internally; records may
+        # land in the file out of order under contention, but `seq`
+        # (assigned under the lock) is the total order readers sort by.
+        if writer is not None:
+            writer.write(rec)
 
     def spans_for(self, request_id: str) -> list[dict]:
         with self._lock:

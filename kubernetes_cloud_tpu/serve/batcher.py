@@ -34,6 +34,7 @@ from kubernetes_cloud_tpu import faults, obs
 from kubernetes_cloud_tpu.obs.tracing import trace
 from kubernetes_cloud_tpu.serve.errors import (  # noqa: F401 - re-export
     DeadlineExceededError,
+    EngineDrainingError,
     QueueFullError,
     RetryableError,
 )
@@ -171,8 +172,9 @@ class BatchingModel(Model):
         if self._thread is not None and self._thread.is_alive():
             if self._stop.is_set():
                 # a previous stop() timed out mid-batch; two dispatchers
-                # would race the queue and the device
-                raise RuntimeError(
+                # would race the queue and the device.  Typed retryable
+                # (503): the old batch finishes on its own (KCT-ERR-004).
+                raise EngineDrainingError(
                     "previous dispatcher still running; call stop() again")
             self.ready = True  # already loaded and dispatching
             return
@@ -437,6 +439,9 @@ class BatchingModel(Model):
             faults.fire("model_fn")
             results = self._run_inner(instances, batch[0].params)
             if len(results) != len(instances):
+                # deliberate 500: a miscounting inner model is a server
+                # fault, not something a client retry can fix
+                # kct-lint: ignore[KCT-ERR-004] - deliberate 500
                 raise RuntimeError(
                     f"inner model returned {len(results)} predictions "
                     f"for {len(instances)} instances")

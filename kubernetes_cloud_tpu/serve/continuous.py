@@ -57,6 +57,7 @@ from kubernetes_cloud_tpu.models.generate import (
 )
 from kubernetes_cloud_tpu.serve.errors import (
     DeadlineExceededError,
+    EngineDrainingError,
     EngineRestartedError,
     QueueFullError,
     RetryableError,
@@ -393,8 +394,9 @@ class ContinuousBatchingEngine:
         if self.alive:
             if self._stop.is_set():
                 # a previous stop() timed out mid-drain; two schedulers
-                # would race the queue and the pool
-                raise RuntimeError(
+                # would race the queue and the pool.  Typed retryable
+                # (503): the drain finishes on its own (KCT-ERR-004).
+                raise EngineDrainingError(
                     "previous scheduler still draining; call stop() again")
             return
         self._stop.clear()
@@ -867,8 +869,9 @@ class ContinuousBatchingModel(Model):
     def load(self) -> None:
         if self.engine is not None and self.engine.draining:
             # flipping ready=True over a stopped-but-draining engine
-            # would make every predict 500 until someone load()s again
-            raise RuntimeError(
+            # would make every predict 500 until someone load()s again.
+            # Typed retryable (503), not a bare 500 (KCT-ERR-004).
+            raise EngineDrainingError(
                 "previous engine still draining; call stop() again")
         if not self.service.ready:
             self.service.load()
@@ -929,7 +932,7 @@ class ContinuousBatchingModel(Model):
                     top_p=float(opts["TOP_P"]),
                     seed=int(opts["SEED"]) + i,
                     deadline=deadline, request_id=rid))
-        except Exception:
+        except Exception:  # noqa: BLE001 - cleanup only; re-raised as-is
             for r in reqs:  # don't orphan already-queued siblings
                 r.cancel()
             raise

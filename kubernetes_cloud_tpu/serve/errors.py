@@ -40,6 +40,13 @@ class EngineRestartedError(RetryableError):
     the fresh engine."""
 
 
+class EngineDrainingError(RetryableError):
+    """A replacement worker cannot start because the previous engine /
+    dispatcher is still draining (a timed-out ``stop()`` left its
+    thread finishing in-flight work).  Transient by construction —
+    retry once the drain completes (call ``stop()`` again first)."""
+
+
 class StreamTimeoutError(RetryableError):
     """A token stream stalled: no token within the poll window, or the
     engine died mid-stream.  Raised by ``GenRequest.iter_tokens``

@@ -243,7 +243,7 @@ class ModelServer:
             return 504, {"error": str(e)}
         except RetryableError as e:  # transient overload/restart: retry
             return 503, {"error": str(e)}
-        except Exception as e:  # surface as a 500, keep serving
+        except Exception as e:  # noqa: BLE001 - surface as 500, keep serving
             log.exception("%s failed", what)
             return 500, {"error": str(e)}
 

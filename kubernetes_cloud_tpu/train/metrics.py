@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Mapping, Optional, Sequence
 
@@ -23,10 +24,16 @@ def _is_rank0() -> bool:
 
 
 class JsonlWriter:
-    """Append-only JSONL sink — the one serialization used by both the
-    training metrics stream (below) and the workflow step-event log
-    (:mod:`kubernetes_cloud_tpu.workflow.events`), so one reader tooling
-    chain consumes either."""
+    """Append-only JSONL sink — the one serialization used by the
+    training metrics stream (below), the workflow step-event log
+    (:mod:`kubernetes_cloud_tpu.workflow.events`), and the request
+    tracer (:mod:`kubernetes_cloud_tpu.obs.tracing`), so one reader
+    tooling chain consumes all three.
+
+    Thread-safe: concurrent emitters (HTTP threads, the scheduler,
+    workflow pool workers) get whole-line atomicity from the internal
+    write lock, so callers never hold their own hot-path locks across
+    the file I/O (kct-lint KCT-LOCK-001)."""
 
     def __init__(self, path: str):
         self.path = path
@@ -34,9 +41,13 @@ class JsonlWriter:
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._fh = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
 
     def write(self, record: Mapping[str, Any]) -> None:
-        self._fh.write(json.dumps(record) + "\n")
+        line = json.dumps(record) + "\n"  # serialize outside the lock
+        with self._lock:
+            # kct-lint: ignore[KCT-LOCK-001] - dedicated I/O lock
+            self._fh.write(line)  # serializing this write is its only job
 
     def close(self) -> None:
         self._fh.close()

@@ -13,7 +13,6 @@ delay), ``step_skipped`` (sentinel-complete resume or ``when`` false).
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Optional
 
@@ -25,11 +24,13 @@ EVENT_LOG = "events.jsonl"
 class WorkflowEventLog:
     """Append-only event emitter; safe to leave open across a SIGKILL
     (line-buffered writes, torn tails tolerated by :func:`read_events`)
-    and across threads (concurrent steps emit from the pool's workers)."""
+    and across threads — concurrent steps emit from the pool's workers;
+    whole-line atomicity comes from the writer's internal lock, so this
+    layer holds no lock of its own across the file I/O (kct-lint
+    KCT-LOCK-001)."""
 
     def __init__(self, path: str):
         self._writer = JsonlWriter(path)
-        self._lock = threading.Lock()
         self.path = path
 
     def emit(self, event: str, step: Optional[str] = None,
@@ -38,8 +39,7 @@ class WorkflowEventLog:
         if step is not None:
             rec["step"] = step
         rec.update(fields)
-        with self._lock:
-            self._writer.write(rec)
+        self._writer.write(rec)
 
     def close(self) -> None:
         self._writer.close()

@@ -1,0 +1,26 @@
+#!/usr/bin/env python3
+"""CI/dev wrapper around the kct-lint engine.
+
+Exactly the same entry point as the ``kct-lint`` console script and
+``python -m kubernetes_cloud_tpu.analysis`` — one engine, one exit-code
+contract (0 clean, 1 new findings, 2 stale baseline suppressions), so
+CI and humans can never disagree about what was checked.
+
+Usage (repo root is auto-detected from this file's location)::
+
+    python scripts/lint.py                # text report vs the baseline
+    python scripts/lint.py --format json  # machine-readable
+    python scripts/lint.py --list-rules   # rule catalog
+"""
+
+import pathlib
+import sys
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from kubernetes_cloud_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["--root", str(_REPO_ROOT), *sys.argv[1:]]))

@@ -1,0 +1,627 @@
+"""kct-lint: rule self-tests on fixture snippets + whole-repo gate.
+
+Every rule family gets a pair of fixtures — one that must fire, one
+(the fixed form) that must stay quiet — so a rule can never silently
+stop detecting its violation.  The whole-repo test is the actual gate:
+the tree must be clean modulo the committed baseline, with no stale
+suppressions.  All AST-based; the analysis package itself must import
+without jax (verified by subprocess) so the gate runs on jax-free CI.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from kubernetes_cloud_tpu.analysis import (
+    apply_baseline,
+    load_baseline,
+    run,
+)
+from kubernetes_cloud_tpu.analysis.cli import main as lint_main
+from kubernetes_cloud_tpu.analysis.engine import BASELINE_FILE
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = [pytest.mark.lint]
+
+
+# ---------------------------------------------------------------------------
+# fixture scaffolding: a minimal repo that passes every rule
+# ---------------------------------------------------------------------------
+
+_ENG_OK = '''\
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.obs.tracing import trace
+
+_M = obs.counter("kct_x_total", "x", ("model",))
+
+
+def admit(rid):
+    faults.fire("model_fn")
+    trace(rid, "queued", model="m")
+'''
+
+_BASE = {
+    "kubernetes_cloud_tpu/__init__.py": "",
+    "kubernetes_cloud_tpu/obs/__init__.py": "",
+    "kubernetes_cloud_tpu/faults.py":
+        'SITES = {"model_fn": "device call"}\n\n\n'
+        'def fire(site):\n    return None\n',
+    "kubernetes_cloud_tpu/obs/catalog.py":
+        'METRIC_FAMILIES = {"kct_x_total": "x"}\n',
+    "kubernetes_cloud_tpu/obs/tracing.py":
+        'SPANS = ("queued", "complete")\n\n\n'
+        'def trace(request_id, span, **fields):\n    pass\n',
+    "kubernetes_cloud_tpu/serve/__init__.py": "",
+    "kubernetes_cloud_tpu/serve/eng.py": _ENG_OK,
+    "deploy/README.md": "sites: `model_fn`\nmetrics: `kct_x_total`\n",
+}
+
+
+def make_repo(tmp_path, extra=None, replace=None):
+    files = dict(_BASE)
+    files.update(replace or {})
+    files.update(extra or {})
+    for rel, content in files.items():
+        if content is None:
+            continue
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(content)
+    return tmp_path
+
+
+def rules_fired(root, select=None):
+    return sorted({f.rule for f in run(root, select=select)})
+
+
+def test_scaffold_is_clean(tmp_path):
+    assert run(make_repo(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# KCT-LOCK — lock discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED_SLEEP = '''\
+import threading
+import time
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        with self._lock:
+            time.sleep(1.0)
+'''
+
+
+def test_lock_blocking_call_fires(tmp_path):
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/locked.py": _LOCKED_SLEEP})
+    assert rules_fired(root, ["KCT-LOCK"]) == ["KCT-LOCK-001"]
+
+
+def test_lock_fixed_form_quiet(tmp_path):
+    fixed = _LOCKED_SLEEP.replace(
+        "        with self._lock:\n            time.sleep(1.0)\n",
+        "        with self._lock:\n            x = 1\n"
+        "        time.sleep(1.0)\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/locked.py": fixed})
+    assert rules_fired(root, ["KCT-LOCK"]) == []
+
+
+@pytest.mark.parametrize("call,fires", [
+    ("self._q.get()", True),            # unbounded queue get
+    ("self._q.get(timeout=0.5)", False),  # bounded
+    ("self._q.get_nowait()", False),
+    ("self._t.join()", True),           # unbounded thread join
+    ("self._t.join(timeout=1.0)", False),
+    ('", ".join(parts)', False),        # str.join is not a thread join
+    ("self._fh.write(data)", True),     # file I/O under lock
+    ("open('/tmp/x')", True),
+])
+def test_lock_blocking_matrix(tmp_path, call, fires):
+    src = ("import threading\n\n\nclass A:\n"
+           "    def f(self, parts, data):\n"
+           "        with self._lock:\n"
+           f"            {call}\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/locked.py": src})
+    got = rules_fired(root, ["KCT-LOCK"])
+    assert got == (["KCT-LOCK-001"] if fires else []), call
+
+
+def test_lock_fault_point_fires(tmp_path):
+    src = ("from kubernetes_cloud_tpu import faults\n\n\nclass A:\n"
+           "    def f(self):\n"
+           "        with self._qlock:\n"
+           '            faults.fire("model_fn")\n')
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/locked.py": src})
+    assert rules_fired(root, ["KCT-LOCK"]) == ["KCT-LOCK-002"]
+
+
+def test_lock_inline_suppression(tmp_path):
+    src = _LOCKED_SLEEP.replace(
+        "            time.sleep(1.0)",
+        "            # kct-lint: ignore[KCT-LOCK-001] - test\n"
+        "            time.sleep(1.0)")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/locked.py": src})
+    assert rules_fired(root, ["KCT-LOCK"]) == []
+
+
+# ---------------------------------------------------------------------------
+# KCT-JIT — trace purity + donation
+# ---------------------------------------------------------------------------
+
+def _jit_repo(tmp_path, body, header=""):
+    src = (f"import jax\nimport numpy as np\nimport time\n{header}\n\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           f"{body}"
+           "    return x\n")
+    return make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/models.py": src})
+
+
+@pytest.mark.parametrize("body,rule", [
+    ("    print(x)\n", "KCT-JIT-001"),
+    ("    t = time.monotonic()\n", "KCT-JIT-001"),
+    ("    r = np.random.default_rng(0)\n", "KCT-JIT-001"),
+    ("    v = x.item()\n", "KCT-JIT-002"),
+    ("    v = float(x)\n", "KCT-JIT-002"),
+    ("    v = np.asarray(x)\n", "KCT-JIT-002"),
+])
+def test_jit_purity_fires(tmp_path, body, rule):
+    assert rules_fired(_jit_repo(tmp_path, body), ["KCT-JIT"]) == [rule]
+
+
+def test_jit_clean_body_quiet(tmp_path):
+    root = _jit_repo(tmp_path, "    x = x * 2 + 1\n")
+    assert rules_fired(root, ["KCT-JIT"]) == []
+
+
+def test_jit_host_effect_outside_jit_quiet(tmp_path):
+    src = ("import time\n\n\n"
+           "def host_loop():\n"
+           "    return time.monotonic()\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/models.py": src})
+    assert rules_fired(root, ["KCT-JIT"]) == []
+
+
+def test_jit_call_form_resolves_local_def(tmp_path):
+    src = ("import jax\n\n\n"
+           "def step(x):\n"
+           "    print(x)\n"
+           "    return x\n\n\n"
+           "jitted = jax.jit(step)\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/models.py": src})
+    assert rules_fired(root, ["KCT-JIT"]) == ["KCT-JIT-001"]
+
+
+def test_jit_donated_reuse_fires(tmp_path):
+    src = ("import jax\n\n\n"
+           "def step(x):\n"
+           "    return x\n\n\n"
+           "def runner(x):\n"
+           "    j = jax.jit(step, donate_argnums=0)\n"
+           "    y = j(x)\n"
+           "    return x + y\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/models.py": src})
+    assert rules_fired(root, ["KCT-JIT"]) == ["KCT-JIT-003"]
+
+
+def test_jit_donated_rebind_quiet(tmp_path):
+    src = ("import jax\n\n\n"
+           "def step(x):\n"
+           "    return x\n\n\n"
+           "def runner(x):\n"
+           "    j = jax.jit(step, donate_argnums=0)\n"
+           "    x = j(x)\n"            # canonical donate-and-replace
+           "    return x\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/models.py": src})
+    assert rules_fired(root, ["KCT-JIT"]) == []
+
+
+def test_jit_argnum_out_of_range_fires(tmp_path):
+    src = ("import jax\n\n\n"
+           "def step(x, y):\n"
+           "    return x + y\n\n\n"
+           "jitted = jax.jit(step, donate_argnums=5)\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/models.py": src})
+    assert rules_fired(root, ["KCT-JIT"]) == ["KCT-JIT-004"]
+
+
+def test_jit_static_params_not_traced(tmp_path):
+    # float(cfg) on a static arg is host math by design — quiet
+    src = ("import jax\n\n\n"
+           "def step(cfg, x):\n"
+           "    s = float(cfg)\n"
+           "    return x * s\n\n\n"
+           "jitted = jax.jit(step, static_argnums=0)\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/models.py": src})
+    assert rules_fired(root, ["KCT-JIT"]) == []
+
+
+# ---------------------------------------------------------------------------
+# KCT-REG — registry drift
+# ---------------------------------------------------------------------------
+
+def test_drift_unregistered_site_fires(tmp_path):
+    bad = _ENG_OK.replace('faults.fire("model_fn")',
+                          'faults.fire("model_fn")\n'
+                          '    faults.fire("mystery_site")')
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/serve/eng.py": bad})
+    assert "KCT-REG-001" in rules_fired(root, ["KCT-REG"])
+
+
+def test_drift_unfired_site_fires(tmp_path):
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/faults.py":
+            'SITES = {"model_fn": "x", "ghost_site": "never fired"}\n'
+            '\n\ndef fire(site):\n    return None\n'})
+    assert "KCT-REG-002" in rules_fired(root, ["KCT-REG"])
+
+
+def test_drift_non_literal_site_fires(tmp_path):
+    bad = _ENG_OK.replace('faults.fire("model_fn")',
+                          'faults.fire("model_fn")\n'
+                          '    faults.fire("site_" + rid)')
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/serve/eng.py": bad})
+    assert "KCT-REG-003" in rules_fired(root, ["KCT-REG"])
+
+
+def test_drift_undocumented_site_fires(tmp_path):
+    root = make_repo(tmp_path, replace={
+        "deploy/README.md": "metrics: `kct_x_total`\n"})  # no model_fn
+    assert "KCT-REG-004" in rules_fired(root, ["KCT-REG"])
+
+
+def test_drift_uncataloged_metric_fires(tmp_path):
+    bad = _ENG_OK + '\n_M2 = obs.gauge("kct_rogue_depth", "y")\n'
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/serve/eng.py": bad})
+    assert "KCT-REG-005" in rules_fired(root, ["KCT-REG"])
+
+
+def test_drift_undocumented_metric_fires(tmp_path):
+    root = make_repo(tmp_path, replace={
+        "deploy/README.md": "sites: `model_fn`\n"})  # no kct_x_total
+    assert "KCT-REG-006" in rules_fired(root, ["KCT-REG"])
+
+
+def test_drift_unregistered_catalog_entry_fires(tmp_path):
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/obs/catalog.py":
+            'METRIC_FAMILIES = {"kct_x_total": "x", '
+            '"kct_phantom_total": "never registered"}\n'})
+    assert "KCT-REG-007" in rules_fired(root, ["KCT-REG"])
+
+
+def test_drift_fstring_label_fires(tmp_path):
+    bad = _ENG_OK + ('\n\ndef record(name):\n'
+                     '    _M.labels(model=f"m-{name}").inc()\n')
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/serve/eng.py": bad})
+    assert "KCT-REG-009" in rules_fired(root, ["KCT-REG"])
+
+
+def test_drift_fstring_label_via_kwargs_dict_fires(tmp_path):
+    # the repo's dominant pattern is `.labels(**m)` over a dict literal
+    # bound in the same scope — the rule must see through it
+    bad = _ENG_OK + ('\n\ndef bind(name):\n'
+                     '    m = {"model": f"m-{name}"}\n'
+                     '    _M.labels(**m).inc()\n')
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/serve/eng.py": bad})
+    assert "KCT-REG-009" in rules_fired(root, ["KCT-REG"])
+
+
+def test_drift_bounded_kwargs_dict_quiet(tmp_path):
+    ok = _ENG_OK + ('\n\ndef bind(self):\n'
+                    '    m = {"model": self.name}\n'
+                    '    _M.labels(**m).inc()\n')
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/serve/eng.py": ok})
+    assert rules_fired(root, ["KCT-REG"]) == []
+
+
+def test_drift_bounded_label_quiet(tmp_path):
+    ok = _ENG_OK + ('\n\ndef record(reason):\n'
+                    '    _M.labels(model=reason).inc()\n')
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/serve/eng.py": ok})
+    assert rules_fired(root, ["KCT-REG"]) == []
+
+
+def test_drift_off_vocabulary_span_fires(tmp_path):
+    bad = _ENG_OK.replace('trace(rid, "queued", model="m")',
+                          'trace(rid, "teleported", model="m")')
+    root = make_repo(tmp_path, replace={
+        "kubernetes_cloud_tpu/serve/eng.py": bad})
+    assert "KCT-REG-010" in rules_fired(root, ["KCT-REG"])
+
+
+# ---------------------------------------------------------------------------
+# KCT-ERR — error taxonomy
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("body,rule", [
+    ("try:\n    x()\nexcept:\n    pass\n", "KCT-ERR-001"),
+    ("raise Exception('boom')\n", "KCT-ERR-002"),
+    ("try:\n    x()\nexcept BaseException:\n    pass\n", "KCT-ERR-002"),
+    ("try:\n    x()\nexcept Exception:\n    pass\n", "KCT-ERR-003"),
+    ("raise RuntimeError('untyped')\n", "KCT-ERR-004"),
+])
+def test_taxonomy_fires(tmp_path, body, rule):
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/bad.py": body})
+    assert rules_fired(root, ["KCT-ERR"]) == [rule]
+
+
+def test_taxonomy_annotated_broad_except_quiet(tmp_path):
+    src = ("try:\n    x()\n"
+           "except Exception:  # noqa: BLE001 - best-effort teardown\n"
+           "    pass\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/ok.py": src})
+    assert rules_fired(root, ["KCT-ERR"]) == []
+
+
+def test_taxonomy_typed_raise_quiet(tmp_path):
+    src = ("from kubernetes_cloud_tpu.serve.errors import RetryableError"
+           "\n\n\ndef f():\n    raise RetryableError('queue full')\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/ok.py": src})
+    assert rules_fired(root, ["KCT-ERR"]) == []
+
+
+def test_taxonomy_out_of_scope_quiet(tmp_path):
+    # the taxonomy applies to serve/ and workflow/, not data/
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/data/bad.py":
+            "raise RuntimeError('elsewhere')\n"})
+    assert rules_fired(root, ["KCT-ERR"]) == []
+
+
+# ---------------------------------------------------------------------------
+# KCT-MAN — manifest rules
+# ---------------------------------------------------------------------------
+
+_GOOD_ISVC = """\
+apiVersion: serving.kserve.io/v1beta1
+kind: InferenceService
+metadata:
+  name: demo
+  annotations:
+    prometheus.io/scrape: "true"
+    prometheus.io/port: "8080"
+    prometheus.io/path: "/metrics"
+spec:
+  predictor:
+    terminationGracePeriodSeconds: 60
+    containers:
+      - name: kserve-container
+        image: x
+        livenessProbe:
+          httpGet: {path: /healthz, port: 8080}
+        readinessProbe:
+          httpGet: {path: /readyz, port: 8080}
+        resources:
+          requests: {cpu: "1", memory: 1Gi}
+          limits: {google.com/tpu: 1}
+    nodeSelector:
+      cloud.google.com/gke-tpu-accelerator: tpu-v5-lite-podslice
+      cloud.google.com/gke-tpu-topology: 2x2
+"""
+
+
+def test_manifest_good_isvc_quiet(tmp_path):
+    root = make_repo(tmp_path, extra={
+        "deploy/online-inference/demo/isvc.yaml": _GOOD_ISVC})
+    assert rules_fired(root, ["KCT-MAN"]) == []
+
+
+@pytest.mark.parametrize("mutate,rule", [
+    (lambda t: t.replace("kind: InferenceService\n", ""), "KCT-MAN-001"),
+    (lambda t: t.replace("google.com/tpu", "nvidia.com/gpu"),
+     "KCT-MAN-002"),
+    (lambda t: t.replace(
+        "      cloud.google.com/gke-tpu-topology: 2x2\n", ""),
+     "KCT-MAN-003"),
+    (lambda t: t.replace("terminationGracePeriodSeconds: 60",
+                         "terminationGracePeriodSeconds: 5"),
+     "KCT-MAN-004"),
+    (lambda t: t.replace("path: /readyz", "path: /healthz"),
+     "KCT-MAN-004"),
+    (lambda t: t.replace('    prometheus.io/scrape: "true"\n', ""),
+     "KCT-MAN-005"),
+    (lambda t: t.replace("          requests: {cpu: \"1\", memory: 1Gi}\n",
+                         ""), "KCT-MAN-006"),
+])
+def test_manifest_violations_fire(tmp_path, mutate, rule):
+    root = make_repo(tmp_path, extra={
+        "deploy/online-inference/demo/isvc.yaml": mutate(_GOOD_ISVC)})
+    assert rule in rules_fired(root, ["KCT-MAN"])
+
+
+def test_manifest_unparseable_yaml_fires(tmp_path):
+    root = make_repo(tmp_path, extra={
+        "deploy/broken.yaml": "kind: [unclosed\n"})
+    assert rules_fired(root, ["KCT-MAN"]) == ["KCT-MAN-001"]
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics: absorb, then go stale with a distinct exit code
+# ---------------------------------------------------------------------------
+
+def test_baseline_absorbs_then_goes_stale(tmp_path, capsys):
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n",
+        "kubernetes_cloud_tpu/serve/locked.py": _LOCKED_SLEEP})
+
+    # 1. violation present, no baseline: exit 1
+    assert lint_main(["--root", str(root)]) == 1
+    capsys.readouterr()
+
+    # 2. write the baseline: the same run is now clean (exit 0)
+    assert lint_main(["--root", str(root), "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert lint_main(["--root", str(root)]) == 0
+    capsys.readouterr()
+
+    # 3. fix the violation: the suppression is stale -> distinct exit
+    #    code 2, and the stale entry is listed
+    (root / "kubernetes_cloud_tpu/serve/locked.py").write_text(
+        _LOCKED_SLEEP.replace("time.sleep(1.0)", "x = 1"))
+    assert lint_main(["--root", str(root)]) == 2
+    out = capsys.readouterr().out
+    assert "stale suppression" in out
+    assert "KCT-LOCK-001" in out
+
+    # 4. deleting the entry restores a clean run
+    (root / BASELINE_FILE).write_text(
+        json.dumps({"version": 1, "suppressions": []}))
+    assert lint_main(["--root", str(root)]) == 0
+    capsys.readouterr()
+
+
+def test_trailing_suppression_does_not_mask_next_line(tmp_path):
+    # an end-of-line marker covers its own line ONLY; a second
+    # violation on the next line must still be reported
+    src = ("import threading\nimport time\n\n\nclass A:\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            time.sleep(1.0)  "
+           "# kct-lint: ignore[KCT-LOCK-001] - x\n"
+           "            time.sleep(2.0)\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/locked.py": src})
+    findings = run(root, select=["KCT-LOCK"])
+    assert len(findings) == 1 and findings[0].line == 9
+
+
+def test_write_baseline_refuses_select(tmp_path, capsys):
+    # --select sees a findings subset; writing it would truncate the
+    # other families' committed suppressions
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n"})
+    rc = lint_main(["--root", str(root), "--select", "KCT-MAN",
+                    "--write-baseline"])
+    assert rc == 3
+    assert not (root / BASELINE_FILE).exists()
+
+
+def test_corrupt_baseline_is_internal_error_not_findings(tmp_path,
+                                                         capsys):
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n"})
+    (root / BASELINE_FILE).write_text("<<<< merge conflict junk")
+    assert lint_main(["--root", str(root)]) == 3
+    assert "unreadable baseline" in capsys.readouterr().err
+
+
+def test_select_ignores_other_families_baseline(tmp_path, capsys):
+    # a KCT-MAN-scoped run must not report the committed KCT-ERR
+    # baseline entries as stale (observed on the real repo)
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n",
+        "kubernetes_cloud_tpu/serve/bad.py": "raise Exception('x')\n"})
+    assert lint_main(["--root", str(root), "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert lint_main(["--root", str(root), "--select", "KCT-MAN"]) == 0
+    capsys.readouterr()
+
+
+def test_baseline_is_a_multiset(tmp_path):
+    # two identical findings, one baseline entry: one stays new
+    src = _LOCKED_SLEEP.replace(
+        "            time.sleep(1.0)\n",
+        "            time.sleep(1.0)\n            time.sleep(1.0)\n")
+    root = make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/locked.py": src})
+    findings = run(root, select=["KCT-LOCK"])
+    assert len(findings) == 2
+    entry = {"rule": findings[0].rule, "path": findings[0].path,
+             "message": findings[0].message}
+    new, stale = apply_baseline(findings, [entry])
+    assert len(new) == 1 and not stale
+
+
+def test_json_format_and_exit_codes(tmp_path, capsys):
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n",
+        "kubernetes_cloud_tpu/serve/bad.py": "raise Exception('x')\n"})
+    rc = lint_main(["--root", str(root), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["summary"]["new"] == 1
+    f = out["findings"][0]
+    assert f["rule"] == "KCT-ERR-002"
+    assert f["path"] == "kubernetes_cloud_tpu/serve/bad.py"
+    assert f["line"] == 1
+
+
+def test_list_rules_covers_all_families(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for family in ("KCT-LOCK", "KCT-JIT", "KCT-REG", "KCT-ERR",
+                   "KCT-MAN"):
+        assert family in out, f"{family} missing from --list-rules"
+
+
+# ---------------------------------------------------------------------------
+# the actual gate: whole repo, committed baseline, no jax
+# ---------------------------------------------------------------------------
+
+def test_whole_repo_clean_modulo_baseline():
+    findings = run(REPO_ROOT)
+    entries = load_baseline(REPO_ROOT / BASELINE_FILE)
+    new, stale = apply_baseline(findings, entries)
+    assert not new, "new findings:\n" + "\n".join(
+        f.format() for f in new)
+    assert not stale, "stale baseline suppressions (delete them):\n" + \
+        "\n".join(f"{e['rule']} {e['path']}: {e['message']}"
+                  for e in stale)
+
+
+def test_module_entry_point_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubernetes_cloud_tpu.analysis",
+         "--format", "json", "--root", str(REPO_ROOT)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["new"] == 0
+    assert payload["summary"]["stale"] == 0
+
+
+def test_analysis_package_never_imports_jax():
+    # the AST rules must run on jax-free boxes (and fast): importing
+    # the package or running the engine must not pull jax in
+    code = ("import sys\n"
+            "from kubernetes_cloud_tpu.analysis import run\n"
+            f"run({str(REPO_ROOT)!r}, select=['KCT-ERR'])\n"
+            "assert 'jax' not in sys.modules, 'analysis imported jax'\n"
+            "print('ok')\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True,
+                          cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.strip() == "ok"

@@ -1,19 +1,26 @@
 """deploy/ manifest library sanity.
 
-The YAML surface is the L5/L6 public interface (SURVEY.md §1); these tests
-keep it loadable and structurally consistent: every file parses, every TPU
-workload pairs a google.com/tpu limit with gke-tpu nodeSelectors, and the
-flagship workflow keeps the reference's 1:1 parameter surface
-(finetuner-workflow/finetune-workflow.yaml:8-199).
+The YAML surface is the L5/L6 public interface (SURVEY.md §1).  The
+structural per-document assertions this file used to hardcode — GPU
+leftovers, TPU accelerator+topology selector pairing, InferenceService
+probe/drain wiring, Prometheus scrape annotations, resource requests —
+are now declarative rules in ``kubernetes_cloud_tpu/analysis`` (the
+KCT-MAN family), run here through the same engine ``kct-lint`` uses, so
+a new manifest is checked the day it lands.  What stays hardcoded below
+is the repo-specific topology: the flagship workflow's 1:1 parameter
+surface, step DAG, event-binding references, JobSet symmetry, and the
+``.ready.txt`` sentinel protocol.
 """
 
 import pathlib
-import re
 
 import pytest
 import yaml
 
-DEPLOY = pathlib.Path(__file__).resolve().parent.parent / "deploy"
+from kubernetes_cloud_tpu.analysis import run as lint_run
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+DEPLOY = ROOT / "deploy"
 YAMLS = sorted(DEPLOY.rglob("*.yaml"))
 
 
@@ -27,31 +34,35 @@ def test_manifests_exist():
     assert len(YAMLS) >= 15
 
 
-@pytest.mark.parametrize("path", YAMLS, ids=lambda p: str(p.relative_to(DEPLOY)))
-def test_manifest_parses(path):
-    docs = _docs(path)
-    assert docs, f"{path} has no documents"
-    for doc in docs:
-        assert "kind" in doc and "apiVersion" in doc
+# ---------------------------------------------------------------------------
+# generalized structural rules: one engine run, asserted clean
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_manifest_rules_clean():
+    """deploy/**/*.yaml passes every declarative KCT-MAN rule (parse +
+    kind/apiVersion, no GPU leftovers, TPU selector pairing, probe &
+    drain contract, scrape annotations, resource requests)."""
+    findings = lint_run(ROOT, select=["KCT-MAN"])
+    assert not findings, "\n".join(f.format() for f in findings)
 
 
-def test_no_gpu_resources_anywhere():
-    """TPU-native means no nvidia.com/gpu or CUDA scheduling leftovers."""
-    for path in YAMLS:
-        text = "\n".join(
-            line for line in path.read_text().splitlines()
-            if not line.lstrip().startswith("#"))
-        assert "nvidia.com/gpu" not in text, path
-        assert "rdma/ib" not in text, path
+@pytest.mark.lint
+def test_manifest_rules_cover_the_serving_catalog():
+    """The probe/scrape rules are only meaningful if they actually see
+    the catalog: count the online-inference InferenceServices the
+    engine walked (≥ 8 — the whole serving catalog)."""
+    seen = 0
+    for path in (DEPLOY / "online-inference").rglob("*.yaml"):
+        for doc in _docs(path):
+            if doc.get("kind") == "InferenceService":
+                seen += 1
+    assert seen >= 8
 
 
-def test_tpu_workloads_pair_limits_with_selectors():
-    for path in YAMLS:
-        text = path.read_text()
-        if "google.com/tpu" in text:
-            assert "gke-tpu-accelerator" in text, (
-                f"{path}: TPU limit without accelerator nodeSelector")
-
+# ---------------------------------------------------------------------------
+# repo-specific topology (not generalizable into rules)
+# ---------------------------------------------------------------------------
 
 def test_finetune_workflow_parameter_surface():
     wf = _docs(DEPLOY / "finetuner-workflow" / "finetune-workflow.yaml")[0]
@@ -117,50 +128,6 @@ def test_jobsets_are_symmetric():
             assert len(jobs) == 1, f"{path}: expected symmetric single job"
             spec = jobs[0]["template"]["spec"]
             assert spec["parallelism"] == spec["completions"]
-
-
-def test_inference_services_wire_probes_and_drain():
-    """The KServe/Knative probe-and-drain contract (serve/server.py):
-    every online-inference InferenceService probes liveness at /healthz
-    (process alive, unconditional) and readiness at /readyz (the honest
-    serving state), and budgets terminationGracePeriodSeconds for the
-    SIGTERM drain."""
-    for path in (DEPLOY / "online-inference").rglob("*.yaml"):
-        for doc in _docs(path):
-            if doc.get("kind") != "InferenceService":
-                continue
-            pred = doc["spec"]["predictor"]
-            assert pred.get("terminationGracePeriodSeconds", 0) >= 60, (
-                f"{path}: no drain budget")
-            ctr = pred["containers"][0]
-            live = ctr.get("livenessProbe", {}).get("httpGet", {})
-            ready = ctr.get("readinessProbe", {}).get("httpGet", {})
-            assert live.get("path") == "/healthz", (
-                f"{path}: livenessProbe must target /healthz")
-            assert ready.get("path") == "/readyz", (
-                f"{path}: readinessProbe must target /readyz")
-
-
-def test_inference_services_opt_into_prometheus_scraping():
-    """The metrics plane (kubernetes_cloud_tpu/obs + GET /metrics on
-    both serving front-ends) is only useful if the cluster Prometheus
-    actually pulls it: every online-inference InferenceService must
-    carry the scrape annotations, pointed at the serving port's
-    /metrics."""
-    seen = 0
-    for path in (DEPLOY / "online-inference").rglob("*.yaml"):
-        for doc in _docs(path):
-            if doc.get("kind") != "InferenceService":
-                continue
-            seen += 1
-            ann = doc["metadata"].get("annotations") or {}
-            assert ann.get("prometheus.io/scrape") == "true", (
-                f"{path}: missing prometheus.io/scrape annotation")
-            assert ann.get("prometheus.io/port") == "8080", (
-                f"{path}: prometheus.io/port must be the serving port")
-            assert ann.get("prometheus.io/path") == "/metrics", (
-                f"{path}: prometheus.io/path must be /metrics")
-    assert seen >= 8  # the whole serving catalog is covered
 
 
 def test_ready_sentinel_protocol_present():
