@@ -186,6 +186,189 @@ def decode_step_slots(cfg: CausalLMConfig, params: Params, tokens: jax.Array,
     return logits, new
 
 
+# ---------------------------------------------------------------------------
+# paged KV pool (vLLM/PagedAttention; serve/continuous.py paged mode)
+# ---------------------------------------------------------------------------
+
+
+def init_page_arena(cfg: CausalLMConfig, num_pages: int, page_size: int,
+                    dtype=None) -> dict[str, jax.Array]:
+    """Block-granular KV arena: ``[L, NUM_PAGES, page_size, Hkv, Dh]``.
+
+    Physical page 0 is the *null page* (``serve.paged_kv.NULL_PAGE``):
+    free slots' page-table entries point at it, so the all-slots decode
+    program has somewhere harmless to park masked garbage writes.  No
+    per-row ``length`` lives on device — the paged scheduler owns
+    lengths host-side and passes them as program arguments."""
+    shape = (cfg.num_layers, num_pages, page_size, cfg.kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype or cfg.dtype),
+            "v": jnp.zeros(shape, dtype or cfg.dtype)}
+
+
+def copy_pages(arena: dict, src: jax.Array, dst: jax.Array) -> dict:
+    """Copy physical pages ``src[i] -> dst[i]`` across every layer —
+    the device half of the allocator's copy-on-write: a shared prefix
+    page goes private before the tail prefill writes into it."""
+    return {"k": arena["k"].at[:, dst].set(arena["k"][:, src]),
+            "v": arena["v"].at[:, dst].set(arena["v"][:, src])}
+
+
+def _page_scatter_indices(page_tables: jax.Array, positions: jax.Array,
+                          valid: jax.Array, page_size: int
+                          ) -> tuple[jax.Array, jax.Array]:
+    """Map absolute token positions to (physical page, row) pairs via
+    each request's page table; invalid (padding) writes route to the
+    null page so they can never collide with a real row."""
+    phys = jnp.take_along_axis(page_tables, positions // page_size,
+                               axis=1)
+    rows = positions % page_size
+    phys = jnp.where(valid, phys, 0)
+    rows = jnp.where(valid, rows, 0)
+    return phys, rows
+
+
+def prefill_into_pages(cfg: CausalLMConfig, params: Params,
+                       input_ids: jax.Array, attention_mask: jax.Array,
+                       arena: dict, page_tables: jax.Array,
+                       start: jax.Array) -> tuple[jax.Array, dict]:
+    """Prefill a batch of prompt *tails* into their reserved pages.
+
+    ``input_ids`` [B, T] holds each request's uncached tail tokens
+    (right-padded); ``start`` [B] is the absolute position of each
+    tail's first token (0 for a prefix-cache miss, the cached length on
+    a hit); ``page_tables`` [B, P] names the physical pages backing the
+    request, null-padded past its reservation.  Tail queries attend to
+    the cached prefix *and* causally to the tail itself through the
+    same gathered view decode uses, so a prefix-cache hit is
+    numerically identical to recomputing the whole prompt.  Returns
+    (last-real-token logits [B, V], arena)."""
+    b, t = input_ids.shape
+    ps = arena["k"].shape[2]
+    max_len = page_tables.shape[1] * ps
+    tail_lens = attention_mask.sum(-1).astype(jnp.int32)
+    positions = start[:, None] + jnp.clip(
+        jnp.cumsum(attention_mask, 1) - 1, 0)  # [B, T] absolute
+
+    rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
+            if cfg.pos_emb == "rope" else None)
+    kpos_all = jnp.broadcast_to(jnp.arange(max_len), (b, max_len))
+    bias = (_alibi_bias(cfg, kpos_all.astype(jnp.float32))
+            if cfg.pos_emb == "alibi" else None)
+    # key j visible to tail query i iff j <= its absolute position:
+    # covers the cached prefix and the causal triangle within the tail,
+    # and excludes every not-yet-written (garbage) row
+    key_mask = (kpos_all[:, None, None, :]
+                <= positions[:, None, :, None]).astype(jnp.int32)
+
+    phys, rows = _page_scatter_indices(page_tables, positions,
+                                       attention_mask != 0, ps)
+    phys_f = phys.reshape(b * t)
+    rows_f = rows.reshape(b * t)
+
+    x = _embed(cfg, params, input_ids, positions)
+
+    def body(carry, layer):
+        x = carry
+        p, ck, cv = layer
+        q, k_new, v_new, attn_in = _project_qkv(
+            cfg, p, x, rope=rope, q_positions=positions)
+        ck = ck.at[phys_f, rows_f].set(
+            k_new.reshape(b * t, cfg.kv_heads, cfg.head_dim
+                          ).astype(ck.dtype))
+        cv = cv.at[phys_f, rows_f].set(
+            v_new.reshape(b * t, cfg.kv_heads, cfg.head_dim
+                          ).astype(cv.dtype))
+        dense_k = ck[page_tables].reshape(b, max_len, cfg.kv_heads,
+                                          cfg.head_dim)
+        dense_v = cv[page_tables].reshape(b, max_len, cfg.kv_heads,
+                                          cfg.head_dim)
+        attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                             dense_v.astype(cfg.dtype), causal=False,
+                             bias=bias, mask=key_mask, impl="xla")
+        x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
+                                token_mask=attention_mask,
+                                moe_no_drop=True)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x,
+                               (params["blocks"], arena["k"], arena["v"]))
+    logits = _unembed(cfg, params, x)
+    last = jnp.take_along_axis(
+        logits, (tail_lens - 1)[:, None, None].clip(0), axis=1)[:, 0]
+    return last, {"k": ks, "v": vs}
+
+
+def decode_step_pages(cfg: CausalLMConfig, params: Params,
+                      tokens: jax.Array, arena: dict,
+                      page_table: jax.Array, lengths: jax.Array,
+                      impl: str = "gather") -> tuple[jax.Array, dict]:
+    """One decode iteration for every slot over the paged arena.
+
+    ``tokens`` [S] is each slot's previously sampled token, ``lengths``
+    [S] the host-tracked context length (= the position this token
+    occupies), ``page_table`` [S, P] the per-slot indirection.  Free
+    slots carry an all-null table and length 0, so their (garbage) K/V
+    write lands in the null page and their logits row is never read.
+    ``impl`` selects the attention gather: ``"gather"`` (pure jnp,
+    bit-identical to :func:`decode_step` over the equivalent dense
+    pool) or ``"pallas"`` (the Mosaic paged-attention kernel in
+    :mod:`kubernetes_cloud_tpu.ops.paged_attention`).  Returns
+    (logits [S, V], arena)."""
+    s = tokens.shape[0]
+    ps = arena["k"].shape[2]
+    max_len = page_table.shape[1] * ps
+    pos = lengths
+    positions = pos[:, None]
+
+    rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
+            if cfg.pos_emb == "rope" else None)
+    kpos_all = jnp.broadcast_to(jnp.arange(max_len), (s, max_len))
+    bias = (_alibi_bias(cfg, kpos_all.astype(jnp.float32))
+            if cfg.pos_emb == "alibi" else None)
+    slopes = (alibi_slopes(cfg.num_heads) if cfg.pos_emb == "alibi"
+              else None)
+    key_mask = (kpos_all <= pos[:, None]).astype(jnp.int32)
+
+    phys = jnp.take_along_axis(page_table, (pos // ps)[:, None],
+                               axis=1)[:, 0]
+    rows = pos % ps
+
+    x = _embed(cfg, params, tokens[:, None], positions)
+
+    def body(carry, layer):
+        x = carry
+        p, ck, cv = layer
+        q, k_new, v_new, attn_in = _project_qkv(
+            cfg, p, x, rope=rope, q_positions=positions)
+        ck = ck.at[phys, rows].set(k_new[:, 0].astype(ck.dtype))
+        cv = cv.at[phys, rows].set(v_new[:, 0].astype(cv.dtype))
+        if impl == "pallas":
+            from kubernetes_cloud_tpu.ops.paged_attention import (
+                paged_decode_attention,
+            )
+
+            attn_vec = paged_decode_attention(
+                q[:, 0], ck.astype(cfg.dtype), cv.astype(cfg.dtype),
+                page_table, pos + 1, slopes=slopes, impl="pallas",
+            )[:, None]
+        else:
+            dense_k = ck[page_table].reshape(s, max_len, cfg.kv_heads,
+                                             cfg.head_dim)
+            dense_v = cv[page_table].reshape(s, max_len, cfg.kv_heads,
+                                             cfg.head_dim)
+            attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                                 dense_v.astype(cfg.dtype), causal=False,
+                                 bias=bias, mask=key_mask, impl="xla")
+        x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
+                                moe_no_drop=True)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x,
+                               (params["blocks"], arena["k"], arena["v"]))
+    return _unembed(cfg, params, x)[:, 0], {"k": ks, "v": vs}
+
+
 def sample_token(logits: jax.Array, rng: jax.Array, *, temperature: float,
                  top_k: int, top_p: float) -> jax.Array:
     """Temperature / top-k / top-p sampling; temperature 0 = greedy."""

@@ -50,6 +50,16 @@ METRIC_FAMILIES = {
         "admission queue depth",
     "kct_engine_kv_utilization":
         "live fraction of KV-pool token rows",
+    "kct_engine_kv_pages":
+        "allocatable pages in the paged KV arena",
+    "kct_engine_kv_pages_free":
+        "pages allocatable right now (free + LRU-evictable)",
+    "kct_engine_prefix_cache_hits_total":
+        "admissions reusing cached prefix pages",
+    "kct_engine_prefix_cache_tokens_saved_total":
+        "prompt tokens served from the prefix cache",
+    "kct_engine_kv_cow_total":
+        "shared pages copied on write before a private prefill",
     # dynamic batcher (serve/batcher.py)
     "kct_batcher_batches_total":
         "batches dispatched to the device",

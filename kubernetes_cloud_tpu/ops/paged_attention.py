@@ -1,0 +1,177 @@
+"""Paged-attention decode kernel — single-token queries over a paged KV
+arena (vLLM/PagedAttention, SOSP '23; see PAPERS.md).
+
+The continuous-batching engine's paged pool stores K/V in a fixed arena
+``[num_pages, page_size, Hkv, Dh]`` per layer, with a per-slot
+indirection table naming which physical pages back each slot's context.
+Decode attention therefore needs a *gather*: slot ``s``'s keys live
+scattered across ``page_table[s]``.  Two interchangeable
+implementations:
+
+* ``impl="gather"`` — pure-jnp: materialize the dense
+  ``[S, max_len, Hkv, Dh]`` view with one advanced-indexing gather and
+  run the stock masked attention.  Runs anywhere (CPU tier-1), and is
+  bit-identical to the slot-pool decode path because the gathered view
+  *is* the slot pool layout.
+* ``impl="pallas"`` — a Mosaic TPU kernel gridded ``(slot, kv_head,
+  page)``: the page table rides in as a scalar-prefetch operand so the
+  BlockSpec index map streams exactly the pages each slot references
+  (never the whole arena), with flash-style online softmax across the
+  page sweep.  GQA maps every query head of a group onto the same
+  resident KV page (same trick as ``ops/flash_kernel``); ALiBi comes in
+  as per-head slopes computed against absolute key positions in-kernel.
+
+``scripts/kernel_parity.py --paged`` locks the two (plus a dense
+reference) together on real hardware; ``tests/test_paged_kv.py`` runs
+the kernel in interpreter mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # matches ops/flash_kernel: exp() stays NaN-free
+
+
+def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """[NP, ps, Hkv, D] arena + [S, P] table → dense [S, P*ps, Hkv, D]."""
+    s, p = page_table.shape
+    ps = pages.shape[1]
+    dense = pages[page_table]  # [S, P, ps, Hkv, D]
+    return dense.reshape(s, p * ps, *pages.shape[2:])
+
+
+def _gather_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes, scale):
+    from kubernetes_cloud_tpu.ops.attention import attention
+
+    max_len = page_table.shape[1] * k_pages.shape[1]
+    dense_k = gather_pages(k_pages, page_table)
+    dense_v = gather_pages(v_pages, page_table)
+    mask = (jnp.arange(max_len)[None, :] < ctx_lens[:, None]).astype(
+        jnp.int32)
+    out = attention(q[:, None], dense_k.astype(q.dtype),
+                    dense_v.astype(q.dtype), causal=False, mask=mask,
+                    alibi_slopes=slopes, scale=scale, impl="xla")
+    return out[:, 0]
+
+
+def _kernel(pt_ref, len_ref, slopes_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, group: int, page_size: int,
+            n_pages: int, scale: float, have_slopes: bool):
+    s, kh, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = len_ref[s]
+    q = q_ref[0, 0].astype(jnp.float32)          # [G, D]
+    kblk = k_ref[0, :, 0, :]                     # [ps, D]
+    vblk = v_ref[0, :, 0, :]
+    scores = jax.lax.dot_general(
+        q, kblk.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [G, ps]
+    kpos = (p * page_size
+            + jax.lax.broadcasted_iota(jnp.int32, (group, page_size), 1))
+    if have_slopes:
+        slope = slopes_ref[pl.ds(kh * group, group)]  # [G]
+        scores = scores + slope[:, None] * kpos.astype(jnp.float32)
+    scores = jnp.where(kpos < ctx, scores, NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    # masked entries (== NEG_INF) contribute exactly 0 (flash_kernel's
+    # _prob rationale: real scores are far above NEG_INF/2)
+    probs = jnp.where(scores > NEG_INF * 0.5, jnp.exp(scores - m_new), 0.0)
+    l_new = l_prev * alpha + jnp.sum(probs, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        probs, vblk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == n_pages - 1)
+    def _():
+        o_ref[0, 0] = (acc_ref[...]
+                       / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _pallas_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes, scale,
+                 interpret):
+    s, h, d = q.shape
+    np_, ps, hkv, _ = k_pages.shape
+    p_per = page_table.shape[1]
+    g = h // hkv
+    have_slopes = slopes is not None
+    qg = q.reshape(s, hkv, g, d)
+
+    kernel = functools.partial(
+        _kernel, group=g, page_size=ps, n_pages=p_per, scale=scale,
+        have_slopes=have_slopes)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s, hkv, p_per),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d),
+                         lambda s_, kh, p_, pt, ln, sl: (s_, kh, 0, 0)),
+            pl.BlockSpec((1, ps, 1, d),
+                         lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], 0,
+                                                         kh, 0)),
+            pl.BlockSpec((1, ps, 1, d),
+                         lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], 0,
+                                                         kh, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda s_, kh, p_, pt, ln, sl: (s_, kh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+        ],
+    )
+    slopes_arg = (slopes.astype(jnp.float32) if have_slopes
+                  else jnp.zeros((h,), jnp.float32))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, hkv, g, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      slopes_arg, qg, k_pages, v_pages)
+    return out.reshape(s, h, d)
+
+
+def paged_decode_attention(
+    q: jax.Array,            # [S, H, D] one query token per slot
+    k_pages: jax.Array,      # [NP, ps, Hkv, D] arena (one layer)
+    v_pages: jax.Array,
+    page_table: jax.Array,   # [S, P] physical page per slot block
+    ctx_lens: jax.Array,     # [S] valid keys per slot (incl. current)
+    *,
+    slopes: Optional[jax.Array] = None,  # [H] ALiBi slopes
+    scale: Optional[float] = None,
+    impl: str = "gather",
+    interpret: bool = False,
+) -> jax.Array:
+    """Attention of one decode token per slot over its paged context;
+    returns [S, H, D].  Rows with ``ctx_lens == 0`` (free slots) return
+    unspecified values — callers mask them (the engine never reads a
+    free slot's logits)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if impl == "pallas":
+        return _pallas_impl(q, k_pages, v_pages, page_table, ctx_lens,
+                            slopes, float(scale), interpret)
+    return _gather_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes,
+                        float(scale))

@@ -22,6 +22,20 @@ lengths mix.  Sampling runs host-side per slot (each request carries
 its own temperature/top-k/top-p/seed — requests never need
 parameter-compatible merging like the Triton-style batcher requires).
 
+**Paged mode** (``EngineConfig.paged``; vLLM/PagedAttention, SOSP '23)
+replaces the dense per-slot pool with a block-granular page arena
+(``[L, NUM_PAGES, page_size, Hkv, Dh]``) plus per-slot indirection
+tables: each request reserves only the pages its ``prompt +
+max_new_tokens`` actually needs, so HBM capacity stops being gated by
+the worst-case ``max_len`` and concurrent sequences scale with *real*
+context lengths.  Full prompt pages are identified by chained block
+hashes and reused copy-on-write across requests
+(:mod:`kubernetes_cloud_tpu.serve.paged_kv`), so a shared system
+prompt's prefill runs once, not per request — the engine admits a
+prefix hit by prefilling only the uncached tail.  Both modes are locked
+token-identical to greedy ``generate`` and to each other
+(``tests/test_paged_kv.py``).
+
 Contract parity with :class:`~kubernetes_cloud_tpu.serve.batcher.
 BatchingModel`: ``self_batching = True`` (ModelServer skips its
 per-model lock), bounded queue with
@@ -51,18 +65,25 @@ from kubernetes_cloud_tpu import faults, obs
 from kubernetes_cloud_tpu.obs.tracing import trace
 from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig
 from kubernetes_cloud_tpu.models.generate import (
+    copy_pages,
+    decode_step_pages,
     decode_step_slots,
     init_cache,
+    init_page_arena,
+    prefill_into_pages,
     prefill_into_slots,
 )
 from kubernetes_cloud_tpu.serve.errors import (
     DeadlineExceededError,
     EngineDrainingError,
     EngineRestartedError,
+    KVPagesExhaustedError,
     QueueFullError,
     RetryableError,
     StreamTimeoutError,
 )
+from kubernetes_cloud_tpu.serve import paged_kv
+from kubernetes_cloud_tpu.serve.paged_kv import PageAllocator
 from kubernetes_cloud_tpu.serve.model import (
     Model,
     instance_text,
@@ -115,6 +136,25 @@ _M_KV_UTIL = obs.gauge(
     "kct_engine_kv_utilization",
     "Fraction of the KV pool's token rows holding live context.",
     ("model",))
+_M_KV_PAGES = obs.gauge(
+    "kct_engine_kv_pages",
+    "Allocatable pages in the paged KV arena (excludes the null page).",
+    ("model",))
+_M_KV_PAGES_FREE = obs.gauge(
+    "kct_engine_kv_pages_free",
+    "Pages allocatable right now (free list + LRU-evictable cached).",
+    ("model",))
+_M_PREFIX_HITS = obs.counter(
+    "kct_engine_prefix_cache_hits_total",
+    "Admissions that reused at least one cached prefix page.", ("model",))
+_M_PREFIX_TOKENS = obs.counter(
+    "kct_engine_prefix_cache_tokens_saved_total",
+    "Prompt tokens served from the prefix cache instead of prefill "
+    "compute.", ("model",))
+_M_COW = obs.counter(
+    "kct_engine_kv_cow_total",
+    "Shared prefix pages copied on write before a private tail "
+    "prefill.", ("model",))
 
 
 class RequestCancelled(RuntimeError):
@@ -138,6 +178,20 @@ class EngineConfig:
     #: from a wedge by heartbeat alone.  Must exceed the worst-case
     #: single compile; applies only while the cold call is in flight.
     compile_grace_s: float = 120.0
+    #: block-granular paged KV pool + cross-request prefix caching
+    #: (vLLM/PagedAttention) instead of the dense per-slot pool.
+    #: ``max_len`` stays the per-request cap (it sizes the page table);
+    #: HBM is bounded by ``num_pages`` instead of ``slots * max_len``.
+    paged: bool = False
+    #: KV rows per page; full prompt pages are the prefix-cache sharing
+    #: unit, so smaller pages share more but gather/hash more
+    page_size: int = 16
+    #: arena pages INCLUDING the reserved null page; 0 = equal bytes
+    #: with the slot pool it replaces (slots * max_len rows) + null
+    num_pages: int = 0
+    #: paged decode attention: "gather" (pure jnp, runs anywhere) or
+    #: "pallas" (Mosaic paged-attention kernel, TPU)
+    attn_impl: str = "gather"
 
     def __post_init__(self):
         if self.slots < 1:
@@ -148,6 +202,31 @@ class EngineConfig:
             raise ValueError("max_queue_size must be >= 1")
         if self.max_admit_per_step < 1:
             raise ValueError("max_admit_per_step must be >= 1")
+        if self.paged:
+            if self.page_size < 1:
+                raise ValueError("page_size must be >= 1")
+            if self.max_len % self.page_size:
+                raise ValueError(
+                    f"max_len ({self.max_len}) must be a multiple of "
+                    f"page_size ({self.page_size})")
+            if self.attn_impl not in ("gather", "pallas"):
+                raise ValueError("attn_impl must be 'gather' or 'pallas'")
+            if self.num_pages and self.num_pages < 2:
+                raise ValueError("num_pages must be >= 2 (page 0 is "
+                                 "the null page)")
+
+    @property
+    def pages_per_slot(self) -> int:
+        """Page-table width: blocks covering one request at max_len."""
+        return self.max_len // self.page_size
+
+    @property
+    def effective_num_pages(self) -> int:
+        """Arena size; default matches the slot pool's row count so
+        paged-vs-slot comparisons are equal-HBM by construction."""
+        if self.num_pages:
+            return self.num_pages
+        return self.slots * self.pages_per_slot + 1
 
 
 class GenRequest:
@@ -156,7 +235,8 @@ class GenRequest:
     __slots__ = ("prompt_ids", "max_new_tokens", "temperature", "top_k",
                  "top_p", "rng", "tokens", "stream", "event", "error",
                  "claimed", "cancelled", "submitted_at", "first_token_at",
-                 "done_at", "deadline", "engine", "request_id")
+                 "done_at", "deadline", "engine", "request_id",
+                 "cached_tokens")
 
     def __init__(self, prompt_ids: Sequence[int], *, max_new_tokens: int,
                  temperature: float, top_k: int, top_p: float, seed: int,
@@ -188,6 +268,10 @@ class GenRequest:
         self.engine: Optional["ContinuousBatchingEngine"] = None
         #: correlation id for lifecycle spans (None = untraced)
         self.request_id = request_id
+        #: prompt tokens served from the prefix cache at admission
+        #: (paged engine; 0 otherwise) — surfaced per prediction so
+        #: load tests can account prefill compute actually spent
+        self.cached_tokens = 0
 
     def cancel(self) -> None:
         """Mark the request dead (client gone).  The scheduler purges it
@@ -303,6 +387,27 @@ def _jit_decode():
     return _JITTED["decode"]
 
 
+def _jit_prefill_pages():
+    if "prefill_pages" not in _JITTED:
+        _JITTED["prefill_pages"] = jax.jit(
+            prefill_into_pages, static_argnums=0, donate_argnums=4)
+    return _JITTED["prefill_pages"]
+
+
+def _jit_decode_pages():
+    if "decode_pages" not in _JITTED:
+        _JITTED["decode_pages"] = jax.jit(
+            decode_step_pages, static_argnums=0,
+            static_argnames=("impl",), donate_argnums=3)
+    return _JITTED["decode_pages"]
+
+
+def _jit_copy_pages():
+    if "copy_pages" not in _JITTED:
+        _JITTED["copy_pages"] = jax.jit(copy_pages, donate_argnums=0)
+    return _JITTED["copy_pages"]
+
+
 class ContinuousBatchingEngine:
     """Owns the slot pool and the scheduler thread.
 
@@ -337,6 +442,26 @@ class ContinuousBatchingEngine:
         self._thread: Optional[threading.Thread] = None
         self._prefill = _jit_prefill()
         self._decode = _jit_decode()
+        #: paged mode: host-owned page allocator + indirection state
+        #: (the scheduler thread is the single owner, like _slots)
+        self.paged = engine_cfg.paged
+        self.allocator: Optional[PageAllocator] = None
+        self._prefill_pages = _jit_prefill_pages()
+        self._decode_pages = _jit_decode_pages()
+        self._copy_pages = _jit_copy_pages()
+        self._page_table = np.zeros(
+            (engine_cfg.slots, engine_cfg.pages_per_slot), np.int32)
+        self._lengths = np.zeros((engine_cfg.slots,), np.int32)
+        self._slot_pages: list[Optional[list]] = [None] * engine_cfg.slots
+        #: device mirror of _page_table, refreshed only when admission/
+        #: eviction dirties it — the table is constant across the
+        #: (hot) decode iterations in between, unlike lengths
+        self._page_table_dev: Optional[jax.Array] = None
+        self._page_table_dirty = True
+        #: armed by reset_peak_active(); applied on the scheduler
+        #: thread so the reset can't lose a race with its
+        #: read-modify-write peak update
+        self._peak_reset = threading.Event()
         #: beaten once per scheduler pass (idle polls included), so a
         #: fresh heartbeat always means "the loop is turning" — the
         #: supervisor's watchdog reads it
@@ -360,10 +485,16 @@ class ContinuousBatchingEngine:
         #: EWMA of decode-iteration wall time — admission control uses
         #: it to estimate queued-work delay for deadline shedding
         self.iter_s: Optional[float] = None
-        # iteration-level telemetry (the serving bench reads these)
+        # iteration-level telemetry (the serving bench reads these);
+        # prefill_tokens counts tokens actually run through prefill
+        # (prefix-cache hits subtract), prompt_tokens the total asked
+        # for — their gap is the compute the cache eliminated
         self.stats = {"iterations": 0, "admitted": 0, "emitted_tokens": 0,
                       "evictions": 0, "cancelled": 0, "active_slot_steps": 0,
-                      "deadline_shed": 0}
+                      "deadline_shed": 0, "prefill_tokens": 0,
+                      "prompt_tokens": 0, "prefix_hits": 0,
+                      "prefix_tokens_saved": 0, "cow_copies": 0,
+                      "peak_active": 0}
         # scrape-facing mirror: label-bound children resolved once so the
         # per-iteration cost is attribute access, not dict lookups
         m = {"model": self.name}
@@ -377,6 +508,11 @@ class ContinuousBatchingEngine:
         self._m_active = _M_ACTIVE.labels(**m)
         self._m_queue = _M_QUEUE.labels(**m)
         self._m_kv_util = _M_KV_UTIL.labels(**m)
+        self._m_kv_pages = _M_KV_PAGES.labels(**m)
+        self._m_kv_pages_free = _M_KV_PAGES_FREE.labels(**m)
+        self._m_prefix_hits = _M_PREFIX_HITS.labels(**m)
+        self._m_prefix_tokens = _M_PREFIX_TOKENS.labels(**m)
+        self._m_cow = _M_COW.labels(**m)
         _M_SLOTS.labels(**m).set(engine_cfg.slots)
 
     # -- lifecycle ---------------------------------------------------------
@@ -410,10 +546,17 @@ class ContinuousBatchingEngine:
         # makes this instant on warm boots.  Prefill compiles stay
         # per-bucket on demand, protected by the compile_grace_s window
         # (_admit raises grace_until around each first-time shape).
-        _, self.pool = self._decode(
-            self.cfg, self.params,
-            jnp.zeros((self.ecfg.slots,), jnp.int32), self.pool,
-            jnp.zeros((self.ecfg.slots,), bool))
+        if self.paged:
+            _, self.pool = self._decode_pages(
+                self.cfg, self.params,
+                jnp.zeros((self.ecfg.slots,), jnp.int32), self.pool,
+                self._device_page_table(),
+                jnp.asarray(self._lengths), impl=self.ecfg.attn_impl)
+        else:
+            _, self.pool = self._decode(
+                self.cfg, self.params,
+                jnp.zeros((self.ecfg.slots,), jnp.int32), self.pool,
+                jnp.zeros((self.ecfg.slots,), bool))
         self.heartbeat.beat()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="cb-engine")
@@ -433,6 +576,8 @@ class ContinuousBatchingEngine:
                     self.ecfg.drain_timeout_s)
 
     def _init_pool(self) -> dict:
+        if self.paged:
+            return self._init_arena()
         pool = init_cache(self.cfg, self.ecfg.slots, self.ecfg.max_len)
         if self.mesh is not None:
             from jax.sharding import PartitionSpec as P
@@ -457,7 +602,55 @@ class ContinuousBatchingEngine:
                 {"k": kv, "v": kv, "length": P(BATCH_AXES)}, self.mesh))
         return pool
 
+    def _init_arena(self) -> dict:
+        """Paged mode: fixed page arena + fresh allocator and cleared
+        host-side indirection (restart = cold prefix cache)."""
+        self.allocator = PageAllocator(self.ecfg.effective_num_pages,
+                                       self.ecfg.page_size)
+        self._page_table[:] = 0
+        self._page_table_dirty = True
+        self._lengths[:] = 0
+        self._slot_pages = [None] * self.ecfg.slots
+        arena = init_page_arena(self.cfg, self.ecfg.effective_num_pages,
+                                self.ecfg.page_size)
+        if self.mesh is not None:
+            # pages replicate (the indirection gather is position-
+            # blind); only KV heads shard, mirroring the slot pool.
+            # Batch-axis sharding of slots belongs to the mesh-serving
+            # work (ROADMAP item 2).
+            from jax.sharding import PartitionSpec as P
+
+            from kubernetes_cloud_tpu.core.mesh import AXIS_MODEL
+            from kubernetes_cloud_tpu.parallel.sharding import (
+                logical_to_physical,
+            )
+
+            heads = (AXIS_MODEL if self.cfg.kv_heads
+                     % max(self.mesh.shape.get(AXIS_MODEL, 1), 1) == 0
+                     else None)
+            kv = P(None, None, None, heads, None)
+            arena = jax.device_put(arena, logical_to_physical(
+                {"k": kv, "v": kv}, self.mesh))
+        return arena
+
     # -- request side ------------------------------------------------------
+
+    def reset_peak_active(self) -> None:
+        """Restart the ``peak_active`` stat's window from the next
+        scheduler pass (benchmarks bracket their measured window with
+        this).  Applied scheduler-side: a direct cross-thread write
+        could land inside the scheduler's read-modify-write of the
+        same key and be overwritten."""
+        self._peak_reset.set()
+
+    def _device_page_table(self) -> jax.Array:
+        """Host→device upload of the indirection table, paid only when
+        admission/eviction changed it (decode iterations between
+        scheduler events reuse the resident copy)."""
+        if self._page_table_dirty or self._page_table_dev is None:
+            self._page_table_dev = jnp.asarray(self._page_table)
+            self._page_table_dirty = False
+        return self._page_table_dev
 
     def queue_depth(self) -> int:
         with self._qlock:
@@ -486,6 +679,16 @@ class ContinuousBatchingEngine:
                 f"prompt ({len(prompt_ids)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the pool max_len "
                 f"({self.ecfg.max_len})")
+        if self.paged:
+            needed = paged_kv.pages_needed(len(prompt_ids), max_new_tokens,
+                                           self.ecfg.page_size)
+            cap = self.ecfg.effective_num_pages - 1
+            if needed > cap:
+                # can never be satisfied, even by a drained arena: a
+                # config error, not transient backpressure
+                raise ValueError(
+                    f"prompt + max_new_tokens needs {needed} KV pages; "
+                    f"the arena has {cap} (raise num_pages)")
         if (self.cfg.pos_emb == "learned"
                 and len(prompt_ids) + max_new_tokens > self.cfg.max_seq_len):
             # same guard as generate(): wpe gathers clamp silently beyond
@@ -606,7 +809,21 @@ class ContinuousBatchingEngine:
                             self.ecfg.max_len)
         self._m_active.set(active)
         self._m_queue.set(self.queue_depth())
-        self._m_kv_util.set(used / (self.ecfg.slots * self.ecfg.max_len))
+        if self._peak_reset.is_set():
+            self._peak_reset.clear()
+            self.stats["peak_active"] = active
+        else:
+            self.stats["peak_active"] = max(self.stats["peak_active"],
+                                            active)
+        if self.paged and self.allocator is not None:
+            alloc = self.allocator
+            self._m_kv_util.set(
+                used / (alloc.capacity * self.ecfg.page_size))
+            self._m_kv_pages.set(alloc.capacity)
+            self._m_kv_pages_free.set(alloc.free_pages())
+        else:
+            self._m_kv_util.set(
+                used / (self.ecfg.slots * self.ecfg.max_len))
 
     def _shed(self, request_id: Optional[str], reason: str) -> None:
         _M_SHED.labels(model=self.name, reason=reason).inc()
@@ -632,9 +849,20 @@ class ContinuousBatchingEngine:
         faults.fire("decode_step")
         faults.fire("model_fn")
         t0 = time.monotonic()
-        logits, self.pool = self._decode(self.cfg, self.params,
-                                         jnp.asarray(tokens), self.pool,
-                                         jnp.asarray(mask))
+        if self.paged:
+            logits, self.pool = self._decode_pages(
+                self.cfg, self.params, jnp.asarray(tokens), self.pool,
+                self._device_page_table(), jnp.asarray(self._lengths),
+                impl=self.ecfg.attn_impl)
+            # each active slot's token just landed at position
+            # lengths[i]; the next iteration (and its page lookup)
+            # sees the advanced context
+            for i in active:
+                self._lengths[i] += 1
+        else:
+            logits, self.pool = self._decode(self.cfg, self.params,
+                                             jnp.asarray(tokens), self.pool,
+                                             jnp.asarray(mask))
         logits = np.asarray(logits)
         dt = time.monotonic() - t0
         self.iter_s = dt if self.iter_s is None else (
@@ -674,14 +902,14 @@ class ContinuousBatchingEngine:
         with self._qlock:
             return self._queue.popleft() if self._queue else None
 
-    def _admit(self) -> None:
-        free = [i for i, s in enumerate(self._slots) if s is None]
-        budget = min(len(free), self.ecfg.max_admit_per_step)
-        batch: list[GenRequest] = []
-        while len(batch) < budget:
+    def _pop_admittable(self) -> Optional[GenRequest]:
+        """Pop queued requests until one is actually decodable, closing
+        out cancelled and deadline-expired ones on the way; None when
+        the queue is drained."""
+        while True:
             req = self._pop_queued()
             if req is None:
-                break
+                return None
             if req.cancelled:  # cancel landed after this step's purge
                 self.stats["cancelled"] += 1
                 self._m_cancelled.inc()
@@ -701,6 +929,31 @@ class ContinuousBatchingEngine:
                 req.stream.put(_STREAM_END)
                 req.event.set()
                 continue
+            return req
+
+    def _prefill_cold_guard(self, shape_key) -> bool:
+        cold = shape_key not in self._warm_shapes
+        if cold:
+            # first compile of this shape: 20-40s of legitimate
+            # silence on cold-cache hardware — tell the watchdog
+            self.grace_until = (time.monotonic()
+                                + self.ecfg.compile_grace_s)
+        return cold
+
+    def _admit(self) -> None:
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        budget = min(len(free), self.ecfg.max_admit_per_step)
+        if self.paged:
+            self._admit_paged(free, budget)
+        else:
+            self._admit_slots(free, budget)
+
+    def _admit_slots(self, free: list[int], budget: int) -> None:
+        batch: list[GenRequest] = []
+        while len(batch) < budget:
+            req = self._pop_admittable()
+            if req is None:
+                break
             req.claimed = True
             trace(req.request_id, "admitted", model=self.name)
             batch.append(req)
@@ -724,12 +977,7 @@ class ContinuousBatchingEngine:
                 ids[r, :len(req.prompt_ids)] = req.prompt_ids
                 mask[r, :len(req.prompt_ids)] = 1
             shape_key = (bucket, len(group))
-            cold = shape_key not in self._warm_shapes
-            if cold:
-                # first compile of this shape: 20-40s of legitimate
-                # silence on cold-cache hardware — tell the watchdog
-                self.grace_until = (time.monotonic()
-                                    + self.ecfg.compile_grace_s)
+            cold = self._prefill_cold_guard(shape_key)
             faults.fire("model_fn")
             logits, self.pool = self._prefill(
                 self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
@@ -741,12 +989,107 @@ class ContinuousBatchingEngine:
             for r, (slot, req) in enumerate(zip(slots, group)):
                 self._slots[slot] = req
                 self.stats["admitted"] += 1
+                self.stats["prefill_tokens"] += len(req.prompt_ids)
+                self.stats["prompt_tokens"] += len(req.prompt_ids)
                 self._m_admitted.inc()
                 trace(req.request_id, "prefill", model=self.name,
                       slot=slot, bucket=bucket)
                 # the slot now joins the persistent decode batch; emit
                 # BEFORE the first token so span order reads
                 # prefill → decode → first_token
+                trace(req.request_id, "decode", model=self.name, slot=slot)
+                self._emit(slot, logits[r])
+        self._admitting = []
+
+    def _admit_paged(self, free: list[int], budget: int) -> None:
+        """Paged admission: reserve pages (reusing cached prefix blocks)
+        per request, then prefill only the uncached tails, grouped by
+        tail-length bucket.  A reservation that cannot be satisfied
+        right now puts the request back at the queue head — pages free
+        as decoding slots evict, exactly like waiting for a free slot."""
+        batch: list[tuple[GenRequest, Any]] = []
+        while len(batch) < budget:
+            req = self._pop_admittable()
+            if req is None:
+                break
+            try:
+                res = self.allocator.reserve(req.prompt_ids,
+                                             req.max_new_tokens)
+            except KVPagesExhaustedError:
+                # transient (submit() rejects permanently-impossible
+                # claims): requeue at the head and stop admitting —
+                # later arrivals must not starve this one
+                with self._qlock:
+                    self._queue.appendleft(req)
+                break
+            req.claimed = True
+            req.cached_tokens = res.cached_tokens
+            trace(req.request_id, "admitted", model=self.name)
+            batch.append((req, res))
+        self._admitting = [req for req, _ in batch]
+        # Every copy-on-write page copy is dispatched BEFORE any prefill
+        # of this pass: the allocator may have recycled a COW source's
+        # physical page for a later reservation in the same batch, and
+        # the copy must read it before that reservation's prefill
+        # overwrites it.
+        for req, res in batch:
+            if res.cow is not None:
+                src, dst = res.cow
+                self.stats["cow_copies"] += 1
+                self._m_cow.inc()
+                self.pool = self._copy_pages(
+                    self.pool, jnp.asarray([src], jnp.int32),
+                    jnp.asarray([dst], jnp.int32))
+        by_bucket: dict[int, list[tuple[GenRequest, Any]]] = {}
+        for req, res in batch:
+            tail = len(req.prompt_ids) - res.cached_tokens
+            by_bucket.setdefault(self._bucket(tail), []).append((req, res))
+        n_pages = self.ecfg.pages_per_slot
+        for bucket, group in by_bucket.items():
+            slots = [free.pop(0) for _ in group]
+            ids = np.full((len(group), bucket), self.pad, np.int32)
+            mask = np.zeros((len(group), bucket), np.int32)
+            tables = np.zeros((len(group), n_pages), np.int32)
+            start = np.zeros((len(group),), np.int32)
+            for r, (req, res) in enumerate(group):
+                tail = req.prompt_ids[res.cached_tokens:]
+                ids[r, :len(tail)] = tail
+                mask[r, :len(tail)] = 1
+                tables[r, :len(res.pages)] = res.pages
+                start[r] = res.cached_tokens
+            shape_key = ("paged", bucket, len(group))
+            cold = self._prefill_cold_guard(shape_key)
+            faults.fire("model_fn")
+            logits, self.pool = self._prefill_pages(
+                self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
+                self.pool, jnp.asarray(tables), jnp.asarray(start))
+            logits = np.asarray(logits)
+            if cold:
+                self._warm_shapes.add(shape_key)
+                self.grace_until = 0.0
+            for r, (slot, (req, res)) in enumerate(zip(slots, group)):
+                self._slots[slot] = req
+                self._slot_pages[slot] = res.pages
+                self._page_table[slot, :] = 0
+                self._page_table[slot, :len(res.pages)] = res.pages
+                self._page_table_dirty = True
+                self._lengths[slot] = len(req.prompt_ids)
+                # the pages now hold this prompt's blocks: publish them
+                # for the next request sharing the prefix
+                self.allocator.register(res)
+                self.stats["admitted"] += 1
+                plen = len(req.prompt_ids)
+                self.stats["prefill_tokens"] += plen - res.cached_tokens
+                self.stats["prompt_tokens"] += plen
+                if res.cached_tokens:
+                    self.stats["prefix_hits"] += 1
+                    self.stats["prefix_tokens_saved"] += res.cached_tokens
+                    self._m_prefix_hits.inc()
+                    self._m_prefix_tokens.inc(res.cached_tokens)
+                self._m_admitted.inc()
+                trace(req.request_id, "prefill", model=self.name,
+                      slot=slot, bucket=bucket,
+                      cached_tokens=res.cached_tokens)
                 trace(req.request_id, "decode", model=self.name, slot=slot)
                 self._emit(slot, logits[r])
         self._admitting = []
@@ -787,10 +1130,23 @@ class ContinuousBatchingEngine:
         self._slots[slot] = None
         self.stats["evictions"] += 1
         self._m_evicted.inc()
-        # Reset the freed row's length so the frozen-slot K/V write in
-        # decode_step_slots stays at position 0 until the next admission.
-        self.pool = dict(self.pool)
-        self.pool["length"] = self.pool["length"].at[slot].set(0)
+        if self.paged:
+            # Drop the page claim (shared prefix pages survive while
+            # siblings reference them; cached ones park in the LRU) and
+            # null the indirection so the frozen slot's garbage write
+            # lands in the null page until the next admission.
+            pages, self._slot_pages[slot] = self._slot_pages[slot], None
+            if pages:
+                self.allocator.release(pages)
+            self._page_table[slot, :] = 0
+            self._page_table_dirty = True
+            self._lengths[slot] = 0
+        else:
+            # Reset the freed row's length so the frozen-slot K/V write
+            # in decode_step_slots stays at position 0 until the next
+            # admission.
+            self.pool = dict(self.pool)
+            self.pool["length"] = self.pool["length"].at[slot].set(0)
         req.error = error
         req.done_at = time.monotonic()
         trace(req.request_id, _terminal_span(error), model=self.name,
@@ -951,7 +1307,12 @@ class ContinuousBatchingModel(Model):
             out_ids = [t for t in req.prompt_ids
                        if t != pad and t != eos] + kept
         out = {"generated_text": tok.decode(out_ids),
-               "tokens_out": len(kept)}
+               "tokens_out": len(kept),
+               # prefill accounting: what the prompt cost vs what the
+               # prefix cache saved (0 unless the paged engine hit) —
+               # load_test.py sums these into its outcomes summary
+               "prompt_tokens": len(req.prompt_ids),
+               "cached_tokens": req.cached_tokens}
         if req.first_token_at is not None:
             # client-visible TTFT (load_test reports its distribution
             # and checks it against the server-side histogram)
@@ -994,4 +1355,8 @@ def load_engine_config(model_dir: str) -> EngineConfig:
         max_queue_size=int(cb.get("max_queue_size", base.max_queue_size)),
         max_admit_per_step=int(cb.get("max_admit_per_step",
                                       base.max_admit_per_step)),
+        paged=bool(cb.get("paged", base.paged)),
+        page_size=int(cb.get("page_size", base.page_size)),
+        num_pages=int(cb.get("num_pages", base.num_pages)),
+        attn_impl=str(cb.get("attn_impl", base.attn_impl)),
     )

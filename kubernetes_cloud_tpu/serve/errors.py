@@ -34,6 +34,14 @@ class QueueFullError(RetryableError):
     real fault's 500."""
 
 
+class KVPagesExhaustedError(QueueFullError):
+    """Backpressure one level below the queue: the paged KV arena has no
+    free (or evictable) pages left for a new request's reservation.
+    Same 503 contract as ``QueueFullError`` — the request was fine, the
+    pod's KV memory transiently was not; retries land once decoding
+    frees pages."""
+
+
 class EngineRestartedError(RetryableError):
     """The supervisor restarted a hung/crashed engine out from under
     this in-flight request.  State (the KV slot) is gone; a retry hits

@@ -295,6 +295,17 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--pool-max-len", type=int, default=0,
                     help="continuous batching: KV rows per slot "
                          "(prompt + completion)")
+    ap.add_argument("--paged", action="store_true",
+                    help="continuous batching: block-granular paged KV "
+                         "pool + cross-request prefix caching instead "
+                         "of the dense per-slot pool (vLLM-style; see "
+                         "deploy/README.md 'Paged KV & prefix caching')")
+    ap.add_argument("--page-size", type=int, default=0,
+                    help="paged mode: KV rows per page (the prefix-"
+                         "sharing unit; default from model_config.json)")
+    ap.add_argument("--num-pages", type=int, default=0,
+                    help="paged mode: arena pages incl. the null page "
+                         "(0 = equal bytes with the slot pool)")
     ap.add_argument("--max-seq-len", type=int, default=0)
     ap.add_argument("--config", default=None,
                     help="model_config.json for batcher knobs")
@@ -357,10 +368,22 @@ def main(argv: Optional[list] = None) -> int:
 
         ecfg = load_engine_config(os.path.dirname(args.config)
                                   if args.config else model_dir)
+        # ONE replace: __post_init__ validates the paged geometry
+        # (max_len % page_size), so flags must land together — applying
+        # --paged before --page-size would validate a half-built config
+        overrides: dict = {}
         if args.slots > 0:
-            ecfg = dataclasses.replace(ecfg, slots=args.slots)
+            overrides["slots"] = args.slots
         if args.pool_max_len > 0:
-            ecfg = dataclasses.replace(ecfg, max_len=args.pool_max_len)
+            overrides["max_len"] = args.pool_max_len
+        if args.paged:
+            overrides["paged"] = True
+        if args.page_size > 0:
+            overrides["page_size"] = args.page_size
+        if args.num_pages > 0:
+            overrides["num_pages"] = args.num_pages
+        if overrides:
+            ecfg = dataclasses.replace(ecfg, **overrides)
         svc = ContinuousBatchingModel(svc.name, svc, ecfg)
     elif args.max_batch_size > 0 or args.config:
         from kubernetes_cloud_tpu.serve.batcher import (

@@ -25,6 +25,12 @@ becomes measurable: every run reports an ``outcomes`` breakdown
 (``2xx`` / ``503_shed`` / ``504_deadline`` / ``client_timeout`` /
 ``4xx`` / ``5xx`` / ``error``).
 
+``--shared-prefix N`` prepends one deterministic N-token prefix to
+every prompt (the system-prompt traffic shape), and the summary's
+``prompt_tokens_total`` / ``cached_prompt_tokens_total`` /
+``prefill_tokens_computed_total`` fields account what the paged
+engine's prefix cache absorbed vs what prefill actually computed.
+
 LM endpoints that attach per-prediction ``ttft_s`` (the continuous-
 batching engine) additionally get a client-observed TTFT distribution
 (``ttft_mean_s`` / ``ttft_p50_s`` / ``ttft_p95_s``).  ``--check-metrics``
@@ -60,6 +66,10 @@ class Result:
     #: continuous-batching engine attaches ``ttft_s`` per prediction);
     #: None when the endpoint doesn't report it
     ttft: Optional[float] = None
+    #: prompt tokens submitted / served from the server's prefix cache
+    #: (paged engine attaches both per prediction); 0 otherwise
+    prompt_tokens: int = 0
+    cached_tokens: int = 0
 
     @property
     def ok(self) -> bool:
@@ -101,6 +111,8 @@ class Summary:
     def stats(self) -> dict:
         lat = sorted(r.latency for r in self.results if r.ok)
         toks = sum(r.tokens_out for r in self.results if r.ok)
+        prompt = sum(r.prompt_tokens for r in self.results if r.ok)
+        cached = sum(r.cached_tokens for r in self.results if r.ok)
         ttfts = sorted(r.ttft for r in self.results
                        if r.ok and r.ttft is not None)
         outcomes: dict[str, int] = {}
@@ -141,14 +153,22 @@ class Summary:
             if ttfts else None,
             "ttft_p50_s": pct(0.50, ttfts),
             "ttft_p95_s": pct(0.95, ttfts),
+            # prefill accounting (paged engine attaches prompt_tokens /
+            # cached_tokens per prediction): what prefill actually cost
+            # vs what the prefix cache absorbed
+            "prompt_tokens_total": prompt,
+            "cached_prompt_tokens_total": cached,
+            "prefill_tokens_computed_total": prompt - cached,
             # shedding visibility: how every request ended
             "outcomes": outcomes,
         }
 
 
-def _parse_response(body: bytes) -> tuple[int, Optional[float]]:
-    """Extract (tokens_out sum, first ttft_s) from a V1 response body
-    (LM endpoints attach both per prediction); (0, None) otherwise."""
+def _parse_response(body: bytes
+                    ) -> tuple[int, Optional[float], int, int]:
+    """Extract (tokens_out sum, first ttft_s, prompt_tokens sum,
+    cached_tokens sum) from a V1 response body (LM endpoints attach
+    them per prediction); zeros/None otherwise."""
     try:
         obj = json.loads(body)
         preds = [p for p in obj.get("predictions", [])
@@ -156,9 +176,11 @@ def _parse_response(body: bytes) -> tuple[int, Optional[float]]:
         toks = sum(int(p.get("tokens_out", 0)) for p in preds)
         ttft = next((float(p["ttft_s"]) for p in preds
                      if p.get("ttft_s") is not None), None)
-        return toks, ttft
+        prompt = sum(int(p.get("prompt_tokens", 0)) for p in preds)
+        cached = sum(int(p.get("cached_tokens", 0)) for p in preds)
+        return toks, ttft, prompt, cached
     except (ValueError, TypeError, AttributeError):
-        return 0, None
+        return 0, None, 0, 0
 
 
 def _one_request(url: str, payload: bytes, timeout: float,
@@ -169,9 +191,10 @@ def _one_request(url: str, payload: bytes, timeout: float,
         req = urllib.request.Request(url, data=payload, headers=hdrs)
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             body = resp.read()
-            toks, ttft = _parse_response(body)
+            toks, ttft, prompt, cached = _parse_response(body)
             return Result(time.monotonic() - t0, resp.status,
-                          tokens_out=toks, ttft=ttft)
+                          tokens_out=toks, ttft=ttft,
+                          prompt_tokens=prompt, cached_tokens=cached)
     except urllib.error.HTTPError as e:
         # keep the real status — the outcome breakdown needs to tell a
         # 503 shed from a 504 deadline miss from a genuine 500
@@ -277,14 +300,45 @@ def check_metrics(before: list, after: list, target_url: str,
             "ok": lo <= server_n <= client_count}
 
 
+def _with_shared_prefix(payload: bytes, prefix: str) -> bytes:
+    """Prepend the shared prefix to every string instance of a V1
+    payload (non-instance payloads pass through untouched)."""
+    try:
+        obj = json.loads(payload)
+        inst = obj.get("instances")
+        if not isinstance(inst, list):
+            return payload
+        obj["instances"] = [prefix + i if isinstance(i, str) else i
+                            for i in inst]
+        return json.dumps(obj).encode()
+    except ValueError:
+        return payload
+
+
+def shared_prefix_text(n_tokens: int, seed: int = 0) -> str:
+    """Deterministic ``n_tokens``-char prefix (byte tokenizer: one char
+    = one token), identical across client processes so every worker
+    hits the SAME server-side prefix-cache entry."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    return "".join(rng.choice("abcdefghij klmnop qrstuv wxyz")
+                   for _ in range(n_tokens))
+
+
 def build_payloads(args) -> list[bytes]:
     if args.inputs:
         with open(args.inputs) as f:
             lines = [ln.strip() for ln in f if ln.strip()]
         cycle = itertools.cycle(lines)
-        return [json.dumps({"instances": [next(cycle)]}).encode()
-                for _ in range(args.requests)]
-    return [args.payload.encode()] * args.requests
+        payloads = [json.dumps({"instances": [next(cycle)]}).encode()
+                    for _ in range(args.requests)]
+    else:
+        payloads = [args.payload.encode()] * args.requests
+    if args.shared_prefix:
+        prefix = shared_prefix_text(args.shared_prefix)
+        payloads = [_with_shared_prefix(p, prefix) for p in payloads]
+    return payloads
 
 
 def main(argv=None) -> dict:
@@ -301,6 +355,12 @@ def main(argv=None) -> dict:
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="attach an X-Request-Deadline-Ms budget to "
                          "every request (server sheds misses with 504)")
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="prepend ONE deterministic N-token prefix to "
+                         "every prompt — the system-prompt traffic "
+                         "shape the paged engine's prefix cache "
+                         "serves; the summary's prefill-token "
+                         "accounting shows what the cache absorbed")
     ap.add_argument("--ramp-stages", default="1,2,4,8",
                     help="comma-separated concurrency levels (ramp mode)")
     ap.add_argument("--stage-duration", type=float, default=15.0,

@@ -1,0 +1,273 @@
+"""Host-side page allocator + cross-request prefix cache for the paged
+KV pool (vLLM/PagedAttention, SOSP '23 — see PAPERS.md).
+
+The continuous-batching engine's original pool reserves ``max_len`` KV
+rows per slot — pure internal fragmentation whenever completion lengths
+vary.  The paged pool replaces that with a fixed arena of
+``num_pages × page_size`` rows and a per-slot indirection table; this
+module owns every *host-side* decision about that arena:
+
+* **Allocation / refcounts.**  ``reserve()`` claims enough physical
+  pages for ``prompt + max_new_tokens`` up front (admission-time
+  reservation: a claimed slot can always run to completion, so the
+  scheduler never needs mid-decode preemption), ``release()`` drops
+  them when the slot evicts.  Pages are refcounted because prefix
+  sharing aliases them across requests.
+* **Prefix caching.**  Full prompt blocks are identified by *chained*
+  block hashes (hash of the block's tokens + the previous block's
+  hash, so a match certifies the entire preceding context, not just
+  the block).  A new prompt walks its chain through the cache and
+  reuses every matched page copy-free — the engine then prefills only
+  the unmatched tail.
+* **Copy-on-write.**  Matching never hands out a page the request
+  would write into — with one deliberate exception: when the prompt is
+  exactly page-aligned and *every* block matches, the last prompt
+  token must still be recomputed (its logits seed sampling), and that
+  token's K/V lands inside the last matched page.  ``reserve()`` then
+  allocates a private copy and reports the (src, dst) pair; the engine
+  issues the device-side page copy before the tail prefill.
+* **LRU eviction.**  A released page whose content is a registered
+  prompt block is not freed — it parks in an LRU of refcount-zero
+  cached pages, serving future prefix hits, and is evicted only when a
+  reservation needs the space.
+
+Deliberately dependency-free (no jax, no numpy): the scheduler thread
+calls into it under no lock (single-owner), and ``tests/test_paged_kv
+.py`` drives it exhaustively without touching a device.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Optional, Sequence
+
+from kubernetes_cloud_tpu.serve.errors import KVPagesExhaustedError
+
+#: physical page 0 is the null page: free slots' page-table entries
+#: point at it, and the decode program parks masked garbage writes
+#: there.  Never allocated, never cached.
+NULL_PAGE = 0
+
+
+def pages_needed(prompt_len: int, max_new_tokens: int,
+                 page_size: int) -> int:
+    """Pages one request reserves: its whole ``prompt + max_new``
+    worst case, page-rounded.  Module-level (not a method) so
+    admission-time validation can run before any allocator exists —
+    one source of truth for the reservation accounting."""
+    return -(-(prompt_len + max_new_tokens) // page_size)
+
+
+def chain_hashes(prompt_ids: Sequence[int], page_size: int) -> list[int]:
+    """Chained hashes of the prompt's *full* blocks (vLLM-style).
+
+    ``h[i] = hash((h[i-1], block_i_tokens))`` — a match on block *i*
+    therefore certifies token-exact equality of blocks ``0..i``, which
+    is what makes cross-request page reuse sound: K/V values depend
+    only on the tokens and their absolute positions, both pinned by
+    the chain."""
+    out: list[int] = []
+    prev = 0
+    for i in range(len(prompt_ids) // page_size):
+        prev = hash((prev, tuple(prompt_ids[i * page_size:
+                                            (i + 1) * page_size])))
+        out.append(prev)
+    return out
+
+
+@dataclasses.dataclass
+class PageReservation:
+    """One admitted request's page claim, in slot-table order: entry
+    ``i`` backs token positions ``[i*page_size, (i+1)*page_size)``."""
+
+    pages: list[int]
+    #: prompt tokens served from the prefix cache (the engine prefills
+    #: only ``prompt_len - cached_tokens`` tail tokens)
+    cached_tokens: int
+    prompt_len: int
+    #: (src, dst) when the last matched page needed a private copy
+    #: (page-aligned full-prompt match); the engine must copy src→dst
+    #: on device *before* the tail prefill writes into dst
+    cow: Optional[tuple[int, int]]
+    #: chain hashes of every full prompt block, for ``register()``
+    hashes: list[int] = dataclasses.field(default_factory=list)
+
+
+class PageAllocator:
+    """Free-list + refcount + prefix-cache bookkeeping for one arena.
+
+    Single-threaded by design: only the engine's scheduler thread
+    allocates/releases (the same ownership discipline as the slot
+    list), so no lock is taken here."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "null page)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: list[int] = list(range(num_pages - 1, NULL_PAGE, -1))
+        self._refcnt = [0] * num_pages
+        #: chain hash -> physical page holding that block's K/V
+        self._cached: dict[int, int] = {}
+        #: physical page -> its chain hash (reverse map for eviction)
+        self._page_hash: dict[int, int] = {}
+        #: refcount-zero cached pages, oldest-released first
+        self._lru: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self.stats = {"hits": 0, "tokens_saved": 0, "cow_copies": 0,
+                      "evicted_pages": 0, "allocated_pages": 0}
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Pages a single reservation could ever claim (arena minus the
+        null page)."""
+        return self.num_pages - 1
+
+    def free_pages(self) -> int:
+        """Pages allocatable right now: the free list plus every
+        refcount-zero cached page the LRU could evict."""
+        return len(self._free) + len(self._lru)
+
+    def used_pages(self) -> int:
+        """Pages currently referenced by at least one live request."""
+        return self.capacity - self.free_pages()
+
+    def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        return pages_needed(prompt_len, max_new_tokens, self.page_size)
+
+    def refcount(self, page: int) -> int:
+        return self._refcnt[page]
+
+    def is_cached(self, page: int) -> bool:
+        return page in self._page_hash
+
+    # -- allocation --------------------------------------------------------
+
+    def _take_page(self) -> int:
+        if self._free:
+            page = self._free.pop()
+        else:
+            # evict the coldest refcount-zero cached page; its hash
+            # entries die with it (a later identical prefix re-prefills)
+            page, _ = self._lru.popitem(last=False)
+            h = self._page_hash.pop(page)
+            del self._cached[h]
+            self.stats["evicted_pages"] += 1
+        self._refcnt[page] = 1
+        self.stats["allocated_pages"] += 1
+        return page
+
+    def _incref(self, page: int) -> None:
+        if self._refcnt[page] == 0:
+            self._lru.pop(page, None)  # back in live use, not evictable
+        self._refcnt[page] += 1
+
+    def reserve(self, prompt_ids: Sequence[int],
+                max_new_tokens: int) -> PageReservation:
+        """Claim pages for one request, reusing every cached prefix
+        block the chained hashes certify.  Raises
+        :class:`KVPagesExhaustedError` (a ``QueueFullError``) when the
+        arena cannot currently (or can never) satisfy the claim —
+        with *nothing* claimed, so the caller can retry the identical
+        reservation next scheduler pass."""
+        ps = self.page_size
+        plen = len(prompt_ids)
+        n_total = self.pages_needed(plen, max_new_tokens)
+        if n_total > self.capacity:
+            raise KVPagesExhaustedError(
+                f"request needs {n_total} KV pages; the arena has "
+                f"{self.capacity} (raise --num-pages or --page-size)")
+        hashes = chain_hashes(prompt_ids, ps)
+        matchable = 0
+        for h in hashes:
+            if h in self._cached:
+                matchable += 1
+            else:
+                break
+        # Feasibility per match depth: matched pages parked in the LRU
+        # (refcount 0) are counted by free_pages() as evictable, but a
+        # reservation pins them — they cannot also back its fresh
+        # pages.  When a deep match is infeasible (its pins starve its
+        # own fresh-page needs), degrade one block at a time down to an
+        # unmatched reservation, which can always evict the cache it
+        # would have reused: reuse is an optimization, never a reason
+        # to refuse work the arena can hold.
+        matched = matchable
+        while True:
+            # A fully page-aligned, fully matched prompt still
+            # recomputes its last token (sampling needs those logits) —
+            # the write lands inside the last matched page, so that
+            # page goes private via copy-on-write instead of being
+            # shared read-only.
+            cow_needed = matched > 0 and matched * ps == plen
+            fresh_needed = n_total - matched + (1 if cow_needed else 0)
+            pinned = sum(1 for h in hashes[:matched]
+                         if self._refcnt[self._cached[h]] == 0)
+            if fresh_needed <= self.free_pages() - pinned:
+                break
+            if matched == 0:
+                raise KVPagesExhaustedError(
+                    f"KV pages exhausted: need {fresh_needed} free, "
+                    f"have {self.free_pages()}")
+            matched -= 1
+        shared = [self._cached[h] for h in hashes[:matched]]
+        for page in shared:
+            self._incref(page)
+        cow = None
+        cow_src = None
+        if cow_needed:
+            cow_src = shared[-1]
+            dst = self._take_page()
+            shared[-1] = dst
+            cow = (cow_src, dst)
+            self.stats["cow_copies"] += 1
+        pages = shared + [self._take_page()
+                          for _ in range(n_total - len(shared))]
+        if cow_src is not None:
+            # dropped only after every fresh page is taken, so this
+            # reservation can never evict-and-recycle its own copy
+            # source; the engine still must order all device COW
+            # copies before any prefill of the same scheduler pass
+            self._decref(cow_src)
+        cached_tokens = (matched * ps - 1) if cow_needed else matched * ps
+        if cached_tokens:
+            self.stats["hits"] += 1
+            self.stats["tokens_saved"] += cached_tokens
+        return PageReservation(pages=pages, cached_tokens=cached_tokens,
+                               prompt_len=plen, cow=cow, hashes=hashes)
+
+    def register(self, res: PageReservation) -> None:
+        """Publish the reservation's full prompt blocks into the prefix
+        cache (call *after* the prefill wrote them).  Already-cached
+        blocks — including a COW copy whose content duplicates the
+        original — keep their existing entry."""
+        for i, h in enumerate(res.hashes):
+            page = res.pages[i]
+            if h not in self._cached and page not in self._page_hash:
+                self._cached[h] = page
+                self._page_hash[page] = h
+
+    def _decref(self, page: int) -> None:
+        if self._refcnt[page] <= 0:
+            raise AssertionError(f"double free of page {page}")
+        self._refcnt[page] -= 1
+        if self._refcnt[page] == 0:
+            if page in self._page_hash:
+                # cached content: park evictable, newest last
+                self._lru[page] = None
+                self._lru.move_to_end(page)
+            else:
+                self._free.append(page)
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one request's claim.  Shared pages survive while any
+        sibling still references them; cached pages at refcount zero
+        park in the LRU instead of the free list."""
+        for page in pages:
+            self._decref(page)

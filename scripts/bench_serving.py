@@ -23,7 +23,14 @@ supervisor instead of throughput: a deterministic fault wedges (or
 crashes) the decode loop mid-stream, and the benchmark reports how long
 the pod took to go unready → restarted engine → ``/readyz`` 200 →
 serving verified, as ``{"metric": "serving_recovery_s", ...}``
-(BENCHMARKS.md "Self-healing recovery")."""
+(BENCHMARKS.md "Self-healing recovery").
+
+Paged mode (``--paged [--prefix-share F --prefix-len N]``) runs the
+equal-pool-bytes A/B instead: slot pool vs paged arena holding the same
+KV rows, reporting concurrent-sequence capacity, prefill tokens
+actually computed, and prefix-cache savings as
+``{"metric": "serving_paged_kv_capacity", ...}`` (BENCHMARKS.md
+"Paged KV + prefix caching")."""
 
 from __future__ import annotations
 
@@ -37,18 +44,34 @@ import jax
 import jax.numpy as jnp
 
 
-def _payload_pool(rng: random.Random, n: int) -> list[bytes]:
+def _payload_pool(rng: random.Random, n: int, prefix_share: float = 0.0,
+                  prefix_len: int = 64) -> list[bytes]:
     """Mixed-length workload: prompts 4-48 tokens, completions 8/16/32,
     greedy (deterministic outputs, comparable across both front-ends).
 
     Completion lengths are quantized to three values so the request-level
     baseline pays a bounded, warmed-up number of XLA compiles (its
     ``generate`` jit is shape-specialized on max_new_tokens) — the
-    measured gap is scheduling, not compilation."""
+    measured gap is scheduling, not compilation.
+
+    ``prefix_share``: fraction of requests opening with ONE shared
+    ``prefix_len``-token prefix (the system-prompt / few-shot-header
+    traffic shape prefix caching exists for) followed by a short unique
+    tail; the byte tokenizer maps chars to tokens 1:1."""
+    alphabet = "abcdefghij klmnop qrstuv wxyz"
+    # guard keeps the RNG stream (and therefore any fixed --seed
+    # workload) byte-identical to pre-prefix-cache benchmark runs
+    shared = ("".join(rng.choice(alphabet) for _ in range(prefix_len))
+              if prefix_share > 0 else "")
     pool = []
     for _ in range(n):
-        prompt = "".join(rng.choice("abcdefghij klmnop qrstuv wxyz")
-                         for _ in range(rng.randint(4, 48)))
+        if rng.random() < prefix_share:
+            tail = "".join(rng.choice(alphabet)
+                           for _ in range(rng.randint(4, 16)))
+            prompt = shared + tail
+        else:
+            prompt = "".join(rng.choice(alphabet)
+                             for _ in range(rng.randint(4, 48)))
         pool.append(json.dumps({
             "instances": [prompt],
             "parameters": {"max_new_tokens": rng.choice([8, 16, 32]),
@@ -74,9 +97,25 @@ def _drive(model, pool, stages, stage_duration, metrics_snapshot=False):
         # scrapes (after warmup, so the delta is the run itself)
         metrics_url = f"http://127.0.0.1:{server.port}/metrics"
         before = scrape_metrics(metrics_url) if metrics_snapshot else None
+        # engine counters also bracket the measured window (warmup
+        # admissions and cache-priming misses must not pollute the
+        # capacity/prefill figures the paged comparison reports);
+        # peak_active resets outright — warmup's peak is not the run's
+        engine = getattr(model, "engine", None)
+        warm_stats = dict(engine.stats) if engine is not None else None
+        if engine is not None:
+            engine.reset_peak_active()
         out = run_ramp(url, pool, stages=stages,
                        stage_duration=stage_duration)
         after = scrape_metrics(metrics_url) if metrics_snapshot else None
+        # KV/admission accounting for the paged-vs-slot comparison:
+        # measured-window deltas (counters minus the warmup snapshot),
+        # taken before stop() tears the engine down
+        engine_stats = None
+        if engine is not None:
+            engine_stats = {
+                k: (v if k == "peak_active" else v - warm_stats[k])
+                for k, v in engine.stats.items()}
     finally:
         server.stop()
         model.stop()
@@ -91,6 +130,12 @@ def _drive(model, pool, stages, stage_duration, metrics_snapshot=False):
         "goodput_rps": best["goodput_rps"],
         "concurrency": best["concurrency"],
     }
+    if engine_stats is not None:
+        result["engine"] = {
+            k: engine_stats[k]
+            for k in ("peak_active", "prefill_tokens", "prompt_tokens",
+                      "prefix_hits", "prefix_tokens_saved", "cow_copies",
+                      "admitted")}
     if metrics_snapshot:
         # counter/sum/count deltas over the measured window (buckets
         # elided: per-le rows would swamp the one-line JSON record)
@@ -224,6 +269,58 @@ def _swallow(fn):
         pass
 
 
+def run_paged_comparison(args, svc, pool, stages) -> int:
+    """Equal-pool-bytes A/B: the slot pool (slots × max_len rows) vs
+    the paged arena holding the SAME row count, with ``--overcommit``×
+    the decode slots so pages — real context lengths — are the binding
+    constraint.  The two figures the ISSUE's acceptance bar names:
+
+    * concurrent-sequence capacity: peak simultaneously-decoding
+      requests over the ramp (``stats["peak_active"]``);
+    * prefill tokens actually computed vs prompt tokens asked for —
+      the gap is the compute the prefix cache eliminated."""
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingModel,
+        EngineConfig,
+    )
+
+    slot_cfg = EngineConfig(slots=args.slots, max_len=args.pool_max_len)
+    paged_cfg = EngineConfig(
+        slots=args.slots * args.overcommit, max_len=args.pool_max_len,
+        paged=True, page_size=args.page_size,
+        num_pages=args.slots * args.pool_max_len // args.page_size + 1)
+    slot = _drive(ContinuousBatchingModel("lm", svc, slot_cfg),
+                  pool, stages, args.stage_duration,
+                  metrics_snapshot=args.metrics_snapshot)
+    paged = _drive(ContinuousBatchingModel("lm", svc, paged_cfg),
+                   pool, stages, args.stage_duration,
+                   metrics_snapshot=args.metrics_snapshot)
+    se, pe = slot["engine"], paged["engine"]
+    record = {
+        "metric": "serving_paged_kv_capacity",
+        # the headline: concurrent sequences at equal pool bytes
+        "value": round(pe["peak_active"] / max(se["peak_active"], 1), 3),
+        "unit": "x_concurrent_seqs",
+        "pool_rows": args.slots * args.pool_max_len,
+        "page_size": args.page_size,
+        "prefix_share": args.prefix_share,
+        "prefix_len": args.prefix_len,
+        "slot": {"slots": slot_cfg.slots, **slot},
+        "paged": {"slots": paged_cfg.slots,
+                  "num_pages": paged_cfg.effective_num_pages, **paged},
+        # prefill tokens actually computed over prompt tokens asked
+        # for, self-normalized (the two ramps admit different request
+        # counts); the slot pool's ratio is 1.0 by construction
+        "prefill_reduction": round(
+            1.0 - pe["prefill_tokens"] / max(pe["prompt_tokens"], 1), 4),
+        "tokens_per_sec_ratio": round(
+            paged["tokens_out_per_sec"]
+            / max(slot["tokens_out_per_sec"], 1e-9), 3),
+    }
+    print(json.dumps(record))
+    return 0
+
+
 def main(argv=None) -> int:
     from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
     from kubernetes_cloud_tpu.serve.batcher import BatcherConfig, BatchingModel
@@ -244,6 +341,24 @@ def main(argv=None) -> int:
                     help="payload pool size (cycled by the ramp)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--skip-baseline", action="store_true")
+    ap.add_argument("--paged", action="store_true",
+                    help="equal-pool-bytes comparison mode: drive the "
+                         "slot-pool engine and the paged engine (same "
+                         "KV bytes, --overcommit x the slots) through "
+                         "the same ramp; reports concurrent-sequence "
+                         "capacity, prefill tokens actually computed, "
+                         "and prefix-cache savings (BENCHMARKS.md "
+                         "'Paged KV + prefix caching')")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="paged mode: KV rows per page")
+    ap.add_argument("--overcommit", type=int, default=4,
+                    help="paged mode: slots = overcommit x baseline "
+                         "slots (pages, not slots, should bind)")
+    ap.add_argument("--prefix-share", type=float, default=0.0,
+                    help="fraction of requests opening with one shared "
+                         "prompt prefix (system-prompt traffic shape)")
+    ap.add_argument("--prefix-len", type=int, default=64,
+                    help="shared prefix length in tokens")
     ap.add_argument("--metrics-snapshot", action="store_true",
                     help="scrape GET /metrics before/after each "
                          "measured ramp and attach the counter deltas "
@@ -262,7 +377,9 @@ def main(argv=None) -> int:
         return run_recovery(args)
 
     rng = random.Random(args.seed)
-    pool = _payload_pool(rng, args.requests)
+    pool = _payload_pool(rng, args.requests,
+                         prefix_share=args.prefix_share,
+                         prefix_len=args.prefix_len)
     stages = [int(s) for s in args.stages.split(",") if s]
 
     cfg = dataclasses.replace(PRESETS[args.preset], dtype=jnp.float32)
@@ -270,6 +387,9 @@ def main(argv=None) -> int:
                           params=init_params(cfg, jax.random.key(0)),
                           dtype=jnp.float32)
     svc.load()
+
+    if args.paged:
+        return run_paged_comparison(args, svc, pool, stages)
 
     baseline = None
     if not args.skip_baseline:

@@ -124,6 +124,61 @@ def test_cancelled_queued_request_frees_queue_capacity(params):
         eng.stop()
 
 
+def test_client_death_keeps_shared_pages_for_siblings(params):
+    """Paged engine: a client dying mid-stream must release only its
+    OWN page claim — prefix pages shared with a still-decoding sibling
+    survive (refcounted), and the sibling's output stays
+    token-identical to greedy generate."""
+    from kubernetes_cloud_tpu.models.generate import generate
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = ContinuousBatchingEngine(
+        CFG, params, EngineConfig(slots=2, max_len=64, paged=True,
+                                  page_size=8),
+        pad_token_id=0)
+    eng.start()
+    try:
+        shared = list(range(1, 17))  # 2 full pages
+        victim_prompt = shared + [30]
+        sibling_prompt = shared + [40]
+        want = np.asarray(generate(
+            CFG, params, jnp.asarray([sibling_prompt], jnp.int32),
+            max_new_tokens=30, temperature=0.0, pad_token_id=0)
+        )[0, len(sibling_prompt):len(sibling_prompt) + 30].tolist()
+
+        # the victim populates the prefix cache, then dies mid-stream
+        warm = eng.submit(victim_prompt, max_new_tokens=2,
+                          temperature=0.0)
+        assert len(warm.wait(eng)) == 2
+        victim = eng.submit(victim_prompt, max_new_tokens=40,
+                            temperature=0.0)
+        sibling = eng.submit(sibling_prompt, max_new_tokens=30,
+                             temperature=0.0)
+        vstream = victim.iter_tokens(timeout=60)
+        next(vstream)
+        sstream = sibling.iter_tokens(timeout=60)
+        next(sstream)  # sibling admitted: shares the 2 prefix pages
+        shared_pages = eng._slot_pages[eng._slots.index(sibling)][:2]
+        assert all(eng.allocator.refcount(p) >= 2 for p in shared_pages)
+
+        victim.cancel()
+        with pytest.raises(RequestCancelled):
+            for _ in vstream:
+                pass
+        # victim's claim is gone, but the sibling still pins the shared
+        # prefix pages — they must NOT have been freed or recycled
+        deadline = time.monotonic() + 30
+        while victim in eng._slots and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert victim not in eng._slots
+        assert all(eng.allocator.refcount(p) >= 1 for p in shared_pages)
+
+        assert sibling.wait(eng) == want, "sibling corrupted by cancel"
+    finally:
+        eng.stop()
+
+
 def test_sigkilled_http_client_does_not_poison_server(params):
     """SIGKILL a real HTTP client mid-request (the workflow-chaos
     pattern): the server thread finishes the orphaned generation, the
