@@ -33,6 +33,10 @@ Sites wired through ``serve/``:
                        parks (only) the scrape's thread; the chaos
                        suite proves a wedged/raising scrape can never
                        take down the data plane or flip ``/readyz``
+``debug.render``       the ``GET /debug/*`` introspection render —
+                       same containment contract as the scrape: a
+                       wedged timeline dump parks one debug request,
+                       never generate or ``/readyz``
 =====================  ====================================================
 
 Determinism: every site counts its hits under a lock; a spec names the
@@ -85,6 +89,10 @@ SITES = {
     "server.handle": "HTTP routing layer (raise becomes a 500)",
     "metrics.render": "GET /metrics exposition render (failure must "
                       "stay contained to the scrape)",
+    "debug.render": "GET /debug/* introspection render (timeline/"
+                    "slots/pages/profile; failure must stay contained "
+                    "to the debug request — the debug plane observes "
+                    "the data plane, it can never wedge it)",
 }
 
 

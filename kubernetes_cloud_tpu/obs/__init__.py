@@ -9,6 +9,13 @@ The observability plane the serving/workflow stack records into:
 * :mod:`~kubernetes_cloud_tpu.obs.tracing` — per-request lifecycle
   spans (``queued → admitted → prefill → decode → first_token →
   complete/shed/failed``) to the repo's shared JSONL sink.
+* :mod:`~kubernetes_cloud_tpu.obs.flight` — the always-on flight
+  recorder: bounded ring of per-iteration phase timings + batch
+  composition, dumped by ``GET /debug/timeline``.
+* :mod:`~kubernetes_cloud_tpu.obs.flops` — analytical model-FLOPs /
+  MFU accounting from the transformer config.
+* :mod:`~kubernetes_cloud_tpu.obs.report` — the where-did-the-time-go
+  analyzer over a timeline dump (``scripts/perf_report.py``).
 
 The metric catalog (names, types, labels) is documented in
 ``deploy/README.md`` § Observability; this package is import-light (no
@@ -29,6 +36,12 @@ from kubernetes_cloud_tpu.obs.metrics import (  # noqa: F401
     histogram,
     parse_text,
     sample_value,
+)
+from kubernetes_cloud_tpu.obs import flight, flops, report  # noqa: F401
+from kubernetes_cloud_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    IterationRecord,
+    ProfileWindow,
 )
 from kubernetes_cloud_tpu.obs import tracing  # noqa: F401
 from kubernetes_cloud_tpu.obs.tracing import (  # noqa: F401

@@ -29,7 +29,13 @@ METRIC_FAMILIES = {
     "kct_engine_iterations_total":
         "decode scheduler iterations",
     "kct_engine_iteration_seconds":
-        "one decode_step_slots dispatch (= per-token latency)",
+        "one scheduler pass, by phase: prefill-bearing vs decode-only",
+    "kct_engine_phase_seconds_total":
+        "seconds accumulated per named scheduler phase",
+    "kct_engine_mfu":
+        "model-FLOPs utilization over the trailing window",
+    "kct_engine_goodput_tokens_per_s":
+        "tokens served per second over the trailing window",
     "kct_engine_admitted_total":
         "requests admitted into slots",
     "kct_engine_evicted_total":
@@ -49,7 +55,8 @@ METRIC_FAMILIES = {
     "kct_engine_queue_depth":
         "admission queue depth",
     "kct_engine_kv_utilization":
-        "live fraction of KV-pool token rows",
+        "KV occupancy: live token rows (slot pool) or reserved pages "
+        "(paged arena)",
     "kct_engine_kv_pages":
         "allocatable pages in the paged KV arena",
     "kct_engine_kv_pages_free":

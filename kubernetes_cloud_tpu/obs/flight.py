@@ -1,0 +1,272 @@
+"""Flight recorder: always-on, bounded-memory engine introspection.
+
+``/metrics`` answers *how much* (counters, distributions); it cannot
+answer *where one iteration's time went*.  The flight recorder is the
+missing instrument: a fixed-capacity ring of per-iteration
+:class:`IterationRecord` s — each scheduler pass broken into named
+phases (``admit``, ``cow_copy``, ``prefill``, ``decode``, ``sample``,
+``stream``, ``host_sync``) with ``perf_counter`` timings, plus the
+pass's batch composition (active slots, prefill vs decode token
+counts, pages reserved/freed, prefix-cache hits) — and a smaller ring
+of per-request completion summaries (TTFT decomposed into queue-wait
+vs prefill-compute).  ``GET /debug/timeline`` dumps it;
+``scripts/perf_report.py`` turns a dump into a where-did-the-time-go
+report; :mod:`~kubernetes_cloud_tpu.obs.report` is the shared
+analyzer both use.
+
+Design constraints, in order:
+
+* **Bounded memory, proven.**  The ring is a preallocated fixed-size
+  list written modulo its capacity — an engine left running for a
+  month holds exactly ``capacity`` records, never more
+  (``tests/test_flight.py`` locks this).
+* **Lock-light.**  One writer (the scheduler thread) commits; readers
+  (HTTP debug threads) snapshot.  The lock guards only the
+  pointer-bump + slot assignment and the snapshot copy — pure memory
+  ops, no I/O, no blocking calls (KCT-LOCK discipline) — so the hot
+  decode loop pays two dict writes and a lock the bench measures
+  under the 2% budget (BENCHMARKS.md "Flight recorder overhead").
+* **Always on.**  Unlike tracing (off by default: file I/O), the
+  recorder writes memory only, so production pods fly with the
+  recorder armed and the *post-incident* question "what was the
+  engine doing?" has an answer.  ``capacity=0`` disables it for A/B
+  overhead audits.
+
+This module is import-light (no jax, no numpy) like the rest of
+:mod:`kubernetes_cloud_tpu.obs`; the optional
+:class:`ProfileWindow` lazily imports ``jax.profiler`` only when an
+operator arms a deep-profiling window via ``/debug/profile``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+#: the phase vocabulary every consumer (report, dashboard, tests)
+#: joins on — a scheduler pass is decomposed into these named slices;
+#: time in none of them (slot bookkeeping, gauge refresh) is the
+#: analyzer's "other" bucket
+PHASES = ("admit", "cow_copy", "prefill", "decode", "sample", "stream",
+          "host_sync")
+
+
+class IterationRecord:
+    """One scheduler pass: phase timings + batch composition.
+
+    Plain attributes (not a dataclass) with ``__slots__``: the
+    scheduler allocates one per pass, so construction cost is part of
+    the measured overhead budget."""
+
+    __slots__ = ("seq", "ts", "dur_s", "phases", "active", "admitted",
+                 "evicted", "queue_depth", "decode_tokens",
+                 "prefill_tokens", "cached_tokens", "prefix_hits",
+                 "pages_reserved", "pages_freed", "flops")
+
+    def __init__(self) -> None:
+        self.seq = 0            # assigned by commit(), monotonically
+        self.ts = 0.0           # wall-clock start (time.time)
+        self.dur_s = 0.0        # whole scheduler pass (perf_counter)
+        self.phases: dict[str, float] = {}  # phase -> seconds
+        self.active = 0         # slots decoding this pass
+        self.admitted = 0       # requests prefilled into slots
+        self.evicted = 0        # slots freed
+        self.queue_depth = 0    # admission queue at pass start
+        self.decode_tokens = 0  # tokens emitted (== active when stepped)
+        self.prefill_tokens = 0  # prompt tokens actually prefilled
+        self.cached_tokens = 0  # prompt tokens served by the prefix cache
+        self.prefix_hits = 0    # admissions that hit the prefix cache
+        self.pages_reserved = 0  # paged mode: pages claimed this pass
+        self.pages_freed = 0    # paged mode: pages released this pass
+        self.flops = 0.0        # analytical model FLOPs this pass
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {s: getattr(self, s) for s in self.__slots__
+             if s != "phases"}
+        d["phases"] = {k: round(v, 9) for k, v in self.phases.items()}
+        return d
+
+
+class FlightRecorder:
+    """Fixed-capacity ring of iteration records + request summaries.
+
+    One engine (or batcher) owns one recorder; a supervisor restart
+    builds a fresh engine and therefore a fresh recorder — the ring
+    documents one engine incarnation, like its stats dict."""
+
+    def __init__(self, capacity: int = 1024, *,
+                 request_capacity: int = 512):
+        if capacity < 0 or request_capacity < 0:
+            raise ValueError("ring capacities must be >= 0")
+        self.capacity = capacity
+        self.request_capacity = request_capacity
+        # preallocated rings: memory is bounded by construction, not by
+        # trusting every writer to also evict
+        self._ring: list[Optional[IterationRecord]] = [None] * capacity
+        self._reqs: list[Optional[dict]] = [None] * request_capacity
+        self._n = 0          # total commits ever (next seq)
+        self._rn = 0         # total request records ever
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def begin(self) -> IterationRecord:
+        """A fresh record for the scheduler to fill — not yet visible
+        to readers (commit publishes it)."""
+        rec = IterationRecord()
+        rec.ts = time.time()
+        return rec
+
+    def commit(self, rec: IterationRecord) -> None:
+        if self.capacity == 0:
+            return
+        with self._lock:  # pointer bump + slot write only (no I/O)
+            self._n += 1
+            rec.seq = self._n
+            self._ring[(self._n - 1) % self.capacity] = rec
+
+    def record_request(self, summary: dict) -> None:
+        """Append one completed request's summary (TTFT decomposition,
+        token counts, outcome) to the request ring."""
+        if self.request_capacity == 0:
+            return
+        with self._lock:
+            self._rn += 1
+            self._reqs[(self._rn - 1) % self.request_capacity] = summary
+
+    # -- readers -----------------------------------------------------------
+
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    def tail(self, last: Optional[int] = None) -> list[dict]:
+        """The newest ``last`` iteration records, oldest first (the
+        ``/debug/timeline`` payload)."""
+        with self._lock:
+            n, ring = self._n, list(self._ring)
+        held = min(n, self.capacity)
+        recs = [ring[(n - held + i) % self.capacity] for i in range(held)]
+        if last is not None and last >= 0:
+            recs = recs[-last:] if last else []
+        return [r.to_dict() for r in recs if r is not None]
+
+    def request_tail(self, last: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            n, ring = self._rn, list(self._reqs)
+        held = min(n, self.request_capacity)
+        recs = [ring[(n - held + i) % self.request_capacity]
+                for i in range(held)]
+        if last is not None and last >= 0:
+            recs = recs[-last:] if last else []
+        return [dict(r) for r in recs if r is not None]
+
+    def rates(self, window_s: float = 10.0) -> dict[str, float]:
+        """Goodput tokens/s and analytical FLOPs/s over the trailing
+        ``window_s`` of records — the engine refreshes its
+        ``kct_engine_goodput_tokens_per_s`` / ``kct_engine_mfu``
+        gauges from this (time-gated, not every pass)."""
+        cutoff = time.time() - window_s
+        tokens = 0
+        flops = 0.0
+        busy = 0.0
+        first_ts = last_end = None
+        with self._lock:
+            n, ring = self._n, list(self._ring)
+        held = min(n, self.capacity)
+        for i in range(held):
+            rec = ring[(n - held + i) % self.capacity]
+            if rec is None or rec.ts < cutoff:
+                continue
+            if first_ts is None:
+                first_ts = rec.ts
+            last_end = rec.ts + rec.dur_s
+            tokens += rec.decode_tokens + rec.prefill_tokens
+            flops += rec.flops
+            busy += rec.dur_s
+        if first_ts is None:
+            return {"tokens_per_s": 0.0, "flops_per_s": 0.0,
+                    "busy_s": 0.0, "span_s": 0.0}
+        # rate over the records' real span (idle gaps included): a
+        # mostly-idle engine reports honest low goodput, not its burst
+        # peak.  A single record's span is its own duration.
+        span = max(last_end - first_ts, busy, 1e-9)
+        return {"tokens_per_s": tokens / span, "flops_per_s": flops / span,
+                "busy_s": busy, "span_s": span}
+
+
+class ProfileActiveError(RuntimeError):
+    """A jax.profiler window is already armed (one at a time)."""
+
+
+class ProfileWindow:
+    """Per-window deep profiling: arm ``jax.profiler.trace`` for N
+    seconds from a live pod (``GET /debug/profile?seconds=N``).
+
+    The flight recorder answers phase-level questions for free; when
+    an iteration needs op-level truth (which fusion, which transfer),
+    an operator arms a bounded window and pulls the TensorBoard trace
+    from ``trace_dir``.  One window at a time — ``jax.profiler`` is a
+    process-global singleton — and the stop is driven by a timer
+    thread, so an operator who forgets to stop can't leave a pod
+    tracing forever."""
+
+    def __init__(self, trace_dir: str = "/tmp/kct-profile", *,
+                 max_seconds: float = 300.0):
+        self.trace_dir = trace_dir
+        self.max_seconds = max_seconds
+        self._lock = threading.Lock()
+        self._armed = False  # cleared by _stop AFTER the trace is
+        self._until = 0.0    # written, so wait() means "files landed"
+
+    @property
+    def active(self) -> bool:
+        return self._armed
+
+    def arm(self, seconds: float) -> dict:
+        """Start a trace window; returns its descriptor.  Raises
+        ``ValueError`` on a bad duration, :class:`ProfileActiveError`
+        when a window is already running."""
+        if not (0 < seconds <= self.max_seconds):
+            raise ValueError(
+                f"seconds must be in (0, {self.max_seconds:g}]")
+        with self._lock:  # check-and-set only; the trace starts below
+            if self._armed:
+                remaining = max(self._until - time.monotonic(), 0.0)
+                raise ProfileActiveError(
+                    f"profile window already armed for another "
+                    f"{remaining:.1f}s")
+            self._armed = True
+            self._until = time.monotonic() + seconds
+        import jax  # deferred: obs stays importable jax-free
+
+        try:
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception:
+            self._armed = False  # disarm so the next attempt can retry
+            raise
+        timer = threading.Timer(seconds, self._stop)
+        timer.daemon = True
+        timer.start()
+        return {"profiling_s": seconds, "trace_dir": self.trace_dir}
+
+    def _stop(self) -> None:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 - stop is best-effort cleanup
+            pass
+        self._armed = False
+
+    def wait(self, timeout: float = 10.0) -> bool:
+        """Block until the current window's trace is fully written
+        (tests and scripted profiling)."""
+        deadline = time.monotonic() + timeout
+        while self.active:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+        return True

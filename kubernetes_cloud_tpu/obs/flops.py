@@ -1,0 +1,157 @@
+"""Analytical model FLOPs + MFU accounting for the serving engine.
+
+MFU (model FLOPs utilization, PaLM App. B) is the honest efficiency
+number: *analytical* matmul FLOPs the model architecture requires for
+the tokens actually served, divided by elapsed time and the chip's
+peak.  Unlike achieved-TFLOPs profiler counters it can't be inflated
+by recomputation, padding, or wasted work — serving a prompt the
+prefix cache absorbed counts zero FLOPs, because zero were required.
+
+Everything here is arithmetic over a :class:`~kubernetes_cloud_tpu.
+models.causal_lm.CausalLMConfig`-shaped object (duck-typed: only
+attribute reads, so tests hand-build configs without importing jax).
+The decode cost at context length ``c`` is affine::
+
+    flops(c) = base + per_ctx * c
+
+``base`` covers the context-independent matmuls (QKV/out projections,
+MLP — top-k experts only for MoE — and the LM-head logits), ``per_ctx``
+the attention score/value matmuls that grow with context.  The engine
+precomputes the two coefficients once and pays two multiply-adds per
+iteration; :func:`span_flops` closes the sum for a prefill span.
+
+Peak FLOPs/s comes from a device-kind table (dense bf16 ratings) with
+a ``KCT_PEAK_FLOPS`` env override for hardware the table doesn't know
+(and for CPU hosts, where "MFU" is only meaningful against a declared
+reference).  Unknown peak ⇒ :func:`peak_flops_per_s` returns ``None``
+and the ``kct_engine_mfu`` gauge reports 0 rather than a lie.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: dense bf16 peak FLOPs/s per chip, by jax device_kind substring
+#: (lowercase match).  Sources: Google Cloud TPU system architecture
+#: docs; per-chip, not per-pod.
+DEVICE_PEAK_FLOPS = {
+    "v6e": 918e12,       # Trillium
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,   # v5e's device_kind spelling in some releases
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+#: env override: authoritative peak FLOPs/s when set (e.g. a CPU dev
+#: box declaring a reference, or hardware missing from the table)
+PEAK_ENV = "KCT_PEAK_FLOPS"
+
+
+def _kv_dim(cfg) -> int:
+    head_dim = cfg.hidden_size // cfg.num_heads
+    kv_heads = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
+    return kv_heads * head_dim
+
+
+def _intermediate(cfg) -> int:
+    return getattr(cfg, "intermediate_size", None) or 4 * cfg.hidden_size
+
+
+def param_count(cfg) -> int:
+    """Parameter count implied by the config (weights only; biases and
+    norm scales included, buffers like the rope cache excluded)."""
+    h, v, L = cfg.hidden_size, cfg.vocab_size, cfg.num_layers
+    inter = _intermediate(cfg)
+    kv = _kv_dim(cfg)
+    bias = 1 if getattr(cfg, "use_bias", True) else 0
+    qkv = h * (h + 2 * kv) + bias * (h + 2 * kv)
+    out = h * h + bias * h
+    experts = getattr(cfg, "moe_experts", 0) or 1
+    router = h * experts if getattr(cfg, "moe_experts", 0) else 0
+    mlp = experts * (2 * h * inter + bias * (inter + h)) + router
+    norms = 2 * 2 * h  # ln1 + ln2, scale + bias each
+    per_layer = qkv + out + mlp + norms
+    embed = v * h
+    if getattr(cfg, "pos_emb", "rope") == "learned":
+        embed += cfg.max_seq_len * h
+    if getattr(cfg, "embed_layernorm", False):
+        embed += 2 * h
+    head = 0 if getattr(cfg, "tie_embeddings", False) else v * h
+    final_norm = 2 * h
+    return embed + L * per_layer + final_norm + head
+
+
+def decode_flops_coeffs(cfg) -> tuple[float, float]:
+    """``(base, per_ctx)`` such that generating ONE token against a
+    context of ``c`` tokens (itself included) costs
+    ``base + per_ctx * c`` forward FLOPs.
+
+    * ``base``: QKV projections ``2h(h + 2·kv)``, attention output
+      ``2h²``, MLP ``4·h·inter`` (×top_k active experts + ``2hE``
+      router for MoE), all ×layers, plus the LM-head logits ``2hV``.
+    * ``per_ctx``: score (``QKᵀ``) and value (``A·V``) matmuls —
+      ``2h + 2h`` per context token per layer (every query head
+      attends regardless of GQA; sharing reduces KV *memory*, not
+      attention compute).
+    """
+    h, L = cfg.hidden_size, cfg.num_layers
+    inter = _intermediate(cfg)
+    kv = _kv_dim(cfg)
+    experts = getattr(cfg, "moe_experts", 0)
+    if experts:
+        mlp = getattr(cfg, "moe_top_k", 2) * 4 * h * inter + 2 * h * experts
+    else:
+        mlp = 4 * h * inter
+    base = L * (2 * h * (h + 2 * kv) + 2 * h * h + mlp) \
+        + 2 * h * cfg.vocab_size
+    per_ctx = L * 4 * h
+    return float(base), float(per_ctx)
+
+
+def span_flops(base: float, per_ctx: float, start: int, n: int) -> float:
+    """FLOPs to run ``n`` consecutive tokens whose contexts grow from
+    ``start + 1`` to ``start + n`` (a prefill of ``n`` tail tokens on
+    top of ``start`` cached ones; ``start=0`` is a full prefill)::
+
+        sum_{k=start+1}^{start+n} (base + per_ctx · k)
+    """
+    if n <= 0:
+        return 0.0
+    return n * base + per_ctx * (n * start + n * (n + 1) / 2.0)
+
+
+def peak_flops_per_s() -> Optional[float]:
+    """This host's per-chip dense peak, or ``None`` when unknown.
+
+    ``KCT_PEAK_FLOPS`` wins; otherwise the first jax device's
+    ``device_kind`` is matched against :data:`DEVICE_PEAK_FLOPS`.
+    jax import is deferred and best-effort — a jax-free process (or a
+    CPU backend) simply has no peak."""
+    env = os.environ.get(PEAK_ENV)
+    if env:
+        try:
+            val = float(env)
+            return val if val > 0 else None
+        except ValueError:
+            return None
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001 - no jax / no devices => no peak
+        return None
+    for key, flops in DEVICE_PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    return None
+
+
+def mfu(flops_per_s: float, peak: Optional[float]) -> float:
+    """Model FLOPs utilization in [0, 1]; 0 when the peak is unknown
+    (a gauge must never report garbage confidence)."""
+    if not peak or peak <= 0:
+        return 0.0
+    return max(0.0, flops_per_s / peak)

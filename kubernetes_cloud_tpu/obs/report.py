@@ -1,0 +1,275 @@
+"""Where-did-the-time-go analysis over a flight-recorder timeline.
+
+The shared analyzer behind ``scripts/perf_report.py`` (terminal
+report), ``scripts/bench_serving.py --timeline`` and
+``serve/load_test.py --timeline`` (benchmark-JSON embedding): given
+one model's ``/debug/timeline`` entry (``iterations`` +
+``requests`` rings, optional ``meta``), it computes
+
+* **phase share** — how the engine's busy time divides across the
+  named scheduler phases (:data:`~kubernetes_cloud_tpu.obs.flight.
+  PHASES`), with untimed bookkeeping as ``other``;
+* **prefill-stall detection** — the Sarathi/Orca interference signal:
+  prefill-bearing iterations whose duration blows past the typical
+  decode-only iteration delay every already-active decode slot by the
+  same amount (each such slot's next token is late by the overshoot);
+* **TTFT decomposition** — queue-wait (submit → admission claim) vs
+  prefill-compute (claim → first token) from the request ring, the
+  split that says whether slow first tokens need more capacity
+  (queue-bound) or chunked prefill (compute-bound);
+* **MFU / goodput** — analytical FLOPs/s over the window against the
+  chip peak (:mod:`~kubernetes_cloud_tpu.obs.flops`).
+
+Pure stdlib arithmetic over dicts — no jax, no numpy — so the report
+runs anywhere a timeline dump lands (laptop, CI, a jump pod).
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Any, Optional, Sequence
+
+from kubernetes_cloud_tpu.obs import flops as flops_mod
+from kubernetes_cloud_tpu.obs.flight import PHASES
+
+#: a prefill-bearing iteration counts as a stall when it runs longer
+#: than this multiple of the median decode-only iteration
+STALL_FACTOR = 3.0
+
+
+def _pct(values: Sequence[float], p: float) -> Optional[float]:
+    if not values:
+        return None
+    vals = sorted(values)
+    return vals[min(len(vals) - 1, int(p * len(vals)))]
+
+
+def analyze(entry: dict, *, peak_flops: Optional[float] = None,
+            stall_factor: float = STALL_FACTOR) -> dict[str, Any]:
+    """Analyze one model's timeline entry into the report dict.
+
+    ``peak_flops`` overrides the entry's ``meta.peak_flops_per_s``
+    (e.g. a declared CPU reference); ``None`` with no meta peak means
+    the MFU field stays 0 and only absolute FLOPs/s is reported."""
+    iters: list[dict] = list(entry.get("iterations") or [])
+    reqs: list[dict] = list(entry.get("requests") or [])
+    meta: dict = dict(entry.get("meta") or {})
+    if peak_flops is None:
+        peak_flops = meta.get("peak_flops_per_s")
+
+    busy = sum(r.get("dur_s", 0.0) for r in iters)
+    phase_seconds = {p: 0.0 for p in PHASES}
+    for r in iters:
+        for p, v in (r.get("phases") or {}).items():
+            phase_seconds[p] = phase_seconds.get(p, 0.0) + v
+    accounted = sum(phase_seconds.values())
+    other = max(busy - accounted, 0.0)
+    denom = busy if busy > 0 else 1.0
+    phase_share = {p: v / denom for p, v in phase_seconds.items()}
+    phase_share["other"] = other / denom
+
+    prefill_bearing = [r for r in iters if r.get("admitted", 0) > 0]
+    decode_only = [r for r in iters
+                   if not r.get("admitted", 0) and r.get("active", 0)]
+
+    # span: first record start -> last record end (idle gaps included),
+    # the honest denominator for goodput/MFU rates
+    span = 0.0
+    if iters:
+        span = max((iters[-1].get("ts", 0.0) + iters[-1].get("dur_s", 0.0))
+                   - iters[0].get("ts", 0.0), busy, 1e-9)
+
+    # -- prefill stalls (decode iterations delayed behind prefills) --------
+    stalls: dict[str, Any] = {"count": 0, "stall_s_total": 0.0,
+                              "delayed_slot_steps": 0, "worst_s": 0.0,
+                              "median_decode_s": None,
+                              "threshold_s": None}
+    if decode_only:
+        med = statistics.median(r["dur_s"] for r in decode_only)
+        threshold = stall_factor * med
+        stalls["median_decode_s"] = med
+        stalls["threshold_s"] = threshold
+        for r in prefill_bearing:
+            # only already-running decode slots are *delayed*; the
+            # freshly admitted ones were going to wait regardless
+            delayed = max(r.get("active", 0) - r.get("admitted", 0), 0)
+            if r["dur_s"] > threshold and delayed:
+                over = r["dur_s"] - med
+                stalls["count"] += 1
+                stalls["stall_s_total"] += over
+                stalls["delayed_slot_steps"] += delayed
+                stalls["worst_s"] = max(stalls["worst_s"], over)
+
+    # -- TTFT decomposition ------------------------------------------------
+    ttfts = [r["ttft_s"] for r in reqs if r.get("ttft_s") is not None]
+    queues = [r["queue_s"] for r in reqs if r.get("queue_s") is not None]
+    prefills = [r["prefill_s"] for r in reqs
+                if r.get("prefill_s") is not None]
+    ttft = {
+        "n": len(ttfts),
+        "ttft_mean_s": statistics.mean(ttfts) if ttfts else None,
+        "ttft_p95_s": _pct(ttfts, 0.95),
+        "queue_mean_s": statistics.mean(queues) if queues else None,
+        "queue_p95_s": _pct(queues, 0.95),
+        "prefill_mean_s": statistics.mean(prefills) if prefills else None,
+        "prefill_p95_s": _pct(prefills, 0.95),
+    }
+    if ttfts and queues and ttft["ttft_mean_s"]:
+        ttft["queue_share"] = ttft["queue_mean_s"] / ttft["ttft_mean_s"]
+    else:
+        ttft["queue_share"] = None
+
+    # -- MFU / goodput -----------------------------------------------------
+    flops_total = sum(r.get("flops", 0.0) for r in iters)
+    decode_tokens = sum(r.get("decode_tokens", 0) for r in iters)
+    prefill_tokens = sum(r.get("prefill_tokens", 0) for r in iters)
+    cached_tokens = sum(r.get("cached_tokens", 0) for r in iters)
+    flops_per_s = flops_total / span if span else 0.0
+    mfu_section = {
+        "flops_total": flops_total,
+        "flops_per_s": flops_per_s,
+        "peak_flops_per_s": peak_flops,
+        "mfu": flops_mod.mfu(flops_per_s, peak_flops),
+        "goodput_tokens_per_s": ((decode_tokens + prefill_tokens) / span
+                                 if span else 0.0),
+        "decode_tokens": decode_tokens,
+        "prefill_tokens": prefill_tokens,
+        "cached_tokens": cached_tokens,
+    }
+
+    return {
+        "iterations": {
+            "count": len(iters),
+            "prefill_bearing": len(prefill_bearing),
+            "decode_only": len(decode_only),
+            "busy_s": busy,
+            "span_s": span,
+        },
+        "phase_seconds": phase_seconds,
+        "phase_share": phase_share,
+        "stalls": stalls,
+        "ttft": ttft,
+        "mfu": mfu_section,
+        "meta": meta,
+    }
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    return f"{v * 1e3:.2f}ms"
+
+
+def _fmt_count(v: float) -> str:
+    for unit, scale in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{unit}"
+    return f"{v:.0f}"
+
+
+def render(analysis: dict, name: str = "engine") -> str:
+    """The terminal where-did-the-time-go report for one model."""
+    it = analysis["iterations"]
+    lines = [
+        f"== perf report: {name} ==",
+        f"iterations: {it['count']} "
+        f"({it['prefill_bearing']} prefill-bearing, "
+        f"{it['decode_only']} decode-only)  "
+        f"busy {_fmt_s(it['busy_s'])} over {_fmt_s(it['span_s'])} span",
+        "",
+        "phase share (of busy time):",
+    ]
+    shares = analysis["phase_share"]
+    ordered = [p for p in (*PHASES, "other") if shares.get(p)]
+    width = max((len(p) for p in ordered), default=5)
+    for p in ordered:
+        share = shares[p]
+        secs = (analysis["phase_seconds"].get(p, 0.0) if p != "other"
+                else it["busy_s"] - sum(analysis["phase_seconds"].values()))
+        bar = "#" * int(round(share * 40))
+        lines.append(f"  {p:<{width}}  {share * 100:5.1f}%  "
+                     f"{_fmt_s(max(secs, 0.0)):>9}  {bar}")
+    st = analysis["stalls"]
+    lines.append("")
+    if st["threshold_s"] is None:
+        lines.append("prefill stalls: n/a (no decode-only iterations "
+                     "to baseline against)")
+    elif st["count"] == 0:
+        lines.append(
+            f"prefill stalls: none "
+            f"(threshold {_fmt_s(st['threshold_s'])} = "
+            f"{STALL_FACTOR:g}x median decode "
+            f"{_fmt_s(st['median_decode_s'])})")
+    else:
+        lines.append(
+            f"prefill stalls: {st['count']} iterations over "
+            f"{_fmt_s(st['threshold_s'])} "
+            f"({STALL_FACTOR:g}x median decode "
+            f"{_fmt_s(st['median_decode_s'])})")
+        lines.append(
+            f"  {st['delayed_slot_steps']} decode-slot steps delayed, "
+            f"{_fmt_s(st['stall_s_total'])} total added latency, "
+            f"worst {_fmt_s(st['worst_s'])} "
+            "(chunked prefill is the fix - ROADMAP item 4)")
+    tt = analysis["ttft"]
+    lines.append("")
+    if tt["n"]:
+        lines.append(
+            f"TTFT ({tt['n']} requests): "
+            f"mean {_fmt_s(tt['ttft_mean_s'])} / "
+            f"p95 {_fmt_s(tt['ttft_p95_s'])}")
+        lines.append(
+            f"  queue-wait      mean {_fmt_s(tt['queue_mean_s'])} / "
+            f"p95 {_fmt_s(tt['queue_p95_s'])}")
+        lines.append(
+            f"  prefill-compute mean {_fmt_s(tt['prefill_mean_s'])} / "
+            f"p95 {_fmt_s(tt['prefill_p95_s'])}")
+        if tt["queue_share"] is not None:
+            bound = ("queue-bound (add capacity)"
+                     if tt["queue_share"] > 0.5
+                     else "compute-bound (chunk prefill)")
+            lines.append(f"  queue share of TTFT: "
+                         f"{tt['queue_share'] * 100:.0f}% - {bound}")
+    else:
+        lines.append("TTFT: no completed requests in the window")
+    mf = analysis["mfu"]
+    lines.append("")
+    lines.append(
+        f"throughput: {mf['goodput_tokens_per_s']:.1f} tokens/s "
+        f"({mf['decode_tokens']} decode + {mf['prefill_tokens']} "
+        f"prefill tokens; {mf['cached_tokens']} served by prefix cache)")
+    peak = mf["peak_flops_per_s"]
+    if peak:
+        lines.append(
+            f"MFU: {mf['mfu'] * 100:.2f}% "
+            f"({_fmt_count(mf['flops_per_s'])}FLOP/s of "
+            f"{_fmt_count(peak)}FLOP/s peak)")
+    else:
+        lines.append(
+            f"MFU: n/a (peak unknown - set {flops_mod.PEAK_ENV}); "
+            f"model FLOPs {_fmt_count(mf['flops_per_s'])}FLOP/s")
+    return "\n".join(lines)
+
+
+def summarize(entry: dict, *, peak_flops: Optional[float] = None) -> dict:
+    """The compact benchmark-JSON embedding (``--timeline``): phase
+    share + stall counts + MFU, rounded for a one-line record."""
+    a = analyze(entry, peak_flops=peak_flops)
+    return {
+        "iterations": a["iterations"]["count"],
+        "phase_share": {p: round(v, 4)
+                        for p, v in a["phase_share"].items() if v},
+        "prefill_stalls": a["stalls"]["count"],
+        "stall_s_total": round(a["stalls"]["stall_s_total"], 4),
+        "goodput_tokens_per_s": round(a["mfu"]["goodput_tokens_per_s"], 2),
+        "flops_per_s": a["mfu"]["flops_per_s"],
+        "mfu": round(a["mfu"]["mfu"], 6),
+        "ttft_queue_mean_s": (round(a["ttft"]["queue_mean_s"], 6)
+                              if a["ttft"]["queue_mean_s"] is not None
+                              else None),
+        "ttft_prefill_mean_s": (round(a["ttft"]["prefill_mean_s"], 6)
+                                if a["ttft"]["prefill_mean_s"] is not None
+                                else None),
+    }

@@ -31,6 +31,7 @@ import time
 from typing import Any, Callable, Mapping, Optional, Sequence
 
 from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.obs.flight import FlightRecorder
 from kubernetes_cloud_tpu.obs.tracing import trace
 from kubernetes_cloud_tpu.serve.errors import (  # noqa: F401 - re-export
     DeadlineExceededError,
@@ -157,6 +158,13 @@ class BatchingModel(Model):
         # batching telemetry (the Triton metrics a load test reads)
         self.stats = {"requests": 0, "batches": 0, "batched_instances": 0,
                       "deadline_shed": 0}
+        #: coarse flight recorder: one record per dispatched batch
+        #: (phases: "admit" = straggler coalescing wait, "decode" =
+        #: the batched device dispatch) — the batch-level counterpart
+        #: of the engine's per-iteration ring, served by the same
+        #: GET /debug/timeline.  Survives dispatcher restarts (the
+        #: model object owns it, like stats).
+        self.flight = FlightRecorder(256, request_capacity=0)
         # scrape-facing mirror, label-bound once per model
         m = {"model": name}
         self._m_batches = _M_BATCHES.labels(**m)
@@ -382,6 +390,7 @@ class BatchingModel(Model):
                 return
         if self._shed_expired(first):
             return
+        t_coalesce = time.perf_counter()
         first.claimed = True
         batch = [first]
         total = len(first.instances)
@@ -405,7 +414,8 @@ class BatchingModel(Model):
             batch.append(nxt)
             total += len(nxt.instances)
             deadline = 0  # drain whatever is already queued
-        self._execute(batch)
+        self._execute(batch,
+                      coalesce_s=time.perf_counter() - t_coalesce)
 
     def _drain_on_stop(self) -> None:
         # fail pending requests rather than hang them
@@ -422,7 +432,8 @@ class BatchingModel(Model):
                   error="RetryableError")
             p.event.set()
 
-    def _execute(self, batch: list[_Pending]) -> None:
+    def _execute(self, batch: list[_Pending],
+                 coalesce_s: float = 0.0) -> None:
         instances = [x for p in batch for x in p.instances]
         self.stats["requests"] += len(batch)
         self.stats["batches"] += 1
@@ -464,7 +475,22 @@ class BatchingModel(Model):
             # strand that batch's waiters across the next restart.
             if self._current_batch is batch:
                 self._current_batch = []
-            self._m_dispatch_s.observe(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            self._m_dispatch_s.observe(dt)
+            rec = self.flight.begin()
+            rec.phases = {"admit": coalesce_s, "decode": dt}
+            rec.dur_s = coalesce_s + dt
+            # ts is the interval START everywhere a record is consumed
+            # (rates() windows, timeline correlation) — begin() ran
+            # after the dispatch, so shift it back
+            rec.ts -= rec.dur_s
+            rec.active = len(batch)
+            # a failed dispatch served nothing: goodput must read 0
+            # during an outage, not len(instances)
+            rec.decode_tokens = (0 if batch and batch[0].error is not None
+                                 else len(instances))
+            rec.queue_depth = self._queue.qsize()
+            self.flight.commit(rec)
             for p in batch:
                 trace(p.request_id,
                       "complete" if p.error is None else "failed",

@@ -49,6 +49,13 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
                          "queued→admitted→prefill→decode→first_token→"
                          "complete per request id); unset disables "
                          "tracing — /metrics stays on regardless")
+    ap.add_argument("--profile-dir",
+                    default=os.environ.get("KCT_PROFILE_DIR",
+                                           "/tmp/kct-profile"),
+                    help="jax.profiler trace output dir for "
+                         "GET /debug/profile?seconds=N windows "
+                         "(TensorBoard-readable; PVC-mount it to pull "
+                         "traces off a pod)")
 
 
 def install_tracer(args) -> None:
@@ -103,7 +110,11 @@ def make_server(models: Iterable[Model], args):
         args.frontend == "auto" and native_server.available())
     cls = native_server.NativeModelServer if use_native else ModelServer
     log.info("front-end: %s", cls.__name__)
-    return cls(models, port=args.port)
+    server = cls(models, port=args.port)
+    profile_dir = getattr(args, "profile_dir", None)
+    if profile_dir:
+        server.profiler.trace_dir = profile_dir
+    return server
 
 
 def install_sigterm_drain(server, drain_timeout: float = 30.0) -> bool:

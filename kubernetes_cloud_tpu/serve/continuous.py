@@ -62,6 +62,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.obs import flops as obs_flops
+from kubernetes_cloud_tpu.obs.flight import PHASES, FlightRecorder
 from kubernetes_cloud_tpu.obs.tracing import trace
 from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig
 from kubernetes_cloud_tpu.models.generate import (
@@ -105,8 +107,27 @@ _M_ITERS = obs.counter(
     ("model",))
 _M_ITER_S = obs.histogram(
     "kct_engine_iteration_seconds",
-    "Wall time of one decode_step_slots dispatch (= per-token latency "
-    "for every active request that iteration).", ("model",))
+    "Wall time of one scheduler pass, split by kind: phase=\"prefill\" "
+    "passes admitted at least one request (prefill stalls live here), "
+    "phase=\"decode\" ran the decode step only (= per-token latency "
+    "for every active request).", ("model", "phase"))
+_M_PHASE_S = obs.counter(
+    "kct_engine_phase_seconds_total",
+    "Seconds accumulated in each named scheduler phase (admit | "
+    "cow_copy | prefill | decode | sample | stream | host_sync); "
+    "rate() over two phases gives the live phase share.  Recorded "
+    "only while the flight recorder is enabled (its default).",
+    ("model", "phase"))
+_M_MFU = obs.gauge(
+    "kct_engine_mfu",
+    "Model-FLOPs utilization over the trailing flight-recorder "
+    "window: analytical FLOPs/s for tokens actually served over the "
+    "chip's dense peak (0 while the peak is unknown - set "
+    "KCT_PEAK_FLOPS).", ("model",))
+_M_GOODPUT = obs.gauge(
+    "kct_engine_goodput_tokens_per_s",
+    "Tokens served per second (decode + computed prefill) over the "
+    "trailing flight-recorder window.", ("model",))
 _M_ADMITTED = obs.counter(
     "kct_engine_admitted_total", "Requests admitted into slots.",
     ("model",))
@@ -192,10 +213,18 @@ class EngineConfig:
     #: paged decode attention: "gather" (pure jnp, runs anywhere) or
     #: "pallas" (Mosaic paged-attention kernel, TPU)
     attn_impl: str = "gather"
+    #: flight-recorder ring capacity: per-iteration phase records kept
+    #: in bounded memory for ``GET /debug/timeline``.  Always on by
+    #: default (the recorder is memory-only); 0 disables it — the A/B
+    #: knob the overhead benchmark flips (BENCHMARKS.md "Flight
+    #: recorder overhead").
+    flight_records: int = 1024
 
     def __post_init__(self):
         if self.slots < 1:
             raise ValueError("slots must be >= 1")
+        if self.flight_records < 0:
+            raise ValueError("flight_records must be >= 0")
         if self.max_len < 2:
             raise ValueError("max_len must be >= 2")
         if self.max_queue_size < 1:
@@ -234,9 +263,9 @@ class GenRequest:
 
     __slots__ = ("prompt_ids", "max_new_tokens", "temperature", "top_k",
                  "top_p", "rng", "tokens", "stream", "event", "error",
-                 "claimed", "cancelled", "submitted_at", "first_token_at",
-                 "done_at", "deadline", "engine", "request_id",
-                 "cached_tokens")
+                 "claimed", "cancelled", "submitted_at", "admitted_at",
+                 "first_token_at", "done_at", "deadline", "engine",
+                 "request_id", "cached_tokens")
 
     def __init__(self, prompt_ids: Sequence[int], *, max_new_tokens: int,
                  temperature: float, top_k: int, top_p: float, seed: int,
@@ -257,6 +286,10 @@ class GenRequest:
         self.claimed = False
         self.cancelled = False
         self.submitted_at = time.monotonic()
+        #: when the scheduler claimed the request (TTFT decomposes as
+        #: queue-wait = admitted_at - submitted_at, prefill-compute =
+        #: first_token_at - admitted_at)
+        self.admitted_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.done_at: Optional[float] = None
         #: absolute monotonic deadline (None = wait forever); expired
@@ -495,11 +528,36 @@ class ContinuousBatchingEngine:
                       "prompt_tokens": 0, "prefix_hits": 0,
                       "prefix_tokens_saved": 0, "cow_copies": 0,
                       "peak_active": 0}
+        #: always-on flight recorder: bounded ring of per-iteration
+        #: phase timings + batch composition (GET /debug/timeline);
+        #: flight_records=0 disables it for overhead A/Bs.  A restart
+        #: builds a fresh engine and therefore a fresh ring, like stats.
+        self.flight: Optional[FlightRecorder] = (
+            FlightRecorder(engine_cfg.flight_records)
+            if engine_cfg.flight_records else None)
+        #: the record of the scheduler pass currently in flight (owned
+        #: by the scheduler thread; helpers like _emit/_finish_slot
+        #: accumulate into it)
+        self._rec = None
+        # analytical FLOPs coefficients: one token at context c costs
+        # base + per_ctx * c (obs/flops.py); precomputed so the hot
+        # loop pays two multiply-adds per iteration
+        self._flops_base, self._flops_per_ctx = \
+            obs_flops.decode_flops_coeffs(cfg)
+        self._peak_flops = obs_flops.peak_flops_per_s()
+        self._rates_at = 0.0  # last MFU/goodput gauge refresh (gated)
         # scrape-facing mirror: label-bound children resolved once so the
         # per-iteration cost is attribute access, not dict lookups
         m = {"model": self.name}
         self._m_iters = _M_ITERS.labels(**m)
-        self._m_iter_s = _M_ITER_S.labels(**m)
+        self._m_iter_prefill = _M_ITER_S.labels(model=self.name,
+                                                phase="prefill")
+        self._m_iter_decode = _M_ITER_S.labels(model=self.name,
+                                               phase="decode")
+        self._m_phase = {p: _M_PHASE_S.labels(model=self.name, phase=p)
+                         for p in PHASES}
+        self._m_mfu = _M_MFU.labels(**m)
+        self._m_goodput = _M_GOODPUT.labels(**m)
         self._m_admitted = _M_ADMITTED.labels(**m)
         self._m_evicted = _M_EVICTED.labels(**m)
         self._m_cancelled = _M_CANCELLED.labels(**m)
@@ -745,6 +803,7 @@ class ContinuousBatchingEngine:
         already won admission once."""
         req.engine = self
         req.claimed = False
+        req.admitted_at = None  # queue-wait restarts on the new engine
         with self._qlock:
             self._queue.append(req)
         self._work.set()
@@ -764,6 +823,80 @@ class ContinuousBatchingEngine:
             self._queue.clear()
         self._fail_active(err)
         return queued
+
+    # -- debug plane (GET /debug/*) ----------------------------------------
+    # Read-only snapshots taken from HTTP threads while the scheduler
+    # runs.  Everything here reads Python-atomic references (or retries
+    # the rare mid-mutation dict copy); the scheduler is never paused —
+    # the debug plane observes the data plane, it must not wedge it.
+
+    def debug_meta(self) -> dict:
+        """Config + analytical constants the timeline analyzer needs."""
+        meta = {"slots": self.ecfg.slots, "max_len": self.ecfg.max_len,
+                "paged": self.paged, "alive": self.alive,
+                "flops_base": self._flops_base,
+                "flops_per_ctx": self._flops_per_ctx,
+                "peak_flops_per_s": self._peak_flops,
+                "iter_s_ewma": self.iter_s,
+                "flight_records": self.ecfg.flight_records}
+        if self.paged:
+            meta["page_size"] = self.ecfg.page_size
+            meta["num_pages"] = self.ecfg.effective_num_pages
+        return meta
+
+    def debug_slots(self) -> list[dict]:
+        """Per-slot occupancy: who is decoding, how far along."""
+        now = time.monotonic()
+        out = []
+        for i, req in enumerate(list(self._slots)):
+            if req is None:
+                out.append({"slot": i, "state": "free"})
+                continue
+            entry = {"slot": i, "state": "decoding",
+                     "request_id": req.request_id,
+                     "prompt_tokens": len(req.prompt_ids),
+                     "tokens_out": len(req.tokens),
+                     "max_new_tokens": req.max_new_tokens,
+                     "cached_tokens": req.cached_tokens,
+                     "age_s": round(now - req.submitted_at, 3)}
+            if req.deadline is not None:
+                entry["deadline_in_s"] = round(req.deadline - now, 3)
+            if self.paged:
+                pages = self._slot_pages[i]
+                entry["pages"] = len(pages) if pages else 0
+                entry["context_len"] = int(self._lengths[i])
+            out.append(entry)
+        return out
+
+    def debug_pages(self) -> Optional[dict]:
+        """Page-arena occupancy + prefix-cache contents (hashes with
+        refcounts and LRU order — block HASHES, never prompt content);
+        ``None`` for the dense slot pool."""
+        if not self.paged or self.allocator is None:
+            return None
+        snap = None
+        for _ in range(3):  # dict copies can race a mid-pass mutation
+            try:
+                snap = self.allocator.snapshot()
+                break
+            except RuntimeError:
+                continue
+        if snap is None:
+            return {"error": "allocator busy; retry"}
+        live_rows = int(sum(int(n) for n in self._lengths))
+        reserved_rows = snap["used_pages"] * self.ecfg.page_size
+        snap["live_rows"] = live_rows
+        snap["reserved_rows"] = reserved_rows
+        # what kct_engine_kv_utilization now reports in paged mode
+        snap["utilization"] = round(
+            snap["used_pages"] / max(snap["capacity"], 1), 6)
+        # internal fragmentation: reserved (worst-case) rows not yet
+        # holding live context — the admission-time-reservation cost
+        # preemption-based growth (ROADMAP item 2/4 follow-up) removes
+        snap["fragmentation"] = (
+            round(1.0 - live_rows / reserved_rows, 4)
+            if reserved_rows else 0.0)
+        return snap
 
     # -- scheduler ---------------------------------------------------------
 
@@ -817,13 +950,28 @@ class ContinuousBatchingEngine:
                                             active)
         if self.paged and self.allocator is not None:
             alloc = self.allocator
-            self._m_kv_util.set(
-                used / (alloc.capacity * self.ecfg.page_size))
+            # TRUE page-arena utilization: pages reserved by live
+            # requests (or pinned by the cache at refcount > 0) over
+            # allocatable pages (null page excluded) — what
+            # /debug/pages shows and what capacity planning needs.
+            # The old live-token-rows ratio understated pressure: a
+            # full arena of worst-case reservations read as nearly
+            # empty right after admission.
+            self._m_kv_util.set(alloc.used_pages()
+                                / max(alloc.capacity, 1))
             self._m_kv_pages.set(alloc.capacity)
             self._m_kv_pages_free.set(alloc.free_pages())
         else:
             self._m_kv_util.set(
                 used / (self.ecfg.slots * self.ecfg.max_len))
+        if self.flight is not None:
+            now = time.monotonic()
+            if now - self._rates_at >= 0.5:  # gate: rates() scans the
+                self._rates_at = now         # ring, not per-pass work
+                rates = self.flight.rates()
+                self._m_goodput.set(rates["tokens_per_s"])
+                self._m_mfu.set(obs_flops.mfu(rates["flops_per_s"],
+                                              self._peak_flops))
 
     def _shed(self, request_id: Optional[str], reason: str) -> None:
         _M_SHED.labels(model=self.name, reason=reason).inc()
@@ -831,11 +979,31 @@ class ContinuousBatchingEngine:
 
     def _step(self, stopping: bool) -> None:
         faults.fire("iteration")
+        fr = self.flight
+        rec = self._rec = fr.begin() if fr is not None else None
+        t_pass = time.perf_counter()
+        if rec is not None:
+            rec.queue_depth = self.queue_depth()
         self._reap_cancelled()
+        admitted = 0
         if not stopping:
-            self._admit()
+            t_admit = time.perf_counter()
+            admitted = self._admit()
+            if rec is not None:
+                # pure scheduler bookkeeping: the admit wall minus the
+                # device/emit phases _admit_* already accounted
+                overhead = (time.perf_counter() - t_admit
+                            - rec.phases.get("prefill", 0.0)
+                            - rec.phases.get("cow_copy", 0.0)
+                            - rec.phases.get("sample", 0.0)
+                            - rec.phases.get("stream", 0.0))
+                if overhead > 0:
+                    rec.phases["admit"] = overhead
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
+            if admitted:  # every admission finished inside its prefill
+                self._m_iter_prefill.observe(time.perf_counter() - t_pass)
+            self._commit_rec(t_pass)
             if not stopping:
                 self._work.clear()
                 if not self._queue:
@@ -843,12 +1011,17 @@ class ContinuousBatchingEngine:
             return
         tokens = np.full((self.ecfg.slots,), self.pad, np.int32)
         mask = np.zeros((self.ecfg.slots,), bool)
+        ctx_sum = 0  # analytical-FLOPs accounting (each new token
+        # attends its whole context, itself included)
         for i in active:
-            tokens[i] = self._slots[i].tokens[-1]
+            req = self._slots[i]
+            tokens[i] = req.tokens[-1]
             mask[i] = True
+            ctx_sum += min(len(req.prompt_ids) + len(req.tokens) + 1,
+                           self.ecfg.max_len)
         faults.fire("decode_step")
         faults.fire("model_fn")
-        t0 = time.monotonic()
+        t0 = time.perf_counter()
         if self.paged:
             logits, self.pool = self._decode_pages(
                 self.cfg, self.params, jnp.asarray(tokens), self.pool,
@@ -863,16 +1036,48 @@ class ContinuousBatchingEngine:
             logits, self.pool = self._decode(self.cfg, self.params,
                                              jnp.asarray(tokens), self.pool,
                                              jnp.asarray(mask))
+        # decode = dispatch + device compute; host_sync = the
+        # device→host logits copy (the split the flight recorder
+        # reports; the explicit block costs nothing — asarray would
+        # have blocked on the same computation)
+        logits.block_until_ready()
+        t1 = time.perf_counter()
         logits = np.asarray(logits)
-        dt = time.monotonic() - t0
+        t2 = time.perf_counter()
+        dt = t2 - t0
         self.iter_s = dt if self.iter_s is None else (
             0.9 * self.iter_s + 0.1 * dt)
         self.stats["iterations"] += 1
         self.stats["active_slot_steps"] += len(active)
         self._m_iters.inc()
-        self._m_iter_s.observe(dt)
+        if rec is not None:
+            rec.phases["decode"] = rec.phases.get("decode", 0.0) \
+                + (t1 - t0)
+            rec.phases["host_sync"] = rec.phases.get("host_sync", 0.0) \
+                + (t2 - t1)
+            rec.active = len(active)
+            rec.decode_tokens = len(active)
+            rec.flops += (len(active) * self._flops_base
+                          + self._flops_per_ctx * ctx_sum)
         for i in active:
             self._emit(i, logits[i])
+        (self._m_iter_prefill if admitted else self._m_iter_decode
+         ).observe(time.perf_counter() - t_pass)
+        self._commit_rec(t_pass)
+
+    def _commit_rec(self, t_pass: float) -> None:
+        """Publish the pass's flight record (if it did any work) and
+        feed the per-phase counters; idle polls stay off the ring."""
+        rec, self._rec = self._rec, None
+        if rec is None:
+            return
+        if not (rec.active or rec.admitted or rec.evicted
+                or rec.decode_tokens):
+            return
+        rec.dur_s = time.perf_counter() - t_pass
+        for phase, secs in rec.phases.items():
+            self._m_phase[phase].inc(secs)
+        self.flight.commit(rec)
 
     def _reap_cancelled(self) -> None:
         for i, req in enumerate(self._slots):
@@ -940,22 +1145,26 @@ class ContinuousBatchingEngine:
                                 + self.ecfg.compile_grace_s)
         return cold
 
-    def _admit(self) -> None:
+    def _admit(self) -> int:
+        """Admit queued requests into free slots; returns how many (a
+        prefill-bearing pass is what the phase-labeled iteration
+        histogram and the stall analysis key on)."""
         free = [i for i, s in enumerate(self._slots) if s is None]
         budget = min(len(free), self.ecfg.max_admit_per_step)
         if self.paged:
-            self._admit_paged(free, budget)
-        else:
-            self._admit_slots(free, budget)
+            return self._admit_paged(free, budget)
+        return self._admit_slots(free, budget)
 
-    def _admit_slots(self, free: list[int], budget: int) -> None:
+    def _admit_slots(self, free: list[int], budget: int) -> int:
         batch: list[GenRequest] = []
         while len(batch) < budget:
             req = self._pop_admittable()
             if req is None:
                 break
             req.claimed = True
-            trace(req.request_id, "admitted", model=self.name)
+            req.admitted_at = time.monotonic()
+            trace(req.request_id, "admitted", model=self.name,
+                  queue_s=round(req.admitted_at - req.submitted_at, 6))
             batch.append(req)
         # Claimed but not yet slotted: visible to the failure paths
         # until every group lands in _slots (cleared at the end; a
@@ -979,10 +1188,15 @@ class ContinuousBatchingEngine:
             shape_key = (bucket, len(group))
             cold = self._prefill_cold_guard(shape_key)
             faults.fire("model_fn")
+            t0 = time.perf_counter()
             logits, self.pool = self._prefill(
                 self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
                 self.pool, jnp.asarray(slots, jnp.int32))
             logits = np.asarray(logits)
+            rec = self._rec
+            if rec is not None:
+                rec.phases["prefill"] = rec.phases.get("prefill", 0.0) \
+                    + (time.perf_counter() - t0)
             if cold:
                 self._warm_shapes.add(shape_key)
                 self.grace_until = 0.0  # compiled; wedges detect normally
@@ -992,6 +1206,12 @@ class ContinuousBatchingEngine:
                 self.stats["prefill_tokens"] += len(req.prompt_ids)
                 self.stats["prompt_tokens"] += len(req.prompt_ids)
                 self._m_admitted.inc()
+                if rec is not None:
+                    rec.admitted += 1
+                    rec.prefill_tokens += len(req.prompt_ids)
+                    rec.flops += obs_flops.span_flops(
+                        self._flops_base, self._flops_per_ctx, 0,
+                        len(req.prompt_ids))
                 trace(req.request_id, "prefill", model=self.name,
                       slot=slot, bucket=bucket)
                 # the slot now joins the persistent decode batch; emit
@@ -1000,13 +1220,15 @@ class ContinuousBatchingEngine:
                 trace(req.request_id, "decode", model=self.name, slot=slot)
                 self._emit(slot, logits[r])
         self._admitting = []
+        return len(batch)
 
-    def _admit_paged(self, free: list[int], budget: int) -> None:
+    def _admit_paged(self, free: list[int], budget: int) -> int:
         """Paged admission: reserve pages (reusing cached prefix blocks)
         per request, then prefill only the uncached tails, grouped by
         tail-length bucket.  A reservation that cannot be satisfied
         right now puts the request back at the queue head — pages free
         as decoding slots evict, exactly like waiting for a free slot."""
+        rec = self._rec
         batch: list[tuple[GenRequest, Any]] = []
         while len(batch) < budget:
             req = self._pop_admittable()
@@ -1023,8 +1245,10 @@ class ContinuousBatchingEngine:
                     self._queue.appendleft(req)
                 break
             req.claimed = True
+            req.admitted_at = time.monotonic()
             req.cached_tokens = res.cached_tokens
-            trace(req.request_id, "admitted", model=self.name)
+            trace(req.request_id, "admitted", model=self.name,
+                  queue_s=round(req.admitted_at - req.submitted_at, 6))
             batch.append((req, res))
         self._admitting = [req for req, _ in batch]
         # Every copy-on-write page copy is dispatched BEFORE any prefill
@@ -1032,14 +1256,20 @@ class ContinuousBatchingEngine:
         # physical page for a later reservation in the same batch, and
         # the copy must read it before that reservation's prefill
         # overwrites it.
+        t_cow = time.perf_counter()
+        any_cow = False
         for req, res in batch:
             if res.cow is not None:
                 src, dst = res.cow
+                any_cow = True
                 self.stats["cow_copies"] += 1
                 self._m_cow.inc()
                 self.pool = self._copy_pages(
                     self.pool, jnp.asarray([src], jnp.int32),
                     jnp.asarray([dst], jnp.int32))
+        if rec is not None and any_cow:
+            rec.phases["cow_copy"] = rec.phases.get("cow_copy", 0.0) \
+                + (time.perf_counter() - t_cow)
         by_bucket: dict[int, list[tuple[GenRequest, Any]]] = {}
         for req, res in batch:
             tail = len(req.prompt_ids) - res.cached_tokens
@@ -1060,10 +1290,14 @@ class ContinuousBatchingEngine:
             shape_key = ("paged", bucket, len(group))
             cold = self._prefill_cold_guard(shape_key)
             faults.fire("model_fn")
+            t0 = time.perf_counter()
             logits, self.pool = self._prefill_pages(
                 self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
                 self.pool, jnp.asarray(tables), jnp.asarray(start))
             logits = np.asarray(logits)
+            if rec is not None:
+                rec.phases["prefill"] = rec.phases.get("prefill", 0.0) \
+                    + (time.perf_counter() - t0)
             if cold:
                 self._warm_shapes.add(shape_key)
                 self.grace_until = 0.0
@@ -1087,12 +1321,23 @@ class ContinuousBatchingEngine:
                     self._m_prefix_hits.inc()
                     self._m_prefix_tokens.inc(res.cached_tokens)
                 self._m_admitted.inc()
+                if rec is not None:
+                    rec.admitted += 1
+                    rec.prefill_tokens += plen - res.cached_tokens
+                    rec.cached_tokens += res.cached_tokens
+                    rec.pages_reserved += len(res.pages)
+                    if res.cached_tokens:
+                        rec.prefix_hits += 1
+                    rec.flops += obs_flops.span_flops(
+                        self._flops_base, self._flops_per_ctx,
+                        res.cached_tokens, plen - res.cached_tokens)
                 trace(req.request_id, "prefill", model=self.name,
                       slot=slot, bucket=bucket,
                       cached_tokens=res.cached_tokens)
                 trace(req.request_id, "decode", model=self.name, slot=slot)
                 self._emit(slot, logits[r])
         self._admitting = []
+        return len(batch)
 
     def _bucket(self, n: int) -> int:
         """Power-of-two prompt bucket (same rationale as
@@ -1108,16 +1353,27 @@ class ContinuousBatchingEngine:
         slot if the request just finished — ordering identical to
         :func:`models.generate.generate`'s sample→emit→check-eos loop."""
         req = self._slots[slot]
+        t0 = time.perf_counter()
         tok = _sample_host(logits_row, req.rng, temperature=req.temperature,
                            top_k=req.top_k, top_p=req.top_p)
+        t1 = time.perf_counter()
         if req.first_token_at is None:
             req.first_token_at = time.monotonic()
             self._m_ttft.observe(req.first_token_at - req.submitted_at)
             trace(req.request_id, "first_token", model=self.name,
-                  ttft_s=round(req.first_token_at - req.submitted_at, 6))
+                  ttft_s=round(req.first_token_at - req.submitted_at, 6),
+                  prefill_s=round(req.first_token_at
+                                  - (req.admitted_at or req.submitted_at),
+                                  6))
         req.tokens.append(tok)
         if faults.fire("stream") != "drop":  # "drop" loses the delivery
             req.stream.put(tok)
+        rec = self._rec
+        if rec is not None:
+            ph = rec.phases
+            ph["sample"] = ph.get("sample", 0.0) + (t1 - t0)
+            ph["stream"] = ph.get("stream", 0.0) \
+                + (time.perf_counter() - t1)
         self.stats["emitted_tokens"] += 1
         self._m_tokens.inc()
         if ((self.eos is not None and tok == self.eos)
@@ -1130,6 +1386,9 @@ class ContinuousBatchingEngine:
         self._slots[slot] = None
         self.stats["evictions"] += 1
         self._m_evicted.inc()
+        rec = self._rec
+        if rec is not None:
+            rec.evicted += 1
         if self.paged:
             # Drop the page claim (shared prefix pages survive while
             # siblings reference them; cached ones park in the LRU) and
@@ -1138,6 +1397,8 @@ class ContinuousBatchingEngine:
             pages, self._slot_pages[slot] = self._slot_pages[slot], None
             if pages:
                 self.allocator.release(pages)
+                if rec is not None:
+                    rec.pages_freed += len(pages)
             self._page_table[slot, :] = 0
             self._page_table_dirty = True
             self._lengths[slot] = 0
@@ -1152,6 +1413,23 @@ class ContinuousBatchingEngine:
         trace(req.request_id, _terminal_span(error), model=self.name,
               tokens=len(req.tokens),
               duration_s=round(req.done_at - req.submitted_at, 6))
+        if self.flight is not None:
+            summary = {"request_id": req.request_id, "ts": time.time(),
+                       "outcome": _terminal_span(error),
+                       "tokens": len(req.tokens),
+                       "prompt_tokens": len(req.prompt_ids),
+                       "cached_tokens": req.cached_tokens,
+                       "duration_s": round(req.done_at - req.submitted_at,
+                                           6)}
+            if req.first_token_at is not None:
+                summary["ttft_s"] = round(
+                    req.first_token_at - req.submitted_at, 6)
+                if req.admitted_at is not None:
+                    summary["queue_s"] = round(
+                        req.admitted_at - req.submitted_at, 6)
+                    summary["prefill_s"] = round(
+                        req.first_token_at - req.admitted_at, 6)
+            self.flight.record_request(summary)
         req.stream.put(_STREAM_END)
         req.event.set()
 
@@ -1315,8 +1593,15 @@ class ContinuousBatchingModel(Model):
                "cached_tokens": req.cached_tokens}
         if req.first_token_at is not None:
             # client-visible TTFT (load_test reports its distribution
-            # and checks it against the server-side histogram)
+            # and checks it against the server-side histogram),
+            # decomposed into queue-wait vs prefill-compute so slow
+            # first tokens are attributable (capacity vs chunking)
             out["ttft_s"] = round(req.first_token_at - req.submitted_at, 6)
+            if req.admitted_at is not None:
+                out["ttft_queue_s"] = round(
+                    req.admitted_at - req.submitted_at, 6)
+                out["ttft_prefill_s"] = round(
+                    req.first_token_at - req.admitted_at, 6)
         return out
 
     def predict(self, payload: Mapping[str, Any]) -> dict:
@@ -1359,4 +1644,5 @@ def load_engine_config(model_dir: str) -> EngineConfig:
         page_size=int(cb.get("page_size", base.page_size)),
         num_pages=int(cb.get("num_pages", base.num_pages)),
         attn_impl=str(cb.get("attn_impl", base.attn_impl)),
+        flight_records=int(cb.get("flight_records", base.flight_records)),
     )

@@ -306,6 +306,11 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--num-pages", type=int, default=0,
                     help="paged mode: arena pages incl. the null page "
                          "(0 = equal bytes with the slot pool)")
+    ap.add_argument("--flight-records", type=int, default=-1,
+                    help="continuous batching: flight-recorder ring "
+                         "capacity (per-iteration phase records for "
+                         "GET /debug/timeline; 0 disables, -1 keeps "
+                         "the default/model_config.json value)")
     ap.add_argument("--max-seq-len", type=int, default=0)
     ap.add_argument("--config", default=None,
                     help="model_config.json for batcher knobs")
@@ -382,6 +387,8 @@ def main(argv: Optional[list] = None) -> int:
             overrides["page_size"] = args.page_size
         if args.num_pages > 0:
             overrides["num_pages"] = args.num_pages
+        if args.flight_records >= 0:
+            overrides["flight_records"] = args.flight_records
         if overrides:
             ecfg = dataclasses.replace(ecfg, **overrides)
         svc = ContinuousBatchingModel(svc.name, svc, ecfg)

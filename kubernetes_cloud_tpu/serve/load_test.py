@@ -66,6 +66,11 @@ class Result:
     #: continuous-batching engine attaches ``ttft_s`` per prediction);
     #: None when the endpoint doesn't report it
     ttft: Optional[float] = None
+    #: TTFT decomposition (engine-attached ``ttft_queue_s`` /
+    #: ``ttft_prefill_s``): time queued before the scheduler claimed
+    #: the request vs prefill compute until the first token
+    ttft_queue: Optional[float] = None
+    ttft_prefill: Optional[float] = None
     #: prompt tokens submitted / served from the server's prefix cache
     #: (paged engine attaches both per prediction); 0 otherwise
     prompt_tokens: int = 0
@@ -115,6 +120,10 @@ class Summary:
         cached = sum(r.cached_tokens for r in self.results if r.ok)
         ttfts = sorted(r.ttft for r in self.results
                        if r.ok and r.ttft is not None)
+        queues = sorted(r.ttft_queue for r in self.results
+                        if r.ok and r.ttft_queue is not None)
+        prefills = sorted(r.ttft_prefill for r in self.results
+                          if r.ok and r.ttft_prefill is not None)
         outcomes: dict[str, int] = {}
         for r in self.results:
             outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
@@ -153,6 +162,16 @@ class Summary:
             if ttfts else None,
             "ttft_p50_s": pct(0.50, ttfts),
             "ttft_p95_s": pct(0.95, ttfts),
+            # TTFT decomposition (engine-attached): time queued before
+            # the scheduler claimed the request vs prefill compute —
+            # the split that says whether slow first tokens need more
+            # replicas (queue-bound) or chunked prefill (compute-bound)
+            "ttft_queue_mean_s": round(statistics.mean(queues), 4)
+            if queues else None,
+            "ttft_queue_p95_s": pct(0.95, queues),
+            "ttft_prefill_mean_s": round(statistics.mean(prefills), 4)
+            if prefills else None,
+            "ttft_prefill_p95_s": pct(0.95, prefills),
             # prefill accounting (paged engine attaches prompt_tokens /
             # cached_tokens per prediction): what prefill actually cost
             # vs what the prefix cache absorbed
@@ -164,23 +183,31 @@ class Summary:
         }
 
 
-def _parse_response(body: bytes
-                    ) -> tuple[int, Optional[float], int, int]:
-    """Extract (tokens_out sum, first ttft_s, prompt_tokens sum,
-    cached_tokens sum) from a V1 response body (LM endpoints attach
-    them per prediction); zeros/None otherwise."""
+def _parse_response(body: bytes) -> dict:
+    """Extract the LM accounting fields a V1 response attaches per
+    prediction (token counts summed, first TTFT + its queue/prefill
+    decomposition); zeros/None otherwise."""
     try:
         obj = json.loads(body)
         preds = [p for p in obj.get("predictions", [])
                  if isinstance(p, dict)]
-        toks = sum(int(p.get("tokens_out", 0)) for p in preds)
-        ttft = next((float(p["ttft_s"]) for p in preds
-                     if p.get("ttft_s") is not None), None)
-        prompt = sum(int(p.get("prompt_tokens", 0)) for p in preds)
-        cached = sum(int(p.get("cached_tokens", 0)) for p in preds)
-        return toks, ttft, prompt, cached
+
+        def first(key):
+            return next((float(p[key]) for p in preds
+                         if p.get(key) is not None), None)
+
+        return {
+            "tokens_out": sum(int(p.get("tokens_out", 0)) for p in preds),
+            "ttft": first("ttft_s"),
+            "ttft_queue": first("ttft_queue_s"),
+            "ttft_prefill": first("ttft_prefill_s"),
+            "prompt_tokens": sum(int(p.get("prompt_tokens", 0))
+                                 for p in preds),
+            "cached_tokens": sum(int(p.get("cached_tokens", 0))
+                                 for p in preds),
+        }
     except (ValueError, TypeError, AttributeError):
-        return 0, None, 0, 0
+        return {}
 
 
 def _one_request(url: str, payload: bytes, timeout: float,
@@ -191,10 +218,8 @@ def _one_request(url: str, payload: bytes, timeout: float,
         req = urllib.request.Request(url, data=payload, headers=hdrs)
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             body = resp.read()
-            toks, ttft, prompt, cached = _parse_response(body)
             return Result(time.monotonic() - t0, resp.status,
-                          tokens_out=toks, ttft=ttft,
-                          prompt_tokens=prompt, cached_tokens=cached)
+                          **_parse_response(body))
     except urllib.error.HTTPError as e:
         # keep the real status — the outcome breakdown needs to tell a
         # 503 shed from a 504 deadline miss from a genuine 500
@@ -270,6 +295,31 @@ def metrics_endpoint(target_url: str) -> str:
     parts = urllib.parse.urlsplit(target_url)
     return urllib.parse.urlunsplit(
         (parts.scheme, parts.netloc, "/metrics", "", ""))
+
+
+def timeline_endpoint(target_url: str, last: int = 4096) -> str:
+    """Derive the ``/debug/timeline`` URL from the driven URL."""
+    import urllib.parse
+
+    parts = urllib.parse.urlsplit(target_url)
+    return urllib.parse.urlunsplit(
+        (parts.scheme, parts.netloc, "/debug/timeline",
+         f"last={last}", ""))
+
+
+def snapshot_timeline(target_url: str, last: int = 4096,
+                      timeout: float = 10.0) -> dict:
+    """Fetch the server's flight-recorder dump and reduce each model's
+    timeline to the phase-share + MFU summary
+    (:func:`kubernetes_cloud_tpu.obs.report.summarize`) — the
+    ``--timeline`` embedding for benchmark JSON records."""
+    from kubernetes_cloud_tpu.obs import report
+
+    with urllib.request.urlopen(timeline_endpoint(target_url, last),
+                                timeout=timeout) as resp:
+        dump = json.loads(resp.read())
+    return {name: report.summarize(entry)
+            for name, entry in dump.get("models", {}).items()}
 
 
 def check_metrics(before: list, after: list, target_url: str,
@@ -370,6 +420,11 @@ def main(argv=None) -> dict:
                          "the server's request histogram count delta "
                          "matches this client's request count (exit 2 "
                          "on disagreement)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="snapshot GET /debug/timeline after the run "
+                         "and embed each model's phase-share + MFU "
+                         "summary (flight-recorder breakdown) in the "
+                         "output JSON")
     args = ap.parse_args(argv)
 
     payloads = build_payloads(args)
@@ -407,6 +462,13 @@ def main(argv=None) -> dict:
         stats["metrics_check"] = check_metrics(
             before, after, args.url, client_n,
             client_responded=responded)
+    if args.timeline:
+        try:
+            stats["timeline"] = snapshot_timeline(args.url)
+        except Exception as e:  # noqa: BLE001 - introspection is
+            # best-effort: a pod without the debug plane (old build,
+            # recorder disabled) must not fail the load test itself
+            stats["timeline"] = {"error": str(e)}
     print(json.dumps(stats))
     if args.check_metrics and not stats["metrics_check"]["ok"]:
         raise SystemExit(2)  # server lost (or double-counted) requests

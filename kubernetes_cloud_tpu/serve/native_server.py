@@ -10,6 +10,15 @@ C++ threads that never touch the GIL; each parsed request enters Python
 once through a ctypes callback into the exact same ``handle()`` routing
 the stdlib server uses — so both front-ends serve identical APIs and
 the pure-Python ``ModelServer`` remains the no-toolchain fallback.
+
+That shared-``handle()`` split is why the observability plane needs no
+native code: ``GET /metrics`` (the :class:`~kubernetes_cloud_tpu.serve.
+server.TextResponse` path carrying the Prometheus content type through
+``hs_respond``) and the ``GET /debug/*`` introspection endpoints
+(flight-recorder timeline, slot/page occupancy, profiler windows —
+plain JSON) ride the same callback, and the ``debug.render`` /
+``metrics.render`` containment contract holds identically on native
+threads (tests/test_debug_endpoints.py drives both front-ends).
 """
 
 from __future__ import annotations

@@ -253,6 +253,35 @@ class PageAllocator:
                 self._cached[h] = page
                 self._page_hash[page] = h
 
+    def snapshot(self) -> dict:
+        """Read-only occupancy + prefix-cache dump for the debug plane
+        (``GET /debug/pages``).  Exposes block *hashes* (hex of the
+        chained hash), refcounts, and LRU order — never token content:
+        a hash certifies identity to someone who already holds the
+        prompt, it reveals nothing to someone who doesn't."""
+        lru = list(self._lru)
+        lru_pos = {p: i for i, p in enumerate(lru)}
+        cache = []
+        for page, h in sorted(self._page_hash.items()):
+            cache.append({
+                "page": page,
+                "hash": format(h & ((1 << 64) - 1), "016x"),
+                "refcount": self._refcnt[page],
+                "lru_position": lru_pos.get(page),  # None = in live use
+            })
+        return {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "capacity": self.capacity,
+            "used_pages": self.used_pages(),
+            "free_pages": self.free_pages(),
+            "free_list_pages": len(self._free),
+            "lru_evictable_pages": len(lru),
+            "lru_order": lru,  # oldest (next evicted) first
+            "prefix_cache": cache,
+            "stats": dict(self.stats),
+        }
+
     def _decref(self, page: int) -> None:
         if self._refcnt[page] <= 0:
             raise AssertionError(f"double free of page {page}")

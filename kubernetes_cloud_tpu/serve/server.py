@@ -21,6 +21,13 @@ the exact REST surface the reference's InferenceServices expose
   process-global registry (:mod:`kubernetes_cloud_tpu.obs`) — engine,
   batcher, supervisor, server, and workflow families; the target of the
   ``prometheus.io/scrape`` pod annotations in ``deploy/``
+* ``GET  /debug/timeline``           flight-recorder dump (per-iteration
+  phase timings + batch composition; ``?last=N``, ``?model=name``)
+* ``GET  /debug/slots``              per-slot engine occupancy
+* ``GET  /debug/pages``              paged-KV arena occupancy +
+  prefix-cache contents (block hashes, never prompt content)
+* ``GET  /debug/profile?seconds=N``  arm one ``jax.profiler`` trace
+  window (409 while one is already running)
 
 Error mapping (:mod:`kubernetes_cloud_tpu.serve.errors`): ValueError →
 400, RetryableError (queue full / engine restarted / stream stalled /
@@ -78,12 +85,15 @@ _M_LATENCY = obs.histogram(
 
 def route_label(path: str) -> str:
     """Bounded route vocabulary for metric labels."""
+    path = path.partition("?")[0]  # query strings are client-chosen
     if path in ("/", "/healthz"):
         return "healthz"
     if path == "/readyz":
         return "readyz"
     if path == "/metrics":
         return "metrics"
+    if path == "/debug" or path.startswith("/debug/"):
+        return "debug"
     if path == "/completion":
         return "completion"
     if path.endswith(":predict"):
@@ -112,6 +122,9 @@ class ModelServer:
         self._draining = False
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        #: per-window deep profiling armed via GET /debug/profile
+        #: (serve.boot points trace_dir at --profile-dir)
+        self.profiler = obs.ProfileWindow()
 
     def load_all(self) -> None:
         for model in self.models.values():
@@ -146,6 +159,10 @@ class ModelServer:
         except faults.FaultError as e:
             return 500, {"error": str(e)}
         if method == "GET":
+            # split the query string off ONCE for every GET route:
+            # /debug/* takes parameters; the fixed routes simply never
+            # match a path that still carries one
+            path, _, query = path.partition("?")
             if path in ("/", "/healthz"):
                 # process liveness only — unconditionally alive; engine
                 # trouble is /readyz's (and the supervisor's) business
@@ -154,6 +171,8 @@ class ModelServer:
                 return self._readyz()
             if path == "/metrics":
                 return self._metrics()
+            if path == "/debug" or path.startswith("/debug/"):
+                return self._debug(path, query)
             if path == "/v1/models":
                 return 200, {"models": sorted(self.models)}
             if path.startswith("/v1/models/"):
@@ -214,6 +233,99 @@ class ModelServer:
         except Exception as e:  # noqa: BLE001 - scrape must stay isolated
             log.exception("metrics render failed")
             return 500, {"error": f"metrics unavailable: {e}"}
+
+    # -- debug plane (performance introspection) ---------------------------
+
+    def _debug(self, path: str, query: str) -> tuple[int, dict]:
+        """Route ``GET /debug/*``.  Failure is CONTAINED exactly like
+        the metrics scrape: a raising (or hanging) introspection render
+        answers this request only — the data plane and ``/readyz``
+        never route through here (fault site ``debug.render``,
+        chaos-locked by tests/test_debug_endpoints.py)."""
+        import urllib.parse
+
+        try:
+            faults.fire("debug.render")
+            params = urllib.parse.parse_qs(query)
+            if path == "/debug/timeline":
+                return self._debug_timeline(params)
+            if path == "/debug/slots":
+                return self._debug_slots(params)
+            if path == "/debug/pages":
+                return self._debug_pages(params)
+            if path == "/debug/profile":
+                return self._debug_profile(params)
+            return 404, {"error": "unknown debug endpoint", "endpoints": [
+                "/debug/timeline?last=N", "/debug/slots", "/debug/pages",
+                "/debug/profile?seconds=N"]}
+        except ValueError as e:  # bad query parameters
+            return 400, {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 - debug must stay isolated
+            log.exception("debug render failed")
+            return 500, {"error": f"debug unavailable: {e}"}
+
+    def _debug_recorders(self):
+        """``(name, kind, engine-or-None, recorder)`` per model that
+        carries a flight recorder (continuous engine or batcher)."""
+        out = []
+        for name, model in self.models.items():
+            engine = getattr(model, "engine", None)
+            recorder = getattr(engine, "flight", None)
+            if recorder is not None:
+                out.append((name, "engine", engine, recorder))
+                continue
+            recorder = getattr(model, "flight", None)
+            if recorder is not None:
+                out.append((name, "batcher", None, recorder))
+        return out
+
+    def _debug_timeline(self, params) -> tuple[int, dict]:
+        last = int(params.get("last", ["256"])[0])
+        if last < 0:
+            raise ValueError("last must be >= 0")
+        only = params.get("model", [None])[0]
+        models = {}
+        for name, kind, engine, recorder in self._debug_recorders():
+            if only and name != only:
+                continue
+            entry = {"kind": kind,
+                     "iterations": recorder.tail(last),
+                     "requests": recorder.request_tail(last)}
+            if engine is not None:
+                entry["meta"] = engine.debug_meta()
+                entry["stats"] = dict(engine.stats)
+            models[name] = entry
+        return 200, {"models": models}
+
+    def _debug_slots(self, params) -> tuple[int, dict]:
+        models = {}
+        for name, model in self.models.items():
+            engine = getattr(model, "engine", None)
+            slots = getattr(engine, "debug_slots", None)
+            if slots is None:
+                continue
+            models[name] = {"slots": slots(),
+                            "queue_depth": engine.queue_depth()}
+        return 200, {"models": models}
+
+    def _debug_pages(self, params) -> tuple[int, dict]:
+        models = {}
+        for name, model in self.models.items():
+            engine = getattr(model, "engine", None)
+            pages = getattr(engine, "debug_pages", None)
+            if pages is None:
+                continue
+            models[name] = pages()  # None for the dense slot pool
+        return 200, {"models": models}
+
+    def _debug_profile(self, params) -> tuple[int, dict]:
+        from kubernetes_cloud_tpu.obs.flight import ProfileActiveError
+
+        seconds = float(params.get("seconds", ["5"])[0])
+        try:
+            return 200, self.profiler.arm(seconds)
+        except ProfileActiveError as e:
+            return 409, {"error": str(e)}
 
     def _readyz(self) -> tuple[int, dict]:
         if self._draining:

@@ -80,9 +80,14 @@ def _payload_pool(rng: random.Random, n: int, prefix_share: float = 0.0,
     return pool
 
 
-def _drive(model, pool, stages, stage_duration, metrics_snapshot=False):
+def _drive(model, pool, stages, stage_duration, metrics_snapshot=False,
+           timeline=False):
     from kubernetes_cloud_tpu import obs
-    from kubernetes_cloud_tpu.serve.load_test import run_ramp, scrape_metrics
+    from kubernetes_cloud_tpu.serve.load_test import (
+        run_ramp,
+        scrape_metrics,
+        snapshot_timeline,
+    )
     from kubernetes_cloud_tpu.serve.server import ModelServer
 
     model.load()
@@ -108,6 +113,10 @@ def _drive(model, pool, stages, stage_duration, metrics_snapshot=False):
         out = run_ramp(url, pool, stages=stages,
                        stage_duration=stage_duration)
         after = scrape_metrics(metrics_url) if metrics_snapshot else None
+        # --timeline: the flight recorder's phase-share + MFU breakdown
+        # for the measured window (ring capacity >> ramp iterations on
+        # the bench preset, so the dump covers the whole run)
+        timeline_summary = snapshot_timeline(url) if timeline else None
         # KV/admission accounting for the paged-vs-slot comparison:
         # measured-window deltas (counters minus the warmup snapshot),
         # taken before stop() tears the engine down
@@ -142,6 +151,8 @@ def _drive(model, pool, stages, stage_duration, metrics_snapshot=False):
         result["metrics_delta"] = obs.delta(
             before, after, "kct_",
             keep=lambda n: not n.endswith("_bucket"))
+    if timeline_summary is not None:
+        result["timeline"] = timeline_summary
     return result
 
 
@@ -284,17 +295,23 @@ def run_paged_comparison(args, svc, pool, stages) -> int:
         EngineConfig,
     )
 
-    slot_cfg = EngineConfig(slots=args.slots, max_len=args.pool_max_len)
+    fr = {} if args.flight_records < 0 else {
+        "flight_records": args.flight_records}
+    slot_cfg = EngineConfig(slots=args.slots, max_len=args.pool_max_len,
+                            **fr)
     paged_cfg = EngineConfig(
         slots=args.slots * args.overcommit, max_len=args.pool_max_len,
         paged=True, page_size=args.page_size,
-        num_pages=args.slots * args.pool_max_len // args.page_size + 1)
+        num_pages=args.slots * args.pool_max_len // args.page_size + 1,
+        **fr)
     slot = _drive(ContinuousBatchingModel("lm", svc, slot_cfg),
                   pool, stages, args.stage_duration,
-                  metrics_snapshot=args.metrics_snapshot)
+                  metrics_snapshot=args.metrics_snapshot,
+                  timeline=args.timeline)
     paged = _drive(ContinuousBatchingModel("lm", svc, paged_cfg),
                    pool, stages, args.stage_duration,
-                   metrics_snapshot=args.metrics_snapshot)
+                   metrics_snapshot=args.metrics_snapshot,
+                   timeline=args.timeline)
     se, pe = slot["engine"], paged["engine"]
     record = {
         "metric": "serving_paged_kv_capacity",
@@ -364,6 +381,16 @@ def main(argv=None) -> int:
                          "measured ramp and attach the counter deltas "
                          "to the benchmark JSON (instrumentation-"
                          "overhead audits read this)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="snapshot GET /debug/timeline after each "
+                         "measured ramp and embed the flight "
+                         "recorder's phase-share + MFU breakdown in "
+                         "the benchmark JSON")
+    ap.add_argument("--flight-records", type=int, default=-1,
+                    help="flight-recorder ring capacity for the "
+                         "continuous engine (0 disables recording — "
+                         "the overhead A/B knob; -1 keeps the engine "
+                         "default)")
     ap.add_argument("--inject", choices=("hang", "crash"), default=None,
                     help="recovery mode: wedge (hang) or crash the "
                          "decode loop and measure supervisor recovery "
@@ -397,13 +424,17 @@ def main(argv=None) -> int:
             BatchingModel("lm", svc,
                           BatcherConfig(max_batch_size=args.slots)),
             pool, stages, args.stage_duration,
-            metrics_snapshot=args.metrics_snapshot)
+            metrics_snapshot=args.metrics_snapshot,
+            timeline=args.timeline)
 
+    fr = {} if args.flight_records < 0 else {
+        "flight_records": args.flight_records}
     cb = _drive(
         ContinuousBatchingModel("lm", svc, EngineConfig(
-            slots=args.slots, max_len=args.pool_max_len)),
+            slots=args.slots, max_len=args.pool_max_len, **fr)),
         pool, stages, args.stage_duration,
-        metrics_snapshot=args.metrics_snapshot)
+        metrics_snapshot=args.metrics_snapshot,
+        timeline=args.timeline)
 
     record = {
         "metric": "serving_decode_tokens_per_sec",
@@ -417,6 +448,8 @@ def main(argv=None) -> int:
     }
     if args.metrics_snapshot:
         record["metrics_delta"] = cb.get("metrics_delta")
+    if args.timeline:
+        record["timeline"] = cb.get("timeline")
     if baseline is not None:
         record["baseline"] = baseline
         if baseline["tokens_out_per_sec"]:

@@ -1,0 +1,133 @@
+"""Where-did-the-time-go report over a flight-recorder timeline.
+
+Turns a ``GET /debug/timeline`` dump — fetched live from a serving pod
+or read from a saved JSON/JSONL file — into the terminal bottleneck
+report the ROADMAP's perf items start from: phase-share table (admit /
+cow_copy / prefill / decode / sample / stream / host_sync), prefill-
+stall detection (decode iterations delayed behind long prefills — the
+Sarathi signal), TTFT decomposed into queue-wait vs prefill-compute,
+and an MFU/goodput summary.
+
+CLI::
+
+    # live pod (any URL on the serving port works; /debug/timeline is
+    # derived the way load_test derives /metrics)
+    python scripts/perf_report.py --url http://pod:8080 [--last 2048]
+
+    # saved dump (a /debug/timeline response body, one model's entry,
+    # or a JSONL file of iteration records)
+    python scripts/perf_report.py --file timeline.json [--model lm]
+
+    # machine-readable (the same dict bench_serving --timeline embeds)
+    python scripts/perf_report.py --file timeline.json --json
+
+``--peak-flops`` declares the hardware peak when the device table
+doesn't know it (CPU dev boxes) — MFU is reported only against a
+declared or detected peak, never guessed.
+
+The analysis itself lives in :mod:`kubernetes_cloud_tpu.obs.report`
+(pure stdlib, no jax) so the load/bench harnesses embed the same
+numbers this prints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import urllib.parse
+import urllib.request
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:  # runnable from anywhere
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from kubernetes_cloud_tpu.obs import report  # noqa: E402
+
+
+def fetch_timeline(url: str, last: int, timeout: float = 10.0) -> dict:
+    """GET the timeline from a serving pod; any URL on the serving
+    port is accepted (the path is replaced, like load_test's
+    ``metrics_endpoint``)."""
+    if "://" not in url:  # bare host[:port] — urlsplit would read the
+        url = "http://" + url  # host as the scheme
+    parts = urllib.parse.urlsplit(url)
+    endpoint = urllib.parse.urlunsplit(
+        (parts.scheme, parts.netloc, "/debug/timeline",
+         f"last={last}", ""))
+    with urllib.request.urlopen(endpoint, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def load_file(path: str) -> dict:
+    """A saved dump: a full ``/debug/timeline`` response, one model's
+    entry (``{"iterations": [...]}``), or a JSONL of iteration
+    records."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        # JSONL: one iteration record per line
+        records = [json.loads(ln) for ln in text.splitlines()
+                   if ln.strip()]
+        return {"models": {"timeline": {"iterations": records,
+                                        "requests": []}}}
+    if isinstance(obj, dict) and "models" in obj:
+        return obj
+    if isinstance(obj, dict) and "iterations" in obj:
+        return {"models": {"timeline": obj}}
+    raise ValueError(
+        f"{path} is neither a /debug/timeline response, a model entry, "
+        "nor a JSONL of iteration records")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="serving pod base URL (or any URL "
+                                   "on its port)")
+    src.add_argument("--file", help="saved timeline dump (JSON or JSONL)")
+    ap.add_argument("--model", default=None,
+                    help="report only this model's timeline")
+    ap.add_argument("--last", type=int, default=4096,
+                    help="live mode: how many records to fetch")
+    ap.add_argument("--peak-flops", type=float, default=None,
+                    help="declare the hardware peak FLOPs/s (MFU "
+                         "denominator) when auto-detection can't")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the analysis dicts instead of the "
+                         "terminal report")
+    args = ap.parse_args(argv)
+
+    dump = (fetch_timeline(args.url, args.last) if args.url
+            else load_file(args.file))
+    models = dump.get("models", {})
+    if args.model:
+        models = {k: v for k, v in models.items() if k == args.model}
+        if not models:
+            print(f"no timeline for model {args.model!r} "
+                  f"(have: {sorted(dump.get('models', {}))})",
+                  file=sys.stderr)
+            return 1
+    if not models:
+        print("no flight-recorder timelines in the dump (engine "
+              "running with flight_records=0?)", file=sys.stderr)
+        return 1
+    out = {}
+    for i, (name, entry) in enumerate(sorted(models.items())):
+        analysis = report.analyze(entry, peak_flops=args.peak_flops)
+        if args.json:
+            out[name] = analysis
+            continue
+        if i:
+            print()
+        print(report.render(analysis, name))
+    if args.json:
+        print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

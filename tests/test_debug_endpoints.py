@@ -1,0 +1,399 @@
+"""/debug/* performance-introspection plane over the real serving
+stack: flight-recorder timeline with phase timings on both front-ends,
+TTFT decomposed into queue-wait vs prefill-compute (predictions, spans,
+and the request ring), the phase-labeled iteration histogram, the paged
+/debug/pages view matching the fixed kv-utilization gauge, the
+jax.profiler window, and the chaos proof that a hung or raising
+``debug.render`` leaves generate and /readyz untouched."""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.obs import flops as obs_flops
+from kubernetes_cloud_tpu.obs import tracing
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingEngine,
+    ContinuousBatchingModel,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.uninstall()
+    tracing.uninstall()
+    obs.REGISTRY.reset()
+    yield
+    faults.uninstall()
+    tracing.uninstall()
+    obs.REGISTRY.reset()
+
+
+@pytest.fixture(scope="module")
+def service():
+    svc = CausalLMService("lm", CFG,
+                          params=init_params(CFG, jax.random.key(0)),
+                          dtype=jnp.float32)
+    svc.load()
+    return svc
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _predict(port, prompt, max_new=4, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/lm:predict",
+        data=json.dumps({"instances": [prompt],
+                         "parameters": {"max_new_tokens": max_new,
+                                        "temperature": 0.0}}).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def served(service):
+    model = ContinuousBatchingModel("lm", service, EngineConfig(
+        slots=2, max_len=64))
+    model.load()
+    srv = ModelServer([model], host="127.0.0.1", port=0)
+    srv.start()
+    yield srv, model
+    srv.stop()
+    model.stop()
+
+
+def test_timeline_phases_and_ttft_decomposition(served, tmp_path):
+    srv, model = served
+    tracing.install(tracing.RequestTracer(str(tmp_path / "t.jsonl")))
+    code, body = _predict(srv.port, "introspect me", max_new=6,
+                          headers={"X-Request-Id": "dbg-1"})
+    assert code == 200
+    pred = body["predictions"][0]
+    # per-prediction TTFT decomposition: the components partition TTFT
+    assert pred["ttft_queue_s"] >= 0 and pred["ttft_prefill_s"] > 0
+    assert pred["ttft_queue_s"] + pred["ttft_prefill_s"] \
+        == pytest.approx(pred["ttft_s"], abs=2e-6)
+    # spans carry the same split
+    spans = {r["span"]: r for r in tracing.active().spans_for("dbg-1")}
+    assert spans["admitted"]["queue_s"] >= 0
+    assert spans["first_token"]["prefill_s"] > 0
+
+    code, dump = _get(srv.port, "/debug/timeline?last=64")
+    assert code == 200
+    entry = dump["models"]["lm"]
+    assert entry["kind"] == "engine"
+    iters = entry["iterations"]
+    assert iters  # the run landed on the ring
+    prefill_recs = [r for r in iters if r["admitted"]]
+    decode_recs = [r for r in iters
+                   if not r["admitted"] and r["decode_tokens"]]
+    assert prefill_recs and decode_recs
+    assert prefill_recs[0]["phases"]["prefill"] > 0
+    assert prefill_recs[0]["prefill_tokens"] == len("introspect me")
+    for r in decode_recs:
+        assert r["phases"]["decode"] > 0
+        assert r["phases"]["host_sync"] >= 0
+        assert r["phases"]["sample"] > 0
+        assert set(r["phases"]) <= set(obs.flight.PHASES)
+        assert r["flops"] > 0
+    # seq strictly increases across the dump
+    seqs = [r["seq"] for r in iters]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # ?last filters
+    assert len(_get(srv.port,
+                    "/debug/timeline?last=1")[1]["models"]["lm"]
+               ["iterations"]) == 1
+    # meta carries the analytical constants the analyzer needs
+    base, per_ctx = obs_flops.decode_flops_coeffs(CFG)
+    assert entry["meta"]["flops_base"] == base
+    assert entry["meta"]["flops_per_ctx"] == per_ctx
+    # the request ring carries the same decomposition
+    reqs = entry["requests"]
+    assert reqs[-1]["outcome"] == "complete"
+    assert reqs[-1]["queue_s"] + reqs[-1]["prefill_s"] \
+        == pytest.approx(reqs[-1]["ttft_s"], abs=2e-6)
+
+
+def test_debug_slots_shows_occupancy(served):
+    srv, model = served
+    _predict(srv.port, "warm", max_new=2)
+    code, body = _get(srv.port, "/debug/slots")
+    assert code == 200
+    slots = body["models"]["lm"]["slots"]
+    assert len(slots) == 2  # EngineConfig(slots=2)
+    assert all(s["state"] == "free" for s in slots)  # drained
+    # occupy a slot mid-flight and observe it decoding
+    eng = model.engine
+    req = eng.submit([1, 2, 3], max_new_tokens=40, temperature=0.0)
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            slots = _get(srv.port, "/debug/slots")[1]["models"]["lm"]
+            busy = [s for s in slots["slots"]
+                    if s["state"] == "decoding"]
+            if busy:
+                break
+        assert busy and busy[0]["prompt_tokens"] == 3
+        assert busy[0]["max_new_tokens"] == 40
+    finally:
+        req.cancel()
+        req.event.wait(timeout=10)
+
+
+def test_phase_labeled_iteration_histogram_and_gauges(served):
+    srv, _ = served
+    _predict(srv.port, "phase split", max_new=24)
+    time.sleep(0.7)  # cross the 0.5s gauge-refresh gate
+    samples = obs.parse_text(obs.render_text())
+    prefill_n = obs.sample_value(
+        samples, "kct_engine_iteration_seconds_count",
+        {"model": "lm", "phase": "prefill"})
+    decode_n = obs.sample_value(
+        samples, "kct_engine_iteration_seconds_count",
+        {"model": "lm", "phase": "decode"})
+    assert prefill_n >= 1  # the admission pass
+    assert decode_n >= 20  # one per decode-only iteration
+    assert obs.sample_value(samples, "kct_engine_phase_seconds_total",
+                            {"model": "lm", "phase": "decode"}) > 0
+    assert obs.sample_value(samples, "kct_engine_phase_seconds_total",
+                            {"model": "lm", "phase": "prefill"}) > 0
+    assert obs.sample_value(samples,
+                            "kct_engine_goodput_tokens_per_s",
+                            {"model": "lm"}) > 0
+    # CPU host: no peak in the device table, so MFU honestly reads 0
+    assert obs.sample_value(samples, "kct_engine_mfu",
+                            {"model": "lm"}) == 0
+
+
+def test_flight_records_zero_disables_recording(service):
+    model = ContinuousBatchingModel("lm", service, EngineConfig(
+        slots=2, max_len=64, flight_records=0))
+    model.load()
+    srv = ModelServer([model], host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        assert _predict(srv.port, "no recorder", max_new=3)[0] == 200
+        code, dump = _get(srv.port, "/debug/timeline")
+        assert code == 200
+        assert dump["models"] == {}  # nothing carries a recorder
+    finally:
+        srv.stop()
+        model.stop()
+
+
+def test_paged_debug_pages_matches_kv_utilization_gauge(service):
+    model = ContinuousBatchingModel("lm", service, EngineConfig(
+        slots=2, max_len=64, paged=True, page_size=16))
+    model.load()
+    srv = ModelServer([model], host="127.0.0.1", port=0)
+    srv.start()
+    eng = model.engine
+    try:
+        # slow every scheduler pass so the request stays verifiably
+        # in flight while we compare the debug view with the gauge
+        with faults.inject(FaultSpec("iteration", mode="slow",
+                                     delay_s=0.05, times=-1)):
+            req = eng.submit(list(range(1, 20)), max_new_tokens=40,
+                             temperature=0.0)
+            deadline = time.monotonic() + 10
+            pages = None
+            while time.monotonic() < deadline:
+                code, body = _get(srv.port, "/debug/pages")
+                assert code == 200
+                pages = body["models"]["lm"]
+                if pages and pages.get("used_pages") \
+                        and len(req.tokens) >= 1:
+                    break
+            # 19 prompt + 40 new = 59 rows → 4 pages of 16
+            assert pages["used_pages"] == 4
+            assert pages["page_size"] == 16
+            assert pages["utilization"] == pytest.approx(
+                4 / pages["capacity"])
+            assert pages["reserved_rows"] == 64
+            assert 0.0 <= pages["fragmentation"] <= 1.0
+            # the FIXED gauge reports the same number (page-arena
+            # utilization, not live-token-rows).  It refreshes at the
+            # top of each scheduler pass, so poll a bounded window —
+            # the slowed iterations keep the request in flight far
+            # longer than one refresh period.
+            want = pages["utilization"]
+            deadline = time.monotonic() + 5
+            got = None
+            while time.monotonic() < deadline:
+                samples = obs.parse_text(obs.render_text())
+                got = obs.sample_value(samples,
+                                       "kct_engine_kv_utilization",
+                                       {"model": "lm"})
+                if got == pytest.approx(want, abs=1e-6):
+                    break
+                time.sleep(0.02)
+            assert got == pytest.approx(want, abs=1e-6)
+            req.cancel()
+        req.event.wait(timeout=10)
+        # after release the pages park in the prefix cache (LRU),
+        # exposed as hashes with refcount 0 — never token content
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            pages = _get(srv.port, "/debug/pages")[1]["models"]["lm"]
+            if not pages.get("used_pages"):
+                break
+        assert pages["used_pages"] == 0
+        cache = pages["prefix_cache"]
+        assert cache  # the full prompt block was published
+        assert all(set(e) == {"page", "hash", "refcount",
+                              "lru_position"} for e in cache)
+        assert all(e["refcount"] == 0 for e in cache)
+    finally:
+        srv.stop()
+        model.stop()
+
+
+def test_dense_debug_pages_is_null(served):
+    srv, _ = served
+    code, body = _get(srv.port, "/debug/pages")
+    assert code == 200
+    assert body["models"]["lm"] is None  # dense pool: no arena
+
+
+def test_debug_unknown_endpoint_and_bad_params(served):
+    srv, _ = served
+    code, body = _get(srv.port, "/debug/nope")
+    assert code == 404 and "endpoints" in body
+    assert _get(srv.port, "/debug/timeline?last=-3")[0] == 400
+    assert _get(srv.port, "/debug/timeline?last=junk")[0] == 400
+
+
+def test_profile_window_arm_conflict_rearm(served, tmp_path):
+    srv, _ = served
+    # the process's FIRST start_trace pays ~10s of profiler-server
+    # init; warm it here so the HTTP window below is fast (a real pod
+    # pays this once, on its first armed window)
+    jax.profiler.start_trace(str(tmp_path / "warm"))
+    jax.profiler.stop_trace()
+    srv.profiler.trace_dir = str(tmp_path / "trace")
+    code, body = _get(srv.port, "/debug/profile?seconds=0.4")
+    assert code == 200
+    assert body["profiling_s"] == 0.4
+    assert body["trace_dir"] == str(tmp_path / "trace")
+    # one window at a time
+    assert _get(srv.port, "/debug/profile?seconds=1")[0] == 409
+    assert srv.profiler.wait(timeout=10)
+    # the trace landed and the window can re-arm
+    assert (tmp_path / "trace").exists()
+    code, _ = _get(srv.port, "/debug/profile?seconds=0.2")
+    assert code == 200
+    assert srv.profiler.wait(timeout=10)
+    # bad durations are 400s
+    assert _get(srv.port, "/debug/profile?seconds=0")[0] == 400
+    assert _get(srv.port, "/debug/profile?seconds=9999")[0] == 400
+
+
+@pytest.mark.chaos
+def test_raising_debug_render_is_contained(served):
+    srv, _ = served
+    with faults.inject(FaultSpec("debug.render", mode="raise",
+                                 times=-1)):
+        code, body = _get(srv.port, "/debug/timeline")
+        assert code == 500
+        assert "debug unavailable" in body["error"]
+        # data plane + readiness untouched
+        assert _predict(srv.port, "still serving", max_new=2)[0] == 200
+        assert _get(srv.port, "/readyz")[0] == 200
+    assert _get(srv.port, "/debug/timeline")[0] == 200  # recovers
+
+
+@pytest.mark.chaos
+def test_hanging_debug_render_is_contained(served):
+    srv, _ = served
+    with faults.inject(FaultSpec("debug.render", mode="hang",
+                                 delay_s=30.0)) as inj:
+        done = threading.Event()
+
+        def dump():
+            _get(srv.port, "/debug/timeline")
+            done.set()
+
+        t = threading.Thread(target=dump, daemon=True)
+        t.start()
+        time.sleep(0.05)  # the dump thread is parked in the hang
+        assert not done.is_set()
+        # generate + readiness answer while the debug plane is wedged
+        assert _predict(srv.port, "wedged debug", max_new=2)[0] == 200
+        assert _get(srv.port, "/readyz")[0] == 200
+        with urllib.request.urlopen(  # /metrics is text, not JSON
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            assert r.status == 200
+        inj.release()
+        t.join(timeout=10)
+        assert done.is_set()
+
+
+def test_native_frontend_debug_parity(service):
+    from kubernetes_cloud_tpu.serve import native_server
+
+    if not native_server.available():
+        pytest.skip("no C++ toolchain")
+    model = ContinuousBatchingModel("lm", service, EngineConfig(
+        slots=2, max_len=64))
+    model.load()
+    srv = native_server.NativeModelServer([model], host="127.0.0.1",
+                                          port=0)
+    srv.start()
+    try:
+        assert _predict(srv.port, "native debug", max_new=3)[0] == 200
+        code, dump = _get(srv.port, "/debug/timeline?last=16")
+        assert code == 200
+        entry = dump["models"]["lm"]
+        assert entry["iterations"][-1]["phases"]
+        assert entry["requests"][-1]["outcome"] == "complete"
+        assert _get(srv.port, "/debug/slots")[0] == 200
+        assert _get(srv.port, "/debug/pages")[0] == 200
+        assert _get(srv.port, "/debug/nope")[0] == 404
+    finally:
+        srv.stop()
+        model.stop()
+
+
+def test_engine_restart_gets_fresh_ring(service):
+    """A supervisor-style rebuild starts a fresh recorder — the ring
+    documents one engine incarnation (like stats)."""
+    eng = ContinuousBatchingEngine(
+        CFG, service.params, EngineConfig(slots=1, max_len=64),
+        pad_token_id=0, name="lm")
+    eng.start()
+    try:
+        eng.submit([1, 2, 3], max_new_tokens=2, temperature=0.0).wait(eng)
+        assert len(eng.flight) > 0
+    finally:
+        eng.stop()
+    replacement = ContinuousBatchingEngine(
+        CFG, service.params, EngineConfig(slots=1, max_len=64),
+        pad_token_id=0, name="lm")
+    assert len(replacement.flight) == 0

@@ -1,0 +1,366 @@
+"""Flight recorder + FLOPs accounting + perf_report analyzer — the
+jax-free core of the performance-introspection plane: ring-buffer
+wraparound and the bounded-memory proof, rate computation, analytical
+FLOPs locked against hand-computed values for the test-config
+transformer, the perf_report golden-output lock on a canned timeline,
+and the batcher's coarse timeline through a live /debug endpoint."""
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_cloud_tpu import obs
+from kubernetes_cloud_tpu.obs import flops, report
+from kubernetes_cloud_tpu.obs.flight import PHASES, FlightRecorder
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# ring buffer: wraparound + bounded memory
+# ---------------------------------------------------------------------------
+
+
+def _commit_n(fr: FlightRecorder, n: int) -> None:
+    for i in range(n):
+        rec = fr.begin()
+        rec.active = 1
+        rec.decode_tokens = i  # distinguishable payload
+        fr.commit(rec)
+
+
+def test_ring_wraparound_keeps_newest():
+    fr = FlightRecorder(4, request_capacity=4)
+    _commit_n(fr, 10)
+    assert len(fr) == 4
+    recs = fr.tail()
+    assert [r["seq"] for r in recs] == [7, 8, 9, 10]  # oldest first
+    assert [r["seq"] for r in fr.tail(2)] == [9, 10]
+    assert fr.tail(0) == []
+    # request ring wraps independently
+    for i in range(9):
+        fr.record_request({"request_id": f"r{i}"})
+    assert [r["request_id"] for r in fr.request_tail()] \
+        == ["r5", "r6", "r7", "r8"]
+
+
+def test_ring_memory_is_bounded_by_construction():
+    """The proof is structural: the backing lists are preallocated at
+    capacity and only ever written modulo it — a month of commits holds
+    exactly `capacity` records."""
+    fr = FlightRecorder(8, request_capacity=2)
+    assert len(fr._ring) == 8 and len(fr._reqs) == 2
+    _commit_n(fr, 1000)
+    for _ in range(1000):
+        fr.record_request({"request_id": "x"})
+    assert len(fr._ring) == 8 and len(fr._reqs) == 2  # never grew
+    assert len(fr) == 8
+    assert fr.tail()[-1]["seq"] == 1000
+
+
+def test_disabled_recorder_is_inert():
+    fr = FlightRecorder(0, request_capacity=0)
+    assert not fr.enabled
+    _commit_n(fr, 5)
+    fr.record_request({"request_id": "x"})
+    assert len(fr) == 0 and fr.tail() == [] and fr.request_tail() == []
+    assert fr.rates() == {"tokens_per_s": 0.0, "flops_per_s": 0.0,
+                          "busy_s": 0.0, "span_s": 0.0}
+    with pytest.raises(ValueError):
+        FlightRecorder(-1)
+
+
+def test_rates_over_trailing_window():
+    fr = FlightRecorder(16)
+    now = time.time()
+    for i in range(4):
+        rec = fr.begin()
+        rec.ts = now - 0.4 + i * 0.1  # 4 records spanning 0.3s + dur
+        rec.dur_s = 0.1
+        rec.decode_tokens = 5
+        rec.prefill_tokens = 5
+        rec.flops = 100.0
+        fr.commit(rec)
+    r = fr.rates(window_s=10.0)
+    # span = last end - first start = 0.3 + 0.1 = 0.4
+    assert r["tokens_per_s"] == pytest.approx(40 / 0.4)
+    assert r["flops_per_s"] == pytest.approx(400 / 0.4)
+    assert r["busy_s"] == pytest.approx(0.4)
+    # a tight window excludes the old records
+    assert fr.rates(window_s=0.25)["tokens_per_s"] < 40 / 0.4 + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# analytical FLOPs: locked against hand-computed values for the
+# test-config transformer (duck-typed config — no jax import needed)
+# ---------------------------------------------------------------------------
+
+
+class _TinyCfg:
+    """The test-tiny architecture as plain attributes (what
+    models.causal_lm.PRESETS['test-tiny'] declares, vocab 512)."""
+
+    vocab_size = 512
+    hidden_size = 64
+    num_layers = 2
+    num_heads = 4
+    num_kv_heads = None
+    intermediate_size = None
+    max_seq_len = 128
+    pos_emb = "rope"
+    use_bias = True
+    tie_embeddings = False
+    embed_layernorm = False
+    moe_experts = 0
+
+
+def test_decode_flops_coeffs_hand_computed():
+    # h=64, L=2, V=512, inter=4h=256, kv_dim=64 (MHA).  Per layer:
+    #   qkv 2·64·(64+128)=24576, out 2·64·64=8192, mlp 4·64·256=65536
+    # base = 2·(24576+8192+65536) + logits 2·64·512 = 196608+65536
+    base, per_ctx = flops.decode_flops_coeffs(_TinyCfg())
+    assert base == 262144.0
+    # per-context-token attention: 4·h per layer = 2·4·64
+    assert per_ctx == 512.0
+
+
+def test_param_count_hand_computed():
+    # embed 512·64=32768; per layer: qkv 64·192+192=12480,
+    # out 64·64+64=4160, mlp 2·64·256+(256+64)=33088, norms 4·64=256
+    # → 49984; ×2 layers; final norm 128; untied head 32768
+    assert flops.param_count(_TinyCfg()) \
+        == 32768 + 2 * 49984 + 128 + 32768
+
+
+def test_span_flops_closed_form_matches_sum():
+    base, per_ctx = 10.0, 1.0
+    # 3 tokens on top of 2 cached: contexts 3, 4, 5
+    assert flops.span_flops(base, per_ctx, 2, 3) \
+        == (10 + 3) + (10 + 4) + (10 + 5)
+    assert flops.span_flops(base, per_ctx, 0, 0) == 0.0
+    # a full prefill == the decode-coeff sum over every position
+    total = sum(base + per_ctx * k for k in range(1, 8))
+    assert flops.span_flops(base, per_ctx, 0, 7) == total
+
+
+def test_gqa_and_moe_flops():
+    class GQA(_TinyCfg):
+        num_kv_heads = 2  # kv_dim 32
+
+    base, per_ctx = flops.decode_flops_coeffs(GQA())
+    # qkv shrinks to 2·64·(64+64)=16384/layer; attention compute
+    # (per_ctx) is unchanged — GQA saves KV memory, not attention math
+    assert base == 2 * (16384 + 8192 + 65536) + 65536
+    assert per_ctx == 512.0
+
+    class MoE(_TinyCfg):
+        moe_experts = 4
+        moe_top_k = 2
+
+    base_moe, _ = flops.decode_flops_coeffs(MoE())
+    # MLP runs top_k experts + the router: 2·4·64·256 + 2·64·4
+    assert base_moe == 2 * (24576 + 8192 + 2 * 65536 + 2 * 64 * 4) + 65536
+
+
+def test_mfu_and_peak_env(monkeypatch):
+    assert flops.mfu(50.0, 100.0) == 0.5
+    assert flops.mfu(50.0, None) == 0.0
+    assert flops.mfu(50.0, 0.0) == 0.0
+    monkeypatch.setenv(flops.PEAK_ENV, "123.5")
+    assert flops.peak_flops_per_s() == 123.5
+    monkeypatch.setenv(flops.PEAK_ENV, "junk")
+    assert flops.peak_flops_per_s() is None
+
+
+# ---------------------------------------------------------------------------
+# analyzer + perf_report golden output on a canned timeline
+# ---------------------------------------------------------------------------
+
+
+def _canned_entry() -> dict:
+    return {
+        "meta": {"slots": 4, "paged": False},
+        "iterations": [
+            {"seq": 1, "ts": 100.0, "dur_s": 0.010, "active": 4,
+             "admitted": 2, "evicted": 0, "decode_tokens": 4,
+             "prefill_tokens": 50, "cached_tokens": 0, "flops": 5e6,
+             "phases": {"admit": 0.001, "prefill": 0.006,
+                        "decode": 0.002, "host_sync": 0.0005,
+                        "sample": 0.0003, "stream": 0.0002}},
+            {"seq": 2, "ts": 100.010, "dur_s": 0.002, "active": 4,
+             "admitted": 0, "evicted": 0, "decode_tokens": 4,
+             "prefill_tokens": 0, "cached_tokens": 0, "flops": 1e6,
+             "phases": {"decode": 0.0015, "host_sync": 0.0002,
+                        "sample": 0.0002, "stream": 0.0001}},
+            {"seq": 3, "ts": 100.012, "dur_s": 0.002, "active": 4,
+             "admitted": 0, "evicted": 2, "decode_tokens": 4,
+             "prefill_tokens": 0, "cached_tokens": 0, "flops": 1e6,
+             "phases": {"decode": 0.0015, "host_sync": 0.0002,
+                        "sample": 0.0002, "stream": 0.0001}},
+        ],
+        "requests": [
+            {"request_id": "r1", "ttft_s": 0.05, "queue_s": 0.01,
+             "prefill_s": 0.04, "tokens": 8, "outcome": "complete"},
+            {"request_id": "r2", "ttft_s": 0.07, "queue_s": 0.03,
+             "prefill_s": 0.04, "tokens": 8, "outcome": "complete"},
+        ],
+    }
+
+
+def test_analyze_canned_timeline_exact():
+    a = report.analyze(_canned_entry(), peak_flops=1e10)
+    it = a["iterations"]
+    assert (it["count"], it["prefill_bearing"], it["decode_only"]) \
+        == (3, 1, 2)
+    assert it["busy_s"] == pytest.approx(0.014)
+    assert it["span_s"] == pytest.approx(0.014)  # 100.0 → 100.014
+    # phase seconds sum across records
+    assert a["phase_seconds"]["decode"] == pytest.approx(0.005)
+    assert a["phase_seconds"]["prefill"] == pytest.approx(0.006)
+    assert a["phase_share"]["prefill"] == pytest.approx(0.006 / 0.014)
+    # stall: it1 (0.010s) > 3× median decode-only (0.002) with
+    # 4-2=2 already-active slots delayed by 0.008s
+    st = a["stalls"]
+    assert st["median_decode_s"] == pytest.approx(0.002)
+    assert st["threshold_s"] == pytest.approx(0.006)
+    assert st["count"] == 1
+    assert st["delayed_slot_steps"] == 2
+    assert st["stall_s_total"] == pytest.approx(0.008)
+    # TTFT decomposition
+    tt = a["ttft"]
+    assert tt["n"] == 2
+    assert tt["ttft_mean_s"] == pytest.approx(0.06)
+    assert tt["queue_mean_s"] == pytest.approx(0.02)
+    assert tt["prefill_mean_s"] == pytest.approx(0.04)
+    assert tt["queue_share"] == pytest.approx(1 / 3)
+    # MFU: 7e6 FLOPs over 0.014s = 5e8/s against 1e10 peak
+    mf = a["mfu"]
+    assert mf["flops_per_s"] == pytest.approx(5e8)
+    assert mf["mfu"] == pytest.approx(0.05)
+    assert mf["goodput_tokens_per_s"] == pytest.approx(62 / 0.014)
+    assert (mf["decode_tokens"], mf["prefill_tokens"]) == (12, 50)
+
+
+def test_render_golden_lines():
+    text = report.render(report.analyze(_canned_entry(),
+                                        peak_flops=1e10), "lm")
+    assert "== perf report: lm ==" in text
+    assert "iterations: 3 (1 prefill-bearing, 2 decode-only)" in text
+    for phase in ("admit", "prefill", "decode", "host_sync", "sample",
+                  "stream", "other"):
+        assert f"\n  {phase}" in text, phase
+    assert "prefill stalls: 1 iterations over 6.00ms" in text
+    assert "2 decode-slot steps delayed" in text
+    assert "queue-wait      mean 20.00ms" in text
+    assert "prefill-compute mean 40.00ms" in text
+    assert "queue share of TTFT: 33% - compute-bound" in text
+    assert "MFU: 5.00%" in text
+    # no-peak mode degrades honestly
+    text2 = report.render(report.analyze(_canned_entry()), "lm")
+    assert "MFU: n/a (peak unknown" in text2
+
+
+def test_summarize_embedding_shape():
+    s = report.summarize(_canned_entry(), peak_flops=1e10)
+    assert s["iterations"] == 3
+    assert s["prefill_stalls"] == 1
+    assert s["mfu"] == pytest.approx(0.05)
+    assert s["ttft_queue_mean_s"] == pytest.approx(0.02)
+    assert s["ttft_prefill_mean_s"] == pytest.approx(0.04)
+    assert set(s["phase_share"]) <= set(PHASES) | {"other"}
+
+
+def test_perf_report_cli_on_canned_file(tmp_path):
+    dump = {"models": {"lm": _canned_entry()}}
+    path = tmp_path / "timeline.json"
+    path.write_text(json.dumps(dump))
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "perf_report.py"),
+         "--file", str(path), "--json", "--peak-flops", "1e10"],
+        capture_output=True, text=True, cwd=str(REPO), check=True)
+    parsed = json.loads(out.stdout)
+    assert parsed["lm"]["mfu"]["mfu"] == pytest.approx(0.05)
+    # human mode prints the report
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "perf_report.py"),
+         "--file", str(path)],
+        capture_output=True, text=True, cwd=str(REPO), check=True)
+    assert "perf report: lm" in out.stdout
+    assert "prefill stalls: 1" in out.stdout
+    # unknown model exits 1 with the available set on stderr
+    bad = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "perf_report.py"),
+         "--file", str(path), "--model", "nope"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert bad.returncode == 1 and "nope" in bad.stderr
+
+
+def test_perf_report_loads_jsonl_and_bare_entry(tmp_path):
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import perf_report
+    finally:
+        sys.path.pop(0)
+    entry = _canned_entry()
+    bare = tmp_path / "entry.json"
+    bare.write_text(json.dumps(entry))
+    assert "timeline" in perf_report.load_file(str(bare))["models"]
+    jsonl = tmp_path / "records.jsonl"
+    jsonl.write_text("\n".join(json.dumps(r)
+                               for r in entry["iterations"]))
+    loaded = perf_report.load_file(str(jsonl))
+    assert len(loaded["models"]["timeline"]["iterations"]) == 3
+    with pytest.raises(ValueError):
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"neither": 1}')
+        perf_report.load_file(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# batcher's coarse timeline through a live /debug endpoint (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_timeline_served_by_debug_endpoint():
+    from kubernetes_cloud_tpu.serve.batcher import (
+        BatcherConfig,
+        BatchingModel,
+    )
+    from kubernetes_cloud_tpu.serve.server import ModelServer
+
+    m = BatchingModel("bm", lambda insts, params: list(insts),
+                      BatcherConfig(max_batch_size=4))
+    m.load()
+    srv = ModelServer([m], host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/models/bm:predict",
+            data=json.dumps({"instances": ["a", "b"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/timeline?last=10",
+                timeout=10) as r:
+            dump = json.loads(r.read())
+        entry = dump["models"]["bm"]
+        assert entry["kind"] == "batcher"
+        rec = entry["iterations"][-1]
+        assert rec["active"] == 1  # one batch
+        assert rec["decode_tokens"] == 2  # two instances
+        assert set(rec["phases"]) == {"admit", "decode"}
+        # /debug/slots has nothing for a batcher, and says so cleanly
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/slots",
+                timeout=10) as r:
+            assert json.loads(r.read()) == {"models": {}}
+    finally:
+        srv.stop()
+        m.stop()
+        obs.REGISTRY.reset()
