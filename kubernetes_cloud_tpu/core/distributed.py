@@ -115,6 +115,28 @@ def maybe_initialize_distributed(
     return True
 
 
+def allgather_step_times(step_s: float):
+    """Per-host step-duration heartbeat: every host contributes its
+    last step's wall seconds, every host receives the full vector
+    (rank 0 feeds the straggler view from it —
+    ``kct_train_step_skew_seconds`` is ``max - min``).
+
+    A few bytes over DCN per step, same budget class as the trainer's
+    preemption allgather.  Single-process runs skip the collective and
+    return the local time as a length-1 vector, so callers (and the
+    MULTICHIP dryrun) exercise one code path everywhere.
+    """
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray([step_s], dtype=np.float64)
+    from jax.experimental import multihost_utils
+
+    times = multihost_utils.process_allgather(
+        np.asarray(step_s, np.float64))
+    return np.asarray(times, dtype=np.float64).reshape(-1)
+
+
 def is_primary() -> bool:
     """True on the process that should write checkpoints / logs / wandb
     (the reference gates on ``LOCAL_RANK in (0, -1)``, ``finetuner.py:362``)."""

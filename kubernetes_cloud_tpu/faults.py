@@ -8,7 +8,7 @@ real failure strikes — and a test (or ``scripts/bench_serving.py
 default, and the only state outside chaos runs) a site costs one module
 attribute check and a ``None`` comparison.
 
-Sites wired through ``serve/``:
+Sites wired through ``serve/`` and ``train/``:
 
 =====================  ====================================================
 ``model_fn``           inside the engine/batcher device-call path — a
@@ -37,6 +37,16 @@ Sites wired through ``serve/``:
                        same containment contract as the scrape: a
                        wedged timeline dump parks one debug request,
                        never generate or ``/readyz``
+``train.step``         once per trainer optimizer step — ``raise`` is
+                       a crashed step program; ``drop`` makes the
+                       step's loss read as NaN (deterministic
+                       divergence injection for sentinel drills)
+``train.data``         per training micro-batch fetch — ``slow`` is a
+                       stalled input pipeline (the ``data_load``
+                       phase), ``raise`` a crashed loader
+``train.checkpoint``   inside the trainer's checkpoint save —
+                       ``raise`` is a failed save, ``hang`` wedged
+                       storage
 =====================  ====================================================
 
 Determinism: every site counts its hits under a lock; a spec names the
@@ -93,6 +103,16 @@ SITES = {
                     "slots/pages/profile; failure must stay contained "
                     "to the debug request — the debug plane observes "
                     "the data plane, it can never wedge it)",
+    "train.step": "once per trainer optimizer step (raise = crashed "
+                  "step program; drop = the step's loss reads as NaN "
+                  "— deterministic divergence injection for sentinel "
+                  "drills)",
+    "train.data": "per training micro-batch fetch (slow = input-"
+                  "pipeline stall, the data_load phase the trainer "
+                  "timeline attributes; raise = crashed loader)",
+    "train.checkpoint": "inside the trainer's checkpoint save (raise "
+                        "= failed save surfaces loudly; hang = wedged "
+                        "storage during the save window)",
 }
 
 

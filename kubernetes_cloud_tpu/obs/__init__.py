@@ -37,11 +37,21 @@ from kubernetes_cloud_tpu.obs.metrics import (  # noqa: F401
     parse_text,
     sample_value,
 )
-from kubernetes_cloud_tpu.obs import flight, flops, report  # noqa: F401
+from kubernetes_cloud_tpu.obs import (  # noqa: F401
+    flight,
+    flops,
+    report,
+    train_flight,
+)
 from kubernetes_cloud_tpu.obs.flight import (  # noqa: F401
     FlightRecorder,
     IterationRecord,
     ProfileWindow,
+)
+from kubernetes_cloud_tpu.obs.train_flight import (  # noqa: F401
+    TRAIN_PHASES,
+    TrainStepRecord,
+    train_recorder,
 )
 from kubernetes_cloud_tpu.obs import tracing  # noqa: F401
 from kubernetes_cloud_tpu.obs.tracing import (  # noqa: F401

@@ -96,4 +96,23 @@ METRIC_FAMILIES = {
         "step retry attempts",
     "kct_workflow_transitions_total":
         "step state transitions by resulting state",
+    # training loop (train/trainer.py + train/metrics.py)
+    "kct_train_step_seconds":
+        "one optimizer step's seconds by named phase",
+    "kct_train_tokens_total":
+        "tokens consumed by completed training steps",
+    "kct_train_data_stall_seconds_total":
+        "seconds the step loop waited on the input pipeline",
+    "kct_train_checkpoint_seconds":
+        "checkpoint-save blocking wall time",
+    "kct_train_recompiles_total":
+        "batch-shape signatures compiled after the first",
+    "kct_train_mfu":
+        "training model-FLOPs utilization over the trailing window",
+    "kct_train_divergence_events_total":
+        "divergence-sentinel events by kind",
+    "kct_train_step_skew_seconds":
+        "max - min per-host step seconds (straggler signal)",
+    "kct_train_metric":
+        "scrape-side mirror of the wandb/JSONL metrics stream",
 }

@@ -87,20 +87,35 @@ class IterationRecord:
         d["phases"] = {k: round(v, 9) for k, v in self.phases.items()}
         return d
 
+    def rate_tokens(self) -> int:
+        """Tokens this record contributes to :meth:`FlightRecorder.
+        rates` — decode output plus computed prefill."""
+        return self.decode_tokens + self.prefill_tokens
+
 
 class FlightRecorder:
     """Fixed-capacity ring of iteration records + request summaries.
 
     One engine (or batcher) owns one recorder; a supervisor restart
     builds a fresh engine and therefore a fresh recorder — the ring
-    documents one engine incarnation, like its stats dict."""
+    documents one engine incarnation, like its stats dict.
+
+    ``record_factory`` parametrizes the record type: the serving
+    engine rings hold :class:`IterationRecord`; the trainer ring
+    (:mod:`~kubernetes_cloud_tpu.obs.train_flight`) holds
+    ``TrainStepRecord`` s.  A record type must provide ``ts``,
+    ``dur_s``, ``seq``, ``flops``, ``rate_tokens()`` and
+    ``to_dict()`` — everything else about the ring (bounded memory,
+    lock discipline, tail/rates readers) is shared."""
 
     def __init__(self, capacity: int = 1024, *,
-                 request_capacity: int = 512):
+                 request_capacity: int = 512,
+                 record_factory: type = IterationRecord):
         if capacity < 0 or request_capacity < 0:
             raise ValueError("ring capacities must be >= 0")
         self.capacity = capacity
         self.request_capacity = request_capacity
+        self._factory = record_factory
         # preallocated rings: memory is bounded by construction, not by
         # trusting every writer to also evict
         self._ring: list[Optional[IterationRecord]] = [None] * capacity
@@ -113,10 +128,10 @@ class FlightRecorder:
     def enabled(self) -> bool:
         return self.capacity > 0
 
-    def begin(self) -> IterationRecord:
+    def begin(self):
         """A fresh record for the scheduler to fill — not yet visible
         to readers (commit publishes it)."""
-        rec = IterationRecord()
+        rec = self._factory()
         rec.ts = time.time()
         return rec
 
@@ -163,11 +178,19 @@ class FlightRecorder:
             recs = recs[-last:] if last else []
         return [dict(r) for r in recs if r is not None]
 
-    def rates(self, window_s: float = 10.0) -> dict[str, float]:
+    def rates(self, window_s: float = 10.0,
+              min_records: int = 0) -> dict[str, float]:
         """Goodput tokens/s and analytical FLOPs/s over the trailing
         ``window_s`` of records — the engine refreshes its
         ``kct_engine_goodput_tokens_per_s`` / ``kct_engine_mfu``
-        gauges from this (time-gated, not every pass)."""
+        gauges from this (time-gated, not every pass).
+
+        ``min_records`` keeps at least that many newest records in the
+        window regardless of age: record timestamps are stamped at
+        *begin*, so a consumer whose units outlast ``window_s`` (a
+        trainer step with a long checkpoint save) would otherwise see
+        every committed record expire before the refresh and read an
+        all-zero rate exactly when it matters."""
         cutoff = time.time() - window_s
         tokens = 0
         flops = 0.0
@@ -178,12 +201,14 @@ class FlightRecorder:
         held = min(n, self.capacity)
         for i in range(held):
             rec = ring[(n - held + i) % self.capacity]
-            if rec is None or rec.ts < cutoff:
+            if rec is None:
+                continue
+            if rec.ts < cutoff and (held - i) > min_records:
                 continue
             if first_ts is None:
                 first_ts = rec.ts
             last_end = rec.ts + rec.dur_s
-            tokens += rec.decode_tokens + rec.prefill_tokens
+            tokens += rec.rate_tokens()
             flops += rec.flops
             busy += rec.dur_s
         if first_ts is None:
@@ -220,6 +245,7 @@ class ProfileWindow:
         self._lock = threading.Lock()
         self._armed = False  # cleared by _stop AFTER the trace is
         self._until = 0.0    # written, so wait() means "files landed"
+        self._timer: Optional[threading.Timer] = None
 
     @property
     def active(self) -> bool:
@@ -250,7 +276,21 @@ class ProfileWindow:
         timer = threading.Timer(seconds, self._stop)
         timer.daemon = True
         timer.start()
+        self._timer = timer
         return {"profiling_s": seconds, "trace_dir": self.trace_dir}
+
+    def disarm(self) -> None:
+        """Close the current window early and write the trace now —
+        the scripted-profiling path (``scripts/profile_step.py`` arms
+        a generous window, runs exactly N steps, then disarms) where
+        the interesting boundary is a step count, not a wall-clock
+        duration.  No-op when nothing is armed."""
+        with self._lock:
+            timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
+        if self._armed:
+            self._stop()
 
     def _stop(self) -> None:
         import jax

@@ -123,6 +123,34 @@ def span_flops(base: float, per_ctx: float, start: int, n: int) -> float:
     return n * base + per_ctx * (n * start + n * (n + 1) / 2.0)
 
 
+#: training multiplier over forward FLOPs: the backward pass costs
+#: ~2x the forward (one matmul each for activation grads and weight
+#: grads per forward matmul — PaLM App. B / Kaplan scaling accounting),
+#: so one train step is ~3x forward.  The optimizer apply is
+#: elementwise (no matmuls) and counts zero, which is also why the
+#: gradient-accumulation microsteps simply multiply: each pays
+#: fwd+bwd, the single apply is free.
+TRAIN_STEP_MULTIPLIER = 3.0
+
+
+def train_step_flops(cfg, batch_size: int, seq_len: int,
+                     grad_accum: int = 1) -> float:
+    """Analytical model FLOPs for ONE optimizer step: ``grad_accum``
+    microsteps of ``batch_size`` packed sequences of ``seq_len``
+    tokens, forward + backward.
+
+    Reuses :func:`decode_flops_coeffs` — so GQA and MoE (top-k experts
+    + router) configs are priced identically here and on the serving
+    plane — with :func:`span_flops` closing the causal-attention sum
+    over positions 1..seq_len, then the fwd+bwd multiplier.  This is
+    the numerator of ``kct_train_mfu``; the denominator is
+    :func:`peak_flops_per_s` times the device count doing the step.
+    """
+    base, per_ctx = decode_flops_coeffs(cfg)
+    fwd = batch_size * span_flops(base, per_ctx, 0, seq_len)
+    return TRAIN_STEP_MULTIPLIER * max(1, grad_accum) * fwd
+
+
 def peak_flops_per_s() -> Optional[float]:
     """This host's per-chip dense peak, or ``None`` when unknown.
 

@@ -27,10 +27,29 @@ runs anywhere a timeline dump lands (laptop, CI, a jump pod).
 from __future__ import annotations
 
 import statistics
+import urllib.parse
 from typing import Any, Optional, Sequence
 
 from kubernetes_cloud_tpu.obs import flops as flops_mod
 from kubernetes_cloud_tpu.obs.flight import PHASES
+from kubernetes_cloud_tpu.obs.train_flight import TRAIN_PHASES
+
+#: HTTP timeout the debug-plane CLIs (perf_report, profile_step) use
+#: against a live pod — generous because a trainer's rank-0 sidecar
+#: thread shares the GIL with the step loop, so on a saturated host a
+#: response can lag tens of seconds behind the request
+DEBUG_HTTP_TIMEOUT_S = 60.0
+
+
+def debug_endpoint(url: str, path: str, query: str = "") -> str:
+    """Normalize a pod URL (bare ``host[:port]`` accepted) and swap in
+    a debug-plane path — shared by every script that points at a live
+    pod (the path is replaced, like load_test's ``metrics_endpoint``)."""
+    if "://" not in url:  # bare host[:port] — urlsplit would read the
+        url = "http://" + url  # host as the scheme
+    parts = urllib.parse.urlsplit(url)
+    return urllib.parse.urlunsplit(
+        (parts.scheme, parts.netloc, path, query, ""))
 
 #: a prefill-bearing iteration counts as a stall when it runs longer
 #: than this multiple of the median decode-only iteration
@@ -273,3 +292,295 @@ def summarize(entry: dict, *, peak_flops: Optional[float] = None) -> dict:
                                 if a["ttft"]["prefill_mean_s"] is not None
                                 else None),
     }
+
+
+# ---------------------------------------------------------------------------
+# training timeline (TrainStepRecord rings / trainer metrics JSONL)
+# ---------------------------------------------------------------------------
+
+
+def analyze_train(entry: dict, *,
+                  peak_flops: Optional[float] = None) -> dict[str, Any]:
+    """Analyze one trainer timeline entry (``/debug/timeline`` from
+    the rank-0 sidecar, or :func:`train_entry_from_metrics` over the
+    metrics JSONL) into the ``perf_report --train`` sections: phase
+    share, data-stall share, checkpoint overhead, divergence events,
+    per-host straggler table, tokens/s and train MFU."""
+    iters: list[dict] = list(entry.get("iterations") or [])
+    meta: dict = dict(entry.get("meta") or {})
+    if peak_flops is None:
+        peak_flops = meta.get("peak_flops_per_s")
+
+    busy = sum(r.get("dur_s", 0.0) for r in iters)
+    phase_seconds = {p: 0.0 for p in TRAIN_PHASES}
+    for r in iters:
+        for p, v in (r.get("phases") or {}).items():
+            phase_seconds[p] = phase_seconds.get(p, 0.0) + v
+    accounted = sum(phase_seconds.values())
+    other = max(busy - accounted, 0.0)
+    denom = busy if busy > 0 else 1.0
+    phase_share = {p: v / denom for p, v in phase_seconds.items()}
+    phase_share["other"] = other / denom
+
+    span = 0.0
+    if iters:
+        span = max((iters[-1].get("ts", 0.0) + iters[-1].get("dur_s", 0.0))
+                   - iters[0].get("ts", 0.0), busy, 1e-9)
+
+    tokens = sum(r.get("tokens", 0) for r in iters)
+    flops_total = sum(r.get("flops", 0.0) for r in iters)
+    flops_per_s = flops_total / span if span else 0.0
+
+    # data stalls: share of busy time the loop waited on the input
+    # pipeline (>~15-20% sustained means the data path, not the chips,
+    # bounds throughput)
+    data_stall = {
+        "seconds": phase_seconds["data_load"],
+        "share": phase_seconds["data_load"] / denom,
+        "worst_step_s": max((r.get("phases", {}).get("data_load", 0.0)
+                             for r in iters), default=0.0),
+    }
+
+    # checkpoint overhead: the step-loop blocking slice of each save
+    saves = [r["phases"]["checkpoint_save"] for r in iters
+             if r.get("phases", {}).get("checkpoint_save")]
+    checkpoint = {
+        "count": len(saves),
+        "seconds_total": sum(saves),
+        "mean_s": statistics.mean(saves) if saves else None,
+        "share": sum(saves) / denom,
+    }
+
+    divergence = {"count": 0, "kinds": {}, "steps": []}
+    recompiles = 0
+    for r in iters:
+        if r.get("recompiled"):
+            recompiles += 1
+        kind = r.get("divergence")
+        if kind:
+            divergence["count"] += 1
+            divergence["kinds"][kind] = divergence["kinds"].get(kind, 0) + 1
+            if len(divergence["steps"]) < 16:
+                divergence["steps"].append(r.get("step"))
+
+    # straggler table: per-host mean/max step seconds + the skew series
+    host_rows: list[list[float]] = []
+    # a metrics-JSONL dump has skew_s (perf/step_skew) but no per-host
+    # breakdown (host_step_s is None there) — the skew series must not
+    # be gated on the breakdown or the offline path reports zero skew
+    skews = [r.get("skew_s") or 0.0 for r in iters
+             if r.get("host_step_s") or r.get("skew_s")]
+    for r in iters:
+        hs = r.get("host_step_s")
+        if not hs:
+            continue
+        for i, v in enumerate(hs):
+            while len(host_rows) <= i:
+                host_rows.append([])
+            host_rows[i].append(v)
+    straggler = {
+        "hosts": [{"host": i, "mean_s": statistics.mean(v),
+                   "max_s": max(v)}
+                  for i, v in enumerate(host_rows) if v],
+        "skew_mean_s": statistics.mean(skews) if skews else 0.0,
+        "skew_max_s": max(skews, default=0.0),
+    }
+
+    losses = [r["loss"] for r in iters if r.get("loss") is not None]
+    finite = [x for x in losses if x == x]
+
+    return {
+        "steps": {"count": len(iters), "busy_s": busy, "span_s": span,
+                  "recompiles": recompiles},
+        "phase_seconds": phase_seconds,
+        "phase_share": phase_share,
+        "data_stall": data_stall,
+        "checkpoint": checkpoint,
+        "divergence": divergence,
+        "straggler": straggler,
+        "loss": {"first": finite[0] if finite else None,
+                 "last": finite[-1] if finite else None,
+                 "min": min(finite) if finite else None},
+        "mfu": {
+            "tokens": tokens,
+            "tokens_per_s": tokens / span if span else 0.0,
+            "flops_total": flops_total,
+            "flops_per_s": flops_per_s,
+            "peak_flops_per_s": peak_flops,
+            "mfu": flops_mod.mfu(flops_per_s, peak_flops),
+        },
+        "meta": meta,
+    }
+
+
+def render_train(analysis: dict, name: str = "trainer") -> str:
+    """The terminal where-did-the-step-go report for a training run."""
+    st = analysis["steps"]
+    lines = [
+        f"== train perf report: {name} ==",
+        f"steps: {st['count']}  busy {_fmt_s(st['busy_s'])} over "
+        f"{_fmt_s(st['span_s'])} span  "
+        f"({st['recompiles']} recompile(s))",
+        "",
+        "phase share (of busy time):",
+    ]
+    shares = analysis["phase_share"]
+    ordered = [p for p in (*TRAIN_PHASES, "other") if shares.get(p)]
+    width = max((len(p) for p in ordered), default=5)
+    for p in ordered:
+        share = shares[p]
+        secs = (analysis["phase_seconds"].get(p, 0.0) if p != "other"
+                else st["busy_s"]
+                - sum(analysis["phase_seconds"].values()))
+        bar = "#" * int(round(share * 40))
+        lines.append(f"  {p:<{width}}  {share * 100:5.1f}%  "
+                     f"{_fmt_s(max(secs, 0.0)):>9}  {bar}")
+    ds = analysis["data_stall"]
+    lines.append("")
+    lines.append(
+        f"data stalls: {ds['share'] * 100:.1f}% of busy time "
+        f"({_fmt_s(ds['seconds'])} total, worst step "
+        f"{_fmt_s(ds['worst_step_s'])})"
+        + (" - input pipeline bound; add loader parallelism"
+           if ds["share"] > 0.2 else ""))
+    ck = analysis["checkpoint"]
+    if ck["count"]:
+        lines.append(
+            f"checkpoints: {ck['count']} saves, "
+            f"{_fmt_s(ck['seconds_total'])} total "
+            f"(mean {_fmt_s(ck['mean_s'])}, "
+            f"{ck['share'] * 100:.1f}% of busy time)")
+    else:
+        lines.append("checkpoints: none in the window")
+    dv = analysis["divergence"]
+    if dv["count"]:
+        kinds = ", ".join(f"{k} x{n}" for k, n in
+                          sorted(dv["kinds"].items()))
+        lines.append(
+            f"divergence: {dv['count']} event(s) ({kinds}) at "
+            f"steps {dv['steps']}")
+    else:
+        lines.append("divergence: none")
+    sg = analysis["straggler"]
+    lines.append("")
+    if len(sg["hosts"]) > 1:
+        lines.append(
+            f"stragglers ({len(sg['hosts'])} hosts): skew mean "
+            f"{_fmt_s(sg['skew_mean_s'])} / max "
+            f"{_fmt_s(sg['skew_max_s'])}")
+        lines.append(f"  {'host':>4}  {'mean':>9}  {'max':>9}")
+        for h in sg["hosts"]:
+            lines.append(f"  {h['host']:>4}  "
+                         f"{_fmt_s(h['mean_s']):>9}  "
+                         f"{_fmt_s(h['max_s']):>9}")
+    elif sg["skew_max_s"] > 0.0:
+        # offline metrics dump: skew was recorded but the per-host
+        # breakdown never leaves the live ring
+        lines.append(
+            f"stragglers: skew mean {_fmt_s(sg['skew_mean_s'])} / max "
+            f"{_fmt_s(sg['skew_max_s'])} (per-host table n/a in a "
+            f"metrics dump)")
+    else:
+        lines.append("stragglers: single host (skew n/a)")
+    lo = analysis["loss"]
+    if lo["last"] is not None:
+        lines.append(f"loss: {lo['first']:.4f} -> {lo['last']:.4f} "
+                     f"(min {lo['min']:.4f})")
+    mf = analysis["mfu"]
+    lines.append("")
+    lines.append(f"throughput: {mf['tokens_per_s']:.1f} tokens/s "
+                 f"({mf['tokens']} tokens)")
+    peak = mf["peak_flops_per_s"]
+    if peak:
+        lines.append(
+            f"train MFU: {mf['mfu'] * 100:.2f}% "
+            f"({_fmt_count(mf['flops_per_s'])}FLOP/s of "
+            f"{_fmt_count(peak)}FLOP/s peak)")
+    else:
+        lines.append(
+            f"train MFU: n/a (peak unknown - set {flops_mod.PEAK_ENV}); "
+            f"model FLOPs {_fmt_count(mf['flops_per_s'])}FLOP/s")
+    return "\n".join(lines)
+
+
+def summarize_train(entry: dict, *,
+                    peak_flops: Optional[float] = None) -> dict:
+    """Compact benchmark-JSON embedding of a training timeline."""
+    a = analyze_train(entry, peak_flops=peak_flops)
+    return {
+        "steps": a["steps"]["count"],
+        "phase_share": {p: round(v, 4)
+                        for p, v in a["phase_share"].items() if v},
+        "data_stall_share": round(a["data_stall"]["share"], 4),
+        "checkpoint_share": round(a["checkpoint"]["share"], 4),
+        "divergence_events": a["divergence"]["count"],
+        "recompiles": a["steps"]["recompiles"],
+        "tokens_per_s": round(a["mfu"]["tokens_per_s"], 2),
+        "flops_per_s": a["mfu"]["flops_per_s"],
+        "mfu": round(a["mfu"]["mfu"], 6),
+        "skew_max_s": round(a["straggler"]["skew_max_s"], 6),
+    }
+
+
+def train_entry_from_metrics(records: Sequence[dict]) -> dict:
+    """Reconstruct a trainer timeline entry from the metrics JSONL
+    stream (``logs/<run>.metrics.jsonl``) — the offline path when no
+    sidecar was scraped.  Per-step records carry the ``perf/*`` phase
+    decomposition the trainer logs; divergence event records mark the
+    step they interrupted."""
+    iters: list[dict] = []
+    diverged: dict[int, str] = {}
+    for rec in records:
+        if rec.get("event") == "divergence":
+            step = rec.get("step")
+            if step is not None:
+                diverged[int(step)] = rec.get("divergence/kind", "unknown")
+            continue
+        if "perf/total_time_per_step" not in rec:
+            continue
+        gas = rec.get("perf/gas_time", 0.0)
+        data_s = rec.get("perf/data_load_time", 0.0)
+        phases = {"data_load": data_s,
+                  "grad_accum": max(gas - data_s, 0.0),
+                  "optimizer_apply": rec.get("perf/opt_time", 0.0),
+                  "checkpoint_save": rec.get("perf/checkpoint_time", 0.0),
+                  "prompt_sample": rec.get("perf/prompt_time", 0.0),
+                  "eval": rec.get("perf/eval_time", 0.0),
+                  "host_sync": rec.get("perf/host_sync_time", 0.0)}
+        phases = {k: v for k, v in phases.items() if v > 0.0}
+        step = rec.get("step") or 0
+        iters.append({
+            "seq": step, "step": step, "ts": rec.get("ts", 0.0),
+            "dur_s": rec.get("perf/step_wall_time",
+                             rec["perf/total_time_per_step"]),
+            "phases": phases,
+            "tokens": rec.get("perf/tokens", 0),
+            "loss": rec.get("train/loss"),
+            "grad_norm": rec.get("train/grad_norm"),
+            "flops": rec.get("perf/model_flops", 0.0),
+            "skew_s": rec.get("perf/step_skew", 0.0),
+            "host_step_s": None,
+            "recompiled": False,
+            "divergence": None,
+        })
+    seen = set()
+    for r in iters:
+        if r["step"] in diverged:
+            r["divergence"] = diverged[r["step"]]
+            seen.add(r["step"])
+    # rollback/halt interrupt the step before its perf record lands —
+    # synthesize a marker record so the event still shows up offline
+    # (stamped at the timeline's end: a zero ts on the last record
+    # would drag the wall-span term negative and silently collapse
+    # span to busy time, inflating tokens/s and MFU on exactly the
+    # diverged runs an operator is diagnosing)
+    end_ts = max((r["ts"] + r["dur_s"] for r in iters), default=0.0)
+    for step, kind in sorted(diverged.items()):
+        if step not in seen:
+            iters.append({"seq": step, "step": step, "ts": end_ts,
+                          "dur_s": 0.0, "phases": {}, "tokens": 0,
+                          "loss": None, "grad_norm": None, "flops": 0.0,
+                          "skew_s": 0.0, "host_step_s": None,
+                          "recompiled": False, "divergence": kind})
+    return {"kind": "trainer", "iterations": iters, "requests": [],
+            "meta": {}}

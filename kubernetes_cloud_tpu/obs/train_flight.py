@@ -1,0 +1,90 @@
+"""Step flight recorder for the training loop.
+
+The serving engine's flight recorder (:mod:`~kubernetes_cloud_tpu.obs.
+flight`) answers "where did this iteration's time go"; the trainer has
+the same question at step granularity — a slow run on a TPU slice must
+be attributable to data stalls, compute, checkpoint I/O, recompilation
+or a straggling host *from a scrape*, not from a wandb login.  This
+module is the training-side record type over the SAME ring machinery
+(:class:`~kubernetes_cloud_tpu.obs.flight.FlightRecorder` with
+``record_factory=TrainStepRecord``): bounded memory by construction,
+pointer-bump-only lock, snapshot readers, ``rates()`` for the
+MFU/goodput gauges.
+
+A :class:`TrainStepRecord` is one optimizer step broken into the
+:data:`TRAIN_PHASES` vocabulary plus the step's training signals (step
+number, tokens, loss, grad norm, analytical train FLOPs), the
+sentinel's divergence verdict, and — on rank 0 of a multi-host run —
+the per-host step-time heartbeat the straggler view aggregates.
+
+Import-light like the rest of ``obs`` (no jax, no numpy): the per-host
+times land as a plain list.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+from kubernetes_cloud_tpu.obs.flight import FlightRecorder
+
+#: the phase vocabulary every trainer-timeline consumer (report,
+#: dashboard, tests) joins on — one optimizer step decomposes into
+#: these named slices; time in none of them (host bookkeeping, metric
+#: emission) is the analyzer's "other" bucket
+TRAIN_PHASES = ("data_load", "grad_accum", "optimizer_apply",
+                "checkpoint_save", "eval", "prompt_sample", "host_sync")
+
+
+class TrainStepRecord:
+    """One optimizer step: phase timings + training signals.
+
+    Same design as the engine's ``IterationRecord``: plain ``__slots__``
+    attributes, one allocation per step, construction cost inside the
+    measured overhead budget (BENCHMARKS.md "Train recorder
+    overhead")."""
+
+    __slots__ = ("seq", "ts", "dur_s", "phases", "step", "tokens",
+                 "loss", "grad_norm", "flops", "recompiled",
+                 "divergence", "host_step_s", "skew_s")
+
+    def __init__(self) -> None:
+        self.seq = 0             # assigned by commit(), monotonically
+        self.ts = 0.0            # wall-clock start (time.time)
+        self.dur_s = 0.0         # whole step wall (perf_counter)
+        self.phases: dict[str, float] = {}  # phase -> seconds
+        self.step = 0            # optimizer step number (1-based)
+        self.tokens = 0          # tokens consumed (batch x gas x ctx)
+        self.loss: Optional[float] = None
+        self.grad_norm: Optional[float] = None
+        self.flops = 0.0         # analytical train FLOPs this step
+        self.recompiled = False  # a new batch-shape signature compiled
+        self.divergence: Optional[str] = None  # sentinel verdict kind
+        #: per-host step seconds (rank 0 of a multi-host run; None
+        #: when single-host or on non-zero ranks)
+        self.host_step_s: Optional[list] = None
+        self.skew_s = 0.0        # max - min across hosts
+
+    def rate_tokens(self) -> int:
+        return self.tokens
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {s: getattr(self, s) for s in self.__slots__
+             if s != "phases"}
+        # /debug/timeline must stay RFC-parseable: a diverged step's
+        # NaN loss would otherwise serialize as the bare token `NaN`
+        # (json.dumps allow_nan) and break strict parsers (jq,
+        # JSON.parse) on exactly the runs the endpoint diagnoses — the
+        # `divergence` field already names what happened
+        for k in ("loss", "grad_norm"):
+            if d[k] is not None and not math.isfinite(d[k]):
+                d[k] = None
+        d["phases"] = {k: round(v, 9) for k, v in self.phases.items()}
+        return d
+
+
+def train_recorder(capacity: int = 1024) -> FlightRecorder:
+    """The trainer's ring: :class:`TrainStepRecord` s, no request ring
+    (training has steps, not requests)."""
+    return FlightRecorder(capacity, request_capacity=0,
+                          record_factory=TrainStepRecord)

@@ -125,6 +125,28 @@ def build_parser() -> DashParser:
                              "'fsdp=4,model=2' (default: all-fsdp)")
     parser.add_argument("--preset-override", type=str, default="",
                         help="JSON dict of CausalLMConfig field overrides")
+    # Training observability plane (deploy/README.md)
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="Rank-0 /metrics + /debug sidecar port "
+                             "(0 = ephemeral; omit to disable)")
+    parser.add_argument("--flight-records", type=val.non_negative(int),
+                        default=1024,
+                        help="Step flight-recorder ring capacity "
+                             "(0 disables phase-level introspection)")
+    parser.add_argument("--eval-every", type=val.non_negative(int),
+                        default=0,
+                        help="Evaluate every N steps (0 = off)")
+    parser.add_argument("--divergence-policy", type=str, default="warn",
+                        choices=("off", "warn", "halt", "rollback"),
+                        help="Divergence-sentinel response: warn (log + "
+                             "skip poisoned applies), halt (stop the "
+                             "run), rollback (restore last checkpoint)")
+    parser.add_argument("--profile-dir", type=str,
+                        default="/tmp/kct-profile",
+                        help="Where /debug/profile's jax.profiler trace "
+                             "lands (point at a mounted volume on "
+                             "ephemeral pods; matches serving's "
+                             "--profile-dir)")
     return parser
 
 
@@ -220,6 +242,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     logging.basicConfig(level=args.log_level)
     log = logging.getLogger("finetuner")
 
+    # chaos drills (deploy/README "Failure modes"): arm KCT_FAULTS at
+    # boot exactly like serve/boot.py, so the documented train.step /
+    # train.data / train.checkpoint drills work on a trainer pod too
+    from kubernetes_cloud_tpu import faults
+
+    faults.install_from_env()
+
     maybe_initialize_distributed()
 
     mined = _mine_ds_config(args.ds_config)
@@ -313,7 +342,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prompt_every=max(0, args.prompt_every),
         prompt_tokens=args.prompt_tokens,
         prompt_samples=args.prompt_samples, top_k=args.top_k,
-        top_p=args.top_p, temperature=args.temperature)
+        top_p=args.top_p, temperature=args.temperature,
+        metrics_port=args.metrics_port,
+        flight_records=args.flight_records,
+        eval_every=args.eval_every,
+        divergence_policy=args.divergence_policy,
+        profile_dir=args.profile_dir)
 
     tokenizer = None
     if args.prompt_file:

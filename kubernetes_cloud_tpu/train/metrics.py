@@ -11,10 +11,25 @@ the operational artifact the reference lacks when wandb is unset.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from typing import Any, Mapping, Optional, Sequence
+
+from kubernetes_cloud_tpu import obs
+
+log = logging.getLogger(__name__)
+
+#: every numeric value the logger emits is mirrored here (label `key`
+#: is the wandb-surface metric name — a bounded, code-chosen
+#: vocabulary: train/*, perf/*, eval/*), so a Prometheus scrape and
+#: the wandb/JSONL stream can never disagree about the same step
+_M_MIRROR = obs.gauge(
+    "kct_train_metric",
+    "Last logged value of each trainer metrics-stream key "
+    "(train/*, perf/*, eval/*) — the scrape-side mirror of the "
+    "wandb/JSONL stream.", ("run", "key"))
 
 
 def _is_rank0() -> bool:
@@ -95,7 +110,28 @@ class MetricsLogger:
                 self._wandb = wandb.init(
                     project=project, name=run_name, id=run_name,
                     resume="allow" if resume else "never")
-            except Exception:
+                # A divergence rollback rewinds the trainer step, and
+                # wandb silently DROPS rows whose explicit step is
+                # below its internal monotonic counter — the recovered
+                # span would vanish from the dashboard.  Chart against
+                # a logged train/step instead (log() adds it) and let
+                # wandb's internal step auto-increment.
+                try:
+                    self._wandb.define_metric(
+                        "*", step_metric="train/step")
+                except Exception:  # noqa: BLE001 - older wandb lacks
+                    # define_metric; rows still land, the x-axis just
+                    # falls back to wandb's internal step
+                    pass
+            except Exception as e:  # noqa: BLE001 - wandb init is
+                # best-effort by design (network, auth, version skew);
+                # the JSONL fallback below keeps the run observable —
+                # but silence here meant operators discovered the
+                # missing dashboard hours into a run, so say it loudly.
+                log.warning(
+                    "wandb init failed (%s: %s); metrics fall back to "
+                    "the JSONL stream under %s", type(e).__name__, e,
+                    log_dir)
                 self._wandb = None
         if self._wandb is None:
             self._fh = JsonlWriter(
@@ -105,12 +141,37 @@ class MetricsLogger:
             commit: bool = True) -> None:
         if not self.enabled:
             return
+        self._mirror(metrics)
         if self._wandb is not None:
-            self._wandb.log(dict(metrics), step=step, commit=commit)
+            # no explicit step= (see init): a post-rollback rewound
+            # step would make wandb drop the whole row
+            payload = dict(metrics)
+            if step is not None:
+                payload.setdefault("train/step", step)
+            self._wandb.log(payload, commit=commit)
             return
         self._fh.write({"ts": time.time(), "step": step, **{
             k: (float(v) if hasattr(v, "__float__") else v)
             for k, v in metrics.items()}})
+
+    def _mirror(self, metrics: Mapping[str, Any]) -> None:
+        """Mirror every numeric value into the obs registry so a
+        ``/metrics`` scrape and the wandb/JSONL stream agree.  Never
+        lets instrumentation break the primary sink.
+
+        Only namespaced keys (``train/*``, ``perf/*``, ``eval/*``,
+        ``divergence/*``) are mirrored — the bounded vocabulary the
+        gauge documents.  ``log_table``'s JSONL fallback routes
+        generation-sample rows through ``log()``, and its bare column
+        names ('Step', 'Contexts Trained') must not become gauge
+        series."""
+        try:
+            for k, v in metrics.items():
+                if "/" in str(k) and hasattr(v, "__float__"):
+                    _M_MIRROR.labels(run=self.run_name,
+                                     key=str(k)).set(float(v))
+        except Exception:  # noqa: BLE001 - pragma: no cover
+            log.exception("metrics mirror failed")
 
     def log_table(self, key: str, columns: Sequence[str],
                   rows: Sequence[Sequence[Any]]) -> None:

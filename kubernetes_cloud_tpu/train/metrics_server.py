@@ -1,0 +1,88 @@
+"""Rank-0 trainer observability sidecar: /metrics + /debug/* over HTTP.
+
+The serving pods got a Prometheus endpoint, a flight-recorder dump and
+a bounded profiler-arming endpoint in PRs 4 and 7; this gives the
+trainer the same plane by *subclassing the serving front-end* rather
+than duplicating it: :class:`TrainerMetricsServer` is a
+:class:`~kubernetes_cloud_tpu.serve.server.ModelServer` with zero
+models whose debug surface is the trainer's step flight recorder.
+Everything load-bearing is inherited —
+
+* ``GET /metrics`` renders the process-global registry (all the
+  ``kct_train_*`` families plus the ``kct_train_metric`` wandb-stream
+  mirror), guarded by the ``metrics.render`` fault site with the same
+  containment contract as serving: a raising or hanging scrape answers
+  that request only, never the training loop;
+* ``GET /debug/timeline?last=N`` dumps the trainer ring (phase
+  timings, loss/grad-norm, divergence verdicts, per-host heartbeats)
+  under the ``debug.render`` site — ``scripts/perf_report.py
+  --train``'s live input;
+* ``GET /debug/profile?seconds=N`` arms one bounded ``jax.profiler``
+  window (409 while armed) via the shared
+  :class:`~kubernetes_cloud_tpu.obs.flight.ProfileWindow` —
+  ``scripts/profile_step.py --url`` drives it;
+* ``GET /healthz`` stays unconditionally alive; ``GET /readyz``
+  reports training progress (step / total) instead of model health.
+
+The server runs as a daemon thread on rank 0 only (non-zero hosts
+stream their heartbeat to rank 0 through the step allgather instead of
+each exposing a port), started by ``Trainer.train()`` when
+``TrainerConfig.metrics_port`` is set.  It must NEVER be able to stall
+a training step: every handler reads snapshots (ring tail, registry
+render) and the containment chaos tests in ``tests/test_train_obs.py``
+lock the fault-site behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from kubernetes_cloud_tpu import obs
+from kubernetes_cloud_tpu.obs.flight import FlightRecorder
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+
+class TrainerMetricsServer(ModelServer):
+    """The trainer sidecar: ModelServer with no models, one recorder.
+
+    ``meta`` rides along in the timeline dump (analytical FLOPs
+    coefficients, world size, peak FLOPs) exactly like an engine's
+    ``debug_meta`` — ``perf_report --train`` reads its
+    ``peak_flops_per_s`` for the MFU denominator.  ``status`` supplies
+    the live ``/readyz`` body (current step, total steps).
+    """
+
+    def __init__(self, recorder: FlightRecorder, *,
+                 meta: Optional[dict] = None,
+                 status: Optional[Callable[[], dict]] = None,
+                 host: str = "0.0.0.0", port: int = 9090,
+                 profile_dir: str = "/tmp/kct-profile"):
+        super().__init__([], host=host, port=port)
+        self.recorder = recorder
+        self.meta = dict(meta or {})
+        self._status = status
+        self.profiler = obs.ProfileWindow(profile_dir)
+
+    # -- debug plane overrides ---------------------------------------------
+    # (the fault-site guards and error containment live in the parent's
+    # _debug()/_metrics(); only the data source differs)
+
+    def _debug_timeline(self, params) -> tuple[int, dict]:
+        last = int(params.get("last", ["256"])[0])
+        if last < 0:
+            raise ValueError("last must be >= 0")
+        entry = {"kind": "trainer",
+                 "iterations": self.recorder.tail(last),
+                 "requests": [],
+                 "meta": dict(self.meta)}
+        return 200, {"models": {"trainer": entry}}
+
+    def _readyz(self) -> tuple[int, dict]:
+        body = {"status": "training"}
+        if self._status is not None:
+            try:
+                body.update(self._status())
+            except Exception:  # noqa: BLE001 - a status-callback bug
+                # must not flip the sidecar to unready
+                body["status_error"] = "status callback failed"
+        return 200, body

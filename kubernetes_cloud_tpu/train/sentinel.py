@@ -1,0 +1,182 @@
+"""Divergence sentinel: catch a blowing-up run BEFORE it wastes steps.
+
+The repo's only divergence story so far is the save-time NaN guard
+(:func:`~kubernetes_cloud_tpu.core.debug.assert_tree_finite` under
+``KCT_DEBUG_CHECKS``) — by the time it fires, the optimizer has already
+applied NaN gradients and every step since the blow-up was wasted.
+The sentinel promotes that into *detection*: every step's loss (and
+grad norm) is checked on the host — the loss is already transferred
+for logging, so the check is free — and an anomaly becomes a typed
+:class:`DivergenceDetected` event in the metrics stream, a
+``kct_train_divergence_events_total`` increment, and a config-gated
+policy response:
+
+* ``warn``   — log + count; on the gradient-accumulation path a
+  non-finite loss additionally skips the optimizer apply (params are
+  never poisoned), training continues.  The fused path (``gas == 1``)
+  applies inside the same XLA program that computes the loss, so its
+  detection is post-apply — the trainer then refuses every subsequent
+  checkpoint/final save while the params are tainted, so the newest
+  persisted state is always finite.
+* ``halt``   — stop the run cleanly (``result["diverged"] = True``);
+  the last checkpoint is the recovery point.  For a workflow-driven
+  run this is the "fail fast, don't burn the slice" policy.
+* ``rollback`` — restore the newest checkpoint, skip past the
+  offending batch, and continue; after ``max_rollbacks`` consecutive
+  rollbacks the policy escalates to ``halt`` (a deterministic blow-up
+  is not recoverable by rewinding).
+
+Detection, in order of confidence:
+
+1. **Non-finite** loss or grad norm — unambiguous.
+2. **Loss spike** — EWMA mean + EWMA absolute deviation; a loss above
+   ``mean + loss_factor * dev`` (after ``min_history`` observations,
+   so the early fast-falling regime never false-fires) is a spike.
+3. **Grad-norm anomaly** — same statistic over the global grad norm,
+   with its own factor (grad norms are spikier than losses).
+
+Spiky-but-finite observations are still folded into the EWMA, so a
+genuine regime change re-normalizes instead of alarming forever.
+
+Pure host arithmetic over floats — no jax — so tests drive it with
+literal sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+#: policies the trainer accepts (``TrainerConfig.divergence_policy``)
+POLICIES = ("off", "warn", "halt", "rollback")
+
+#: bounded event-kind vocabulary (metric label + event records)
+KINDS = ("nonfinite_loss", "nonfinite_grad", "loss_spike",
+         "grad_norm_spike")
+
+
+@dataclasses.dataclass(frozen=True)
+class DivergenceDetected:
+    """One sentinel verdict — the typed event logged into the metrics
+    stream and counted by ``kct_train_divergence_events_total``."""
+
+    step: int
+    kind: str            # one of KINDS
+    value: float         # the offending observation
+    threshold: Optional[float]  # None for non-finite (no statistic)
+    policy: str          # the policy in force when detected
+
+    def to_record(self) -> dict:
+        return {"event": "divergence", "divergence/kind": self.kind,
+                "divergence/value": self.value,
+                "divergence/threshold": self.threshold,
+                "divergence/policy": self.policy}
+
+
+def _finite(x: float) -> bool:
+    return math.isfinite(x)
+
+
+#: deviation floor as a fraction of |mean|: on a plateaued curve the
+#: EWMA deviation decays toward zero and a razor-thin threshold would
+#: flag sub-percent wiggles as spikes (observed on the CPU ramp:
+#: 6.26864 "spiking" over a 6.26814 threshold) — the floor keeps the
+#: spike bar at least factor x 1% of the signal away from the mean
+MIN_REL_DEV = 0.01
+
+
+class _Ewma:
+    """EWMA mean + EWMA absolute deviation of a scalar stream."""
+
+    def __init__(self, alpha: float):
+        self.alpha = alpha
+        self.mean: Optional[float] = None
+        self.dev: Optional[float] = None
+        self.n = 0
+
+    def threshold(self, factor: float) -> Optional[float]:
+        if self.mean is None or self.dev is None:
+            return None
+        floor = max(MIN_REL_DEV * abs(self.mean), 1e-12)
+        return self.mean + factor * max(self.dev, floor)
+
+    def update(self, x: float) -> None:
+        self.n += 1
+        if self.mean is None:
+            self.mean, self.dev = x, 0.0
+            return
+        a = self.alpha
+        self.dev = (1 - a) * self.dev + a * abs(x - self.mean)
+        self.mean = (1 - a) * self.mean + a * x
+
+
+class DivergenceSentinel:
+    """Per-run anomaly detector; one per Trainer, reset on rollback
+    (the restored regime's statistics start fresh)."""
+
+    def __init__(self, policy: str = "warn", *,
+                 loss_factor: float = 4.0, grad_factor: float = 6.0,
+                 alpha: float = 0.05, min_history: int = 20):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"divergence policy must be one of {POLICIES}, "
+                f"got {policy!r}")
+        self.policy = policy
+        self.loss_factor = loss_factor
+        self.grad_factor = grad_factor
+        self.alpha = alpha
+        self.min_history = min_history
+        self._loss = _Ewma(alpha)
+        self._grad = _Ewma(alpha)
+
+    @property
+    def enabled(self) -> bool:
+        return self.policy != "off"
+
+    def reset(self) -> None:
+        self._loss = _Ewma(self.alpha)
+        self._grad = _Ewma(self.alpha)
+
+    def _observe(self, tracker: _Ewma, step: int, value: float,
+                 factor: float, nonfinite_kind: str,
+                 spike_kind: str) -> Optional[DivergenceDetected]:
+        if not self.enabled:
+            return None
+        if not _finite(value):
+            return DivergenceDetected(step, nonfinite_kind, value,
+                                      None, self.policy)
+        event = None
+        if tracker.n >= self.min_history:
+            thr = tracker.threshold(factor)
+            if thr is not None and value > thr:
+                event = DivergenceDetected(step, spike_kind, value,
+                                           thr, self.policy)
+        tracker.update(value)  # spikes fold in: regime changes adapt
+        return event
+
+    def observe_loss(self, step: int,
+                     loss: float) -> Optional[DivergenceDetected]:
+        """Check the step's mean loss — called BEFORE the optimizer
+        apply on the accumulation path, so a poisoned step never
+        touches the parameters."""
+        return self._observe(self._loss, step, loss, self.loss_factor,
+                             "nonfinite_loss", "loss_spike")
+
+    def observe_grad_norm(self, step: int,
+                          grad_norm: float
+                          ) -> Optional[DivergenceDetected]:
+        return self._observe(self._grad, step, grad_norm,
+                             self.grad_factor, "nonfinite_grad",
+                             "grad_norm_spike")
+
+    def should_apply(self, event: Optional[DivergenceDetected]) -> bool:
+        """Whether the optimizer apply should still run given a
+        pre-apply verdict: a non-finite loss never applies (the grads
+        are garbage); a finite spike applies only under ``warn``/
+        ``off`` (``halt``/``rollback`` discard the step anyway)."""
+        if event is None:
+            return True
+        if event.kind.startswith("nonfinite"):
+            return False
+        return self.policy in ("off", "warn")

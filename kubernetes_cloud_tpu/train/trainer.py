@@ -35,11 +35,20 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.core.distributed import allgather_step_times
 from kubernetes_cloud_tpu.core.memory import DeviceMemoryUsage
 from kubernetes_cloud_tpu.data.tokenized import sharded_batches
 from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig, loss_fn
+from kubernetes_cloud_tpu.obs import flops as obs_flops
+from kubernetes_cloud_tpu.obs import train_flight
 from kubernetes_cloud_tpu.models.generate import generate
 from kubernetes_cloud_tpu.train.metrics import MetricsLogger
+from kubernetes_cloud_tpu.train.sentinel import (
+    POLICIES,
+    DivergenceDetected,
+    DivergenceSentinel,
+)
 from kubernetes_cloud_tpu.train.train_step import (
     TrainConfig,
     init_train_state,
@@ -50,6 +59,46 @@ from kubernetes_cloud_tpu.weights.checkpoint import Checkpointer, mark_ready
 from kubernetes_cloud_tpu.weights.tensorstream import write_pytree
 
 log = logging.getLogger(__name__)
+
+# Trainer metric families — the training-plane mirror of the engine's
+# kct_engine_* set (obs/catalog.py + the deploy/README.md metric
+# catalog carry the full detail; kct-lint KCT-REG keeps all three in
+# sync).  Children are bound once per Trainer under the run label.
+_M_STEP_S = obs.histogram(
+    "kct_train_step_seconds",
+    "One optimizer step's seconds by named phase (data_load / "
+    "grad_accum / optimizer_apply / checkpoint_save / eval / "
+    "prompt_sample / host_sync).", ("run", "phase"))
+_M_TOKENS = obs.counter(
+    "kct_train_tokens_total",
+    "Tokens consumed by completed training steps.", ("run",))
+_M_DATA_STALL = obs.counter(
+    "kct_train_data_stall_seconds_total",
+    "Seconds the step loop spent blocked on the input pipeline "
+    "(the data_load phase, accumulated).", ("run",))
+_M_CKPT_S = obs.histogram(
+    "kct_train_checkpoint_seconds",
+    "Checkpoint-save wall seconds (the step-loop blocking portion "
+    "of the async save).", ("run",),
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             120.0, 300.0, 600.0))
+_M_RECOMPILES = obs.counter(
+    "kct_train_recompiles_total",
+    "New batch-shape signatures compiled after the first (each one "
+    "implies an XLA recompilation of a step program).", ("run",))
+_M_MFU = obs.gauge(
+    "kct_train_mfu",
+    "Training model-FLOPs utilization over the trailing "
+    "flight-recorder window (0 while the chip peak is unknown - "
+    "set KCT_PEAK_FLOPS).", ("run",))
+_M_DIVERGENCE = obs.counter(
+    "kct_train_divergence_events_total",
+    "Divergence-sentinel events by kind (nonfinite_loss | "
+    "nonfinite_grad | loss_spike | grad_norm_spike).", ("run", "kind"))
+_M_SKEW = obs.gauge(
+    "kct_train_step_skew_seconds",
+    "Max - min per-host step seconds at the last heartbeat "
+    "(multi-host straggler signal; 0 single-host).", ("run",))
 
 
 @dataclasses.dataclass
@@ -75,6 +124,30 @@ class TrainerConfig:
     top_k: int = 50
     top_p: float = 0.95
     temperature: float = 1.0
+    # Observability (deploy/README.md "Training observability")
+    flight_records: int = 1024   # step flight-recorder ring (0 = off)
+    #: rank-0 /metrics + /debug sidecar port; None disables, 0 binds an
+    #: ephemeral port (tests read ``trainer.metrics_server.port``)
+    metrics_port: Optional[int] = None
+    #: where /debug/profile's jax.profiler trace lands — point it at a
+    #: mounted volume on ephemeral pods or the trace dies with the pod
+    profile_dir: str = "/tmp/kct-profile"
+    eval_every: int = 0          # steps between eval passes (0 = off)
+    eval_batches: int = 8        # eval-pass length cap
+    # Divergence sentinel (train/sentinel.py)
+    divergence_policy: str = "warn"   # off | warn | halt | rollback
+    divergence_loss_factor: float = 4.0
+    divergence_grad_factor: float = 6.0
+    divergence_min_history: int = 20
+    max_rollbacks: int = 3       # consecutive rollbacks before halt
+
+    def __post_init__(self):
+        if self.divergence_policy not in POLICIES:
+            raise ValueError(
+                f"divergence_policy must be one of {POLICIES}, got "
+                f"{self.divergence_policy!r}")
+        if self.flight_records < 0:
+            raise ValueError("flight_records must be >= 0")
 
     @property
     def run_dir(self) -> str:
@@ -283,6 +356,44 @@ class Trainer:
         self._preempted = False
         self._handler_installed = False
 
+        # -- observability plane (deploy/README "Training observability")
+        self._rank0 = jax.process_index() == 0
+        #: always-on step flight recorder (flight_records=0 disables
+        #: the ring — record fill, FLOPs accounting, MFU ring scan —
+        #: for overhead A/Bs, like the engine's knob; the per-step
+        #: timing and the metric families are the pre-existing JSONL
+        #: surface and stay on in both arms)
+        self.flight = train_flight.train_recorder(
+            trainer_cfg.flight_records)
+        self.sentinel = DivergenceSentinel(
+            trainer_cfg.divergence_policy,
+            loss_factor=trainer_cfg.divergence_loss_factor,
+            grad_factor=trainer_cfg.divergence_grad_factor,
+            min_history=trainer_cfg.divergence_min_history)
+        #: rank-0 HTTP sidecar, started/stopped by train()
+        self.metrics_server = None
+        self._batches = None
+        self._eval_loss = None
+        self._last_step = 0
+        self._flops_cache: dict[tuple[int, int], float] = {}
+        self._seen_sigs: set = set()  # (program, shapes) compile keys
+        #: injectable for tests; single-process returns a length-1 vector
+        self._allgather_step_times = allgather_step_times
+        peak = obs_flops.peak_flops_per_s()
+        #: MFU denominator: per-chip peak times every chip in the step
+        self._peak_flops = (peak * jax.device_count()) if peak else None
+        m = {"run": trainer_cfg.run_name}
+        self._m_step_s = {p: _M_STEP_S.labels(run=trainer_cfg.run_name,
+                                              phase=p)
+                          for p in train_flight.TRAIN_PHASES}
+        self._m_tokens = _M_TOKENS.labels(**m)
+        self._m_data_stall = _M_DATA_STALL.labels(**m)
+        self._m_ckpt_s = _M_CKPT_S.labels(**m)
+        self._m_recompiles = _M_RECOMPILES.labels(**m)
+        self._m_mfu = _M_MFU.labels(**m)
+        self._m_skew = _M_SKEW.labels(**m)
+        self._mfu_next = 0.0  # next rates() refresh (time-gated)
+
     # -- checkpointing -----------------------------------------------------
 
     def maybe_resume(self) -> int:
@@ -295,17 +406,29 @@ class Trainer:
         self.state = self.checkpointer.restore(self.state, step=latest)
         return int(latest)
 
-    def save_checkpoint(self, step: int, force: bool = False) -> None:
+    def save_checkpoint(self, step: int, force: bool = False) -> float:
+        """Save (async) and return the step-loop blocking seconds —
+        the ``checkpoint_save`` phase / ``kct_train_checkpoint_seconds``
+        sample."""
         from kubernetes_cloud_tpu.core.debug import (
             assert_tree_finite,
             debug_checks_enabled,
         )
 
+        t0 = time.perf_counter()
+        # the fault site sits INSIDE the timed window — an injected
+        # slow/hang is wedged storage and must be attributed to the
+        # checkpoint_save phase, same contract as train.data
+        faults.fire("train.checkpoint")
         if debug_checks_enabled():
             # Never persist a diverged state (KCT_DEBUG_CHECKS=1): a NaN
             # checkpoint silently poisons every resume after it.
             assert_tree_finite(self.state["params"], "params")
         self.checkpointer.save(step, self.state, force=force)
+        elapsed = time.perf_counter() - t0
+        if self._rank0:
+            self._m_ckpt_s.observe(elapsed)
+        return elapsed
 
     def save_final(self) -> str:
         """``results-<run>/final`` + tokenizer + ``.ready.txt``."""
@@ -425,6 +548,196 @@ class Trainer:
             np.asarray(self._preempted))
         return bool(np.any(flags))
 
+    # -- step-loop observability helpers -----------------------------------
+
+    def _make_batches(self, start_step: int, gas: int) -> None:
+        self._batches = sharded_batches(
+            self.dataset, self.cfg.batch_size, self.mesh,
+            shuffle=self.cfg.shuffle, seed=self.cfg.seed, epochs=None,
+            skip_batches=start_step * gas)  # cheap resume fast-forward
+
+    def _next_batch(self):
+        """One micro-batch, timed: the ``data_load`` phase /
+        ``kct_train_data_stall_seconds_total`` unit.  The fault site
+        sits inside the timed window — an injected ``slow`` IS a data
+        stall and must be attributed as one."""
+        t0 = time.perf_counter()
+        faults.fire("train.data")
+        batch = next(self._batches)
+        return batch, time.perf_counter() - t0
+
+    def _micro_flops(self, batch) -> float:
+        """Analytical train FLOPs of one micro-batch (cached per
+        shape)."""
+        b, s = batch["input_ids"].shape
+        key = (int(b), int(s))
+        flops = self._flops_cache.get(key)
+        if flops is None:
+            flops = self._flops_cache[key] = obs_flops.train_step_flops(
+                self.model_cfg, key[0], key[1], 1)
+        return flops
+
+    def _note_compile(self, kind: str, batch) -> bool:
+        """Track batch-shape signatures per step program; a signature
+        beyond a program's first implies an XLA recompile
+        (``kct_train_recompiles_total``)."""
+        sig = (kind,) + tuple(sorted(
+            (k, tuple(v.shape), str(v.dtype)) for k, v in batch.items()))
+        if sig in self._seen_sigs:
+            return False
+        first = not any(s[0] == kind for s in self._seen_sigs)
+        self._seen_sigs.add(sig)
+        if first:
+            return False
+        if self._rank0:
+            self._m_recompiles.inc()
+        return True
+
+    def evaluate(self, max_batches: Optional[int] = None
+                 ) -> Optional[float]:
+        """Mean eval-set loss over up to ``eval_batches`` batches (the
+        ``eval`` phase), or None without an eval dataset."""
+        if self.eval_dataset is None or len(self.eval_dataset) == 0:
+            return None
+        limit = (max_batches if max_batches is not None
+                 else self.cfg.eval_batches)
+        if self._eval_loss is None:
+            model_cfg, loss = self.model_cfg, self._loss
+
+            def eval_loss(params, batch):
+                return loss(model_cfg, params, batch)[0]
+
+            self._eval_loss = jax.jit(eval_loss)
+        total, count = 0.0, 0
+        for batch in sharded_batches(
+                self.eval_dataset, self.cfg.batch_size, self.mesh,
+                shuffle=False, epochs=1):
+            total += float(self._eval_loss(self.state["params"], batch))
+            count += 1
+            if count >= limit:
+                break
+        return total / count if count else None
+
+    def _start_metrics_server(self, total_steps: int):
+        """Rank-0 observability sidecar (``metrics_port``): /metrics,
+        /debug/timeline, /debug/profile over the shared serving
+        front-end."""
+        if self.cfg.metrics_port is None or not self._rank0:
+            return None
+        from kubernetes_cloud_tpu.train.metrics_server import (
+            TrainerMetricsServer,
+        )
+
+        meta = {"run": self.cfg.run_name,
+                "world": jax.process_count(),
+                "batch_size": self.cfg.batch_size,
+                "gradients": self.cfg.gradients,
+                "param_count": obs_flops.param_count(self.model_cfg),
+                "peak_flops_per_s": self._peak_flops,
+                "flight_records": self.cfg.flight_records}
+        srv = TrainerMetricsServer(
+            self.flight, meta=meta, port=self.cfg.metrics_port,
+            profile_dir=self.cfg.profile_dir,
+            status=lambda: {"step": self._last_step,
+                            "total_steps": total_steps})
+        srv.start()
+        self.metrics_server = srv
+        return srv
+
+    def _record_divergence(self, event: DivergenceDetected,
+                           step: int) -> None:
+        """Typed event into the metrics stream + the obs counter."""
+        log.warning(
+            "divergence at step %d: %s value=%s threshold=%s policy=%s",
+            step, event.kind, event.value, event.threshold, event.policy)
+        if self._rank0:
+            _M_DIVERGENCE.labels(run=self.cfg.run_name,
+                                 kind=event.kind).inc()
+        self.metrics.log(event.to_record(), step=step)
+
+    def _rollback_to_checkpoint(self) -> Optional[int]:
+        """Restore the newest checkpoint after a divergence verdict;
+        returns the restored step, or None when no checkpoint exists
+        (the caller escalates to halt).  Restoring never writes, so
+        the latest checkpoint cannot be corrupted by the rollback."""
+        self.checkpointer.wait()  # never race an in-flight async save
+        # (and only read latest_step AFTER the wait — an in-flight
+        # save is invisible before it lands, and restoring the save
+        # before it would rewind further than necessary)
+        latest = self.checkpointer.latest_step()
+        if latest is None:
+            return None
+        self.state = self.checkpointer.restore(self.state, step=latest)
+        self.sentinel.reset()  # fresh statistics for the restored regime
+        if self._rank0:
+            log.warning("rolled back to checkpoint-%d", latest)
+        return int(latest)
+
+    def _maybe_preempt(self, step: int, logrec: dict, *,
+                       poisoned: Optional[str] = None
+                       ) -> Optional[dict[str, Any]]:
+        """SIGTERM path: persist progress inside the grace period and
+        leave; the replacement pod resumes from this step.  Guarded
+        like the final save — orbax refuses to overwrite a step a
+        periodic save already wrote.  ``poisoned`` (fused-path
+        non-finite taint) forbids the save: the replacement pod must
+        resume from the last finite checkpoint, not from NaN params."""
+        if not self._preemption_agreed():
+            return None
+        self.metrics.log(logrec, step=step)
+        if (poisoned is None
+                and self.checkpointer.latest_step() != step):
+            self.save_checkpoint(step, force=True)
+        self.checkpointer.wait()
+        self.metrics.close()
+        if jax.process_index() == 0:
+            saved = ("checkpoint saved" if poisoned is None else
+                     "params non-finite, save skipped")
+            print(f"preempted at step {step}; {saved}")
+        res = {"steps": step, "preempted": True, **logrec}
+        if poisoned is not None:
+            res.update(diverged=True, divergence=poisoned)
+        return res
+
+    def _observe_step(self, rec, *, step, wall, phases, tokens, flops,
+                      loss_val, grad_norm, recompiled, event, times,
+                      skew) -> None:
+        """Publish one step to the obs families and (when the recorder
+        is enabled) the flight ring, then refresh the MFU gauge."""
+        if self._rank0:
+            for p, v in phases.items():
+                self._m_step_s[p].observe(v)
+            self._m_tokens.inc(tokens)
+            if phases.get("data_load"):
+                self._m_data_stall.inc(phases["data_load"])
+        if rec is None:
+            return
+        rec.step = step
+        rec.dur_s = wall
+        rec.phases = phases
+        rec.tokens = int(tokens)
+        rec.loss = loss_val
+        rec.grad_norm = grad_norm
+        rec.flops = flops
+        rec.recompiled = recompiled
+        rec.divergence = event.kind if event is not None else None
+        rec.host_step_s = [round(float(x), 6) for x in times]
+        rec.skew_s = skew
+        self.flight.commit(rec)
+        if self._rank0 and time.monotonic() >= self._mfu_next:
+            # time-gated like the engine's gauge refresh (a fast run
+            # would otherwise scan the full ring every ~25ms step);
+            # min_records: step starts stamp rec.ts, so a step slower
+            # than the 10 s window (checkpoint save, big model) would
+            # otherwise expire every record before this refresh and
+            # zero the MFU gauge exactly on the runs being diagnosed
+            self._mfu_next = time.monotonic() + 0.5
+            rates = self.flight.rates(min_records=8)
+            self._m_mfu.set(obs_flops.mfu(rates["flops_per_s"],
+                                          self._peak_flops))
+
+    # -- the loop body -----------------------------------------------------
+
     def train(self) -> dict[str, Any]:
         cfg = self.cfg
         gas = max(1, cfg.gradients)
@@ -433,78 +746,258 @@ class Trainer:
             1, len(self.dataset) // (cfg.batch_size * gas))
         total_steps = steps_per_epoch * cfg.epochs
         world = jax.process_count()
+        self._make_batches(start_step, gas)
+        server = self._start_metrics_server(total_steps)
+        try:
+            return self._train_loop(cfg, gas, start_step,
+                                    steps_per_epoch, total_steps, world)
+        finally:
+            if server is not None:
+                server.stop()
 
-        batches = sharded_batches(
-            self.dataset, cfg.batch_size, self.mesh, shuffle=cfg.shuffle,
-            seed=cfg.seed, epochs=None,
-            skip_batches=start_step * gas)  # cheap resume fast-forward
-
+    def _train_loop(self, cfg, gas, start_step, steps_per_epoch,
+                    total_steps, world) -> dict[str, Any]:
         step = start_step
         last_metrics: dict[str, Any] = {}
+        rollbacks = 0
+        #: fused-path taint: the fused program applies the update in
+        #: the same XLA call that computes the loss, so a non-finite
+        #: verdict there is post-apply — the live params are suspect
+        #: until a checkpoint restore replaces them.  While tainted,
+        #: no save (periodic, preemption, or final) may persist them.
+        poisoned: Optional[str] = None
         while step < total_steps:
+            self._last_step = step
+            fl = self.flight if self.flight.enabled else None
+            rec = self.flight.begin() if fl is not None else None
             t0 = time.perf_counter()
+            # drop-mode at this site turns the step's loss into NaN —
+            # the deterministic divergence drill the sentinel chaos
+            # tests (and KCT_FAULTS-armed containers) use
+            step_fault = faults.fire("train.step")
+            tokens = 0
+            data_s = 0.0
+            flops = 0.0
             if self._fused:
-                batch = next(batches)
+                batch, data_s = self._next_batch()
+                tokens = int(batch["input_ids"].size)
+                flops = self._micro_flops(batch)
+                recompiled = self._note_compile("fused", batch)
                 self.state, metrics = self._fused_step(self.state, batch)
                 jax.block_until_ready(metrics["loss"])
                 t_gas = time.perf_counter() - t0
                 t_opt = 0.0
+                loss_val = float(metrics["loss"])
+                if step_fault == "drop":
+                    loss_val = float("nan")
+                grad_norm = (float(metrics["grad_norm"])
+                             if "grad_norm" in metrics else None)
+                # The fused program applies the update in the same XLA
+                # program that computes the loss, so the verdict here is
+                # post-apply — halt/rollback still recover through the
+                # checkpoint; the accumulation path below is the
+                # pre-apply guarantee.
+                event = self.sentinel.observe_loss(step + 1, loss_val)
+                if event is None and grad_norm is not None:
+                    event = self.sentinel.observe_grad_norm(step + 1,
+                                                            grad_norm)
+                if (event is not None
+                        and event.kind.startswith("nonfinite")):
+                    poisoned = event.kind
             else:
                 grads = None
                 loss_acc = 0.0
+                metrics = {}
                 for _ in range(gas):
-                    batch = next(batches)
+                    batch, d = self._next_batch()
+                    data_s += d
+                    tokens += int(batch["input_ids"].size)
+                    flops += self._micro_flops(batch)
                     g, metrics = self._grad_micro(self.state["params"],
                                                   batch)
                     grads = g if grads is None else self._accum(grads, g)
                     loss_acc += metrics["loss"]
                 jax.block_until_ready(loss_acc)
                 t_gas = time.perf_counter() - t0
-                self.state, grad_norm = self._apply(self.state, grads,
-                                                    float(gas))
-                jax.block_until_ready(self.state["step"])
+                recompiled = self._note_compile("micro", batch)
+                loss_val = float(loss_acc) / gas
+                if step_fault == "drop":
+                    loss_val = float("nan")
+                # Sentinel check BEFORE the optimizer apply: a poisoned
+                # step never reaches the parameters.
+                event = self.sentinel.observe_loss(step + 1, loss_val)
+                grad_norm = None
+                if self.sentinel.should_apply(event):
+                    self.state, gn = self._apply(self.state, grads,
+                                                 float(gas))
+                    jax.block_until_ready(self.state["step"])
+                    grad_norm = float(gn)
+                    if event is None:
+                        event = self.sentinel.observe_grad_norm(
+                            step + 1, grad_norm)
+                        if (event is not None
+                                and event.kind.startswith("nonfinite")):
+                            # a finite loss got past should_apply but
+                            # the grads were garbage — the apply above
+                            # already folded them into the params, so
+                            # this verdict is post-apply: same taint
+                            # as the fused path, no save may persist
+                            # the params until a restore replaces them
+                            poisoned = event.kind
                 t_opt = time.perf_counter() - t0 - t_gas
-                metrics = dict(metrics, loss=loss_acc / gas,
+                metrics = dict(metrics, loss=loss_val,
                                grad_norm=grad_norm)
             step += 1
+            self._last_step = step
 
             step_time = t_gas + t_opt
             rank_sps = cfg.batch_size * gas / world / step_time
             tokens_seen = step * cfg.batch_size * gas
-            log = {
-                "train/loss": float(metrics["loss"]),
+            logrec = {
+                "train/loss": loss_val,
                 "train/epoch": step / steps_per_epoch,
                 "perf/opt_time": t_opt,
                 "perf/gas_time": t_gas,
                 "perf/total_time_per_step": step_time,
                 "perf/rank_samples_per_second": rank_sps,
                 "perf/world_samples_per_second": rank_sps * world,
+                "perf/data_load_time": data_s,
+                "perf/tokens": tokens,
+                "perf/model_flops": flops,
             }
-            self.metrics.log(log, step=step)
-            last_metrics = log
+            if grad_norm is not None:
+                logrec["train/grad_norm"] = grad_norm
 
-            # Preemption check comes FIRST: the SIGTERM grace period must
-            # not be burned on periodic saves or prompt sampling.
-            if self._preemption_agreed():
-                # Persist progress inside the grace period and leave; the
-                # replacement pod resumes from this step.  Guarded like
-                # the final save — orbax refuses to overwrite a step that
-                # a periodic save already wrote.
-                if self.checkpointer.latest_step() != step:
-                    self.save_checkpoint(step, force=True)
-                self.checkpointer.wait()
-                self.metrics.close()
-                if jax.process_index() == 0:
-                    print(f"preempted at step {step}; checkpoint saved")
-                return {"steps": step, "preempted": True, **last_metrics}
-            if cfg.save_steps and step % cfg.save_steps == 0:
-                self.save_checkpoint(step)
+            # -- divergence policy (event already excluded the apply
+            # for non-finite losses on the accumulation path) ---------
+            if event is not None:
+                self._record_divergence(event, step)
+
+                def _commit_interrupted():
+                    # rollback/halt leave this loop iteration early —
+                    # publish the poisoned step's record now (the warn
+                    # path publishes through the normal end-of-step
+                    # observe below instead)
+                    wall = time.perf_counter() - t0
+                    self._observe_step(
+                        rec, step=step, wall=wall,
+                        phases=self._phase_dict(data_s, t_gas, t_opt,
+                                                0.0, 0.0, 0.0, 0.0),
+                        tokens=tokens, flops=flops, loss_val=loss_val,
+                        grad_norm=grad_norm, recompiled=recompiled,
+                        event=event, times=[wall], skew=0.0)
+
+                if (self.sentinel.policy == "rollback"
+                        and rollbacks < cfg.max_rollbacks):
+                    restored = self._rollback_to_checkpoint()
+                    if restored is not None:
+                        _commit_interrupted()
+                        rollbacks += 1
+                        # the parameters resume from the checkpoint;
+                        # the data does NOT rewind — the iterator is
+                        # already positioned just past the poisoned
+                        # batch, and rebuilding it from the rewound
+                        # step counter would replay batches consumed
+                        # since an earlier rollback (including the
+                        # batch that poisoned it)
+                        step = restored
+                        poisoned = None  # restore replaced the params
+                        res = self._maybe_preempt(step, logrec)
+                        if res is not None:
+                            return res
+                        continue
+                    log.error("rollback requested but no checkpoint "
+                              "exists yet; halting")
+                if self.sentinel.policy in ("halt", "rollback"):
+                    # halt — or a rollback that is exhausted/impossible
+                    _commit_interrupted()
+                    self.metrics.log(logrec, step=step)
+                    self.checkpointer.wait()
+                    self.metrics.close()
+                    return {"steps": step, "diverged": True,
+                            "divergence": event.kind, **logrec}
+            else:
+                rollbacks = 0
+
+            # Preemption check comes FIRST: the SIGTERM grace period
+            # must not be burned on periodic saves or prompt sampling.
+            res = self._maybe_preempt(step, logrec, poisoned=poisoned)
+            if res is not None:
+                return res
+            ckpt_s = prompt_s = eval_s = 0.0
+            if (cfg.save_steps and step % cfg.save_steps == 0
+                    and poisoned is None
+                    and self.checkpointer.latest_step() != step):
+                ckpt_s = self.save_checkpoint(step)
+                logrec["perf/checkpoint_time"] = ckpt_s
             if cfg.prompt_every and step % cfg.prompt_every == 0:
+                t = time.perf_counter()
                 self.sample_prompts(step, tokens_seen)
+                prompt_s = time.perf_counter() - t
+                logrec["perf/prompt_time"] = prompt_s
+            if cfg.eval_every and step % cfg.eval_every == 0:
+                t = time.perf_counter()
+                eval_loss = self.evaluate()
+                eval_s = time.perf_counter() - t
+                logrec["perf/eval_time"] = eval_s
+                if eval_loss is not None:
+                    logrec["eval/loss"] = eval_loss
 
+            # per-host step heartbeat -> straggler skew (rank-0 view)
+            t_sync = time.perf_counter()
+            times = self._allgather_step_times(
+                time.perf_counter() - t0)
+            host_sync_s = time.perf_counter() - t_sync
+            logrec["perf/host_sync_time"] = host_sync_s
+            skew = float(times.max() - times.min())
+            if self._rank0:
+                self._m_skew.set(skew)
+            if getattr(times, "size", len(times)) > 1:
+                logrec["perf/step_skew"] = skew
+
+            wall = time.perf_counter() - t0
+            logrec["perf/step_wall_time"] = wall
+            self.metrics.log(logrec, step=step)
+            last_metrics = logrec
+            self._observe_step(
+                rec, step=step, wall=wall,
+                phases=self._phase_dict(data_s, t_gas, t_opt, ckpt_s,
+                                        prompt_s, eval_s, host_sync_s),
+                tokens=tokens, flops=flops, loss_val=loss_val,
+                grad_norm=grad_norm, recompiled=recompiled, event=event,
+                times=times, skew=skew)
+
+        if poisoned is not None:
+            # every save since the fused-path non-finite verdict was
+            # skipped; never persist NaN params as a resume point or a
+            # final model — the newest finite checkpoint is the
+            # recovery point.
+            log.error(
+                "run reached its last step with non-finite parameters "
+                "(%s; the verdict landed after the apply) — "
+                "refusing to write final weights", poisoned)
+            self.checkpointer.wait()
+            self.metrics.close()
+            return {"steps": step, "diverged": True,
+                    "divergence": poisoned, **last_metrics}
         if self.checkpointer.latest_step() != step:
             self.save_checkpoint(step, force=True)
         self.checkpointer.wait()
         final_dir = self.save_final()
         self.metrics.close()
         return {"steps": step, "final_dir": final_dir, **last_metrics}
+
+    @staticmethod
+    def _phase_dict(data_s, t_gas, t_opt, ckpt_s, prompt_s, eval_s,
+                    host_sync_s) -> dict[str, float]:
+        """The TRAIN_PHASES decomposition of one step; zero-duration
+        phases are dropped (a fused step has no optimizer_apply
+        slice, most steps save no checkpoint)."""
+        phases = {"data_load": data_s,
+                  "grad_accum": max(t_gas - data_s, 0.0),
+                  "optimizer_apply": t_opt,
+                  "checkpoint_save": ckpt_s,
+                  "prompt_sample": prompt_s,
+                  "eval": eval_s,
+                  "host_sync": host_sync_s}
+        return {k: v for k, v in phases.items() if v > 0.0}

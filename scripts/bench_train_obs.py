@@ -1,0 +1,146 @@
+"""Train-recorder overhead A/B: is the observability plane free?
+
+Same discipline as the PR-7 flight-recorder measurement
+(BENCHMARKS.md): interleaved off/on pairs on the CPU training ramp,
+peak-of-N per arm — on a shared box, co-tenant contention only ever
+*subtracts*, so the per-arm peak is the honest comparator.
+
+Each run is a real ``Trainer.train()`` on test-tiny (the tier-1
+training configuration): the OFF arm sets ``flight_records=0`` (ring
+never allocated: no record fill, no MFU ring scan; the analytical-
+FLOPs lookup feeds ``perf/model_flops`` in both arms) and
+``divergence_policy="off"`` with no sidecar; the
+ON arm is the production default (1024-record ring, sentinel armed,
+metrics sidecar serving /metrics on an ephemeral port).  The per-step
+``perf_counter`` phase timing and the Prometheus family updates are
+the pre-existing metrics-stream surface and run in BOTH arms — the
+A/B isolates what the *recorder plane* adds on top of it.  Steady-state tokens/s comes from the run's own metrics JSONL
+(``perf/total_time_per_step``), skipping the compile-bearing first
+steps so XLA compilation — identical in both arms — never pollutes
+the delta.
+
+    python scripts/bench_train_obs.py [--pairs 5] [--steps 16]
+    # -> one JSON line {"metric": "train_obs_overhead", ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import shutil
+import sys
+import tempfile
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:  # runnable from anywhere
+    sys.path.insert(0, str(_REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+WARM_STEPS = 4  # compile + cache warmup steps excluded from the rate
+
+
+def one_run(arm: str, idx: int, steps: int, workdir: str) -> dict:
+    import numpy as np
+
+    from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+    from kubernetes_cloud_tpu.data.tokenized import TokenizedDataset
+    from kubernetes_cloud_tpu.models.causal_lm import PRESETS
+    from kubernetes_cloud_tpu.train.metrics import read_jsonl
+    from kubernetes_cloud_tpu.train.train_step import TrainConfig
+    from kubernetes_cloud_tpu.train.trainer import Trainer, TrainerConfig
+
+    import jax
+
+    bs, gas, ctx = 8, 1, 32
+    rows = steps * bs * gas
+    data = os.path.join(workdir, "data.tokens")
+    if not os.path.exists(data):
+        np.random.RandomState(0).randint(
+            2, 500, size=(rows, ctx)).astype(np.uint16).tofile(data)
+    ds = TokenizedDataset(data, context_size=ctx)
+    mesh = build_mesh(MeshSpec(data=1), devices=jax.devices("cpu")[:1])
+    run_name = f"{arm}{idx}"
+    on = arm == "on"
+    tcfg = TrainerConfig(
+        run_name=run_name, output_path=workdir, batch_size=bs,
+        gradients=gas, epochs=1, save_steps=0, prompt_every=0,
+        logs=os.path.join(workdir, "logs"), resume=False,
+        flight_records=1024 if on else 0,
+        metrics_port=0 if on else None,
+        divergence_policy="warn" if on else "off")
+    trainer = Trainer(PRESETS["test-tiny"],
+                      TrainConfig(warmup_steps=2, total_steps=steps),
+                      tcfg, mesh, ds)
+    # the ON arm's sidecar thread serves /metrics for the whole run; a
+    # scraper hitting it concurrently is exercised by the test suite —
+    # here both arms must differ ONLY by the recording work itself
+    result = trainer.train()
+    recs = [r for r in read_jsonl(os.path.join(
+        workdir, "logs", f"{run_name}.metrics.jsonl"))
+        if "perf/total_time_per_step" in r]
+    steady = recs[WARM_STEPS:]
+    # median step time, not the sum: a co-tenant burst landing on two
+    # steps of one run must not charge the whole run (the peak-of-N
+    # across runs then converges with far fewer pairs)
+    import statistics
+
+    med = statistics.median(r["perf/total_time_per_step"]
+                            for r in steady)
+    return {"arm": arm, "steps": result["steps"],
+            "tokens_per_s": bs * gas * ctx / med if med else 0.0}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pairs", type=int, default=5,
+                    help="interleaved off/on pairs")
+    ap.add_argument("--steps", type=int, default=16,
+                    help="steps per run (first %d excluded)" % WARM_STEPS)
+    ap.add_argument("--json", action="store_true",
+                    help="JSON line only (no per-run log)")
+    args = ap.parse_args(argv)
+    if args.steps <= WARM_STEPS:
+        ap.error(f"--steps must exceed the {WARM_STEPS} excluded "
+                 "warmup steps (the steady-state window would be "
+                 "empty)")
+    # the bench reads each run's metrics JSONL — a live WANDB_API_KEY
+    # would route MetricsLogger to wandb instead (no JSONL, empty
+    # steady window, one stray wandb run per bench iteration)
+    os.environ.pop("WANDB_API_KEY", None)
+
+    peaks = {"off": 0.0, "on": 0.0}
+    runs = []
+    root = tempfile.mkdtemp(prefix="kct-train-obs-bench-")
+    try:
+        for i in range(args.pairs):
+            for arm in ("off", "on"):
+                workdir = os.path.join(root, f"{arm}{i}")
+                os.makedirs(workdir, exist_ok=True)
+                r = one_run(arm, i, args.steps, workdir)
+                runs.append(r)
+                peaks[arm] = max(peaks[arm], r["tokens_per_s"])
+                if not args.json:
+                    print(f"pair {i} {arm:>3}: "
+                          f"{r['tokens_per_s']:.1f} tok/s",
+                          file=sys.stderr)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    delta = ((peaks["on"] - peaks["off"]) / peaks["off"]
+             if peaks["off"] else 0.0)
+    print(json.dumps({
+        "metric": "train_obs_overhead",
+        "peak_off_tokens_per_s": round(peaks["off"], 1),
+        "peak_on_tokens_per_s": round(peaks["on"], 1),
+        "overhead_pct": round(-delta * 100, 2),
+        "pairs": args.pairs, "steps": args.steps,
+        "within_budget": -delta < 0.02,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

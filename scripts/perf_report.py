@@ -21,6 +21,13 @@ CLI::
     # machine-readable (the same dict bench_serving --timeline embeds)
     python scripts/perf_report.py --file timeline.json --json
 
+    # TRAINING runs: phase-share / data-stall / MFU / checkpoint
+    # overhead / divergence / straggler table, from the rank-0
+    # trainer sidecar or its saved dump — or offline from the run's
+    # metrics JSONL (logs/<run>.metrics.jsonl)
+    python scripts/perf_report.py --train --url http://trainer:9090
+    python scripts/perf_report.py --train --file run.metrics.jsonl
+
 ``--peak-flops`` declares the hardware peak when the device table
 doesn't know it (CPU dev boxes) — MFU is reported only against a
 declared or detected peak, never guessed.
@@ -36,7 +43,6 @@ import argparse
 import json
 import pathlib
 import sys
-import urllib.parse
 import urllib.request
 
 _REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
@@ -46,38 +52,45 @@ if str(_REPO_ROOT) not in sys.path:  # runnable from anywhere
 from kubernetes_cloud_tpu.obs import report  # noqa: E402
 
 
-def fetch_timeline(url: str, last: int, timeout: float = 10.0) -> dict:
-    """GET the timeline from a serving pod; any URL on the serving
-    port is accepted (the path is replaced, like load_test's
-    ``metrics_endpoint``)."""
-    if "://" not in url:  # bare host[:port] — urlsplit would read the
-        url = "http://" + url  # host as the scheme
-    parts = urllib.parse.urlsplit(url)
-    endpoint = urllib.parse.urlunsplit(
-        (parts.scheme, parts.netloc, "/debug/timeline",
-         f"last={last}", ""))
+def fetch_timeline(url: str, last: int,
+                   timeout: float = report.DEBUG_HTTP_TIMEOUT_S) -> dict:
+    """GET the timeline from a serving or trainer pod; any URL on the
+    pod's port is accepted."""
+    endpoint = report.debug_endpoint(url, "/debug/timeline",
+                                     f"last={last}")
     with urllib.request.urlopen(endpoint, timeout=timeout) as resp:
         return json.loads(resp.read())
 
 
-def load_file(path: str) -> dict:
+def load_file(path: str, train: bool = False) -> dict:
     """A saved dump: a full ``/debug/timeline`` response, one model's
-    entry (``{"iterations": [...]}``), or a JSONL of iteration
-    records."""
+    entry (``{"iterations": [...]}``), or a JSONL file — of iteration
+    records, or (``--train``) of the trainer's metrics stream, which
+    is converted through :func:`report.train_entry_from_metrics`."""
     with open(path) as f:
         text = f.read()
     try:
         obj = json.loads(text)
     except ValueError:
-        # JSONL: one iteration record per line
-        records = [json.loads(ln) for ln in text.splitlines()
-                   if ln.strip()]
-        return {"models": {"timeline": {"iterations": records,
-                                        "requests": []}}}
+        obj = None  # multi-line JSONL; records parsed below
     if isinstance(obj, dict) and "models" in obj:
         return obj
     if isinstance(obj, dict) and "iterations" in obj:
         return {"models": {"timeline": obj}}
+    # JSONL: iteration records, or the trainer metrics stream (a
+    # one-line JSONL parses as plain JSON above, hence the fallthrough)
+    records = ([obj] if isinstance(obj, dict)
+               else [json.loads(ln) for ln in text.splitlines()
+                     if ln.strip()] if obj is None else None)
+    if records is not None:
+        if train and any("perf/total_time_per_step" in r
+                         or r.get("event") == "divergence"
+                         for r in records):
+            return {"models": {
+                "trainer": report.train_entry_from_metrics(records)}}
+        if obj is None:
+            return {"models": {"timeline": {"iterations": records,
+                                            "requests": []}}}
     raise ValueError(
         f"{path} is neither a /debug/timeline response, a model entry, "
         "nor a JSONL of iteration records")
@@ -99,10 +112,16 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the analysis dicts instead of the "
                          "terminal report")
+    ap.add_argument("--train", action="store_true",
+                    help="trainer timeline: render phase-share / "
+                         "data-stall / MFU / checkpoint / divergence "
+                         "/ straggler sections (accepts the trainer "
+                         "sidecar's /debug/timeline or the run's "
+                         "metrics JSONL)")
     args = ap.parse_args(argv)
 
     dump = (fetch_timeline(args.url, args.last) if args.url
-            else load_file(args.file))
+            else load_file(args.file, train=args.train))
     models = dump.get("models", {})
     if args.model:
         models = {k: v for k, v in models.items() if k == args.model}
@@ -117,13 +136,18 @@ def main(argv=None) -> int:
         return 1
     out = {}
     for i, (name, entry) in enumerate(sorted(models.items())):
-        analysis = report.analyze(entry, peak_flops=args.peak_flops)
+        if args.train:
+            analysis = report.analyze_train(entry,
+                                            peak_flops=args.peak_flops)
+        else:
+            analysis = report.analyze(entry, peak_flops=args.peak_flops)
         if args.json:
             out[name] = analysis
             continue
         if i:
             print()
-        print(report.render(analysis, name))
+        render = report.render_train if args.train else report.render
+        print(render(analysis, name))
     if args.json:
         print(json.dumps(out))
     return 0

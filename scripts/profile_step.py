@@ -1,14 +1,30 @@
 """Capture + summarize an op-level TPU profile of the headline train step.
 
-Writes a jax.profiler trace for a few bench-shaped steps, then parses the
-trace-viewer JSON to rank XLA ops by total device time.  Usage:
+Two modes, both riding the SAME bounded profiler-window machinery the
+serving pods use (:class:`kubernetes_cloud_tpu.obs.flight.
+ProfileWindow` behind ``GET /debug/profile``):
 
-    python scripts/profile_step.py [variant]
+* **local** — build the bench-shaped step, arm a window, run exactly N
+  steps, disarm, then parse the trace-viewer JSON to rank XLA ops by
+  total device time::
 
-Variants mirror scripts/perf_sweep.py ("base" = the bench.py config).
+      python scripts/profile_step.py [variant]
+
+  Variants mirror scripts/perf_sweep.py ("base" = the bench.py config).
+
+* **live pod** — arm the window on a running trainer (the rank-0
+  metrics sidecar, ``Trainer(metrics_port=...)``) or serving pod; the
+  TensorBoard trace lands in the pod's ``--profile-dir``::
+
+      python scripts/profile_step.py --url http://pod:9090 --seconds 10
+
+  A second arming while one is running answers 409, exactly like the
+  serving endpoint — there is no separate ad-hoc trainer profiling
+  path anymore.
 """
 from __future__ import annotations
 
+import argparse
 import dataclasses
 import glob
 import gzip
@@ -16,22 +32,31 @@ import json
 import os
 import sys
 import time
+import pathlib
+import urllib.error
+import urllib.request
 from collections import defaultdict
 
-import jax
-import jax.numpy as jnp
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:  # runnable from anywhere
+    sys.path.insert(0, str(_REPO_ROOT))
 
-from kubernetes_cloud_tpu.models.causal_lm import PRESETS
-from kubernetes_cloud_tpu.parallel.sharding import shard_batch
-from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
-from kubernetes_cloud_tpu.train.train_step import (
-    TrainConfig, init_train_state, make_train_step)
+from kubernetes_cloud_tpu.obs import report  # noqa: E402
 
 BATCH, SEQ = 16, 1024
 TRACE_DIR = "/tmp/kct_trace"
 
 
 def build_step(variant: str):
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+    from kubernetes_cloud_tpu.models.causal_lm import PRESETS
+    from kubernetes_cloud_tpu.parallel.sharding import shard_batch
+    from kubernetes_cloud_tpu.train.train_step import (
+        TrainConfig, init_train_state, make_train_step)
+
     policy = "attn_mlp"
     attn = "auto"
     remat = True
@@ -95,27 +120,78 @@ def summarize(trace_dir: str, top: int = 40) -> None:
         print(f"{ms:10.2f} {count[name]:6d}  {name[:110]}")
 
 
-def main() -> None:
-    variant = sys.argv[1] if len(sys.argv) > 1 else "base"
+def arm_remote(url: str, seconds: float,
+               timeout: float = report.DEBUG_HTTP_TIMEOUT_S) -> int:
+    """Arm a ProfileWindow on a live pod via ``GET /debug/profile`` —
+    the trainer sidecar and the serving front-ends expose the same
+    endpoint.  Returns the process exit code (409 -> 2)."""
+    endpoint = report.debug_endpoint(url, "/debug/profile",
+                                     f"seconds={seconds:g}")
+    try:
+        with urllib.request.urlopen(endpoint, timeout=timeout) as resp:
+            body = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except ValueError:  # an ingress/proxy answered with HTML
+            body = {"error": "non-JSON error body"}
+        print(json.dumps({"status": e.code, **body}))
+        return 2 if e.code == 409 else 1
+    print(json.dumps(body))
+    print(f"trace will land in the pod's {body.get('trace_dir')!r}; "
+          "point TensorBoard's profile plugin at it", file=sys.stderr)
+    return 0
+
+
+def profile_local(variant: str, steps: int = 5) -> None:
+    """Arm a bounded window around exactly ``steps`` bench-shaped
+    steps (ProfileWindow's timer is the runaway backstop; disarm()
+    closes the window at the step boundary)."""
+    import jax
+
+    from kubernetes_cloud_tpu.obs.flight import ProfileWindow
+
     step, state, batch = build_step(variant)
     for _ in range(3):
         state, m = step(state, batch)
     jax.block_until_ready((state, m))
     int(state["step"])
 
+    window = ProfileWindow(TRACE_DIR, max_seconds=600.0)
     t0 = time.perf_counter()
-    N = 5
-    with jax.profiler.trace(TRACE_DIR):
-        for _ in range(N):
+    window.arm(600.0)  # generous bound; disarm() below is the close
+    try:
+        for _ in range(steps):
             state, m = step(state, batch)
         jax.block_until_ready((state, m))
         int(state["step"])
+    finally:
+        window.disarm()
     dt = time.perf_counter() - t0
     print(json.dumps({"variant": variant,
-                      "tok_s": round(BATCH * SEQ * N / dt, 1),
-                      "ms_step": round(dt / N * 1000, 2)}))
+                      "tok_s": round(BATCH * SEQ * steps / dt, 1),
+                      "ms_step": round(dt / steps * 1000, 2)}))
     summarize(TRACE_DIR)
 
 
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("variant", nargs="?", default="base",
+                    help="local mode: perf_sweep-style step variant")
+    ap.add_argument("--url", default=None,
+                    help="arm the profiler window on a live pod "
+                         "(trainer sidecar or serving front-end) "
+                         "instead of profiling locally")
+    ap.add_argument("--seconds", type=float, default=10.0,
+                    help="remote window duration")
+    ap.add_argument("--steps", type=int, default=5,
+                    help="local mode: steps inside the window")
+    args = ap.parse_args(argv)
+    if args.url:
+        return arm_remote(args.url, args.seconds)
+    profile_local(args.variant, args.steps)
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,515 @@
+"""Training observability plane — the jax-free core: the trainer's
+step flight-recorder ring, the analytical train-FLOPs coefficient, the
+divergence sentinel's detection/policy logic, the perf_report --train
+analyzer (golden lines on a canned timeline + the metrics-JSONL
+adapter), and the rank-0 metrics sidecar incl. the metrics.render /
+debug.render containment contract."""
+
+import json
+import math
+import pathlib
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.obs import flops, report
+from kubernetes_cloud_tpu.obs.train_flight import (
+    TRAIN_PHASES,
+    TrainStepRecord,
+    train_recorder,
+)
+from kubernetes_cloud_tpu.train.sentinel import (
+    DivergenceSentinel,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# train ring: shared FlightRecorder machinery, train record type
+# ---------------------------------------------------------------------------
+
+
+def _commit_step(fr, step, *, tokens=256, flops_=1e6, dur=0.1,
+                 loss=2.0, divergence=None):
+    rec = fr.begin()
+    rec.step = step
+    rec.dur_s = dur
+    rec.tokens = tokens
+    rec.flops = flops_
+    rec.loss = loss
+    rec.divergence = divergence
+    rec.phases = {"grad_accum": dur * 0.8}
+    fr.commit(rec)
+    return rec
+
+
+def test_train_ring_wraparound_and_rates():
+    fr = train_recorder(4)
+    assert fr.capacity == 4 and fr.request_capacity == 0
+    for i in range(10):
+        _commit_step(fr, i + 1)
+    assert len(fr) == 4
+    recs = fr.tail()
+    assert [r["step"] for r in recs] == [7, 8, 9, 10]
+    assert isinstance(fr.begin(), TrainStepRecord)
+    # rates() counts rec.tokens through rate_tokens()
+    r = fr.rates(window_s=3600.0)
+    assert r["tokens_per_s"] > 0
+    assert r["flops_per_s"] > 0
+    # disabled ring is inert, like the engine's
+    off = train_recorder(0)
+    _commit_step(off, 1)
+    assert len(off) == 0 and not off.enabled
+
+
+def test_rates_min_records_survives_slow_steps():
+    """A step whose wall time exceeds the rates() window must still
+    contribute: rec.ts is stamped at begin(), so without the
+    min_records floor every record of a slow run would expire before
+    the per-step gauge refresh and MFU would read 0 exactly on the
+    runs being diagnosed (trainer.py passes min_records=8)."""
+    fr = train_recorder(8)
+    for i in range(3):
+        rec = _commit_step(fr, i + 1, dur=30.0)
+        rec.ts -= 120.0  # stamp the step start well past the window
+    assert fr.rates(window_s=10.0)["flops_per_s"] == 0.0
+    r = fr.rates(window_s=10.0, min_records=8)
+    assert r["flops_per_s"] > 0
+    assert r["tokens_per_s"] > 0
+
+
+def test_train_record_to_dict_carries_train_fields():
+    fr = train_recorder(4)
+    rec = _commit_step(fr, 3, divergence="loss_spike")
+    rec.host_step_s = [0.1, 0.3]
+    rec.skew_s = 0.2
+    d = fr.tail()[-1]
+    assert d["step"] == 3 and d["divergence"] == "loss_spike"
+    assert d["host_step_s"] == [0.1, 0.3]
+    assert d["skew_s"] == pytest.approx(0.2)
+    assert set(d["phases"]) == {"grad_accum"}
+
+
+# ---------------------------------------------------------------------------
+# analytical train FLOPs (fwd+bwd ~= 3x forward, x gas)
+# ---------------------------------------------------------------------------
+
+
+class _TinyCfg:
+    vocab_size = 512
+    hidden_size = 64
+    num_layers = 2
+    num_heads = 4
+    num_kv_heads = None
+    intermediate_size = None
+    max_seq_len = 128
+    pos_emb = "rope"
+    use_bias = True
+    tie_embeddings = False
+    embed_layernorm = False
+    moe_experts = 0
+
+
+def test_train_step_flops_is_3x_forward_times_gas():
+    cfg = _TinyCfg()
+    base, per_ctx = flops.decode_flops_coeffs(cfg)
+    fwd = 4 * flops.span_flops(base, per_ctx, 0, 32)  # B=4, S=32
+    assert flops.train_step_flops(cfg, 4, 32, 1) \
+        == pytest.approx(3.0 * fwd)
+    assert flops.train_step_flops(cfg, 4, 32, 5) \
+        == pytest.approx(15.0 * fwd)
+    # GQA/MoE pricing rides the shared coefficients
+
+    class MoE(_TinyCfg):
+        moe_experts = 4
+        moe_top_k = 2
+
+    assert flops.train_step_flops(MoE(), 4, 32, 1) \
+        > flops.train_step_flops(cfg, 4, 32, 1)
+
+
+# ---------------------------------------------------------------------------
+# divergence sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_nonfinite_detection_and_apply_gate():
+    s = DivergenceSentinel("warn")
+    assert s.observe_loss(1, 2.0) is None
+    ev = s.observe_loss(2, float("nan"))
+    assert ev is not None and ev.kind == "nonfinite_loss"
+    assert ev.threshold is None and ev.policy == "warn"
+    # non-finite never applies, any policy
+    assert not s.should_apply(ev)
+    ev2 = s.observe_grad_norm(2, float("inf"))
+    assert ev2.kind == "nonfinite_grad"
+    rec = ev.to_record()
+    assert rec["event"] == "divergence"
+    assert rec["divergence/kind"] == "nonfinite_loss"
+
+
+def test_sentinel_loss_spike_after_history():
+    s = DivergenceSentinel("halt", loss_factor=4.0, min_history=10)
+    for i in range(10):
+        assert s.observe_loss(i + 1, 2.0 + 0.01 * (i % 3)) is None
+    ev = s.observe_loss(11, 50.0)
+    assert ev is not None and ev.kind == "loss_spike"
+    assert ev.threshold is not None and 50.0 > ev.threshold
+    # finite spike under halt/rollback does NOT apply; under warn it does
+    assert not s.should_apply(ev)
+    assert DivergenceSentinel("warn").should_apply(ev) is True
+    # reset clears the statistics (post-rollback regime starts fresh)
+    s.reset()
+    assert s.observe_loss(1, 50.0) is None  # no history -> no spike
+
+
+def test_sentinel_grad_norm_spike_and_off_policy():
+    s = DivergenceSentinel("rollback", grad_factor=6.0, min_history=5)
+    for i in range(5):
+        assert s.observe_grad_norm(i + 1, 1.0) is None
+    ev = s.observe_grad_norm(6, 1000.0)
+    assert ev is not None and ev.kind == "grad_norm_spike"
+    off = DivergenceSentinel("off")
+    assert off.observe_loss(1, float("nan")) is None
+    assert not off.enabled
+    with pytest.raises(ValueError):
+        DivergenceSentinel("explode")
+
+
+def test_sentinel_spikes_fold_into_ewma():
+    """A regime change re-normalizes instead of alarming forever."""
+    s = DivergenceSentinel("warn", loss_factor=4.0, min_history=5,
+                           alpha=0.5)
+    for i in range(5):
+        s.observe_loss(i + 1, 1.0)
+    spikes = sum(
+        1 for i in range(30)
+        if s.observe_loss(6 + i, 10.0) is not None)
+    assert 1 <= spikes < 10  # fires, then adapts to the new level
+
+
+# ---------------------------------------------------------------------------
+# analyzer + perf_report --train golden output on a canned timeline
+# ---------------------------------------------------------------------------
+
+
+def _canned_train_entry() -> dict:
+    mk = dict(tokens=256, grad_norm=1.0, recompiled=False,
+              divergence=None, host_step_s=[0.099, 0.101], skew_s=0.002)
+    return {
+        "meta": {"run": "t", "peak_flops_per_s": 1e9},
+        "iterations": [
+            {"seq": 1, "step": 1, "ts": 100.0, "dur_s": 0.1,
+             "loss": 4.0, "flops": 6e6,
+             "phases": {"data_load": 0.02, "grad_accum": 0.06,
+                        "optimizer_apply": 0.015,
+                        "host_sync": 0.001}, **mk},
+            {"seq": 2, "step": 2, "ts": 100.1, "dur_s": 0.1,
+             "loss": 3.5, "flops": 6e6,
+             "phases": {"data_load": 0.02, "grad_accum": 0.06,
+                        "optimizer_apply": 0.015,
+                        "host_sync": 0.001}, **mk},
+            {"seq": 3, "step": 3, "ts": 100.2, "dur_s": 0.3,
+             "loss": 3.0, "flops": 6e6,
+             "phases": {"data_load": 0.02, "grad_accum": 0.06,
+                        "optimizer_apply": 0.015,
+                        "checkpoint_save": 0.2,
+                        "host_sync": 0.001}, **mk},
+            {"seq": 4, "step": 4, "ts": 100.5, "dur_s": 0.1,
+             "loss": float("nan"), "flops": 6e6,
+             "phases": {"data_load": 0.02, "grad_accum": 0.06},
+             **{**mk, "divergence": "nonfinite_loss"}},
+        ],
+        "requests": [],
+    }
+
+
+def test_analyze_train_canned_exact():
+    a = report.analyze_train(_canned_train_entry())
+    assert a["steps"]["count"] == 4
+    assert a["steps"]["busy_s"] == pytest.approx(0.6)
+    assert a["steps"]["span_s"] == pytest.approx(0.6)  # 100.0 -> 100.6
+    assert a["phase_seconds"]["data_load"] == pytest.approx(0.08)
+    assert a["data_stall"]["share"] == pytest.approx(0.08 / 0.6)
+    assert a["data_stall"]["worst_step_s"] == pytest.approx(0.02)
+    ck = a["checkpoint"]
+    assert ck["count"] == 1
+    assert ck["seconds_total"] == pytest.approx(0.2)
+    assert ck["share"] == pytest.approx(0.2 / 0.6)
+    dv = a["divergence"]
+    assert dv["count"] == 1
+    assert dv["kinds"] == {"nonfinite_loss": 1}
+    assert dv["steps"] == [4]
+    sg = a["straggler"]
+    assert len(sg["hosts"]) == 2
+    assert sg["skew_max_s"] == pytest.approx(0.002)
+    assert sg["hosts"][0]["mean_s"] == pytest.approx(0.099)
+    # loss trajectory skips the NaN
+    assert a["loss"]["first"] == 4.0 and a["loss"]["last"] == 3.0
+    mf = a["mfu"]
+    assert mf["tokens"] == 1024
+    assert mf["flops_per_s"] == pytest.approx(24e6 / 0.6)
+    assert mf["mfu"] == pytest.approx(24e6 / 0.6 / 1e9)
+
+
+def test_render_train_golden_lines():
+    text = report.render_train(
+        report.analyze_train(_canned_train_entry()), "t1")
+    assert "== train perf report: t1 ==" in text
+    assert "steps: 4" in text
+    for phase in ("data_load", "grad_accum", "optimizer_apply",
+                  "checkpoint_save", "host_sync"):
+        assert f"\n  {phase}" in text, phase
+    assert "data stalls: 13.3% of busy time" in text
+    assert "checkpoints: 1 saves" in text
+    assert "divergence: 1 event(s) (nonfinite_loss x1) at steps [4]" \
+        in text
+    assert "stragglers (2 hosts)" in text
+    assert "loss: 4.0000 -> 3.0000" in text
+    assert "train MFU: 4.00%" in text
+    # no-peak mode degrades honestly
+    entry = _canned_train_entry()
+    del entry["meta"]["peak_flops_per_s"]
+    assert "train MFU: n/a" in report.render_train(
+        report.analyze_train(entry))
+
+
+def test_summarize_train_embedding_shape():
+    s = report.summarize_train(_canned_train_entry())
+    assert s["steps"] == 4
+    assert s["divergence_events"] == 1
+    assert s["data_stall_share"] == pytest.approx(0.08 / 0.6, abs=1e-4)
+    assert set(s["phase_share"]) <= set(TRAIN_PHASES) | {"other"}
+    assert s["mfu"] == pytest.approx(0.04, abs=1e-4)
+
+
+def test_wandb_logging_survives_step_rewind(monkeypatch, tmp_path):
+    """wandb silently DROPS rows whose explicit step is below its
+    internal monotonic counter — after a divergence rollback rewinds
+    the trainer step, the recovered span would vanish from the
+    dashboard.  The logger must therefore never pass step= and instead
+    chart against a logged train/step (define_metric)."""
+    import types
+
+    from kubernetes_cloud_tpu.train.metrics import MetricsLogger
+
+    class _FakeRun:
+        def __init__(self):
+            self.logged = []
+            self.defined = []
+
+        def define_metric(self, name, step_metric=None):
+            self.defined.append((name, step_metric))
+
+        def log(self, payload, commit=True, **kw):
+            assert "step" not in kw, "explicit step= drops rewound rows"
+            self.logged.append(payload)
+
+    run = _FakeRun()
+    monkeypatch.setitem(
+        sys.modules, "wandb",
+        types.SimpleNamespace(init=lambda **kw: run))
+    ml = MetricsLogger("rewind", log_dir=str(tmp_path), use_wandb=True)
+    ml.log({"train/loss": 1.0}, step=10)
+    ml.log({"train/loss": 2.0}, step=5)  # post-rollback rewind
+    assert [p["train/step"] for p in run.logged] == [10, 5]
+    assert ("*", "train/step") in run.defined
+
+
+def test_train_entry_from_metrics_jsonl():
+    records = [
+        {"ts": 1.0, "step": 1, "train/loss": 4.0, "train/grad_norm": 1.0,
+         "perf/opt_time": 0.01, "perf/gas_time": 0.08,
+         "perf/total_time_per_step": 0.09, "perf/data_load_time": 0.02,
+         "perf/tokens": 256, "perf/model_flops": 6e6,
+         "perf/step_wall_time": 0.1, "perf/host_sync_time": 0.001},
+        {"ts": 1.1, "step": 2, "train/loss": 3.5,
+         "perf/opt_time": 0.01, "perf/gas_time": 0.08,
+         "perf/total_time_per_step": 0.09, "perf/data_load_time": 0.02,
+         "perf/tokens": 256, "perf/model_flops": 6e6,
+         "perf/checkpoint_time": 0.2, "perf/step_wall_time": 0.3,
+         "perf/step_skew": 0.004},
+        {"ts": 1.4, "step": 3, "event": "divergence",
+         "divergence/kind": "nonfinite_loss",
+         "divergence/policy": "rollback"},
+        {"ts": 1.5, "table": "Generations", "Prompt": "x"},  # ignored
+    ]
+    entry = report.train_entry_from_metrics(records)
+    iters = entry["iterations"]
+    assert len(iters) == 3  # 2 perf steps + synthesized divergence marker
+    assert iters[0]["phases"]["grad_accum"] == pytest.approx(0.06)
+    assert iters[1]["phases"]["checkpoint_save"] == pytest.approx(0.2)
+    assert iters[2]["divergence"] == "nonfinite_loss"
+    a = report.analyze_train(entry)
+    assert a["divergence"]["count"] == 1
+    assert a["checkpoint"]["count"] == 1
+    # the offline path has no per-host breakdown (host_step_s is None)
+    # but DID record perf/step_skew — the skew series must survive
+    assert a["straggler"]["skew_max_s"] == pytest.approx(0.004)
+    assert a["straggler"]["hosts"] == []
+    rendered = report.render_train(a, "trainer")
+    assert "skew mean" in rendered and "per-host table n/a" in rendered
+
+
+def test_perf_report_train_cli(tmp_path):
+    dump = {"models": {"trainer": _canned_train_entry()}}
+    path = tmp_path / "train_timeline.json"
+    path.write_text(json.dumps(dump))
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "perf_report.py"),
+         "--train", "--file", str(path)],
+        capture_output=True, text=True, cwd=str(REPO), check=True)
+    assert "train perf report: trainer" in out.stdout
+    assert "data stalls:" in out.stdout
+    assert "stragglers (2 hosts)" in out.stdout
+    # --json emits the analysis dict
+    out2 = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "perf_report.py"),
+         "--train", "--file", str(path), "--json"],
+        capture_output=True, text=True, cwd=str(REPO), check=True)
+    parsed = json.loads(out2.stdout)
+    assert parsed["trainer"]["divergence"]["count"] == 1
+    # a trainer metrics JSONL goes through the adapter
+    jl = tmp_path / "run.metrics.jsonl"
+    jl.write_text(json.dumps(
+        {"ts": 1.0, "step": 1, "train/loss": 2.0,
+         "perf/opt_time": 0.01, "perf/gas_time": 0.08,
+         "perf/total_time_per_step": 0.09,
+         "perf/step_wall_time": 0.1}) + "\n")
+    out3 = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "perf_report.py"),
+         "--train", "--file", str(jl)],
+        capture_output=True, text=True, cwd=str(REPO), check=True)
+    assert "steps: 1" in out3.stdout
+
+
+# ---------------------------------------------------------------------------
+# rank-0 trainer metrics sidecar (jax-free: recorder + HTTP only)
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10)
+
+
+def _sidecar(recorder, **kw):
+    from kubernetes_cloud_tpu.train.metrics_server import (
+        TrainerMetricsServer,
+    )
+
+    srv = TrainerMetricsServer(recorder, host="127.0.0.1", port=0, **kw)
+    srv.start()
+    return srv
+
+
+def test_trainer_sidecar_timeline_metrics_readyz():
+    fr = train_recorder(16)
+    for i in range(3):
+        _commit_step(fr, i + 1)
+    obs.counter("kct_train_tokens_total", "t", ("run",)).labels(
+        run="side").inc(768)
+    srv = _sidecar(fr, meta={"run": "side", "peak_flops_per_s": 1e9},
+                   status=lambda: {"step": 3, "total_steps": 8})
+    try:
+        with _get(srv.port, "/debug/timeline?last=2") as r:
+            dump = json.loads(r.read())
+        entry = dump["models"]["trainer"]
+        assert entry["kind"] == "trainer"
+        assert len(entry["iterations"]) == 2
+        assert entry["meta"]["peak_flops_per_s"] == 1e9
+        with _get(srv.port, "/metrics") as r:
+            samples = obs.parse_text(r.read().decode())
+        assert obs.sample_value(samples, "kct_train_tokens_total",
+                                {"run": "side"}) == 768
+        with _get(srv.port, "/readyz") as r:
+            body = json.loads(r.read())
+        assert body["status"] == "training"
+        assert body["step"] == 3 and body["total_steps"] == 8
+        with _get(srv.port, "/healthz") as r:
+            assert r.status == 200
+        # bad query parameter -> 400, not a crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/debug/timeline?last=-1")
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+        obs.REGISTRY.reset()
+
+
+def test_trainer_sidecar_render_failures_are_contained():
+    """metrics.render / debug.render faults answer only that request —
+    the trainer sidecar inherits the serving containment contract."""
+    fr = train_recorder(8)
+    _commit_step(fr, 1)
+    srv = _sidecar(fr)
+    try:
+        with faults.inject(FaultSpec("debug.render", mode="raise",
+                                     times=1)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/debug/timeline")
+            assert ei.value.code == 500
+            # next request (fault exhausted) succeeds; loop untouched
+            with _get(srv.port, "/debug/timeline") as r:
+                assert json.loads(r.read())["models"]["trainer"]
+        with faults.inject(FaultSpec("metrics.render", mode="raise",
+                                     times=1)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/metrics")
+            assert ei.value.code == 500
+            with _get(srv.port, "/healthz") as r:
+                assert r.status == 200  # liveness never routes there
+    finally:
+        srv.stop()
+        obs.REGISTRY.reset()
+
+
+def test_profile_step_arm_remote_against_sidecar(tmp_path):
+    """scripts/profile_step.py --url drives the shared ProfileWindow
+    arming path (409 while armed) instead of an ad-hoc profiler."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import profile_step
+    finally:
+        sys.path.pop(0)
+
+    class FakeWindow:
+        def __init__(self):
+            self.armed_for = None
+
+        def arm(self, seconds):
+            from kubernetes_cloud_tpu.obs.flight import (
+                ProfileActiveError,
+            )
+
+            if self.armed_for is not None:
+                raise ProfileActiveError("window already armed")
+            self.armed_for = seconds
+            return {"profiling_s": seconds, "trace_dir": str(tmp_path)}
+
+    fr = train_recorder(4)
+    srv = _sidecar(fr)
+    srv.profiler = FakeWindow()  # no real jax.profiler in this test
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        assert profile_step.arm_remote(url, 5.0) == 0
+        assert srv.profiler.armed_for == 5.0
+        assert profile_step.arm_remote(url, 5.0) == 2  # 409 -> exit 2
+    finally:
+        srv.stop()
+
+
+def test_finite_helper_matches_math():
+    from kubernetes_cloud_tpu.train import sentinel
+
+    for v in (0.0, 1.5, -2.0):
+        assert sentinel._finite(v)
+    for v in (float("nan"), float("inf"), float("-inf")):
+        assert not sentinel._finite(v)
+        assert not math.isfinite(v)

@@ -1,0 +1,428 @@
+"""Trainer observability end-to-end + sentinel chaos.
+
+The acceptance slice of the training observability plane: a real CPU
+training run serves /metrics and /debug/timeline from the rank-0
+sidecar mid-run; the flight ring carries the full phase decomposition;
+the obs counters reconcile with the run's arithmetic; the divergence
+sentinel's warn/halt/rollback policies respond to a deterministically
+injected NaN loss (faults site ``train.step``) without corrupting the
+latest checkpoint — including SIGTERM landing during a rollback; and
+the data-stall / straggler / recompile signals fire.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.data.tokenized import TokenizedDataset
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.models.causal_lm import PRESETS
+from kubernetes_cloud_tpu.train.metrics import read_jsonl
+from kubernetes_cloud_tpu.train.train_step import TrainConfig
+from kubernetes_cloud_tpu.train.trainer import Trainer, TrainerConfig
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(2, 500, size=(64, 32)).astype(np.uint16)
+    path = str(tmp_path / "data.tokens")
+    tokens.tofile(path)
+    return TokenizedDataset(path, context_size=32)
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    yield
+    obs.REGISTRY.reset()
+
+
+def _trainer(tmp_path, dataset, mesh, **kw):
+    defaults = dict(
+        run_name="obs", output_path=str(tmp_path), batch_size=4,
+        gradients=2, epochs=1, save_steps=3, logs=str(tmp_path / "logs"),
+        prompt_every=0)
+    defaults.update(kw)
+    tcfg = TrainerConfig(**defaults)
+    train_cfg = TrainConfig(warmup_steps=2, total_steps=8)
+    return Trainer(PRESETS["test-tiny"], train_cfg, tcfg, mesh,
+                   dataset, eval_dataset=dataset)
+
+
+def _counter(name, **labels):
+    fam = obs.REGISTRY.get(name)
+    return fam.labels(**labels).value if fam is not None else 0.0
+
+
+def test_e2e_run_with_live_sidecar(tmp_path, dataset, devices8):
+    """A real run: scrape /metrics and /debug/timeline WHILE training,
+    then reconcile counters, ring contents, JSONL keys and the
+    metrics-stream mirror."""
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    trainer = _trainer(tmp_path, dataset, mesh, run_name="live",
+                       metrics_port=0, eval_every=4)
+    result = {}
+
+    def run():
+        result.update(trainer.train())
+
+    t = threading.Thread(target=run)
+    t.start()
+    live_scrape = None
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and live_scrape is None:
+            srv = trainer.metrics_server
+            if srv is not None and srv.port:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{srv.port}/metrics",
+                            timeout=5) as r:
+                        live_scrape = r.read().decode()
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{srv.port}/readyz",
+                            timeout=5) as r:
+                        ready = json.loads(r.read())
+                except OSError:
+                    time.sleep(0.05)
+            else:
+                time.sleep(0.05)
+    finally:
+        t.join(timeout=300)
+    assert not t.is_alive()
+    assert result["steps"] == 8
+    assert live_scrape is not None, "sidecar never answered mid-run"
+    obs.parse_text(live_scrape)  # well-formed exposition mid-run
+    assert ready["status"] == "training" and ready["total_steps"] == 8
+
+    # ring: every step recorded with the phase decomposition
+    recs = trainer.flight.tail()
+    assert [r["step"] for r in recs] == list(range(1, 9))
+    last = recs[-1]
+    assert {"data_load", "grad_accum", "optimizer_apply",
+            "host_sync", "eval"} <= set(last["phases"])
+    assert "checkpoint_save" in recs[2]["phases"]  # save_steps=3
+    assert last["tokens"] == 4 * 2 * 32
+    assert last["flops"] > 0 and np.isfinite(last["loss"])
+    assert last["host_step_s"] is not None and last["skew_s"] == 0.0
+
+    # counters reconcile with the run arithmetic
+    assert _counter("kct_train_tokens_total", run="live") == 8 * 256
+    assert _counter("kct_train_recompiles_total", run="live") == 0
+    # the wandb/JSONL mirror agrees with the stream's last record
+    (metrics_file,) = (tmp_path / "logs").glob("*.jsonl")
+    records = [r for r in read_jsonl(str(metrics_file))
+               if "train/loss" in r]
+    assert records[-1]["train/loss"] == pytest.approx(
+        _counter("kct_train_metric", run="live", key="train/loss"))
+    assert {"perf/data_load_time", "perf/tokens", "perf/model_flops",
+            "perf/step_wall_time", "perf/host_sync_time",
+            "train/grad_norm"} <= set(records[-1])
+    assert any("eval/loss" in r for r in records)
+    # sidecar is stopped with the run
+    assert trainer.metrics_server._httpd is None
+
+
+def test_sentinel_warn_skips_poisoned_apply(tmp_path, dataset, devices8):
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    with faults.inject(FaultSpec("train.step", mode="drop", at=4)):
+        trainer = _trainer(tmp_path, dataset, mesh, run_name="warn",
+                           divergence_policy="warn")
+        result = trainer.train()
+    assert result["steps"] == 8 and "diverged" not in result
+    params = trainer.state["params"]
+    import jax.numpy as jnp
+
+    assert bool(jnp.isfinite(params["embed"]["wte"]).all())
+    assert _counter("kct_train_divergence_events_total", run="warn",
+                    kind="nonfinite_loss") == 1
+    # the typed event landed in the metrics stream at step 4
+    (metrics_file,) = (tmp_path / "logs").glob("*.jsonl")
+    events = [r for r in read_jsonl(str(metrics_file))
+              if r.get("event") == "divergence"]
+    assert len(events) == 1 and events[0]["step"] == 4
+    assert events[0]["divergence/kind"] == "nonfinite_loss"
+    # and the ring marks the step
+    assert [r["step"] for r in trainer.flight.tail()
+            if r["divergence"]] == [4]
+
+
+def test_fused_nonfinite_taint_refuses_saves(tmp_path, dataset,
+                                             devices8):
+    """The fused path (gradients=1) applies the update in the same XLA
+    program that computes the loss, so a NaN verdict is post-apply —
+    under ``warn`` the run continues, but the taint must forbid every
+    later save: the newest persisted state stays finite and the run
+    reports diverged instead of shipping NaN final weights."""
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    with faults.inject(FaultSpec("train.step", mode="drop", at=4)):
+        trainer = _trainer(tmp_path, dataset, mesh, run_name="fused",
+                           gradients=1, divergence_policy="warn")
+        assert trainer._fused
+        result = trainer.train()
+    # warn keeps training to the end (gas=1: one epoch = 16 steps),
+    # but the result is honest...
+    assert result["steps"] == 16
+    assert result["diverged"] is True
+    assert result["divergence"] == "nonfinite_loss"
+    # ...every periodic save after the poisoned step 4 was refused
+    # (taint), so the newest checkpoint predates it...
+    assert trainer.checkpointer.latest_step() == 3
+    # ...and no final artifact was written
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "results-fused", ".ready.txt"))
+    assert "final_dir" not in result
+
+
+def test_gas_nonfinite_grad_taint_refuses_saves(tmp_path, dataset,
+                                                devices8):
+    """The accumulation path checks the loss BEFORE the apply, but the
+    grad norm only exists after it — a finite loss over NaN grads
+    (fp16/bf16 backward overflow) passes should_apply and poisons the
+    params, so the verdict must taint exactly like the fused path:
+    every later save refused, run reported diverged."""
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    trainer = _trainer(tmp_path, dataset, mesh, run_name="gastaint",
+                       divergence_policy="warn")
+    assert not trainer._fused
+    real_apply = trainer._apply
+    calls = {"n": 0}
+
+    def nan_grad_apply(state, grads, gas):
+        calls["n"] += 1
+        state, gn = real_apply(state, grads, gas)
+        return state, float("nan") if calls["n"] == 4 else gn
+
+    trainer._apply = nan_grad_apply
+    result = trainer.train()
+    # warn keeps training to the end, but the result is honest...
+    assert result["steps"] == 8
+    assert result["diverged"] is True
+    assert result["divergence"] == "nonfinite_grad"
+    # ...the periodic save at step 6 was refused (taint), so the
+    # newest checkpoint predates the poisoned apply...
+    assert trainer.checkpointer.latest_step() == 3
+    # ...and no final artifact was written
+    assert "final_dir" not in result
+    assert _counter("kct_train_divergence_events_total", run="gastaint",
+                    kind="nonfinite_grad") == 1
+    # the ring sanitizes the non-finite grad norm for the JSON dump
+    # but keeps the verdict
+    marked = [r for r in trainer.flight.tail() if r["divergence"]]
+    assert [r["step"] for r in marked] == [4]
+    assert marked[0]["grad_norm"] is None
+
+
+def test_sentinel_halt_preserves_last_checkpoint(tmp_path, dataset,
+                                                 devices8):
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    with faults.inject(FaultSpec("train.step", mode="drop", at=5)):
+        trainer = _trainer(tmp_path, dataset, mesh, run_name="halt",
+                           divergence_policy="halt")
+        result = trainer.train()
+    assert result["diverged"] is True
+    assert result["divergence"] == "nonfinite_loss"
+    assert result["steps"] == 5
+    # the periodic checkpoint-3 is untouched and restorable
+    assert trainer.checkpointer.latest_step() == 3
+    fresh = _trainer(tmp_path, dataset, mesh, run_name="halt",
+                     divergence_policy="halt")
+    assert fresh.maybe_resume() == 3
+    import jax.numpy as jnp
+
+    assert bool(jnp.isfinite(fresh.state["params"]["embed"]["wte"]).all())
+    # no final artifact: the run did NOT complete
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "results-halt", ".ready.txt"))
+
+
+def test_sentinel_rollback_completes_run(tmp_path, dataset, devices8):
+    """NaN at step 5 -> rollback to checkpoint-3, skip the poisoned
+    batch, finish all 8 steps with finite params."""
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    with faults.inject(FaultSpec("train.step", mode="drop", at=5)):
+        trainer = _trainer(tmp_path, dataset, mesh, run_name="rb",
+                           divergence_policy="rollback")
+        result = trainer.train()
+    assert result["steps"] == 8 and "diverged" not in result
+    assert os.path.exists(os.path.join(
+        str(tmp_path), "results-rb", ".ready.txt"))
+    import jax.numpy as jnp
+
+    assert bool(jnp.isfinite(
+        trainer.state["params"]["embed"]["wte"]).all())
+    assert _counter("kct_train_divergence_events_total", run="rb",
+                    kind="nonfinite_loss") == 1
+    # steps 4..8 ran twice (pre- and post-rollback): ring holds both
+    steps = [r["step"] for r in trainer.flight.tail()]
+    assert steps.count(5) >= 1 and steps[-1] == 8
+
+
+def test_second_rollback_never_rewinds_data(tmp_path, dataset,
+                                            devices8):
+    """The data iterator must never rewind on rollback: it is already
+    positioned just past the poisoned batch, and rebuilding it from
+    the rewound step counter would replay batches consumed since an
+    earlier rollback (double-training them and potentially re-feeding
+    the poisoned batch until max_rollbacks escalates to halt)."""
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    # fault firings count site hits (one per step attempt), not step
+    # numbers: firing 5 = step 5, then rollback reruns the counter
+    # from 4, so firing 7 lands on the rerun's step 5
+    with faults.inject(FaultSpec("train.step", mode="drop", at=5),
+                       FaultSpec("train.step", mode="drop", at=7)):
+        trainer = _trainer(tmp_path, dataset, mesh, run_name="rb2",
+                           divergence_policy="rollback")
+        rebuilds = []
+        real_make = trainer._make_batches
+        trainer._make_batches = (
+            lambda *a: (rebuilds.append(a), real_make(*a))[1])
+        result = trainer.train()
+    # both rollbacks recovered and the run completed
+    assert result["steps"] == 8 and "diverged" not in result
+    assert _counter("kct_train_divergence_events_total", run="rb2",
+                    kind="nonfinite_loss") == 2
+    # the one rebuild is train()'s startup fast-forward — neither
+    # rollback rebuilt (= rewound) the iterator
+    assert len(rebuilds) == 1
+    import jax.numpy as jnp
+
+    assert bool(jnp.isfinite(
+        trainer.state["params"]["embed"]["wte"]).all())
+
+
+def test_sigterm_during_rollback_leaves_resumable_checkpoint(
+        tmp_path, dataset, devices8):
+    """The preemption + sentinel interaction: SIGTERM delivered while
+    a divergence rollback is in flight must still end the run with a
+    resumable, finite checkpoint (the chaos case the grace period
+    exists for)."""
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+
+    class PreemptedMidRollback(Trainer):
+        def _rollback_to_checkpoint(self):
+            restored = super()._rollback_to_checkpoint()
+            # the SIGTERM handler fires while the restore is happening
+            os.kill(os.getpid(), __import__("signal").SIGTERM)
+            return restored
+
+    tcfg = TrainerConfig(
+        run_name="term", output_path=str(tmp_path), batch_size=4,
+        gradients=2, epochs=1, save_steps=3,
+        logs=str(tmp_path / "logs"), prompt_every=0,
+        divergence_policy="rollback")
+    trainer = PreemptedMidRollback(
+        PRESETS["test-tiny"], TrainConfig(warmup_steps=2, total_steps=8),
+        tcfg, mesh, dataset)
+    trainer.install_preemption_handler()
+    try:
+        with faults.inject(FaultSpec("train.step", mode="drop", at=5)):
+            result = trainer.train()
+    finally:
+        trainer.restore_signal_handler()
+    assert result["preempted"] is True
+    assert result["steps"] == 3  # rolled back to checkpoint-3, then left
+    # the checkpoint is resumable and finite
+    fresh = _trainer(tmp_path, dataset, mesh, run_name="term",
+                     divergence_policy="rollback")
+    assert fresh.maybe_resume() == 3
+    import jax.numpy as jnp
+
+    assert bool(jnp.isfinite(fresh.state["params"]["embed"]["wte"]).all())
+    resumed = fresh.train()
+    assert resumed["steps"] == 8
+    assert os.path.exists(os.path.join(
+        str(tmp_path), "results-term", ".ready.txt"))
+
+
+def test_rollback_without_checkpoint_escalates_to_halt(
+        tmp_path, dataset, devices8):
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    with faults.inject(FaultSpec("train.step", mode="drop", at=1)):
+        trainer = _trainer(tmp_path, dataset, mesh, run_name="noroll",
+                           divergence_policy="rollback",
+                           save_steps=100)  # nothing saved before NaN
+        result = trainer.train()
+    assert result["diverged"] is True and result["steps"] == 1
+
+
+def test_train_data_stall_fault_feeds_counter(tmp_path, dataset,
+                                              devices8):
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    with faults.inject(FaultSpec("train.data", mode="slow", at=1,
+                                 times=-1, delay_s=0.05)):
+        trainer = _trainer(tmp_path, dataset, mesh, run_name="stall")
+        trainer.train()
+    # 8 steps x gas 2 micro-fetches, each slowed 50 ms
+    stall = _counter("kct_train_data_stall_seconds_total", run="stall")
+    assert stall >= 8 * 2 * 0.05 * 0.9
+    rec = trainer.flight.tail()[-1]
+    assert rec["phases"]["data_load"] >= 0.09
+
+
+def test_train_checkpoint_fault_surfaces(tmp_path, dataset, devices8):
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    trainer = _trainer(tmp_path, dataset, mesh, run_name="ckf")
+    with faults.inject(FaultSpec("train.checkpoint", mode="raise")):
+        with pytest.raises(faults.FaultError):
+            trainer.save_checkpoint(1, force=True)
+
+
+def test_straggler_skew_from_host_heartbeats(tmp_path, dataset,
+                                             devices8):
+    """Monkeypatched multi-host heartbeat: the skew gauge, the record's
+    per-host vector, and the JSONL perf/step_skew key all carry
+    max - min."""
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    trainer = _trainer(tmp_path, dataset, mesh, run_name="skew")
+    trainer._allgather_step_times = lambda t: np.asarray([t, t + 0.25])
+    trainer.train()
+    assert _counter("kct_train_step_skew_seconds", run="skew") \
+        == pytest.approx(0.25, abs=1e-6)
+    rec = trainer.flight.tail()[-1]
+    assert len(rec["host_step_s"]) == 2
+    assert rec["skew_s"] == pytest.approx(0.25, abs=1e-6)
+    (metrics_file,) = (tmp_path / "logs").glob("*.jsonl")
+    last = [r for r in read_jsonl(str(metrics_file))
+            if "perf/step_skew" in r][-1]
+    assert last["perf/step_skew"] == pytest.approx(0.25, abs=1e-6)
+
+
+def test_recompile_counter_on_new_shape_signature(tmp_path, dataset,
+                                                  devices8):
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    trainer = _trainer(tmp_path, dataset, mesh, run_name="reco")
+
+    class B(dict):
+        pass
+
+    import jax.numpy as jnp
+
+    b1 = {"input_ids": jnp.ones((4, 32), jnp.int32)}
+    b2 = {"input_ids": jnp.ones((4, 64), jnp.int32)}
+    assert trainer._note_compile("micro", b1) is False  # first compile
+    assert trainer._note_compile("micro", b1) is False  # cached
+    assert trainer._note_compile("micro", b2) is True   # recompile
+    assert trainer._note_compile("fused", b2) is False  # other program
+    assert _counter("kct_train_recompiles_total", run="reco") == 1
+
+
+def test_flight_records_zero_disables_ring_not_training(
+        tmp_path, dataset, devices8):
+    mesh = build_mesh(MeshSpec(data=2), devices=devices8[:2])
+    trainer = _trainer(tmp_path, dataset, mesh, run_name="off",
+                       flight_records=0)
+    result = trainer.train()
+    assert result["steps"] == 8
+    assert len(trainer.flight) == 0 and not trainer.flight.enabled
+    # cheap counters still live (the ring, not telemetry, was disabled)
+    assert _counter("kct_train_tokens_total", run="off") == 8 * 256
