@@ -103,6 +103,11 @@ SITES = {
                     "slots/pages/profile; failure must stay contained "
                     "to the debug request — the debug plane observes "
                     "the data plane, it can never wedge it)",
+    "tenancy.admit": "per-tenant admission check in engine submit "
+                     "(HTTP thread, BEFORE the queue): raise/hang is "
+                     "contained to the submitting request — the "
+                     "scheduler pass never routes through this site, "
+                     "so a wedged admission can never stall decoding",
     "train.step": "once per trainer optimizer step (raise = crashed "
                   "step program; drop = the step's loss reads as NaN "
                   "— deterministic divergence injection for sentinel "

@@ -67,6 +67,19 @@ METRIC_FAMILIES = {
         "prompt tokens served from the prefix cache",
     "kct_engine_kv_cow_total":
         "shared pages copied on write before a private prefill",
+    # multi-tenant traffic plane (serve/tenancy.py)
+    "kct_tenant_admitted_total":
+        "requests admitted into slots per tenant and QoS lane",
+    "kct_tenant_shed_total":
+        "requests shed before decoding per tenant, by reason",
+    "kct_tenant_preempted_total":
+        "mid-decode batch-lane preemptions suffered per tenant",
+    "kct_tenant_tokens_total":
+        "tokens served per tenant by kind (prefill computed | decode)",
+    "kct_tenant_queue_depth":
+        "queued (not yet admitted) requests per tenant",
+    "kct_tenant_ttft_seconds":
+        "submit to first token per tenant and lane",
     # dynamic batcher (serve/batcher.py)
     "kct_batcher_batches_total":
         "batches dispatched to the device",

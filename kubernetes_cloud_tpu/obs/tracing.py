@@ -41,7 +41,8 @@ REQUEST_ID_HEADER = "X-Request-Id"
 
 #: engine span vocabulary, in lifecycle order (terminal spans last)
 SPANS = ("queued", "admitted", "prefill", "decode", "first_token",
-         "dispatched", "complete", "shed", "failed", "cancelled")
+         "preempted", "dispatched", "complete", "shed", "failed",
+         "cancelled")
 
 TERMINAL_SPANS = ("complete", "shed", "failed", "cancelled")
 

@@ -49,7 +49,6 @@ generate` for any admission order.
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import logging
 import queue
@@ -86,6 +85,12 @@ from kubernetes_cloud_tpu.serve.errors import (
 )
 from kubernetes_cloud_tpu.serve import paged_kv
 from kubernetes_cloud_tpu.serve.paged_kv import PageAllocator
+from kubernetes_cloud_tpu.serve.tenancy import (
+    LANES,
+    TenancyConfig,
+    TenantScheduler,
+    parse_tenancy,
+)
 from kubernetes_cloud_tpu.serve.model import (
     Model,
     instance_text,
@@ -219,6 +224,12 @@ class EngineConfig:
     #: knob the overhead benchmark flips (BENCHMARKS.md "Flight
     #: recorder overhead").
     flight_records: int = 1024
+    #: multi-tenant traffic plane (serve/tenancy.py): per-tenant
+    #: token-bucket admission, weighted fair queueing in decoded+
+    #: prefilled tokens, QoS lanes with interactive-over-batch
+    #: preemption.  None = one unlimited default tenant, which is
+    #: byte-for-byte the pre-tenancy FIFO behavior.
+    tenancy: Optional[TenancyConfig] = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -265,12 +276,14 @@ class GenRequest:
                  "top_p", "rng", "tokens", "stream", "event", "error",
                  "claimed", "cancelled", "submitted_at", "admitted_at",
                  "first_token_at", "done_at", "deadline", "engine",
-                 "request_id", "cached_tokens")
+                 "request_id", "cached_tokens", "tenant", "lane",
+                 "pinned_pages", "preemptions", "resume_len")
 
     def __init__(self, prompt_ids: Sequence[int], *, max_new_tokens: int,
                  temperature: float, top_k: int, top_p: float, seed: int,
                  deadline: Optional[float] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 tenant: str = "default", lane: str = "interactive"):
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -305,6 +318,21 @@ class GenRequest:
         #: (paged engine; 0 otherwise) — surfaced per prediction so
         #: load tests can account prefill compute actually spent
         self.cached_tokens = 0
+        #: traffic-plane identity (serve/tenancy.py): resolved tenant
+        #: name + QoS lane, carried through spans and /debug/slots
+        self.tenant = tenant
+        self.lane = lane
+        #: paged mode keeps a preempted request's KV pages reserved so
+        #: resume is prefill-free; cleared on resume/transplant/close
+        self.pinned_pages: Optional[list] = None
+        #: times this request was preempted mid-decode (surfaced per
+        #: prediction — the fairness bench asserts preemption actually
+        #: exercised)
+        self.preemptions = 0
+        #: tokens already emitted at the last (re)admission — the
+        #: preemption progress guard reads the delta (a batch slot is
+        #: only preemptable after min_batch_progress fresh tokens)
+        self.resume_len = 0
 
     def cancel(self) -> None:
         """Mark the request dead (client gone).  The scheduler purges it
@@ -465,10 +493,17 @@ class ContinuousBatchingEngine:
         self.name = name
         self.pool: Optional[dict] = None
         self._slots: list[Optional[GenRequest]] = [None] * engine_cfg.slots
-        # deque + lock rather than queue.Queue: cancelled requests must be
-        # purgeable from the middle (a dead request sitting in a bounded
-        # queue would 503 live clients while every slot is busy)
-        self._queue: "collections.deque[GenRequest]" = collections.deque()
+        # Per-tenant queues + WFQ drain order instead of one global
+        # deque (serve/tenancy.py); _qlock still guards every queue
+        # mutation AND the virtual-time/occupancy accounting, so the
+        # old single-queue invariants (purgeable middles, trace-inside-
+        # lock ordering) carry over.  The no-config default is one
+        # unlimited FIFO tenant — the legacy behavior exactly.
+        self.tenants = TenantScheduler(
+            engine_cfg.tenancy, slots=engine_cfg.slots,
+            page_capacity=(engine_cfg.effective_num_pages - 1
+                           if engine_cfg.paged else 0),
+            model=name)
         self._qlock = threading.Lock()
         self._stop = threading.Event()
         self._work = threading.Event()  # submit()/stop() wake the loop
@@ -527,7 +562,7 @@ class ContinuousBatchingEngine:
                       "deadline_shed": 0, "prefill_tokens": 0,
                       "prompt_tokens": 0, "prefix_hits": 0,
                       "prefix_tokens_saved": 0, "cow_copies": 0,
-                      "peak_active": 0}
+                      "peak_active": 0, "preemptions": 0, "resumed": 0}
         #: always-on flight recorder: bounded ring of per-iteration
         #: phase timings + batch composition (GET /debug/timeline);
         #: flight_records=0 disables it for overhead A/Bs.  A restart
@@ -711,23 +746,47 @@ class ContinuousBatchingEngine:
         return self._page_table_dev
 
     def queue_depth(self) -> int:
+        """Aggregate admission-queue depth ACROSS every per-tenant
+        queue — what deadline admission, the supervisor's ``/readyz``
+        shed threshold, and the queue-depth gauge all read, so the
+        traffic plane cannot hide queued work from any of them."""
         with self._qlock:
-            return len(self._queue)
+            return self.tenants.depth()
 
-    def estimated_queue_delay(self) -> float:
-        """Admission-control estimate: how long freshly queued work will
-        wait, from the current queue depth and the measured iteration
-        time.  0.0 until the first decode iteration lands (optimism at
-        cold start beats shedding the warmup request)."""
+    def estimated_queue_delay(self, tenant: Optional[str] = None
+                              ) -> float:
+        """Admission-control estimate: how long freshly queued work
+        will wait, from queue depth and the measured iteration time.
+        0.0 until the first decode iteration lands (optimism at cold
+        start beats shedding the warmup request).
+
+        With a ``tenant``, the estimate is WFQ-aware: the tenant waits
+        behind its OWN queue at its share of the admission bandwidth
+        (worst case ~1/n_busy of ``max_admit_per_step`` per pass) —
+        NOT behind the aggregate FIFO backlog.  Without this, a batch
+        tenant's deep queue would shed another tenant's deadline-
+        bearing interactive request at the door, defeating exactly the
+        isolation the traffic plane provides.  For the no-config
+        single-tenant engine both forms are identical.  The aggregate
+        form (no tenant) still feeds the supervisor's readiness
+        threshold."""
         if self.iter_s is None:
             return 0.0
-        return (self.queue_depth() / self.ecfg.max_admit_per_step
-                ) * self.iter_s
+        if tenant is None:
+            return (self.queue_depth() / self.ecfg.max_admit_per_step
+                    ) * self.iter_s
+        with self._qlock:
+            own = self.tenants.state(tenant).queued()
+            busy = self.tenants.busy_count()
+        return (own * max(busy, 1)
+                / self.ecfg.max_admit_per_step) * self.iter_s
 
     def submit(self, prompt_ids: Sequence[int], *, max_new_tokens: int = 64,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                seed: int = 0, deadline: Optional[float] = None,
-               request_id: Optional[str] = None) -> GenRequest:
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None, api_key: Optional[str] = None,
+               lane: Optional[str] = None) -> GenRequest:
         if not prompt_ids:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
@@ -757,37 +816,77 @@ class ContinuousBatchingEngine:
                 f"({self.cfg.max_seq_len}) for learned positions")
         if self._stop.is_set() or not self.alive:
             raise RetryableError("engine stopped")
+        # Traffic-plane admission, BEFORE the shared queue: identity,
+        # then the tenant's own token buckets.  The fault site runs on
+        # THIS (HTTP) thread only — the scheduler pass never routes
+        # through it, so an injected raise/hang is contained to the
+        # submitting request (chaos-locked by tests/test_tenancy_chaos)
+        spec = self.tenants.resolve(tenant, api_key)
+        if lane is not None and lane not in LANES:
+            raise ValueError(f"lane must be one of {LANES}")
+        if lane == "interactive" and spec.lane != "interactive":
+            # the interactive lane is preemption PRIORITY and
+            # batch-lane work is what gets preempted: a self-declared
+            # upgrade would both jump the QoS queue and make the
+            # caller's long generations unevictable — lane upgrades
+            # are a config decision, not a payload field
+            raise ValueError(
+                f"tenant {spec.name!r} may not upgrade to the "
+                f"interactive lane per-request (configure its lane)")
+        req_lane = lane or spec.lane
+        faults.fire("tenancy.admit")
+        self.tenants.admit_check(spec, len(prompt_ids))
+        # from here on a shed bought the tenant nothing: refund the
+        # bucket charge so backpressure cannot double-penalize a
+        # tenant below its contracted rate
         if deadline is not None:
             now = time.monotonic()
             if deadline <= now:
-                self._shed(request_id, "deadline_admission")
+                self.tenants.refund(spec, len(prompt_ids))
+                self._shed(request_id, "deadline_admission", spec.name)
                 raise DeadlineExceededError(
                     "deadline expired before admission")
-            est = self.estimated_queue_delay()
+            est = self.estimated_queue_delay(spec.name)
             if now + est > deadline:
                 # shedding at the door beats burning a slot on an
                 # answer nobody is waiting for
-                self._shed(request_id, "deadline_admission")
+                self.tenants.refund(spec, len(prompt_ids))
+                self._shed(request_id, "deadline_admission", spec.name)
                 raise DeadlineExceededError(
                     f"queue delay ~{est:.3f}s implies a deadline miss")
         if faults.fire("queue") == "drop":
-            self._shed(request_id, "queue_full")
+            self.tenants.refund(spec, len(prompt_ids))
+            self._shed(request_id, "queue_full", spec.name)
             raise QueueFullError("request queue full (injected)")
         req = GenRequest(prompt_ids, max_new_tokens=max_new_tokens,
                          temperature=temperature, top_k=top_k, top_p=top_p,
                          seed=seed, deadline=deadline,
-                         request_id=request_id)
+                         request_id=request_id, tenant=spec.name,
+                         lane=req_lane)
         req.engine = self
         with self._qlock:
-            if len(self._queue) >= self.ecfg.max_queue_size:
-                self._shed(request_id, "queue_full")
-                raise QueueFullError("request queue full")
-            self._queue.append(req)
-            # trace INSIDE the lock: the scheduler pops under the same
-            # lock, so "admitted" can never outrun this record (span
-            # order queued → admitted is a documented invariant)
-            trace(request_id, "queued", model=self.name,
-                  prompt_tokens=len(req.prompt_ids))
+            # the bounded queue is enforced PER TENANT (weight share
+            # of max_queue_size) with the aggregate bound as the
+            # memory backstop: one tenant's flood fills only its own
+            # slice, never its neighbours' admission
+            full = (self.tenants.state(spec.name).queued()
+                    >= self.tenants.queue_share(
+                        spec, self.ecfg.max_queue_size)
+                    or self.tenants.depth() >= self.ecfg.max_queue_size)
+            if not full:
+                self.tenants.append(req)
+                # trace INSIDE the lock: the scheduler pops under the
+                # same lock, so "admitted" can never outrun this
+                # record (span order queued → admitted is a
+                # documented invariant)
+                trace(request_id, "queued", model=self.name,
+                      prompt_tokens=len(req.prompt_ids),
+                      tenant=spec.name, lane=req_lane)
+        if full:
+            # refund outside the queue lock (the bucket has its own)
+            self.tenants.refund(spec, len(prompt_ids))
+            self._shed(request_id, "queue_full", spec.name)
+            raise QueueFullError("request queue full")
         if self._stop.is_set():
             # lost the race with stop(): the scheduler may already have
             # run its final queue drain, so fail the stragglers here —
@@ -804,8 +903,12 @@ class ContinuousBatchingEngine:
         req.engine = self
         req.claimed = False
         req.admitted_at = None  # queue-wait restarts on the new engine
+        # pinned pages (a preempted request's prefill-free resume
+        # claim) belonged to the ABANDONED engine's arena — the
+        # replacement re-prefills its context instead
+        req.pinned_pages = None
         with self._qlock:
-            self._queue.append(req)
+            self.tenants.append(req)
         self._work.set()
 
     def abandon(self, err: Exception) -> list[GenRequest]:
@@ -819,8 +922,7 @@ class ContinuousBatchingEngine:
         self._stop.set()
         self._work.set()
         with self._qlock:
-            queued = [r for r in self._queue if not r.cancelled]
-            self._queue.clear()
+            queued = [r for r in self.tenants.drain() if not r.cancelled]
         self._fail_active(err)
         return queued
 
@@ -854,10 +956,13 @@ class ContinuousBatchingEngine:
                 continue
             entry = {"slot": i, "state": "decoding",
                      "request_id": req.request_id,
+                     "tenant": req.tenant,
+                     "lane": req.lane,
                      "prompt_tokens": len(req.prompt_ids),
                      "tokens_out": len(req.tokens),
                      "max_new_tokens": req.max_new_tokens,
                      "cached_tokens": req.cached_tokens,
+                     "preemptions": req.preemptions,
                      "age_s": round(now - req.submitted_at, 3)}
             if req.deadline is not None:
                 entry["deadline_in_s"] = round(req.deadline - now, 3)
@@ -867,6 +972,13 @@ class ContinuousBatchingEngine:
                 entry["context_len"] = int(self._lengths[i])
             out.append(entry)
         return out
+
+    def debug_tenants(self) -> dict:
+        """Per-tenant traffic-plane state (queue depths by lane,
+        occupancy vs quota, virtual clocks, lifetime counters) — the
+        ``/debug/slots`` companion the fairness bench reads."""
+        with self._qlock:
+            return self.tenants.snapshot()
 
     def debug_pages(self) -> Optional[dict]:
         """Page-arena occupancy + prefix-cache contents (hashes with
@@ -914,7 +1026,8 @@ class ContinuousBatchingEngine:
             self._update_gauges()
             stopping = self._stop.is_set()
             if stopping:
-                self._fail_queued(RetryableError("engine stopped"))
+                self._fail_queued(RetryableError("engine stopped"),
+                                  release_pinned=True)
             if stopping and not any(s is not None for s in self._slots):
                 return
             try:
@@ -942,6 +1055,7 @@ class ContinuousBatchingEngine:
                             self.ecfg.max_len)
         self._m_active.set(active)
         self._m_queue.set(self.queue_depth())
+        self.tenants.refresh_gauges()
         if self._peak_reset.is_set():
             self._peak_reset.clear()
             self.stats["peak_active"] = active
@@ -973,8 +1087,13 @@ class ContinuousBatchingEngine:
                 self._m_mfu.set(obs_flops.mfu(rates["flops_per_s"],
                                               self._peak_flops))
 
-    def _shed(self, request_id: Optional[str], reason: str) -> None:
+    def _shed(self, request_id: Optional[str], reason: str,
+              tenant: Optional[str] = None) -> None:
         _M_SHED.labels(model=self.name, reason=reason).inc()
+        if tenant is not None:
+            self.tenants.count_shed(
+                tenant, "queue_full" if reason == "queue_full"
+                else "deadline")
         trace(request_id, "shed", model=self.name, reason=reason)
 
     def _step(self, stopping: bool) -> None:
@@ -1006,7 +1125,7 @@ class ContinuousBatchingEngine:
             self._commit_rec(t_pass)
             if not stopping:
                 self._work.clear()
-                if not self._queue:
+                if not self.tenants.depth():
                     self._work.wait(self.ecfg.idle_wait_s)
             return
         tokens = np.full((self.ecfg.slots,), self.pad, np.int32)
@@ -1086,16 +1205,14 @@ class ContinuousBatchingEngine:
                 self._m_cancelled.inc()
                 self._finish_slot(i, error=RequestCancelled(
                     "request cancelled"))
-        # Purge cancelled requests from anywhere in the queue, even with
-        # zero free slots — a dead request must not hold bounded queue
-        # capacity (503ing live clients) while long generations run.
+        # Purge cancelled requests from anywhere in ANY tenant queue,
+        # even with zero free slots — a dead request must not hold
+        # bounded queue capacity (503ing live clients) while long
+        # generations run.
         with self._qlock:
-            dead = [r for r in self._queue if r.cancelled]
-            if dead:
-                alive = [r for r in self._queue if not r.cancelled]
-                self._queue.clear()
-                self._queue.extend(alive)
+            dead = self.tenants.purge(lambda r: r.cancelled)
         for req in dead:
+            self._release_pinned(req)
             self.stats["cancelled"] += 1
             self._m_cancelled.inc()
             trace(req.request_id, "cancelled", model=self.name)
@@ -1103,36 +1220,90 @@ class ContinuousBatchingEngine:
             req.stream.put(_STREAM_END)
             req.event.set()
 
-    def _pop_queued(self) -> Optional[GenRequest]:
+    def _reclaim_pinned(self) -> bool:
+        """Release ONE queued preempted request's pinned page claim
+        (it re-prefills at resume) so an admission blocked on a full
+        arena can proceed; False when nothing is pinned.  Scheduler-
+        thread only."""
         with self._qlock:
-            return self._queue.popleft() if self._queue else None
-
-    def _pop_admittable(self) -> Optional[GenRequest]:
-        """Pop queued requests until one is actually decodable, closing
-        out cancelled and deadline-expired ones on the way; None when
-        the queue is drained."""
-        while True:
-            req = self._pop_queued()
+            req = self.tenants.find_pinned()
             if req is None:
-                return None
-            if req.cancelled:  # cancel landed after this step's purge
-                self.stats["cancelled"] += 1
-                self._m_cancelled.inc()
-                trace(req.request_id, "cancelled", model=self.name)
-                req.error = RequestCancelled("request cancelled")
-                req.stream.put(_STREAM_END)
-                req.event.set()
-                continue
-            if (req.deadline is not None
-                    and time.monotonic() > req.deadline):
-                # expired while queued: shed instead of spending prefill
-                # + decode on an answer nobody is waiting for
-                self.stats["deadline_shed"] += 1
-                self._shed(req.request_id, "deadline_queued")
-                req.error = DeadlineExceededError(
-                    "deadline expired in queue")
-                req.stream.put(_STREAM_END)
-                req.event.set()
+                return False
+            pages, req.pinned_pages = req.pinned_pages, None
+            self.tenants.note_pages(req.tenant, -len(pages))
+        self.allocator.release(pages)
+        return True
+
+    def _release_pinned(self, req: GenRequest) -> None:
+        """Free a preempted request's pinned KV pages when it leaves
+        the queue for good (cancel / deadline shed / stop).  Scheduler-
+        thread only — the allocator is single-owner, like _slots."""
+        pages, req.pinned_pages = req.pinned_pages, None
+        if pages and self.allocator is not None:
+            self.allocator.release(pages)
+            with self._qlock:
+                self.tenants.note_pages(req.tenant, -len(pages))
+
+    def _close_out_unadmittable(self, req: GenRequest) -> bool:
+        """Close a popped request that must not decode (cancelled or
+        deadline-expired while queued); True when it was closed.  The
+        WFQ pop charged a provisional slot — give it back."""
+        if req.cancelled:  # cancel landed after this step's purge
+            with self._qlock:
+                self.tenants.note_dequeued(req)
+            self._release_pinned(req)
+            self.stats["cancelled"] += 1
+            self._m_cancelled.inc()
+            trace(req.request_id, "cancelled", model=self.name)
+            req.error = RequestCancelled("request cancelled")
+            req.stream.put(_STREAM_END)
+            req.event.set()
+            return True
+        if (req.deadline is not None
+                and time.monotonic() > req.deadline):
+            # expired while queued: shed instead of spending prefill
+            # + decode on an answer nobody is waiting for — and
+            # refund the admission-bucket charge like every other
+            # shed (the tenant got no service; cancellation, by
+            # contrast, keeps its charge: the client walked away)
+            with self._qlock:
+                self.tenants.note_dequeued(req)
+            self._release_pinned(req)
+            self.tenants.refund(self.tenants.state(req.tenant).spec,
+                                len(req.prompt_ids))
+            self.stats["deadline_shed"] += 1
+            self._shed(req.request_id, "deadline_queued", req.tenant)
+            req.error = DeadlineExceededError(
+                "deadline expired in queue")
+            req.stream.put(_STREAM_END)
+            req.event.set()
+            return True
+        return False
+
+    def _unpop_leftover(self, forced: list) -> None:
+        """A forced preemptor the admit pass could not place (budget
+        exhausted, or paged admission broke on page exhaustion) MUST
+        go back to its lane head with its provisional slot charge
+        reversed — dropping it would hang its client forever and leak
+        the tenant's occupancy accounting."""
+        while forced:
+            with self._qlock:
+                self.tenants.unpop(forced.pop())
+
+    def _next_admittable(self, forced: list) -> Optional[GenRequest]:
+        """Next decodable request: preemption-forced pops first, then
+        the weighted-fair-queueing drain; cancelled and deadline-
+        expired requests are closed out on the way.  None when every
+        queue is drained."""
+        while True:
+            if forced:
+                req = forced.pop(0)
+            else:
+                with self._qlock:
+                    req = self.tenants.pop_next()
+                if req is None:
+                    return None
+            if self._close_out_unadmittable(req):
                 continue
             return req
 
@@ -1148,28 +1319,104 @@ class ContinuousBatchingEngine:
     def _admit(self) -> int:
         """Admit queued requests into free slots; returns how many (a
         prefill-bearing pass is what the phase-labeled iteration
-        histogram and the stall analysis key on)."""
+        histogram and the stall analysis key on).  With every slot
+        busy, QoS-lane preemption may first evict batch slots for
+        waiting interactive requests (``_preempt_for_interactive``)."""
         free = [i for i, s in enumerate(self._slots) if s is None]
-        budget = min(len(free), self.ecfg.max_admit_per_step)
+        forced = self._preempt_for_interactive(free)
+        # the admit budget must cover every forced preemptor — they
+        # are already popped and charged, and the slots they evicted
+        # are in `free`; a budget below len(forced) (reachable with
+        # max_admit_per_step < max_preempt_per_step) would strand them
+        budget = min(len(free), max(self.ecfg.max_admit_per_step,
+                                    len(forced)))
         if self.paged:
-            return self._admit_paged(free, budget)
-        return self._admit_slots(free, budget)
+            return self._admit_paged(free, budget, forced)
+        return self._admit_slots(free, budget, forced)
 
-    def _admit_slots(self, free: list[int], budget: int) -> int:
+    def _preempt_for_interactive(self, free: list[int]) -> list[GenRequest]:
+        """Lane semantics: while NO slot is free and an interactive
+        request waits for a tenant still under its slot quota, evict a
+        batch-lane slot mid-decode (victim: the batch request whose
+        tenant has consumed the most weighted service, newest first on
+        ties).  The evicted request re-queues at its lane head with
+        its state intact — paged mode keeps its pages pinned so resume
+        is prefill-free; slot mode re-prefills its context — and its
+        emitted tokens / RNG are never recomputed, so outputs stay
+        token-identical across the round trip.  Returns the popped
+        interactive requests, which the admit pass MUST place (they
+        are already charged and out of the queue)."""
+        forced: list[GenRequest] = []
+        cap = self.tenants.cfg.max_preempt_per_step
+        # keep preempting while every free slot is already earmarked
+        # by a forced pop (a burst of interactive arrivals may evict
+        # several batch slots in ONE pass, up to the per-pass cap) —
+        # but never when a genuinely spare slot could serve the
+        # arrival without an eviction
+        while len(forced) < cap and len(free) <= len(forced):
+            with self._qlock:
+                req = self.tenants.pop_interactive_preemptor()
+                if req is None:
+                    break
+                victim = self.tenants.pick_victim(
+                    [(i, r) for i, r in enumerate(self._slots)
+                     if r is not None])
+                if victim is None:  # no batch-lane slot to evict
+                    self.tenants.unpop(req)
+                    break
+            self._preempt_slot(victim)
+            free.append(victim)
+            forced.append(req)
+        return forced
+
+    def _preempt_slot(self, slot: int) -> None:
+        req = self._slots[slot]
+        self._slots[slot] = None
+        if self.paged:
+            # keep the pages reserved (pinned on the request): the KV
+            # for every consumed position survives, so resume is just
+            # re-installing the indirection — prefill-free
+            req.pinned_pages, self._slot_pages[slot] = \
+                self._slot_pages[slot], None
+            self._page_table[slot, :] = 0
+            self._page_table_dirty = True
+            self._lengths[slot] = 0
+        else:
+            # the slot's KV rows are recycled; resume re-prefills
+            # prompt + emitted tokens (deterministic, so re-derived KV
+            # continues the sequence bitwise-identically)
+            self.pool = dict(self.pool)
+            self.pool["length"] = self.pool["length"].at[slot].set(0)
+        req.claimed = False  # back in the queue, not slot-bound
+        req.preemptions += 1
+        self.stats["preemptions"] += 1
+        trace(req.request_id, "preempted", model=self.name, slot=slot,
+              tenant=req.tenant, tokens=len(req.tokens))
+        with self._qlock:
+            self.tenants.note_preempted(req)
+            self.tenants.append_head(req)
+
+    def _admit_slots(self, free: list[int], budget: int,
+                     forced: Optional[list] = None) -> int:
         batch: list[GenRequest] = []
-        while len(batch) < budget:
-            req = self._pop_admittable()
+        resumes: list[GenRequest] = []
+        forced = forced or []
+        while len(batch) + len(resumes) < budget:
+            req = self._next_admittable(forced)
             if req is None:
                 break
             req.claimed = True
+            resumed = bool(req.tokens)  # preempted mid-decode earlier
             req.admitted_at = time.monotonic()
             trace(req.request_id, "admitted", model=self.name,
-                  queue_s=round(req.admitted_at - req.submitted_at, 6))
-            batch.append(req)
+                  queue_s=round(req.admitted_at - req.submitted_at, 6),
+                  tenant=req.tenant, lane=req.lane, resumed=resumed)
+            (resumes if resumed else batch).append(req)
+        self._unpop_leftover(forced)
         # Claimed but not yet slotted: visible to the failure paths
         # until every group lands in _slots (cleared at the end; a
         # crash in between is _fail_active's to clean up).
-        self._admitting = batch
+        self._admitting = batch + resumes
         # One prefill dispatch per prompt-length bucket, not per request:
         # a same-bucket burst scatters into its slots with a single
         # program call (compile count stays bounded at
@@ -1206,6 +1453,8 @@ class ContinuousBatchingEngine:
                 self.stats["prefill_tokens"] += len(req.prompt_ids)
                 self.stats["prompt_tokens"] += len(req.prompt_ids)
                 self._m_admitted.inc()
+                with self._qlock:  # WFQ service clock: prompt tokens
+                    self.tenants.charge_prefill(req, len(req.prompt_ids))
                 if rec is not None:
                     rec.admitted += 1
                     rec.prefill_tokens += len(req.prompt_ids)
@@ -1219,38 +1468,129 @@ class ContinuousBatchingEngine:
                 # prefill → decode → first_token
                 trace(req.request_id, "decode", model=self.name, slot=slot)
                 self._emit(slot, logits[r])
+        for req in resumes:
+            self._resume_into_slot(free.pop(0), req)
         self._admitting = []
-        return len(batch)
+        return len(batch) + len(resumes)
 
-    def _admit_paged(self, free: list[int], budget: int) -> int:
+    def _resume_into_slot(self, slot: int, req: GenRequest) -> None:
+        """Slot-mode resume after preemption: re-derive the slot's KV
+        by prefilling prompt + every emitted token but the last (the
+        exact context a continuing decode would hold — the last token's
+        KV is written by its own next decode step), then re-activate.
+        The prefill logits are DISCARDED: the last emitted token was
+        already streamed, and re-sampling it would double-emit.  The
+        request's RNG and token list are untouched, so the continuation
+        is token-identical to never having been preempted."""
+        ids_list = req.prompt_ids + req.tokens[:-1]
+        bucket = self._bucket(len(ids_list))
+        ids = np.full((1, bucket), self.pad, np.int32)
+        mask = np.zeros((1, bucket), np.int32)
+        ids[0, :len(ids_list)] = ids_list
+        mask[0, :len(ids_list)] = 1
+        shape_key = (bucket, 1)
+        cold = self._prefill_cold_guard(shape_key)
+        faults.fire("model_fn")
+        t0 = time.perf_counter()
+        logits, self.pool = self._prefill(
+            self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
+            self.pool, jnp.asarray([slot], jnp.int32))
+        logits.block_until_ready()  # discard: see docstring
+        rec = self._rec
+        if rec is not None:
+            rec.phases["prefill"] = rec.phases.get("prefill", 0.0) \
+                + (time.perf_counter() - t0)
+            rec.admitted += 1
+            rec.prefill_tokens += len(ids_list)
+            rec.flops += obs_flops.span_flops(
+                self._flops_base, self._flops_per_ctx, 0, len(ids_list))
+        if cold:
+            self._warm_shapes.add(shape_key)
+            self.grace_until = 0.0
+        self._slots[slot] = req
+        req.resume_len = len(req.tokens)
+        self.stats["resumed"] += 1
+        # engine-level prefill_tokens counts the recompute (it is real
+        # compute the stall analysis must see); the tenant's virtual
+        # clock does NOT advance — the victim already paid for these
+        # tokens once, and preemption overhead is the preemptor's
+        # fault, not the victim's service
+        self.stats["prefill_tokens"] += len(ids_list)
+        trace(req.request_id, "prefill", model=self.name, slot=slot,
+              resumed=True)
+        trace(req.request_id, "decode", model=self.name, slot=slot)
+
+    def _admit_paged(self, free: list[int], budget: int,
+                     forced: Optional[list] = None) -> int:
         """Paged admission: reserve pages (reusing cached prefix blocks)
         per request, then prefill only the uncached tails, grouped by
         tail-length bucket.  A reservation that cannot be satisfied
         right now puts the request back at the queue head — pages free
-        as decoding slots evict, exactly like waiting for a free slot."""
+        as decoding slots evict, exactly like waiting for a free slot.
+
+        Resumes ride the same machinery: a preempted request with its
+        pages still pinned just re-installs its indirection (prefill-
+        free); one whose pages are gone (supervisor transplant) runs as
+        a virtual prompt of ``prompt + tokens[:-1]`` whose prefill
+        logits are discarded — either way the emitted-token list and
+        RNG are untouched, so the continuation is token-identical."""
         rec = self._rec
-        batch: list[tuple[GenRequest, Any]] = []
-        while len(batch) < budget:
-            req = self._pop_admittable()
+        forced = forced or []
+        #: (req, reservation, virtual prompt, is_resume)
+        batch: list[tuple[GenRequest, Any, list, bool]] = []
+        pinned: list[GenRequest] = []
+        while len(batch) + len(pinned) < budget:
+            req = self._next_admittable(forced)
             if req is None:
                 break
-            try:
-                res = self.allocator.reserve(req.prompt_ids,
-                                             req.max_new_tokens)
-            except KVPagesExhaustedError:
-                # transient (submit() rejects permanently-impossible
-                # claims): requeue at the head and stop admitting —
-                # later arrivals must not starve this one
+            resumed = bool(req.tokens)
+            if resumed and req.pinned_pages:
+                req.claimed = True
+                req.admitted_at = time.monotonic()
+                trace(req.request_id, "admitted", model=self.name,
+                      queue_s=round(req.admitted_at - req.submitted_at,
+                                    6),
+                      tenant=req.tenant, lane=req.lane, resumed=True)
+                pinned.append(req)
+                continue
+            # a resume without pages re-derives KV from its virtual
+            # prompt; its reservation covers exactly the positions the
+            # original claim did (context so far + what remains)
+            vprompt = (req.prompt_ids if not resumed
+                       else req.prompt_ids + req.tokens[:-1])
+            vnew = (req.max_new_tokens if not resumed
+                    else req.max_new_tokens - len(req.tokens) + 1)
+            res = None
+            while res is None:
+                try:
+                    res = self.allocator.reserve(vprompt, vnew)
+                except KVPagesExhaustedError:
+                    # pressure valve first: queued preempted requests
+                    # still pin their old pages for a prefill-free
+                    # resume, and on a full arena those pins would
+                    # turn the very preemption that freed this slot
+                    # into a no-op — reclaim one claim (its owner
+                    # re-prefills at resume, like a transplant) and
+                    # retry before giving up
+                    if not self._reclaim_pinned():
+                        break
+            if res is None:
+                # genuinely transient (submit() rejects permanently-
+                # impossible claims): requeue at the head and stop
+                # admitting — later arrivals must not starve this one
                 with self._qlock:
-                    self._queue.appendleft(req)
+                    self.tenants.unpop(req)
                 break
             req.claimed = True
             req.admitted_at = time.monotonic()
-            req.cached_tokens = res.cached_tokens
+            if not resumed:
+                req.cached_tokens = res.cached_tokens
             trace(req.request_id, "admitted", model=self.name,
-                  queue_s=round(req.admitted_at - req.submitted_at, 6))
-            batch.append((req, res))
-        self._admitting = [req for req, _ in batch]
+                  queue_s=round(req.admitted_at - req.submitted_at, 6),
+                  tenant=req.tenant, lane=req.lane, resumed=resumed)
+            batch.append((req, res, vprompt, resumed))
+        self._unpop_leftover(forced)
+        self._admitting = [req for req, _, _, _ in batch] + pinned
         # Every copy-on-write page copy is dispatched BEFORE any prefill
         # of this pass: the allocator may have recycled a COW source's
         # physical page for a later reservation in the same batch, and
@@ -1258,7 +1598,7 @@ class ContinuousBatchingEngine:
         # overwrites it.
         t_cow = time.perf_counter()
         any_cow = False
-        for req, res in batch:
+        for req, res, _, _ in batch:
             if res.cow is not None:
                 src, dst = res.cow
                 any_cow = True
@@ -1270,10 +1610,11 @@ class ContinuousBatchingEngine:
         if rec is not None and any_cow:
             rec.phases["cow_copy"] = rec.phases.get("cow_copy", 0.0) \
                 + (time.perf_counter() - t_cow)
-        by_bucket: dict[int, list[tuple[GenRequest, Any]]] = {}
-        for req, res in batch:
-            tail = len(req.prompt_ids) - res.cached_tokens
-            by_bucket.setdefault(self._bucket(tail), []).append((req, res))
+        by_bucket: dict[int, list[tuple[GenRequest, Any, list, bool]]] = {}
+        for entry in batch:
+            _, res, vprompt, _ = entry
+            tail = len(vprompt) - res.cached_tokens
+            by_bucket.setdefault(self._bucket(tail), []).append(entry)
         n_pages = self.ecfg.pages_per_slot
         for bucket, group in by_bucket.items():
             slots = [free.pop(0) for _ in group]
@@ -1281,8 +1622,8 @@ class ContinuousBatchingEngine:
             mask = np.zeros((len(group), bucket), np.int32)
             tables = np.zeros((len(group), n_pages), np.int32)
             start = np.zeros((len(group),), np.int32)
-            for r, (req, res) in enumerate(group):
-                tail = req.prompt_ids[res.cached_tokens:]
+            for r, (req, res, vprompt, _) in enumerate(group):
+                tail = vprompt[res.cached_tokens:]
                 ids[r, :len(tail)] = tail
                 mask[r, :len(tail)] = 1
                 tables[r, :len(res.pages)] = res.pages
@@ -1301,19 +1642,45 @@ class ContinuousBatchingEngine:
             if cold:
                 self._warm_shapes.add(shape_key)
                 self.grace_until = 0.0
-            for r, (slot, (req, res)) in enumerate(zip(slots, group)):
+            for r, (slot, (req, res, vprompt, resumed)) in enumerate(
+                    zip(slots, group)):
                 self._slots[slot] = req
                 self._slot_pages[slot] = res.pages
                 self._page_table[slot, :] = 0
                 self._page_table[slot, :len(res.pages)] = res.pages
                 self._page_table_dirty = True
-                self._lengths[slot] = len(req.prompt_ids)
+                self._lengths[slot] = len(vprompt)
                 # the pages now hold this prompt's blocks: publish them
                 # for the next request sharing the prefix
                 self.allocator.register(res)
+                plen = len(vprompt)
+                computed = plen - res.cached_tokens
+                self.stats["prefill_tokens"] += computed
+                with self._qlock:
+                    self.tenants.note_pages(req.tenant, len(res.pages))
+                    if not resumed:
+                        self.tenants.charge_prefill(req, computed)
+                if rec is not None:
+                    rec.admitted += 1
+                    rec.prefill_tokens += computed
+                    rec.pages_reserved += len(res.pages)
+                    rec.flops += obs_flops.span_flops(
+                        self._flops_base, self._flops_per_ctx,
+                        res.cached_tokens, computed)
+                if resumed:
+                    # transplant resume: the virtual prompt re-derived
+                    # the context; nothing new to emit or account —
+                    # the original admission already counted the
+                    # request, and the victim's service clock does not
+                    # pay for preemption overhead
+                    req.resume_len = len(req.tokens)
+                    self.stats["resumed"] += 1
+                    trace(req.request_id, "prefill", model=self.name,
+                          slot=slot, resumed=True)
+                    trace(req.request_id, "decode", model=self.name,
+                          slot=slot)
+                    continue
                 self.stats["admitted"] += 1
-                plen = len(req.prompt_ids)
-                self.stats["prefill_tokens"] += plen - res.cached_tokens
                 self.stats["prompt_tokens"] += plen
                 if res.cached_tokens:
                     self.stats["prefix_hits"] += 1
@@ -1322,22 +1689,34 @@ class ContinuousBatchingEngine:
                     self._m_prefix_tokens.inc(res.cached_tokens)
                 self._m_admitted.inc()
                 if rec is not None:
-                    rec.admitted += 1
-                    rec.prefill_tokens += plen - res.cached_tokens
                     rec.cached_tokens += res.cached_tokens
-                    rec.pages_reserved += len(res.pages)
                     if res.cached_tokens:
                         rec.prefix_hits += 1
-                    rec.flops += obs_flops.span_flops(
-                        self._flops_base, self._flops_per_ctx,
-                        res.cached_tokens, plen - res.cached_tokens)
                 trace(req.request_id, "prefill", model=self.name,
                       slot=slot, bucket=bucket,
                       cached_tokens=res.cached_tokens)
                 trace(req.request_id, "decode", model=self.name, slot=slot)
                 self._emit(slot, logits[r])
+        for req in pinned:
+            # prefill-free resume: the pinned pages still hold KV for
+            # every consumed position; re-installing the indirection
+            # at context length prompt + tokens - 1 (the last emitted
+            # token's KV is written by its own next decode step) puts
+            # the request exactly where preemption found it
+            slot = free.pop(0)
+            pages, req.pinned_pages = req.pinned_pages, None
+            self._slots[slot] = req
+            self._slot_pages[slot] = pages
+            self._page_table[slot, :] = 0
+            self._page_table[slot, :len(pages)] = pages
+            self._page_table_dirty = True
+            self._lengths[slot] = len(req.prompt_ids) + len(req.tokens) - 1
+            req.resume_len = len(req.tokens)
+            self.stats["resumed"] += 1
+            trace(req.request_id, "decode", model=self.name, slot=slot,
+                  resumed=True)
         self._admitting = []
-        return len(batch)
+        return len(batch) + len(pinned)
 
     def _bucket(self, n: int) -> int:
         """Power-of-two prompt bucket (same rationale as
@@ -1360,12 +1739,22 @@ class ContinuousBatchingEngine:
         if req.first_token_at is None:
             req.first_token_at = time.monotonic()
             self._m_ttft.observe(req.first_token_at - req.submitted_at)
+            self.tenants.observe_ttft(
+                req, req.first_token_at - req.submitted_at)
             trace(req.request_id, "first_token", model=self.name,
                   ttft_s=round(req.first_token_at - req.submitted_at, 6),
                   prefill_s=round(req.first_token_at
                                   - (req.admitted_at or req.submitted_at),
                                   6))
         req.tokens.append(tok)
+        # WFQ service clock: one decoded token.  Deliberately LOCK-FREE
+        # on the hot path: only the scheduler thread charges clocks,
+        # and the one other vt writer — append()'s idle-tenant lift,
+        # under _qlock on HTTP threads — cannot run concurrently for
+        # this tenant (a tenant with an active slot is in_system, so
+        # the lift is skipped); GIL-atomic float reads make the
+        # cross-thread vt *reads* in pop ordering safe.
+        self.tenants.charge_decode(req)
         if faults.fire("stream") != "drop":  # "drop" loses the delivery
             req.stream.put(tok)
         rec = self._rec
@@ -1386,6 +1775,10 @@ class ContinuousBatchingEngine:
         self._slots[slot] = None
         self.stats["evictions"] += 1
         self._m_evicted.inc()
+        released = (len(self._slot_pages[slot])
+                    if self.paged and self._slot_pages[slot] else 0)
+        with self._qlock:
+            self.tenants.note_finished(req, released)
         rec = self._rec
         if rec is not None:
             rec.evicted += 1
@@ -1433,11 +1826,17 @@ class ContinuousBatchingEngine:
         req.stream.put(_STREAM_END)
         req.event.set()
 
-    def _fail_queued(self, err: Exception) -> None:
+    def _fail_queued(self, err: Exception,
+                     release_pinned: bool = False) -> None:
         with self._qlock:
-            drained = list(self._queue)
-            self._queue.clear()
+            drained = self.tenants.drain()
         for req in drained:
+            if release_pinned:
+                # scheduler-thread drains free a preempted request's
+                # pinned pages; the submit()-race caller (an HTTP
+                # thread) must not touch the single-owner allocator —
+                # that engine is stopping and its arena dies with it
+                self._release_pinned(req)
             req.error = err
             trace(req.request_id, "failed", model=self.name,
                   error=type(err).__name__)
@@ -1541,7 +1940,10 @@ class ContinuousBatchingModel(Model):
 
     def _submit_all(self, prompts: Sequence[str], opts: Mapping[str, Any],
                     deadline: Optional[float] = None,
-                    request_id: Optional[str] = None) -> list[GenRequest]:
+                    request_id: Optional[str] = None,
+                    tenant: Optional[str] = None,
+                    api_key: Optional[str] = None,
+                    lane: Optional[str] = None) -> list[GenRequest]:
         # Snapshot the engine once: a supervisor restart thread swaps
         # self.engine (briefly to None) concurrently, and a re-read
         # mid-loop would turn that transient into an AttributeError 500
@@ -1565,7 +1967,8 @@ class ContinuousBatchingModel(Model):
                     top_k=int(opts["TOP_K"]),
                     top_p=float(opts["TOP_P"]),
                     seed=int(opts["SEED"]) + i,
-                    deadline=deadline, request_id=rid))
+                    deadline=deadline, request_id=rid,
+                    tenant=tenant, api_key=api_key, lane=lane))
         except Exception:  # noqa: BLE001 - cleanup only; re-raised as-is
             for r in reqs:  # don't orphan already-queued siblings
                 r.cancel()
@@ -1590,7 +1993,14 @@ class ContinuousBatchingModel(Model):
                # prefix cache saved (0 unless the paged engine hit) —
                # load_test.py sums these into its outcomes summary
                "prompt_tokens": len(req.prompt_ids),
-               "cached_tokens": req.cached_tokens}
+               "cached_tokens": req.cached_tokens,
+               # traffic-plane accounting: how the request was
+               # classified and whether QoS preemption touched it —
+               # the trace-replay harness groups its per-tenant stats
+               # on these
+               "tenant": req.tenant,
+               "lane": req.lane,
+               "preemptions": req.preemptions}
         if req.first_token_at is not None:
             # client-visible TTFT (load_test reports its distribution
             # and checks it against the server-side histogram),
@@ -1604,12 +2014,25 @@ class ContinuousBatchingModel(Model):
                     req.first_token_at - req.admitted_at, 6)
         return out
 
+    @staticmethod
+    def _identity(payload: Mapping[str, Any]) -> dict:
+        """Tenant identity off the payload: an explicit ``tenant``
+        field, the ``X-API-Key`` value the server stamped as
+        ``api_key``, and an optional per-request ``lane`` override —
+        resolution itself (key → tenant → lane default) lives in the
+        engine's :class:`~kubernetes_cloud_tpu.serve.tenancy.
+        TenantScheduler`."""
+        return {"tenant": payload.get("tenant"),
+                "api_key": payload.get("api_key"),
+                "lane": payload.get("lane")}
+
     def predict(self, payload: Mapping[str, Any]) -> dict:
         prompts = [instance_text(i) for i in parse_instances(payload)]
         opts = self.service.configure_request(payload)
         reqs = self._submit_all(prompts, opts,
                                 deadline=request_deadline(payload),
-                                request_id=payload.get("request_id"))
+                                request_id=payload.get("request_id"),
+                                **self._identity(payload))
         return {"predictions": [self._finish(r, opts) for r in reqs]}
 
     def completion(self, payload: Mapping[str, Any]) -> dict:
@@ -1617,13 +2040,16 @@ class ContinuousBatchingModel(Model):
         opts = self.service.completion_options(payload)
         req = self._submit_all([prompt], opts,
                                deadline=request_deadline(payload),
-                               request_id=payload.get("request_id"))[0]
+                               request_id=payload.get("request_id"),
+                               **self._identity(payload))[0]
         return {"completion": self._finish(req, opts)["generated_text"]}
 
 
 def load_engine_config(model_dir: str) -> EngineConfig:
     """Read continuous-batching knobs from ``model_config.json`` (the
-    same file the dynamic batcher reads), ``continuous_batching`` key."""
+    same file the dynamic batcher reads), ``continuous_batching`` key;
+    the traffic plane's tenant table comes from the top-level
+    ``tenancy`` key (schema: deploy/README.md "Multi-tenancy & QoS")."""
     import json
     import os
 
@@ -1645,4 +2071,5 @@ def load_engine_config(model_dir: str) -> EngineConfig:
         num_pages=int(cb.get("num_pages", base.num_pages)),
         attn_impl=str(cb.get("attn_impl", base.attn_impl)),
         flight_records=int(cb.get("flight_records", base.flight_records)),
+        tenancy=parse_tenancy(raw.get("tenancy")),
     )

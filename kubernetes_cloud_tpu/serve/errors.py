@@ -42,6 +42,22 @@ class KVPagesExhaustedError(QueueFullError):
     frees pages."""
 
 
+class TenantQuotaError(RetryableError):
+    """Per-tenant admission quota exhausted (request-rate or prompt-
+    token bucket drained).  The 429 of this stack's vocabulary, mapped
+    to the same retryable 503 as queue backpressure so Knative/KServe
+    retry ladders need no new case — but scoped to ONE tenant: the
+    request never touched the shared queue, so a hot-looping tenant
+    sheds only itself.  ``retry_after_s`` carries the bucket's refill
+    estimate; the server surfaces it in the error body as the
+    Retry-After hint."""
+
+    def __init__(self, message: str,
+                 retry_after_s: "float | None" = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class EngineRestartedError(RetryableError):
     """The supervisor restarted a hung/crashed engine out from under
     this in-flight request.  State (the KV slot) is gone; a retry hits

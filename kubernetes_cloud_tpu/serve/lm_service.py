@@ -311,6 +311,13 @@ def main(argv: Optional[list] = None) -> int:
                          "capacity (per-iteration phase records for "
                          "GET /debug/timeline; 0 disables, -1 keeps "
                          "the default/model_config.json value)")
+    ap.add_argument("--tenancy", default=None, metavar="FILE",
+                    help="continuous batching: JSON tenant table for "
+                         "the multi-tenant traffic plane (per-tenant "
+                         "token-bucket admission, weighted fair "
+                         "queueing, QoS lanes); overrides the "
+                         "model_config.json 'tenancy' key — see "
+                         "deploy/README.md 'Multi-tenancy & QoS'")
     ap.add_argument("--max-seq-len", type=int, default=0)
     ap.add_argument("--config", default=None,
                     help="model_config.json for batcher knobs")
@@ -389,6 +396,16 @@ def main(argv: Optional[list] = None) -> int:
             overrides["num_pages"] = args.num_pages
         if args.flight_records >= 0:
             overrides["flight_records"] = args.flight_records
+        if args.tenancy:
+            import json
+
+            from kubernetes_cloud_tpu.serve.tenancy import parse_tenancy
+
+            with open(args.tenancy) as f:
+                raw = json.load(f)
+            # accept a bare tenant table or a {"tenancy": {...}}
+            # wrapper (the model_config.json shape)
+            overrides["tenancy"] = parse_tenancy(raw.get("tenancy", raw))
         if overrides:
             ecfg = dataclasses.replace(ecfg, **overrides)
         svc = ContinuousBatchingModel(svc.name, svc, ecfg)

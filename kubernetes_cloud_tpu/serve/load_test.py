@@ -31,6 +31,15 @@ every prompt (the system-prompt traffic shape), and the summary's
 ``prefill_tokens_computed_total`` fields account what the paged
 engine's prefix cache absorbed vs what prefill actually computed.
 
+``--trace trace.jsonl`` switches to OPEN-LOOP trace replay
+(:mod:`kubernetes_cloud_tpu.serve.trace`): requests fire at their
+recorded arrival times regardless of outstanding work — the tenant-mix
+workload shape per-tenant SLO claims must be measured under — and the
+report becomes per-tenant p50/p95 TTFT + tokens/s plus a Jain fairness
+index.  ``--gen-trace poisson|bursty|diurnal`` synthesizes such a trace
+(Zipf-skewed tenants, mixed lengths, deterministic ``--trace-seed``);
+``--trace-out`` saves it as JSONL instead of replaying.
+
 LM endpoints that attach per-prediction ``ttft_s`` (the continuous-
 batching engine) additionally get a client-observed TTFT distribution
 (``ttft_mean_s`` / ``ttft_p50_s`` / ``ttft_p95_s``).  ``--check-metrics``
@@ -393,7 +402,9 @@ def build_payloads(args) -> list[bytes]:
 
 def main(argv=None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--url", required=True)
+    ap.add_argument("--url", default=None,
+                    help="target endpoint (required unless only "
+                         "generating a trace with --trace-out)")
     ap.add_argument("--requests", type=int, default=100)
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--mode", choices=("async", "sync", "ramp"),
@@ -415,6 +426,40 @@ def main(argv=None) -> dict:
                     help="comma-separated concurrency levels (ramp mode)")
     ap.add_argument("--stage-duration", type=float, default=15.0,
                     help="seconds per ramp stage")
+    ap.add_argument("--trace", default=None, metavar="FILE",
+                    help="trace-replay mode: fire a JSONL arrival "
+                         "trace (serve/trace.py schema) OPEN-LOOP — "
+                         "requests launch at their recorded t, not "
+                         "when a worker frees up — and report per-"
+                         "tenant p50/p95 TTFT, tokens/s, and a Jain "
+                         "fairness index instead of the closed-loop "
+                         "summary")
+    ap.add_argument("--gen-trace", default=None,
+                    choices=("poisson", "bursty", "diurnal"),
+                    help="generate a synthetic trace (Zipf-skewed "
+                         "tenants, mixed lengths, deterministic seed) "
+                         "and either save it (--trace-out) or replay "
+                         "it immediately")
+    ap.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="write the generated trace as JSONL and exit "
+                         "(no --url needed)")
+    ap.add_argument("--trace-duration", type=float, default=30.0,
+                    help="generated trace length in seconds")
+    ap.add_argument("--trace-rate", type=float, default=8.0,
+                    help="generated trace mean arrival rate (req/s)")
+    ap.add_argument("--trace-tenants", type=int, default=4,
+                    help="generated trace tenant count (Zipf mix)")
+    ap.add_argument("--trace-zipf", type=float, default=1.1,
+                    help="Zipf skew exponent for the tenant mix")
+    ap.add_argument("--trace-seed", type=int, default=0,
+                    help="trace generator seed (same seed = identical "
+                         "trace, byte for byte)")
+    ap.add_argument("--trace-speed", type=float, default=1.0,
+                    help="replay time compression (2.0 = fire the "
+                         "trace twice as fast)")
+    ap.add_argument("--trace-workers", type=int, default=128,
+                    help="replay worker-pool bound (true open-loop "
+                         "needs more workers than peak in-flight)")
     ap.add_argument("--check-metrics", action="store_true",
                     help="scrape GET /metrics before/after and assert "
                          "the server's request histogram count delta "
@@ -427,10 +472,38 @@ def main(argv=None) -> dict:
                          "output JSON")
     args = ap.parse_args(argv)
 
-    payloads = build_payloads(args)
     headers = None
     if args.deadline_ms is not None:
         headers = {"X-Request-Deadline-Ms": str(args.deadline_ms)}
+
+    if args.trace or args.gen_trace:
+        from kubernetes_cloud_tpu.serve import trace as trace_mod
+
+        if args.trace:
+            entries = trace_mod.load_trace(args.trace)
+        else:
+            entries = trace_mod.generate_trace(
+                kind=args.gen_trace, duration_s=args.trace_duration,
+                rate_rps=args.trace_rate, n_tenants=args.trace_tenants,
+                zipf_s=args.trace_zipf, seed=args.trace_seed)
+        if args.trace_out:
+            trace_mod.save_trace(args.trace_out, entries)
+            out = {"trace": args.trace_out, "requests": len(entries)}
+            print(json.dumps(out))
+            return out
+        if not args.url:
+            ap.error("--url is required to replay a trace "
+                     "(use --trace-out to only generate one)")
+        stats = trace_mod.replay(
+            args.url, entries, timeout=args.timeout,
+            speed=args.trace_speed, headers=headers,
+            max_workers=args.trace_workers)
+        print(json.dumps(stats))
+        return stats
+
+    if not args.url:
+        ap.error("--url is required")
+    payloads = build_payloads(args)
     before = (scrape_metrics(metrics_endpoint(args.url))
               if args.check_metrics else None)
     if args.mode == "ramp":

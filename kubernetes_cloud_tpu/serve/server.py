@@ -72,6 +72,12 @@ log = logging.getLogger(__name__)
 #: gRPC's grpc-timeout plays this role on the other data plane)
 DEADLINE_HEADER = "X-Request-Deadline-Ms"
 
+#: tenant identity header (serve/tenancy.py).  Title-cased spelling so
+#: ONE lookup works on both front-ends: the stdlib front-end's header
+#: mapping is case-insensitive, the native front-end's raw header block
+#: is parsed into Title-Cased names — "X-API-Key" arrives as this.
+API_KEY_HEADER = "X-Api-Key"
+
 # HTTP-layer metric families (labels bound per request; the label space
 # is the fixed route vocabulary below — never the raw path, whose model
 # names would otherwise make cardinality unbounded)
@@ -205,6 +211,14 @@ class ModelServer:
                         rid = headers.get(tracing.REQUEST_ID_HEADER)
                         if rid:
                             payload.setdefault("request_id", rid)
+                        # tenant identity at the door: the API key
+                        # rides the payload so every model sees the
+                        # same classification regardless of front-end
+                        # (serve/tenancy.py resolves key -> tenant; an
+                        # explicit payload "tenant" field still wins)
+                        key = headers.get(API_KEY_HEADER)
+                        if key:
+                            payload.setdefault("api_key", key)
                     # stamp every request exactly once at the door — the
                     # id ties HTTP, engine spans, and the client together
                     payload.setdefault("request_id",
@@ -306,6 +320,9 @@ class ModelServer:
                 continue
             models[name] = {"slots": slots(),
                             "queue_depth": engine.queue_depth()}
+            tenants = getattr(engine, "debug_tenants", None)
+            if tenants is not None:
+                models[name]["tenants"] = tenants()
         return 200, {"models": models}
 
     def _debug_pages(self, params) -> tuple[int, dict]:
@@ -354,7 +371,13 @@ class ModelServer:
         except DeadlineExceededError as e:  # shed: nobody is waiting
             return 504, {"error": str(e)}
         except RetryableError as e:  # transient overload/restart: retry
-            return 503, {"error": str(e)}
+            body = {"error": str(e)}
+            # tenant-quota sheds carry the bucket's refill estimate —
+            # the Retry-After hint a well-behaved client backs off by
+            retry_after = getattr(e, "retry_after_s", None)
+            if retry_after is not None:
+                body["retry_after_s"] = round(float(retry_after), 3)
+            return 503, body
         except Exception as e:  # noqa: BLE001 - surface as 500, keep serving
             log.exception("%s failed", what)
             return 500, {"error": str(e)}

@@ -1,0 +1,686 @@
+"""Multi-tenant traffic plane: admission quotas, weighted fair queueing,
+and QoS lanes for the continuous-batching engine.
+
+"Millions of users" is a scheduling problem before it is a throughput
+problem: the reference stack's only traffic knob is Knative
+``containerConcurrency``, so fairness across callers lands inside the
+engine — and an engine admitting from one global FIFO lets a single
+aggressive tenant monopolize every slot and every KV page while
+interactive callers starve behind batch jobs.  This module is the
+traffic plane in front of (and inside) the scheduler:
+
+* **Identity** — every request carries a tenant, resolved at the door
+  from the ``X-API-Key`` header or an explicit payload ``tenant`` field
+  (``TenancyConfig.resolve``); unknown callers share the ``default``
+  tenant, so metric label cardinality is bounded by configuration, not
+  by client-chosen strings.
+* **Admission** — per-tenant token buckets in requests/s and
+  prompt-tokens/s (:class:`TokenBucket`).  A drained bucket raises the
+  typed, retryable :class:`~kubernetes_cloud_tpu.serve.errors.
+  TenantQuotaError` (HTTP 503 with a ``retry_after_s`` hint) *before*
+  the request touches the bounded queue — quota exhaustion is the
+  tenant's problem, never its neighbours'.
+* **Weighted fair queueing** — per-tenant queues drained in virtual-time
+  order (:class:`TenantScheduler`), the VTC rendering (PAPERS.md:
+  "Fairness in Serving Large Language Models", OSDI '24): each tenant's
+  virtual clock advances by *service actually received* — prefilled +
+  decoded tokens, not request count — divided by its weight, so long
+  generations pay their way and a greedy tenant's clock races ahead
+  until everyone else catches up.  Idle tenants re-enter at the
+  busy minimum (no credit banking).  Per-pass slot and page quotas cap
+  a tenant at its weight share of the pool *under contention* while
+  idle capacity stays work-conserving.
+* **QoS lanes** — two lanes, ``interactive`` and ``batch``.  An
+  interactive arrival may preempt a batch slot mid-decode (the engine's
+  half lives in ``serve/continuous.py``): the preempted request
+  re-queues at its lane head — paged mode keeps its KV pages pinned so
+  resume is prefill-free; slot mode re-prefills its context — and
+  resumes bitwise-identically (the RNG and emitted tokens live on the
+  request, never re-sampled).
+
+Everything here is host-side bookkeeping: the scheduler state is
+guarded by the engine's queue lock (``TenantScheduler`` documents which
+methods expect it); only :class:`TokenBucket` carries its own lock,
+because admission checks run on HTTP threads before the queue lock is
+taken.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import threading
+import time
+from typing import TYPE_CHECKING, Any, Mapping, Optional
+
+from kubernetes_cloud_tpu import obs
+from kubernetes_cloud_tpu.serve.errors import TenantQuotaError
+
+if TYPE_CHECKING:  # pragma: no cover - annotation-only import cycle
+    from kubernetes_cloud_tpu.serve.continuous import GenRequest
+
+#: QoS lanes, in preemption-priority order: an ``interactive`` arrival
+#: may preempt a ``batch`` slot; never the reverse.
+LANES = ("interactive", "batch")
+
+#: the catch-all tenant every unconfigured caller shares
+DEFAULT_TENANT = "default"
+
+# Per-tenant metric families.  Label cardinality is bounded: ``tenant``
+# only ever takes configured tenant names plus DEFAULT_TENANT (unknown
+# callers collapse into it), ``lane`` is the fixed LANES vocabulary.
+_M_ADMITTED = obs.counter(
+    "kct_tenant_admitted_total",
+    "Requests admitted into slots, per tenant and QoS lane.",
+    ("model", "tenant", "lane"))
+_M_SHED = obs.counter(
+    "kct_tenant_shed_total",
+    "Requests shed before decoding, per tenant by reason "
+    "(quota_requests | quota_tokens | queue_full | deadline).",
+    ("model", "tenant", "reason"))
+_M_PREEMPTED = obs.counter(
+    "kct_tenant_preempted_total",
+    "Mid-decode batch-lane preemptions suffered, per tenant.",
+    ("model", "tenant"))
+_M_TOKENS = obs.counter(
+    "kct_tenant_tokens_total",
+    "Tokens actually served per tenant, by kind (prefill = prompt "
+    "tokens computed, cache hits excluded; decode = completion tokens "
+    "emitted) — the service measure the fair-queueing virtual clock "
+    "advances on.",
+    ("model", "tenant", "kind"))
+_M_QUEUE = obs.gauge(
+    "kct_tenant_queue_depth",
+    "Queued (not yet admitted) requests per tenant; summing over "
+    "tenants gives the engine's aggregate admission queue depth.",
+    ("model", "tenant"))
+_M_TTFT = obs.histogram(
+    "kct_tenant_ttft_seconds",
+    "Submit to first emitted token, per tenant and lane (the per-"
+    "tenant SLO the fairness plane exists to protect).",
+    ("model", "tenant", "lane"))
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's traffic contract (deploy/README.md "Multi-tenancy &
+    QoS" documents the tuning math)."""
+
+    name: str
+    #: fair-queueing weight: under contention the tenant is entitled to
+    #: ``weight / sum(weights of busy tenants)`` of slots, pages, and
+    #: tokens/s
+    weight: float = 1.0
+    #: default QoS lane for this tenant's requests ("interactive" may
+    #: preempt "batch" slots mid-decode)
+    lane: str = "interactive"
+    #: admission token bucket in requests/s (0 = unlimited)
+    req_rate: float = 0.0
+    #: request-bucket burst capacity (0 = ceil(req_rate), min 1)
+    req_burst: float = 0.0
+    #: admission token bucket in prompt tokens/s (0 = unlimited)
+    token_rate: float = 0.0
+    #: prompt-token bucket burst capacity (0 = ceil(token_rate))
+    token_burst: float = 0.0
+    #: API keys mapping to this tenant (the ``X-API-Key`` values)
+    api_keys: tuple = ()
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("tenant name must be non-empty")
+        if not self.weight > 0:
+            raise ValueError(f"tenant {self.name}: weight must be > 0")
+        if self.lane not in LANES:
+            raise ValueError(
+                f"tenant {self.name}: lane must be one of {LANES}")
+        for f in ("req_rate", "req_burst", "token_rate", "token_burst"):
+            if getattr(self, f) < 0:
+                raise ValueError(f"tenant {self.name}: {f} must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenancyConfig:
+    """The engine's tenant table.  The zero-argument default — no
+    configured tenants, an unlimited default tenant — degenerates to
+    the pre-tenancy engine exactly: one FIFO queue, no buckets, no
+    preemption (every request shares one lane)."""
+
+    tenants: tuple = ()
+    default: TenantSpec = TenantSpec(DEFAULT_TENANT)
+    #: interactive-over-batch preemption (lane semantics) on/off
+    preemption: bool = True
+    #: preemptions allowed per scheduler pass (bounds re-prefill churn)
+    max_preempt_per_step: int = 2
+    #: a batch slot is preemptable only after decoding this many
+    #: tokens since its last (re)admission — the progress guarantee
+    #: that turns preemption thrash (evict → re-prefill → evict ...)
+    #: into bounded overhead: a request of N completion tokens suffers
+    #: at most N / min_batch_progress preemptions
+    min_batch_progress: int = 16
+
+    def __post_init__(self):
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names: {names}")
+        if DEFAULT_TENANT in names:
+            raise ValueError(
+                f"configure the catch-all via 'default', not a tenant "
+                f"named {DEFAULT_TENANT!r}")
+        if self.max_preempt_per_step < 0:
+            raise ValueError("max_preempt_per_step must be >= 0")
+        if self.min_batch_progress < 1:
+            raise ValueError("min_batch_progress must be >= 1")
+        keys: dict[str, str] = {}
+        for t in (*self.tenants, self.default):
+            for k in t.api_keys:
+                if k in keys:
+                    raise ValueError(
+                        f"api key maps to both {keys[k]!r} and "
+                        f"{t.name!r}")
+                keys[k] = t.name
+
+    def spec(self, name: Optional[str]) -> TenantSpec:
+        for t in self.tenants:
+            if t.name == name:
+                return t
+        return self.default
+
+    def resolve(self, tenant: Optional[str] = None,
+                api_key: Optional[str] = None) -> TenantSpec:
+        """Identity ladder: the API key is the credential, so it wins —
+        a recognized key (in a tenant's ``api_keys``, or equal to a
+        configured tenant name) resolves to that tenant regardless of
+        what the payload claims; an UNRECOGNIZED key resolves to the
+        default tenant (presenting a bad credential must not let the
+        payload ``tenant`` label impersonate a configured tenant and
+        drain its buckets).  Only a keyless request may classify
+        itself via the payload ``tenant`` field (mesh-internal
+        callers); everyone else shares the default tenant — so
+        labels/queues stay bounded by config."""
+        if api_key:
+            for t in (*self.tenants, self.default):
+                if api_key in t.api_keys:
+                    return t
+            for t in self.tenants:
+                # name-as-key convenience ONLY for tenants that
+                # configured no keys: names are public (metrics,
+                # /debug, error bodies), so a tenant WITH secret keys
+                # must not be reachable by its name
+                if api_key == t.name and not t.api_keys:
+                    return t
+            return self.default
+        for t in self.tenants:
+            if tenant == t.name:
+                return t
+        return self.default
+
+
+def parse_tenancy(raw: Optional[Mapping[str, Any]]
+                  ) -> Optional[TenancyConfig]:
+    """``model_config.json`` ``"tenancy"`` key → :class:`TenancyConfig`
+    (None stays None: tenancy off means the legacy single-queue path).
+
+    Schema (deploy/README.md "Multi-tenancy & QoS")::
+
+        {"tenancy": {
+           "preemption": true, "max_preempt_per_step": 2,
+           "default": {"weight": 1, "lane": "interactive", ...},
+           "tenants": [{"name": "acme", "weight": 4, "lane": "batch",
+                        "req_rate": 10, "token_rate": 4096,
+                        "api_keys": ["k-acme-1"]}, ...]}}
+    """
+    if not raw:
+        return None
+
+    def spec(name: str, d: Mapping[str, Any]) -> TenantSpec:
+        known = ("weight", "lane", "req_rate", "req_burst", "token_rate",
+                 "token_burst", "api_keys")
+        unknown = set(d) - set(known) - {"name"}
+        if unknown:
+            raise ValueError(
+                f"tenant {name!r}: unknown keys {sorted(unknown)}")
+        kw: dict[str, Any] = {k: d[k] for k in known if k in d}
+        if "api_keys" in kw:
+            kw["api_keys"] = tuple(str(k) for k in kw["api_keys"])
+        for k in ("weight", "req_rate", "req_burst", "token_rate",
+                  "token_burst"):
+            if k in kw:
+                kw[k] = float(kw[k])
+        return TenantSpec(name=name, **kw)
+
+    tenants = tuple(spec(str(d.get("name", "")), d)
+                    for d in raw.get("tenants", ()))
+    default = spec(DEFAULT_TENANT, raw.get("default") or {})
+    return TenancyConfig(
+        tenants=tenants, default=default,
+        preemption=bool(raw.get("preemption", True)),
+        max_preempt_per_step=int(raw.get("max_preempt_per_step", 2)),
+        min_batch_progress=int(raw.get("min_batch_progress", 16)))
+
+
+class TokenBucket:
+    """Monotonic-clock token bucket; thread-safe (admission checks run
+    on HTTP threads).  ``rate <= 0`` disables the bucket entirely."""
+
+    def __init__(self, rate: float, burst: float = 0.0,
+                 now: Optional[float] = None):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst > 0 else max(1.0,
+                                                        math.ceil(rate))
+        self._level = self.burst
+        self._at = time.monotonic() if now is None else now
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0,
+                 now: Optional[float] = None) -> float:
+        """Take ``n`` tokens if available; returns 0.0 on success, else
+        the seconds until ``n`` tokens will have refilled (the
+        ``retry_after_s`` hint — nothing is taken on refusal)."""
+        if self.rate <= 0:
+            return 0.0
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            self._level = min(self.burst,
+                              self._level + (now - self._at) * self.rate)
+            self._at = now
+            if self._level >= n:
+                self._level -= n
+                return 0.0
+            need = min(n, self.burst) - self._level
+            return max(need / self.rate, 1e-3)
+
+    def give_back(self, n: float = 1.0) -> None:
+        """Refund a charge that bought nothing (the request was shed
+        later in admission — queue full, deadline) so backpressure
+        does not double-penalize a tenant below its contracted rate."""
+        if self.rate <= 0:
+            return
+        with self._lock:
+            self._level = min(self.burst, self._level + n)
+
+
+class _TenantState:
+    """One tenant's live scheduling state inside an engine (queues,
+    virtual clock, occupancy counts, bound metric children)."""
+
+    __slots__ = ("spec", "vt", "queues", "active_slots", "pages",
+                 "req_bucket", "tok_bucket", "m_admitted", "m_shed",
+                 "m_preempted", "m_prefill", "m_decode", "m_queue",
+                 "m_ttft", "stats")
+
+    def __init__(self, spec: TenantSpec, model: str):
+        self.spec = spec
+        self.vt = 0.0
+        self.queues: dict[str, collections.deque] = {
+            lane: collections.deque() for lane in LANES}
+        self.active_slots = 0
+        self.pages = 0
+        self.req_bucket = TokenBucket(spec.req_rate, spec.req_burst)
+        self.tok_bucket = TokenBucket(spec.token_rate, spec.token_burst)
+        t = {"model": model, "tenant": spec.name}
+        self.m_admitted = {lane: _M_ADMITTED.labels(lane=lane, **t)
+                           for lane in LANES}
+        self.m_shed = {r: _M_SHED.labels(reason=r, **t)
+                       for r in ("quota_requests", "quota_tokens",
+                                 "queue_full", "deadline")}
+        self.m_preempted = _M_PREEMPTED.labels(**t)
+        self.m_prefill = _M_TOKENS.labels(kind="prefill", **t)
+        self.m_decode = _M_TOKENS.labels(kind="decode", **t)
+        self.m_queue = _M_QUEUE.labels(**t)
+        self.m_ttft = {lane: _M_TTFT.labels(lane=lane, **t)
+                       for lane in LANES}
+        #: bench-facing in-process counters, engine-lifetime
+        self.stats = {"admitted": 0, "shed": 0, "preempted": 0,
+                      "prefill_tokens": 0, "decode_tokens": 0}
+
+    def queued(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def in_system(self) -> bool:
+        return self.active_slots > 0 or self.pages > 0 or self.queued() > 0
+
+
+class TenantScheduler:
+    """Per-tenant queues + the virtual-time drain order.
+
+    Thread-safety contract: every method is designed to run under the
+    ENGINE'S queue lock (the same ``_qlock`` that guarded the old
+    global deque) — the scheduler adds no lock of its own, so the
+    submit-path invariant ("queued" trace inside the lock can never be
+    outrun by "admitted") carries over unchanged.  The only exception
+    is :meth:`admit_check`, which touches only the tenant's own
+    (internally locked) buckets and MUST be called *without* the queue
+    lock, from the submitting HTTP thread.
+    """
+
+    def __init__(self, cfg: Optional[TenancyConfig], *, slots: int,
+                 page_capacity: int = 0, model: str = "engine"):
+        self.cfg = cfg or TenancyConfig()
+        self.slots = slots
+        self.page_capacity = page_capacity
+        self.model = model
+        self._states: dict[str, _TenantState] = {}
+        for spec in (*self.cfg.tenants, self.cfg.default):
+            self._states[spec.name] = _TenantState(spec, model)
+        #: the highest virtual clock ever served — the re-entry floor
+        #: for a tenant returning to an otherwise-idle engine, so
+        #: sitting out a quiet period never banks credit against
+        #: tenants who worked through it
+        self._vt_floor = 0.0
+
+    # -- identity / admission (HTTP threads) -------------------------------
+
+    def resolve(self, tenant: Optional[str] = None,
+                api_key: Optional[str] = None) -> TenantSpec:
+        return self.cfg.resolve(tenant, api_key)
+
+    def state(self, name: Optional[str]) -> _TenantState:
+        st = self._states.get(name or DEFAULT_TENANT)
+        return st if st is not None else self._states[DEFAULT_TENANT]
+
+    def admit_check(self, spec: TenantSpec, prompt_tokens: int) -> None:
+        """Charge the tenant's buckets for one request; raises the
+        retryable :class:`TenantQuotaError` (→ 503 + ``retry_after_s``)
+        when a bucket is dry.  Called WITHOUT the queue lock (buckets
+        are internally locked); a shed here never touches the queue, so
+        a hot-looping tenant burns only its own HTTP threads."""
+        st = self.state(spec.name)
+        if (spec.token_rate > 0
+                and prompt_tokens > st.tok_bucket.burst):
+            # can NEVER be admitted, even by a full bucket: a config
+            # mismatch, not transient backpressure — a retryable 503
+            # with a tiny retry_after_s would hot-loop the client
+            # forever (same contract as submit()'s impossible
+            # page-claim rejection)
+            raise ValueError(
+                f"prompt ({prompt_tokens} tokens) exceeds tenant "
+                f"{spec.name!r} token-bucket burst "
+                f"({st.tok_bucket.burst:g}); raise token_burst")
+        wait = st.req_bucket.try_take(1.0)
+        if wait > 0.0:
+            st.m_shed["quota_requests"].inc()
+            st.stats["shed"] += 1
+            raise TenantQuotaError(
+                f"tenant {spec.name!r} request quota exhausted "
+                f"({spec.req_rate:g} req/s)", retry_after_s=wait)
+        wait = st.tok_bucket.try_take(float(prompt_tokens))
+        if wait > 0.0:
+            st.req_bucket.give_back(1.0)  # the pair is all-or-nothing
+            st.m_shed["quota_tokens"].inc()
+            st.stats["shed"] += 1
+            raise TenantQuotaError(
+                f"tenant {spec.name!r} prompt-token quota exhausted "
+                f"({spec.token_rate:g} tok/s)", retry_after_s=wait)
+
+    def refund(self, spec: TenantSpec, prompt_tokens: int) -> None:
+        """Give back an :meth:`admit_check` charge whose request was
+        shed later in admission (queue full, dead deadline): the
+        tenant got no service, so under sustained backpressure its
+        buckets must not lock it out below the contracted rate.
+        Called WITHOUT the queue lock, like admit_check."""
+        st = self.state(spec.name)
+        st.req_bucket.give_back(1.0)
+        st.tok_bucket.give_back(float(prompt_tokens))
+
+    def count_shed(self, tenant: Optional[str], reason: str) -> None:
+        st = self.state(tenant)
+        st.m_shed[reason].inc()
+        st.stats["shed"] += 1
+
+    # -- queue surface (engine's _qlock held) ------------------------------
+
+    def append(self, req: "GenRequest") -> None:
+        st = self.state(req.tenant)
+        if not st.in_system():
+            # VTC lift: an idle tenant re-enters at the busy minimum —
+            # fairness is about rates while competing, not banked
+            # credit for time spent away.  With nobody busy, re-enter
+            # at the highest clock ever served (the floor): a tenant
+            # returning to an idle engine must not drag the fair-share
+            # baseline back to its own ancient clock.
+            busy = [s.vt for s in self._states.values() if s.in_system()]
+            st.vt = max(st.vt, min(busy) if busy else self._vt_floor)
+        st.queues[req.lane].append(req)
+
+    def append_head(self, req: "GenRequest") -> None:
+        """Lane-head re-queue: a preempted (or transiently page-starved)
+        request goes back in FRONT of its lane so later arrivals of its
+        own tenant cannot leapfrog it."""
+        self.state(req.tenant).queues[req.lane].appendleft(req)
+
+    def depth(self) -> int:
+        return sum(st.queued() for st in self._states.values())
+
+    def busy_count(self) -> int:
+        """Tenants currently in the system (queued or holding slots/
+        pages) — the worst-case divisor of the admission bandwidth a
+        newly queued request competes under."""
+        return sum(1 for st in self._states.values() if st.in_system())
+
+    def queue_share(self, spec: TenantSpec, max_queue_size: int) -> int:
+        """The tenant's slice of the bounded admission queue:
+        ``weight / Σ(all configured weights)`` of the bound (min 1).
+        Enforcing the bound per tenant — not on the aggregate — is
+        what keeps one unlimited tenant's backlog from 503ing its
+        neighbours out of admission entirely; the single-default-
+        tenant config degenerates to the whole bound (legacy
+        behavior)."""
+        total = sum(t.weight
+                    for t in (*self.cfg.tenants, self.cfg.default))
+        return max(1, math.ceil(spec.weight / total * max_queue_size))
+
+    def depths(self) -> dict[str, int]:
+        return {name: st.queued() for name, st in self._states.items()}
+
+    def drain(self) -> list:
+        out: list = []
+        for st in self._states.values():
+            for q in st.queues.values():
+                out.extend(q)
+                q.clear()
+        return out
+
+    def purge(self, pred) -> list:
+        """Remove (and return) every queued request matching ``pred`` —
+        the cancelled-request reaper, now reaching into every tenant
+        queue (a dead request must not hold bounded capacity)."""
+        out: list = []
+        for st in self._states.values():
+            for q in st.queues.values():
+                dead = [r for r in q if pred(r)]
+                if dead:
+                    alive = [r for r in q if not pred(r)]
+                    q.clear()
+                    q.extend(alive)
+                    out.extend(dead)
+        return out
+
+    # -- fair-queueing drain (scheduler thread, _qlock held) ---------------
+
+    def _quota_slots(self, st: _TenantState, total_w: float) -> int:
+        return max(1, math.ceil(st.spec.weight / total_w * self.slots))
+
+    def _quota_pages(self, st: _TenantState, total_w: float) -> int:
+        return max(1, math.ceil(st.spec.weight / total_w
+                                * self.page_capacity))
+
+    def _under_quota(self, st: _TenantState, total_w: float) -> bool:
+        if st.active_slots >= self._quota_slots(st, total_w):
+            return False
+        if (self.page_capacity
+                and st.pages >= self._quota_pages(st, total_w)):
+            return False
+        return True
+
+    def _busy_weight(self) -> float:
+        return sum(st.spec.weight for st in self._states.values()
+                   if st.in_system()) or 1.0
+
+    def pop_next(self) -> Optional["GenRequest"]:
+        """The WFQ drain: among tenants with queued work, serve the
+        smallest virtual clock, preferring tenants still under their
+        per-pass slot/page quota; when ONLY over-quota tenants are
+        queued the minimum-clock one is served anyway (work
+        conservation — an idle slot helps nobody).  Within a tenant the
+        interactive lane drains before batch; each lane is FIFO.
+
+        The popped tenant's ``active_slots`` is charged immediately
+        (the pass admits several requests before any lands in a slot;
+        deferring the charge would let one tenant blow through its
+        quota inside a single pass) — give it back via :meth:`unpop`
+        if admission cannot complete."""
+        cands = [st for st in self._states.values() if st.queued()]
+        if not cands:
+            return None
+        total_w = self._busy_weight()
+        cands.sort(key=lambda st: (st.vt, st.spec.name))
+        pick = next((st for st in cands
+                     if self._under_quota(st, total_w)), cands[0])
+        self._vt_floor = max(self._vt_floor, pick.vt)
+        for lane in LANES:
+            if pick.queues[lane]:
+                req = pick.queues[lane].popleft()
+                pick.active_slots += 1
+                return req
+        raise AssertionError("queued() lied")  # pragma: no cover
+
+    def unpop(self, req: "GenRequest") -> None:
+        """Give back a popped request (transient page exhaustion):
+        lane-head re-queue + the provisional slot charge reversed."""
+        st = self.state(req.tenant)
+        st.active_slots -= 1
+        st.queues[req.lane].appendleft(req)
+
+    def note_dequeued(self, req: "GenRequest") -> None:
+        """A popped request was closed out (cancelled / deadline shed)
+        instead of admitted: reverse the provisional slot charge."""
+        self.state(req.tenant).active_slots -= 1
+
+    def note_pages(self, tenant: Optional[str], delta: int) -> None:
+        self.state(tenant).pages += delta
+
+    def find_pinned(self) -> Optional["GenRequest"]:
+        """A queued preempted request still holding pinned KV pages
+        (the prefill-free-resume claim), or None.  The engine's arena
+        pressure valve: pinned pages must not starve the admission a
+        preemption was FOR, so under exhaustion one claim is released
+        and that request re-prefills at resume instead."""
+        for st in self._states.values():
+            for q in st.queues.values():
+                for r in q:
+                    if r.pinned_pages:
+                        return r
+        return None
+
+    def note_finished(self, req: "GenRequest",
+                      pages_released: int = 0) -> None:
+        st = self.state(req.tenant)
+        st.active_slots -= 1
+        st.pages -= pages_released
+
+    # -- service accounting (virtual time) ---------------------------------
+
+    def charge_prefill(self, req: "GenRequest", tokens: int) -> None:
+        st = self.state(req.tenant)
+        st.vt += tokens / st.spec.weight
+        self._vt_floor = max(self._vt_floor, st.vt)
+        st.m_prefill.inc(tokens)
+        st.stats["prefill_tokens"] += tokens
+        st.m_admitted[req.lane].inc()
+        st.stats["admitted"] += 1
+
+    def charge_decode(self, req: "GenRequest") -> None:
+        st = self.state(req.tenant)
+        st.vt += 1.0 / st.spec.weight
+        self._vt_floor = max(self._vt_floor, st.vt)
+        st.m_decode.inc()
+        st.stats["decode_tokens"] += 1
+
+    def observe_ttft(self, req: "GenRequest", seconds: float) -> None:
+        self.state(req.tenant).m_ttft[req.lane].observe(seconds)
+
+    # -- preemption (lane semantics) ---------------------------------------
+
+    def pop_interactive_preemptor(self) -> Optional["GenRequest"]:
+        """Pop the interactive request that justifies evicting a batch
+        slot mid-decode: smallest-virtual-clock tenant with queued
+        interactive work that is still UNDER its slot quota.  Quota-
+        capping the preemptor bounds preemption churn — sustained
+        interactive overload stops taking batch slots at its weight
+        share instead of starving the batch lane outright.  None when
+        preemption is off or nobody qualifies.  Charges the tenant's
+        provisional slot exactly like :meth:`pop_next` (``unpop`` to
+        give it back)."""
+        if not self.cfg.preemption:
+            return None
+        total_w = self._busy_weight()
+        cands = [st for st in self._states.values()
+                 if st.queues["interactive"]
+                 and self._under_quota(st, total_w)]
+        if not cands:
+            return None
+        st = min(cands, key=lambda s: (s.vt, s.spec.name))
+        self._vt_floor = max(self._vt_floor, st.vt)
+        req = st.queues["interactive"].popleft()
+        st.active_slots += 1
+        return req
+
+    def pick_victim(self, slotted) -> Optional[int]:
+        """Choose the batch-lane slot to preempt: the request whose
+        tenant has consumed the most weighted service (max virtual
+        clock — the mirror image of the drain order), newest admission
+        first on ties (least wasted work to redo).  Slots that have
+        not yet decoded ``min_batch_progress`` tokens since their last
+        (re)admission are ineligible — the progress guarantee that
+        bounds thrash."""
+        best, best_key = None, None
+        for slot, req in slotted:
+            if req.lane != "batch":
+                continue
+            if (len(req.tokens) - req.resume_len
+                    < self.cfg.min_batch_progress):
+                continue
+            key = (self.state(req.tenant).vt,
+                   req.admitted_at or 0.0)
+            if best_key is None or key > best_key:
+                best, best_key = slot, key
+        return best
+
+    def note_preempted(self, req: "GenRequest") -> None:
+        st = self.state(req.tenant)
+        st.active_slots -= 1  # pages stay charged while pinned
+        st.m_preempted.inc()
+        st.stats["preempted"] += 1
+
+    # -- observability -----------------------------------------------------
+
+    def refresh_gauges(self) -> None:
+        for st in self._states.values():
+            st.m_queue.set(st.queued())
+
+    def snapshot(self) -> dict:
+        """Per-tenant scheduling state for ``GET /debug/slots`` (and
+        the bench): queue depths by lane, occupancy, virtual clocks."""
+        total_w = self._busy_weight()
+        out = {}
+        for name, st in self._states.items():
+            entry = {
+                "lane": st.spec.lane,
+                "weight": st.spec.weight,
+                "queued": {lane: len(st.queues[lane]) for lane in LANES},
+                "active_slots": st.active_slots,
+                "slot_quota": self._quota_slots(st, total_w),
+                "virtual_time": round(st.vt, 3),
+                **st.stats,
+            }
+            if self.page_capacity:
+                entry["pages"] = st.pages
+                entry["page_quota"] = self._quota_pages(st, total_w)
+            out[name] = entry
+        return out
+
+    def stats(self) -> dict:
+        return {name: dict(st.stats)
+                for name, st in self._states.items()}

@@ -1,0 +1,369 @@
+"""Trace-replay harness: realistic multi-tenant arrival processes.
+
+Closed-loop load generators (``load_test.py --mode async/ramp``) hold
+concurrency constant, so the server's own backpressure throttles the
+offered load — fine for throughput ceilings, wrong for SLO claims: a
+production tenant mix arrives *open-loop* (users do not wait for each
+other), bursty, and Zipf-skewed.  This module gives ``load_test.py
+--trace`` that workload as data:
+
+* **Trace schema** — JSONL, one request per line, deterministic and
+  diffable::
+
+      {"t": 1.204, "tenant": "tenant-0", "api_key": "key-0",
+       "lane": "interactive", "prompt_tokens": 23,
+       "max_new_tokens": 16, "id": "r-000017"}
+
+  ``t`` is seconds from replay start (open-loop: the driver fires at
+  ``t`` regardless of outstanding requests).  Either ``prompt`` (text)
+  or ``prompt_tokens`` (a deterministic synthetic prompt of that many
+  byte-tokenizer tokens is derived from ``id``) must be present.
+  :func:`validate_trace` rejects anything else, with line numbers.
+
+* **Generators** (:func:`generate_trace`) — arrival processes
+  ``poisson`` (homogeneous), ``bursty`` (on/off modulated: quiet base
+  rate punctuated by ``burst_factor``× storms), ``diurnal``
+  (sinusoidal rate, thinning-sampled); tenant mix Zipf(``zipf_s``)
+  over ``n_tenants``; mixed prompt/output lengths (short-interactive /
+  long-batch mixture).  Everything derives from one ``seed``: the same
+  flags reproduce the same trace byte-for-byte.
+
+* **Replay + per-tenant report** (:func:`replay`) — fires the trace
+  open-loop against a served model (tenant identity rides the
+  ``X-API-Key`` header when the entry carries ``api_key``, the payload
+  ``tenant`` field otherwise), then reports per-tenant p50/p95 TTFT,
+  tokens/s, and latency percentiles plus a Jain fairness index over
+  per-tenant decoded tokens — the figure BENCHMARKS.md "Multi-tenant
+  fairness" tracks.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+import threading
+import time
+from typing import Any, Mapping, Optional, Sequence
+
+#: schema fields (anything else is a validation error — traces are
+#: interchange artifacts, typos must not silently no-op)
+REQUIRED_FIELDS = ("t",)
+OPTIONAL_FIELDS = ("tenant", "api_key", "lane", "prompt", "prompt_tokens",
+                   "max_new_tokens", "id")
+_LANES = ("interactive", "batch")
+
+
+def validate_trace(entries: Sequence[Mapping[str, Any]]) -> None:
+    """Raise ``ValueError`` (with the offending line number) unless
+    every entry conforms to the trace schema."""
+    if not entries:
+        raise ValueError("trace is empty")
+    for i, e in enumerate(entries, 1):
+        if not isinstance(e, Mapping):
+            raise ValueError(f"trace line {i}: not an object")
+        unknown = set(e) - set(REQUIRED_FIELDS) - set(OPTIONAL_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"trace line {i}: unknown fields {sorted(unknown)}")
+        for f in REQUIRED_FIELDS:
+            if f not in e:
+                raise ValueError(f"trace line {i}: missing {f!r}")
+        t = e["t"]
+        if not isinstance(t, (int, float)) or isinstance(t, bool) \
+                or not math.isfinite(t) or t < 0:
+            raise ValueError(
+                f"trace line {i}: t must be a finite number >= 0")
+        if ("prompt" not in e) == ("prompt_tokens" not in e):
+            raise ValueError(
+                f"trace line {i}: exactly one of prompt | "
+                f"prompt_tokens required")
+        if "prompt" in e and (not isinstance(e["prompt"], str)
+                              or not e["prompt"]):
+            raise ValueError(
+                f"trace line {i}: prompt must be a non-empty string")
+        if "prompt_tokens" in e and (
+                not isinstance(e["prompt_tokens"], int)
+                or isinstance(e["prompt_tokens"], bool)
+                or e["prompt_tokens"] < 1):
+            raise ValueError(
+                f"trace line {i}: prompt_tokens must be an int >= 1")
+        if "max_new_tokens" in e and (
+                not isinstance(e["max_new_tokens"], int)
+                or isinstance(e["max_new_tokens"], bool)
+                or e["max_new_tokens"] < 1):
+            raise ValueError(
+                f"trace line {i}: max_new_tokens must be an int >= 1")
+        if "lane" in e and e["lane"] not in _LANES:
+            raise ValueError(
+                f"trace line {i}: lane must be one of {_LANES}")
+        for f in ("tenant", "api_key", "id"):
+            if f in e and (not isinstance(e[f], str) or not e[f]):
+                raise ValueError(
+                    f"trace line {i}: {f} must be a non-empty string")
+
+
+def load_trace(path: str) -> list[dict]:
+    """Read + validate a JSONL trace file (blank lines skipped)."""
+    entries = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except ValueError as e:
+                raise ValueError(f"trace line {i}: invalid JSON: {e}") \
+                    from None
+    validate_trace(entries)
+    return entries
+
+
+def save_trace(path: str, entries: Sequence[Mapping[str, Any]]) -> None:
+    validate_trace(entries)
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e, sort_keys=True) + "\n")
+
+
+def zipf_weights(n: int, s: float) -> list[float]:
+    """Zipf(s) popularity over ``n`` tenants, normalized (tenant 0 is
+    the head of the skew — the "greedy" caller every fairness test
+    worries about)."""
+    w = [1.0 / (k ** s) for k in range(1, n + 1)]
+    total = sum(w)
+    return [x / total for x in w]
+
+
+def _arrival_times(rng: random.Random, kind: str, duration_s: float,
+                   rate_rps: float, *, burst_factor: float,
+                   period_s: float, amplitude: float) -> list[float]:
+    """Sample one arrival process on [0, duration_s)."""
+    if rate_rps <= 0 or duration_s <= 0:
+        raise ValueError("rate_rps and duration_s must be > 0")
+    if kind == "poisson":
+        out, t = [], 0.0
+        while True:
+            t += rng.expovariate(rate_rps)
+            if t >= duration_s:
+                return out
+            out.append(t)
+    if kind == "bursty":
+        # on/off modulated Poisson: half of each period quiet at the
+        # base rate, half a burst_factor x storm — the queue-depth
+        # shape admission control and preemption must absorb
+        out, t = [], 0.0
+        while t < duration_s:
+            phase = (t % period_s) / period_s
+            lam = rate_rps * (burst_factor if phase < 0.5 else 1.0)
+            t += rng.expovariate(lam)
+            if t < duration_s:
+                out.append(t)
+        return out
+    if kind == "diurnal":
+        # sinusoidal rate via thinning: candidates at the peak rate,
+        # accepted with lambda(t)/lambda_max
+        lam_max = rate_rps * (1.0 + amplitude)
+        out, t = [], 0.0
+        while True:
+            t += rng.expovariate(lam_max)
+            if t >= duration_s:
+                return out
+            lam = rate_rps * (1.0 + amplitude
+                              * math.sin(2 * math.pi * t / period_s))
+            if rng.random() < lam / lam_max:
+                out.append(t)
+    raise ValueError(
+        f"unknown arrival kind {kind!r} "
+        f"(expected poisson | bursty | diurnal)")
+
+
+def generate_trace(*, kind: str = "poisson", duration_s: float = 30.0,
+                   rate_rps: float = 8.0, n_tenants: int = 4,
+                   zipf_s: float = 1.1, seed: int = 0,
+                   burst_factor: float = 4.0, period_s: float = 10.0,
+                   amplitude: float = 0.8,
+                   interactive_tenants: Optional[Sequence[str]] = None
+                   ) -> list[dict]:
+    """Deterministic synthetic trace: ``kind`` arrivals, Zipf tenant
+    mix, mixed prompt/output lengths.  Tenants are named
+    ``tenant-0..n-1`` with API keys ``key-0..n-1``; by default the
+    Zipf head (``tenant-0``) runs the long-prompt/long-output batch
+    lane and everyone else is interactive with short prompts — the
+    worst-case mix for FIFO scheduling and exactly the one the
+    fairness plane exists for.  The default mix reaches prompt 160 +
+    max_new 64: replay against a pool with ``max_len >= 224`` or the
+    longest batch entries 400 (and the outcome breakdown shows it)."""
+    rng = random.Random(seed)
+    times = _arrival_times(rng, kind, duration_s, rate_rps,
+                           burst_factor=burst_factor, period_s=period_s,
+                           amplitude=amplitude)
+    weights = zipf_weights(n_tenants, zipf_s)
+    names = [f"tenant-{k}" for k in range(n_tenants)]
+    if interactive_tenants is None:
+        interactive = set(names[1:])
+    else:
+        interactive = set(interactive_tenants)
+    entries = []
+    for i, t in enumerate(times):
+        tenant = rng.choices(names, weights=weights)[0]
+        if tenant in interactive:
+            lane = "interactive"
+            prompt_tokens = rng.randint(8, 32)
+            max_new = rng.choice([4, 8, 16])
+        else:
+            lane = "batch"
+            prompt_tokens = rng.randint(32, 160)
+            max_new = rng.choice([16, 32, 64])
+        entries.append({
+            "t": round(t, 4),
+            "tenant": tenant,
+            "api_key": f"key-{names.index(tenant)}",
+            "lane": lane,
+            "prompt_tokens": prompt_tokens,
+            "max_new_tokens": max_new,
+            "id": f"r-{i:06d}",
+        })
+    validate_trace(entries)
+    return entries
+
+
+def synthetic_prompt(n_tokens: int, key: str = "") -> str:
+    """Deterministic ``n_tokens``-char prompt (byte tokenizer: one char
+    = one token), varied by ``key`` so distinct requests do not
+    accidentally share a prefix-cache entry."""
+    rng = random.Random(f"trace:{key}:{n_tokens}")
+    return "".join(rng.choice("abcdefghij klmnop qrstuv wxyz")
+                   for _ in range(n_tokens))
+
+
+def entry_payload(e: Mapping[str, Any]) -> tuple[bytes, dict]:
+    """One trace entry → (POST body, extra headers)."""
+    prompt = e.get("prompt") or synthetic_prompt(
+        int(e["prompt_tokens"]), e.get("id", ""))
+    payload: dict[str, Any] = {
+        "instances": [prompt],
+        "parameters": {
+            "max_new_tokens": int(e.get("max_new_tokens", 16)),
+            "temperature": 0.0,
+        },
+    }
+    headers: dict[str, str] = {}
+    if e.get("api_key"):
+        headers["X-API-Key"] = str(e["api_key"])
+    elif e.get("tenant"):
+        payload["tenant"] = str(e["tenant"])
+    if e.get("lane"):
+        payload["lane"] = str(e["lane"])
+    if e.get("id"):
+        headers["X-Request-Id"] = str(e["id"])
+    return json.dumps(payload).encode(), headers
+
+
+def jain_index(values: Sequence[float]) -> Optional[float]:
+    """Jain's fairness index over per-tenant allocations: 1.0 =
+    perfectly even, 1/n = one tenant took everything.  None when
+    nothing was allocated."""
+    xs = [float(v) for v in values]
+    if not xs or not any(xs):
+        return None
+    sq = sum(x * x for x in xs)
+    return round((sum(xs) ** 2) / (len(xs) * sq), 4)
+
+
+def replay(url: str, entries: Sequence[Mapping[str, Any]], *,
+           timeout: float = 300.0, speed: float = 1.0,
+           headers: Optional[Mapping[str, str]] = None,
+           max_workers: int = 128) -> dict:
+    """Fire the trace open-loop and report per-tenant SLO stats.
+
+    The dispatcher sleeps to each entry's ``t / speed`` and hands the
+    request to a worker pool — arrivals never wait for completions.
+    ``max_workers`` bounds true concurrency: a dispatch landing while
+    every worker is busy queues inside the pool and fires late, which
+    silently degrades the open-loop contract toward closed-loop — so
+    every such dispatch (and any dispatcher oversleep) is counted in
+    ``late_dispatches``; a nonzero count means raise ``max_workers``
+    before trusting the latency figures."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from kubernetes_cloud_tpu.serve.load_test import _one_request
+
+    ordered = sorted(entries, key=lambda e: e["t"])
+    results: list[tuple[str, Any]] = []
+    lock = threading.Lock()
+    late = [0]
+    inflight = [0]
+
+    def fire(e):
+        payload, extra = entry_payload(e)
+        hdrs = {**(headers or {}), **extra}
+        r = _one_request(url, payload, timeout, hdrs)
+        with lock:
+            inflight[0] -= 1
+            results.append((str(e.get("tenant") or "default"), r))
+
+    t0 = time.monotonic()
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        for e in ordered:
+            due = t0 + float(e["t"]) / max(speed, 1e-9)
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            with lock:
+                # a saturated pool parks this submission behind an
+                # in-flight request: the arrival will fire late
+                if inflight[0] >= max_workers or delay < -0.05:
+                    late[0] += 1
+                inflight[0] += 1
+            pool.submit(fire, e)
+    total = time.monotonic() - t0
+    return _report(results, total, late[0])
+
+
+def _percentile(xs: list[float], p: float) -> Optional[float]:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(p * len(xs)))], 4)
+
+
+def _report(results: list, total_time: float, late: int) -> dict:
+    by_tenant: dict[str, list] = {}
+    for tenant, r in results:
+        by_tenant.setdefault(tenant, []).append(r)
+    per_tenant = {}
+    tokens_by_tenant = {}
+    for tenant, rs in sorted(by_tenant.items()):
+        ok = [r for r in rs if r.ok]
+        lat = [r.latency for r in ok]
+        ttfts = [r.ttft for r in ok if r.ttft is not None]
+        toks = sum(r.tokens_out for r in ok)
+        outcomes: dict[str, int] = {}
+        for r in rs:
+            outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
+        tokens_by_tenant[tenant] = toks
+        per_tenant[tenant] = {
+            "requests": len(rs),
+            "successful": len(ok),
+            "tokens_out_total": toks,
+            "tokens_out_per_sec": round(toks / max(total_time, 1e-9), 4),
+            "ttft_p50_s": _percentile(ttfts, 0.50),
+            "ttft_p95_s": _percentile(ttfts, 0.95),
+            "latency_p50_s": _percentile(lat, 0.50),
+            "latency_p95_s": _percentile(lat, 0.95),
+            "outcomes": outcomes,
+        }
+    return {
+        "mode": "trace-replay",
+        "requests": len(results),
+        "total_time_s": round(total_time, 4),
+        "late_dispatches": late,
+        "tenants": per_tenant,
+        # fairness over raw per-tenant decoded tokens: the
+        # equal-weight figure; weighted setups divide by weight first
+        # (scripts/bench_serving.py --fairness does)
+        "jain_fairness_index": jain_index(
+            list(tokens_by_tenant.values())),
+    }

@@ -30,7 +30,16 @@ equal-pool-bytes A/B instead: slot pool vs paged arena holding the same
 KV rows, reporting concurrent-sequence capacity, prefill tokens
 actually computed, and prefix-cache savings as
 ``{"metric": "serving_paged_kv_capacity", ...}`` (BENCHMARKS.md
-"Paged KV + prefix caching")."""
+"Paged KV + prefix caching").
+
+Fairness mode (``--fairness``) measures the multi-tenant traffic plane
+(serve/tenancy.py): an equal-weight batch-lane greedy flooder at
+``--fairness-overload``× the interactive concurrency vs one
+interactive tenant, reporting the Jain index over weight-normalized
+decoded tokens, the greedy tenant's share vs its weight share,
+interactive p95 TTFT uncontended vs contended, and preemption +
+token-identity checks as ``{"metric": "serving_fairness_jain", ...}``
+(BENCHMARKS.md "Multi-tenant fairness")."""
 
 from __future__ import annotations
 
@@ -338,6 +347,392 @@ def run_paged_comparison(args, svc, pool, stages) -> int:
     return 0
 
 
+def _closed_loop(url: str, make_payload, headers: dict, conc: int,
+                 duration_s: float, timeout: float = 120.0) -> list:
+    """``conc`` workers firing back-to-back until the window closes;
+    returns the per-request ``load_test.Result`` list."""
+    import threading
+    import time
+
+    from kubernetes_cloud_tpu.serve.load_test import _one_request
+
+    deadline = time.monotonic() + duration_s
+    results, lock = [], threading.Lock()
+
+    def worker(wid):
+        i = 0
+        while time.monotonic() < deadline:
+            r = _one_request(url, make_payload(wid, i), timeout, headers)
+            i += 1
+            with lock:
+                results.append(r)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(conc)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def run_fairness(args, svc) -> int:
+    """--fairness: the multi-tenant overload A/B the acceptance bar
+    names (BENCHMARKS.md "Multi-tenant fairness").  Three equal-weight
+    tenants drive one engine:
+
+    * ``greedy`` — batch lane, long generations, closed-loop flooder
+      at ``--fairness-overload`` x the interactive saturator's
+      concurrency (the 10:1 overload);
+    * ``alice``  — interactive lane, short requests, closed-loop at
+      ``--fairness-conc`` (> her slot quota, so she always has queued
+      work: the decoded-token split between the two SATURATING tenants
+      is then a fairness measurement, not a demand artifact);
+    * ``ping``   — interactive lane, low-rate OPEN-LOOP probe: its p95
+      TTFT is the SLO figure, measured without ever queueing behind
+      its own backlog.
+
+    Phase A runs the interactive lane ALONE at its own full load
+    (alice + ping) — the tentpole claim is "interactive p95 flat under
+    batch overload", so the baseline is the lane's own busy p95, not
+    an idle engine's.  Phase B adds the greedy flooder.
+
+    Reports the Jain index over the saturating tenants' weight-
+    normalized decoded tokens, greedy's share of that pool vs its
+    weight share, ping's p95 TTFT ratio, preemption counts, and a
+    batch-lane canary that must stay token-identical to one-shot
+    greedy ``generate`` through the overload (preemption/resume
+    included)."""
+    import threading
+    import time
+    import urllib.request
+
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingModel,
+        EngineConfig,
+    )
+    from kubernetes_cloud_tpu.serve.load_test import _one_request
+    from kubernetes_cloud_tpu.serve.server import ModelServer
+    from kubernetes_cloud_tpu.serve.tenancy import TenancyConfig, TenantSpec
+    from kubernetes_cloud_tpu.serve.trace import jain_index
+
+    tenancy = TenancyConfig(tenants=(
+        TenantSpec("greedy", weight=1.0, lane="batch",
+                   api_keys=("key-greedy",)),
+        TenantSpec("alice", weight=1.0, lane="interactive",
+                   api_keys=("key-alice",)),
+        TenantSpec("ping", weight=1.0, lane="interactive",
+                   api_keys=("key-ping",)),
+    ))
+    model = ContinuousBatchingModel("lm", svc, EngineConfig(
+        slots=args.slots, max_len=args.pool_max_len, tenancy=tenancy))
+    model.load()
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}/v1/models/lm:predict"
+    rng = random.Random(args.seed)
+
+    def interactive_payload(wid, i):
+        # 3 instances per POST: the saturator keeps a persistent
+        # engine-side backlog (> her slot quota) without needing a
+        # thread per in-flight request — decoded-token share is then a
+        # scheduling measurement, not a client-latency artifact
+        prompt = "".join(rng.choice("abcdefg hij") for _ in range(12))
+        return json.dumps({
+            "instances": [f"i{wid}-{i}-a-{prompt}",
+                          f"i{wid}-{i}-b-{prompt}",
+                          f"i{wid}-{i}-c-{prompt}"],
+            "parameters": {"max_new_tokens": 12, "temperature": 0.0},
+        }).encode()
+
+    def ping_payload(wid, i):
+        return json.dumps({
+            "instances": [f"p{wid}-{i}-are you still interactive?"],
+            "parameters": {"max_new_tokens": 4, "temperature": 0.0},
+        }).encode()
+
+    # the batch job shape: a prompt long enough that greedy's
+    # prefill:decode service ratio roughly matches alice's — WFQ
+    # equalizes TOTAL service (prefilled + decoded tokens), so the
+    # decoded-token split only reads as the weight split when the two
+    # workloads pay comparable prefill per decoded token
+    greedy_prompt = "flood the pool with a long batch job prompt now"
+
+    def greedy_payload(wid, i):
+        # overload x instances per POST: the flood offers overload x
+        # the saturator's per-worker demand through the SAME number of
+        # client threads, so the contended phase measures scheduling,
+        # not client-side GIL pressure from a thread herd
+        return json.dumps({
+            "instances": [f"g{wid}-{i}-n{k} {greedy_prompt}"
+                          for k in range(args.fairness_overload)],
+            "parameters": {"max_new_tokens": 48, "temperature": 0.0},
+        }).encode()
+
+    def open_loop(payload_fn, headers, rate_rps, duration_s):
+        """Fixed-rate probe: fire every 1/rate s regardless of
+        outstanding requests (each shot on its own thread)."""
+        results, lock = [], threading.Lock()
+        shots = []
+        deadline = time.monotonic() + duration_s
+
+        def shot(i):
+            r = _one_request(url, payload_fn(0, i), 120.0, headers)
+            with lock:
+                results.append(r)
+
+        i = 0
+        while time.monotonic() < deadline:
+            t = threading.Thread(target=shot, args=(i,))
+            t.start()
+            shots.append(t)
+            i += 1
+            time.sleep(1.0 / rate_rps)
+        for t in shots:
+            t.join()
+        return results
+
+    conc = args.fairness_conc
+    dur = args.fairness_duration
+    try:
+        # warmup: compile EVERY shape a measured window can hit —
+        # prefill groups of 1..max_admit_per_step at the short bucket
+        # (both phases), plus the long single-row bucket a preemption
+        # resume re-prefills into (first hit mid-window would stall a
+        # pass for the length of an XLA compile and poison the p95)
+        _closed_loop(url, interactive_payload,
+                     {"X-API-Key": "key-alice"}, conc, 4.0)
+        _closed_loop(url, greedy_payload,
+                     {"X-API-Key": "key-greedy"}, conc, 4.0)
+        _closed_loop(url, ping_payload, {"X-API-Key": "key-ping"},
+                     1, 1.0)
+        def one_post(instances, key, max_new=4):
+            req = urllib.request.Request(url, data=json.dumps({
+                "instances": instances,
+                "parameters": {"max_new_tokens": max_new,
+                               "temperature": 0.0},
+            }).encode(), headers={"Content-Type": "application/json",
+                                  "X-API-Key": key})
+            with urllib.request.urlopen(req, timeout=180):
+                pass
+
+        # every admit-group shape (both prompt buckets x group 1..4),
+        # several rounds (group sizes race the scheduler pass
+        # boundary), plus the single-row bucket a preemption resume
+        # re-prefills into
+        for _ in range(3):
+            for k in range(1, 5):
+                one_post([f"warm-{k}-{j} shapes" for j in range(k)],
+                         "key-alice")
+                one_post([f"W{k}-{j} {greedy_prompt}"
+                          for j in range(k)], "key-greedy")
+        one_post(["w" * 110], "key-greedy")
+
+        def drain_barrier(timeout_s=30.0):
+            # phases must not bleed into each other: wait until the
+            # engine is fully idle before starting a measured window
+            t0 = time.monotonic()
+            eng = model.engine
+            while time.monotonic() - t0 < timeout_s:
+                if (eng.queue_depth() == 0
+                        and not any(s is not None for s in eng._slots)):
+                    return
+                time.sleep(0.05)
+
+        drain_barrier()
+
+        # phase A: the interactive lane at its own full load, no
+        # batch tenant — the "uncontended" p95 the overload phase is
+        # held against
+        def run_side(name, fn, store):
+            def runner():
+                store[name] = fn()
+            t = threading.Thread(target=runner)
+            t.start()
+            return t
+
+        base_side: dict = {}
+        base_sat = run_side("alice", lambda: _closed_loop(
+            url, interactive_payload, {"X-API-Key": "key-alice"},
+            conc, dur), base_side)
+        alone = open_loop(ping_payload, {"X-API-Key": "key-ping"},
+                          5.0, dur)
+        base_sat.join()
+        drain_barrier()
+
+        # canary reference: one-shot greedy generate, fixed prompt,
+        # long enough to cross the preemption progress guard
+        canary_prompt = "canary prompt for token identity"
+        opts = {"MAX_NEW_TOKENS": 48, "TEMPERATURE": 0.0, "TOP_K": 0,
+                "TOP_P": 1.0, "SEED": 0, "ECHO_PROMPT": False}
+        want = svc.generate_texts([canary_prompt], opts)[0]
+        canary = {"attempts": 0, "identical": True, "preemptions": 0}
+
+        def canary_loop(stop_at):
+            # batch-lane canary fired repeatedly through the overload:
+            # every response must match one-shot greedy generate, and
+            # at least one attempt should ride through a real
+            # preemption/resume round trip (preemptions is reported so
+            # the claim is checkable, not asserted)
+            while time.monotonic() < stop_at:
+                creq = urllib.request.Request(url, data=json.dumps({
+                    "instances": [canary_prompt],
+                    "parameters": {"max_new_tokens": 48,
+                                   "temperature": 0.0},
+                }).encode(), headers={
+                    "Content-Type": "application/json",
+                    "X-API-Key": "key-greedy"})
+                with urllib.request.urlopen(creq, timeout=120) as r:
+                    pred = json.loads(r.read())["predictions"][0]
+                canary["attempts"] += 1
+                canary["identical"] &= (pred["generated_text"] == want)
+                canary["preemptions"] = max(canary["preemptions"],
+                                            pred.get("preemptions", 0))
+            return canary
+
+        # phase B: greedy flooder + interactive saturator + probe.
+        # The token-share window is snapshotted strictly INSIDE the
+        # doubly-saturated interval (both edges see both tenants
+        # running) — bracketing any flood-only ramp seconds would
+        # credit greedy with uncontended time and misread the share.
+        side_results: dict = {}
+        flood = run_side("greedy", lambda: _closed_loop(
+            url, greedy_payload, {"X-API-Key": "key-greedy"},
+            conc, dur + 4.0), side_results)
+        time.sleep(1.0)  # let the flood saturate every slot first
+        sat = run_side("alice", lambda: _closed_loop(
+            url, interactive_payload, {"X-API-Key": "key-alice"},
+            conc, dur + 1.0), side_results)
+        canary_t = run_side(
+            "canary", lambda: canary_loop(time.monotonic() + dur),
+            side_results)
+        time.sleep(1.0)  # ... and alice to reach her steady backlog
+        before = model.engine.tenants.stats()
+        contended = open_loop(ping_payload, {"X-API-Key": "key-ping"},
+                              5.0, dur - 1.0)
+        after = model.engine.tenants.stats()
+        sat.join()
+        canary_t.join()
+        flood.join()
+        stats = dict(model.engine.stats)
+
+        # deterministic preemption/resume identity proof on the same
+        # engine: fill every slot with long batch generations, then
+        # fire an interactive burst — lane preemption MUST trigger
+        # (no free slots, victims past the progress guard) and every
+        # batch output must still match one-shot greedy generate
+        # through the preempt → requeue → resume round trip
+        probe_new = min(64, args.pool_max_len - 64)
+        probe_prompts = [f"identity probe {k} of the preemption round"
+                         for k in range(args.slots)]
+        probe_want = svc.generate_texts(
+            probe_prompts, {**opts, "MAX_NEW_TOKENS": probe_new})
+        probe_out: dict = {}
+
+        def probe_one(k):
+            preq = urllib.request.Request(url, data=json.dumps({
+                "instances": [probe_prompts[k]],
+                "parameters": {"max_new_tokens": probe_new,
+                               "temperature": 0.0},
+            }).encode(), headers={"Content-Type": "application/json",
+                                  "X-API-Key": "key-greedy"})
+            with urllib.request.urlopen(preq, timeout=120) as r:
+                probe_out[k] = json.loads(r.read())["predictions"][0]
+
+        probes = [threading.Thread(target=probe_one, args=(k,))
+                  for k in range(args.slots)]
+        for t in probes:
+            t.start()
+        # fire the interactive burst the moment every slot is a
+        # mid-decode batch generation past the progress guard — a
+        # fixed sleep either misses the guard or the whole run
+        guard = tenancy.min_batch_progress
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 20.0:
+            occupied = [s for s in model.engine.debug_slots()
+                        if s.get("state") == "decoding"]
+            if (len(occupied) == args.slots
+                    and min(s["tokens_out"] for s in occupied)
+                    > guard):
+                break
+            time.sleep(0.01)
+        _closed_loop(url, ping_payload, {"X-API-Key": "key-ping"},
+                     2, 0.5)
+        for t in probes:
+            t.join()
+        identity_ok = all(
+            probe_out[k]["generated_text"] == probe_want[k]
+            for k in range(args.slots))
+        identity_preemptions = sum(
+            probe_out[k].get("preemptions", 0)
+            for k in range(args.slots))
+
+        # decoded tokens over the contended window for the two
+        # SATURATING tenants (the probe's trickle is reported but
+        # sits outside the share math: work conservation hands its
+        # unused share to whoever is busy, by design)
+        tok = {t: after[t]["decode_tokens"] - before[t]["decode_tokens"]
+               for t in ("greedy", "alice", "ping")}
+        # total service = prefilled + decoded tokens, the measure the
+        # WFQ virtual clock actually equalizes (the decoded-token
+        # split additionally matches weights because the two
+        # saturating workloads pay comparable prefill per decode)
+        svc_tok = {t: tok[t] + after[t]["prefill_tokens"]
+                   - before[t]["prefill_tokens"]
+                   for t in ("greedy", "alice")}
+        weight = {"greedy": 1.0, "alice": 1.0}
+        sat_pool = tok["greedy"] + tok["alice"]
+        share = tok["greedy"] / max(sat_pool, 1)
+        weight_share = weight["greedy"] / sum(weight.values())
+
+        def p95(results):
+            ttfts = sorted(r.ttft for r in results
+                           if r.ok and r.ttft is not None)
+            if not ttfts:
+                return None
+            return round(ttfts[min(len(ttfts) - 1,
+                                   int(0.95 * len(ttfts)))], 4)
+
+        record = {
+            "metric": "serving_fairness_jain",
+            "value": jain_index(
+                [tok[t] / weight[t] for t in weight]),
+            "unit": "index",
+            "slots": args.slots,
+            "overload_x": args.fairness_overload,
+            "window_s": dur,
+            "tokens": tok,
+            "greedy_share": round(share, 4),
+            "weight_share": weight_share,
+            "held_to_share_x": round(share / weight_share, 3),
+            "service_tokens": svc_tok,
+            "greedy_service_share": round(
+                svc_tok["greedy"] / max(sum(svc_tok.values()), 1), 4),
+            "ping_ttft_p95_uncontended_s": p95(alone),
+            "ping_ttft_p95_contended_s": p95(contended),
+            "ping_requests_contended": len(contended),
+            "ping_ok_contended": sum(r.ok for r in contended),
+            "alice_ok": sum(r.ok for r in side_results["alice"]),
+            "preemptions": stats["preemptions"],
+            "resumed": stats["resumed"],
+            "canary_attempts": canary["attempts"],
+            "canary_token_identical": bool(canary["identical"]),
+            "canary_max_preemptions": canary["preemptions"],
+            "identity_probe_token_identical": identity_ok,
+            "identity_probe_preemptions": identity_preemptions,
+            "tenants": model.engine.debug_tenants(),
+        }
+        a, b = (record["ping_ttft_p95_uncontended_s"],
+                record["ping_ttft_p95_contended_s"])
+        if a and b:
+            record["ttft_p95_ratio"] = round(b / a, 3)
+    finally:
+        server.stop()
+        model.stop()
+    print(json.dumps(record))
+    return 0
+
+
 def main(argv=None) -> int:
     from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
     from kubernetes_cloud_tpu.serve.batcher import BatcherConfig, BatchingModel
@@ -391,6 +786,24 @@ def main(argv=None) -> int:
                          "continuous engine (0 disables recording — "
                          "the overhead A/B knob; -1 keeps the engine "
                          "default)")
+    ap.add_argument("--fairness", action="store_true",
+                    help="multi-tenant overload scenario: a batch-lane "
+                         "greedy flooder vs an interactive tenant at "
+                         "equal weight; reports the Jain index, the "
+                         "greedy tenant's decoded-token share vs its "
+                         "weight share, interactive p95 TTFT "
+                         "uncontended vs contended, and preemption/"
+                         "token-identity checks (BENCHMARKS.md "
+                         "'Multi-tenant fairness')")
+    ap.add_argument("--fairness-duration", type=float, default=15.0,
+                    help="fairness mode: measured window seconds per "
+                         "phase")
+    ap.add_argument("--fairness-conc", type=int, default=2,
+                    help="fairness mode: interactive tenant's closed-"
+                         "loop concurrency")
+    ap.add_argument("--fairness-overload", type=int, default=10,
+                    help="fairness mode: greedy flooder concurrency = "
+                         "this x the interactive concurrency")
     ap.add_argument("--inject", choices=("hang", "crash"), default=None,
                     help="recovery mode: wedge (hang) or crash the "
                          "decode loop and measure supervisor recovery "
@@ -414,6 +827,9 @@ def main(argv=None) -> int:
                           params=init_params(cfg, jax.random.key(0)),
                           dtype=jnp.float32)
     svc.load()
+
+    if args.fairness:
+        return run_fairness(args, svc)
 
     if args.paged:
         return run_paged_comparison(args, svc, pool, stages)
