@@ -108,6 +108,17 @@ SITES = {
                      "contained to the submitting request — the "
                      "scheduler pass never routes through this site, "
                      "so a wedged admission can never stall decoding",
+    "fleet.dispatch": "per fleet-router dispatch attempt, on the "
+                      "submitting HTTP thread: raise/hang is contained "
+                      "to that one request (counted as a replica "
+                      "dispatch failure and retried within budget) — "
+                      "probing, other requests, and the replicas "
+                      "themselves never route through this site",
+    "fleet.probe": "per replica health probe on the router's prober "
+                   "thread: raise reads as a failed probe (feeding "
+                   "outlier ejection), hang parks (only) the prober — "
+                   "dispatch keeps routing on last-known health, so a "
+                   "wedged probe can never stall the data plane",
     "train.step": "once per trainer optimizer step (raise = crashed "
                   "step program; drop = the step's loss reads as NaN "
                   "— deterministic divergence injection for sentinel "

@@ -80,6 +80,29 @@ METRIC_FAMILIES = {
         "queued (not yet admitted) requests per tenant",
     "kct_tenant_ttft_seconds":
         "submit to first token per tenant and lane",
+    # fleet router (serve/fleet.py)
+    "kct_fleet_replicas":
+        "fleet replicas per health state",
+    "kct_fleet_dispatches_total":
+        "dispatch attempts per replica by outcome",
+    "kct_fleet_retries_total":
+        "fleet-level retries by outcome",
+    "kct_fleet_hedges_total":
+        "hedged dispatches by outcome (win = hedge answered first)",
+    "kct_fleet_ejections_total":
+        "replica outlier ejections by cause",
+    "kct_fleet_recoveries_total":
+        "replicas reinstated after a half-open trial",
+    "kct_fleet_queue_depth":
+        "last-probed admission queue depth per replica",
+    "kct_fleet_inflight":
+        "router-tracked in-flight dispatches per replica",
+    "kct_fleet_transplanted_total":
+        "queued requests moved off a draining replica",
+    "kct_fleet_rolling_restarts_total":
+        "completed zero-drop rolling-restart sweeps",
+    "kct_fleet_unplaceable_total":
+        "requests 503d with no active replica to take them",
     # dynamic batcher (serve/batcher.py)
     "kct_batcher_batches_total":
         "batches dispatched to the device",

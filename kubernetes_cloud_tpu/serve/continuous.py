@@ -911,6 +911,84 @@ class ContinuousBatchingEngine:
             self.tenants.append(req)
         self._work.set()
 
+    @staticmethod
+    def _rid_matches(req: GenRequest, request_id: str) -> bool:
+        """True when ``req`` belongs to the HTTP-level ``request_id``:
+        exact match, or the per-prompt suffixed form a multi-instance
+        predict submits (``rid-0``, ``rid-1``, …)."""
+        rid = req.request_id
+        return rid is not None and (
+            rid == request_id or rid.startswith(request_id + "-"))
+
+    def request_phase(self, request_id: Optional[str]) -> Optional[str]:
+        """Where an HTTP-level request currently is on THIS engine:
+        ``"active"`` (at least one of its prompts holds a slot),
+        ``"queued"`` (known, but no slot yet), or ``None`` (unknown —
+        finished, never submitted, or already transplanted).  The
+        fleet router's hedging gate: a request still queued-not-
+        admitted may be duplicated on another replica; one that
+        started decoding may not (its tokens are already being paid
+        for)."""
+        if not request_id:
+            return None
+        for req in list(self._slots):
+            if req is not None and self._rid_matches(req, request_id):
+                return "active"
+        for req in self._admitting:
+            if self._rid_matches(req, request_id):
+                return "active"
+        with self._qlock:
+            for req in self.tenants.iter_queued():
+                if self._rid_matches(req, request_id):
+                    return "queued"
+        return None
+
+    def cancel_request(self, request_id: Optional[str]) -> bool:
+        """Cancel every in-flight prompt of an HTTP-level request by id
+        (the fleet router's hedge-loser path; also served as ``POST
+        /v1/models/<name>:cancel``).  Rides the existing ``cancel()``
+        machinery — the scheduler reaps marked requests at its next
+        pass, out of the queue or out of their slots.  Returns True if
+        anything matched."""
+        if not request_id:
+            return False
+        hit = False
+        for req in list(self._slots):
+            if req is not None and self._rid_matches(req, request_id):
+                req.cancel()
+                hit = True
+        for req in self._admitting:
+            # mid-admission (queue popped, slot not yet assigned — the
+            # whole prefill window): request_phase already calls this
+            # "active", so cancel must reach it too or a hedge loser
+            # caught here decodes its full generation into the void
+            if self._rid_matches(req, request_id):
+                req.cancel()
+                hit = True
+        with self._qlock:
+            for req in self.tenants.iter_queued():
+                if self._rid_matches(req, request_id):
+                    req.cancel()
+                    hit = True
+        if hit:
+            self._work.set()
+        return hit
+
+    def extract_queued(self) -> list[GenRequest]:
+        """Pop every never-claimed queued request, WITHOUT failing it —
+        the zero-drop rolling-restart transplant (the router re-admits
+        each into another replica via ``requeue()`` before this engine
+        drains).  Pinned-page claims die with this engine's arena, so
+        they are dropped here exactly like a supervisor transplant;
+        the receiving engine resumes via re-prefill, token-identity
+        intact."""
+        with self._qlock:
+            queued = [r for r in self.tenants.drain() if not r.cancelled]
+        for req in queued:
+            req.pinned_pages = None  # old arena; see requeue()
+            req.claimed = False
+        return queued
+
     def abandon(self, err: Exception) -> list[GenRequest]:
         """Supervisor restart path: give up on this engine NOW, without
         joining its (possibly wedged) scheduler thread.  Active requests
@@ -1922,6 +2000,19 @@ class ContinuousBatchingModel(Model):
         if self.engine is not None:
             self.engine.stop()
         self.ready = False
+
+    def request_phase(self, request_id: Optional[str]) -> Optional[str]:
+        """Fleet-router hedging gate: where the request is on this
+        replica's engine (``"queued"`` / ``"active"`` / ``None``)."""
+        eng = self.engine
+        return eng.request_phase(request_id) if eng is not None else None
+
+    def cancel_request(self, request_id: Optional[str]) -> bool:
+        """Cancel by HTTP-level request id (``:cancel`` route / fleet
+        hedge-loser path)."""
+        eng = self.engine
+        return (eng.cancel_request(request_id)
+                if eng is not None else False)
 
     def _local_health(self) -> dict:
         """Unsupervised readiness (a ServingSupervisor, when watching

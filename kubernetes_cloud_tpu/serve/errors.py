@@ -58,6 +58,22 @@ class TenantQuotaError(RetryableError):
         self.retry_after_s = retry_after_s
 
 
+class ReplicaUnavailableError(RetryableError):
+    """The fleet router could not place the request on any replica:
+    every replica is ejected/draining/dead, or the chosen replica
+    failed before producing a response and the retry budget (or the
+    candidate set) is exhausted.  Same retryable-503 contract as queue
+    backpressure — the request was fine, the *fleet* transiently was
+    not; Knative-level retries (or the client's own backoff) land once
+    a replica recovers.  ``retry_after_s`` optionally carries the
+    router's next-probe estimate."""
+
+    def __init__(self, message: str,
+                 retry_after_s: "float | None" = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class EngineRestartedError(RetryableError):
     """The supervisor restarted a hung/crashed engine out from under
     this in-flight request.  State (the KV slot) is gone; a retry hits

@@ -1,0 +1,1281 @@
+"""Resilient serving fleet: health-aware routing, retry budgets,
+hedged dispatch, zero-drop rolling restarts.
+
+The paper's serving layer never exposes a single pod: every workload is
+a KServe ``InferenceService`` behind Knative autoscaling — a *fleet* of
+replicas with an activator routing around unready pods.  Everything
+below this module heals ONE engine inside ONE process
+(:mod:`~kubernetes_cloud_tpu.serve.supervisor`); this module is the
+layer above — what stands between a replica dying mid-stream, a hung
+pod, or a rolling weight/config restart and the client's error budget.
+The techniques are the "Tail at Scale" toolkit (Dean & Barroso, CACM
+'13; PAPERS.md):
+
+* **Health-aware routing** (:class:`ReplicaHealth`).  Active ``/readyz``
+  probing — the body's ``heartbeat_age_s`` / ``queue_depth`` per model,
+  which the PR-3 readiness split already carries, so a *hung* engine
+  (alive thread, stale heartbeat) fails the probe even though its HTTP
+  plane answers 200 — plus passive per-dispatch error/timeout EWMAs.
+  Either signal feeds **outlier ejection**: an ejected replica takes no
+  traffic until a probe succeeds (→ ``half_open``), then one trial
+  request must succeed before full reinstatement (→ ``active``).
+* **Weighted least-loaded dispatch**: score = (router-tracked in-flight
+  + last-probed queue depth) / weight; ejected/draining replicas are
+  skipped, and the skip is surfaced per response (``rerouted``) so load
+  tests can report it honestly.
+* **Retry budget** (:class:`RetryBudget`).  Failed dispatches retry on
+  another replica ONLY while the token-bucket budget holds (each
+  arriving request deposits ``retry_budget_ratio`` tokens, each retry
+  spends one) — the bounded-retry discipline that keeps a brown-out
+  from amplifying into a retry storm.  Only the typed RetryableError
+  503 ladder (and transport failures/timeouts) retries; 504s carry a
+  dead deadline and tenant-quota 503s (``error_kind`` in the body)
+  would launder one tenant's quota through its neighbours' replicas.
+  A request is retried only while ZERO tokens have been delivered to
+  the client — with buffered JSON responses that is every failure, and
+  greedy decoding makes the retried output token-identical by
+  construction.
+* **Hedged dispatch**.  A request still *queued-not-admitted* on its
+  replica after ``hedge_after_s`` (the engine's ``request_phase`` — a
+  request that started decoding is never duplicated) is mirrored to a
+  second replica; the first response wins and the loser is cancelled
+  through the existing ``cancel()`` path (in-process directly, remote
+  via ``POST /v1/models/<m>:cancel``).
+* **Zero-drop rolling restarts** (:meth:`FleetRouter.rolling_restart`).
+  One replica at a time: stop routing to it, transplant its
+  never-claimed queue through the router into its peers (the engines'
+  existing ``requeue()`` machinery — waiters follow the request), let
+  its in-flight slots drain (the PR-3 stop/drain path), rebuild, probe
+  back to active, proceed.  Requests that race the drain window fail
+  with a retryable 503 and are absorbed by the retry ladder, so the
+  client-visible error count stays zero.
+* **Fleet-wide fairness**.  One :class:`~kubernetes_cloud_tpu.serve.
+  tenancy.FleetClock` is attached to every in-process replica's
+  :class:`~kubernetes_cloud_tpu.serve.tenancy.TenantScheduler`, so the
+  PR-9 WFQ virtual clocks (and the no-banked-credit floor) are a single
+  fleet-wide ledger instead of per-replica opinions.
+
+Replicas come in two shapes: :class:`LocalReplica` wraps an in-process
+:class:`~kubernetes_cloud_tpu.serve.server.ModelServer` (tier-1 tests
+and the availability bench stay CPU-runnable; calls go straight into
+its routing, bypassing only the per-request HTTP metrics so
+``kct_server_*`` counts each client request once, at the router's
+door), and :class:`RemoteReplica` fronts a real pod by URL.  The router
+itself IS a :class:`~kubernetes_cloud_tpu.serve.server.ModelServer`
+subclass, so both front-ends (stdlib + native C++) serve it unchanged
+and the V1 predict/completion/cancel surface, deadline headers, tenant
+keys, ``/metrics`` and the debug plane all ride the shared
+``handle()``.
+
+Fault sites: ``fleet.dispatch`` (per dispatch attempt, on the
+submitting HTTP thread — raise/hang contained to that request) and
+``fleet.probe`` (on the prober thread — raise reads as a failed probe,
+hang parks only the prober; dispatch keeps routing on last-known
+health).  Chaos-locked by ``tests/test_fleet_chaos.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Mapping, Optional, Sequence
+
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.serve.errors import (
+    ReplicaUnavailableError,
+    RetryableError,
+)
+from kubernetes_cloud_tpu.serve.server import ModelServer
+from kubernetes_cloud_tpu.serve.tenancy import FleetClock
+
+log = logging.getLogger(__name__)
+
+#: replica health states (the outlier-ejection state machine)
+ACTIVE = "active"          # takes traffic
+EJECTED = "ejected"        # outlier: probes only, no traffic
+HALF_OPEN = "half_open"    # probe succeeded; one trial request decides
+DRAINING = "draining"      # rolling restart in progress: no traffic
+STATES = (ACTIVE, EJECTED, HALF_OPEN, DRAINING)
+
+#: 503 ``error_kind``s the router must NOT retry on another replica:
+#: a tenant-quota shed is the tenant's contract, and laundering it
+#: through a neighbour replica's bucket would defeat admission control
+_NO_RETRY_KINDS = frozenset({"TenantQuotaError"})
+
+# Fleet metric families (labels: replica ids are configured, bounded)
+_M_REPLICAS = obs.gauge(
+    "kct_fleet_replicas",
+    "Fleet replicas per health state (active | ejected | half_open | "
+    "draining).", ("state",))
+_M_DISPATCH = obs.counter(
+    "kct_fleet_dispatches_total",
+    "Dispatch attempts per replica by outcome (ok | error | timeout).",
+    ("replica", "outcome"))
+_M_RETRIES = obs.counter(
+    "kct_fleet_retries_total",
+    "Fleet-level retries by outcome (ok = the retry answered, failed "
+    "= it did not, budget_exhausted = the retry token bucket refused "
+    "one).", ("outcome",))
+_M_HEDGES = obs.counter(
+    "kct_fleet_hedges_total",
+    "Hedged dispatches by outcome (win = the hedge answered first, "
+    "loss = the primary did).", ("outcome",))
+_M_EJECTIONS = obs.counter(
+    "kct_fleet_ejections_total",
+    "Replica ejections by cause (probe | errors | timeouts | trial).",
+    ("replica", "cause"))
+_M_RECOVERIES = obs.counter(
+    "kct_fleet_recoveries_total",
+    "Replicas reinstated to active after a half-open trial succeeded.",
+    ("replica",))
+_M_QUEUE = obs.gauge(
+    "kct_fleet_queue_depth",
+    "Last-probed aggregate admission queue depth per replica (what "
+    "least-loaded dispatch weighs).", ("replica",))
+_M_INFLIGHT = obs.gauge(
+    "kct_fleet_inflight",
+    "Router-tracked in-flight dispatches per replica.", ("replica",))
+_M_TRANSPLANTED = obs.counter(
+    "kct_fleet_transplanted_total",
+    "Never-claimed queued requests moved off a draining replica "
+    "during a rolling restart.", ("replica",))
+_M_ROLLING = obs.counter(
+    "kct_fleet_rolling_restarts_total",
+    "Completed zero-drop rolling-restart sweeps over the fleet.")
+_M_UNPLACEABLE = obs.counter(
+    "kct_fleet_unplaceable_total",
+    "Requests answered 503 because no active replica could take them "
+    "(every replica ejected/draining/dead, or retries exhausted).")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Router knobs (deploy/README.md "Fleet & rolling restarts" maps
+    them onto the Knative activator/containerConcurrency contract)."""
+
+    #: active health probing cadence (GET /readyz per replica)
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 5.0
+    #: a readyz body whose worst model ``heartbeat_age_s`` exceeds this
+    #: is unhealthy even at HTTP 200 — the hung-pod signal
+    heartbeat_stale_s: float = 10.0
+    #: consecutive failed probes before an ACTIVE replica is ejected
+    probe_fail_threshold: int = 3
+    #: passive outlier ejection: per-dispatch error EWMA weight and the
+    #: level (after ``min_samples`` dispatches) that ejects
+    error_ewma_alpha: float = 0.3
+    error_ewma_eject: float = 0.6
+    min_samples: int = 4
+    #: consecutive dispatch timeouts that eject (a hung replica fails
+    #: no requests — it just never answers)
+    timeout_eject: int = 2
+    #: bound on one dispatch attempt (generation included); a hung
+    #: replica surfaces here, feeding the timeout ejector
+    dispatch_timeout_s: float = 300.0
+    #: retries per request (candidate replicas permitting)
+    max_retries: int = 3
+    #: retry budget: every arriving request deposits this many retry
+    #: tokens (capped at ``retry_budget_burst``), every retry spends
+    #: one — fleet-wide retries are bounded at ~ratio x request rate
+    retry_budget_ratio: float = 0.2
+    retry_budget_burst: float = 10.0
+    #: hedge a request still queued-not-admitted after this long; None
+    #: disables hedging
+    hedge_after_s: Optional[float] = None
+    #: rolling restart: bound on waiting a rebuilt replica healthy
+    restart_probe_timeout_s: float = 60.0
+
+    def __post_init__(self):
+        if self.probe_interval_s <= 0 or self.probe_timeout_s <= 0:
+            raise ValueError("probe intervals must be > 0")
+        if self.probe_fail_threshold < 1 or self.timeout_eject < 1:
+            raise ValueError("ejection thresholds must be >= 1")
+        if not 0 < self.error_ewma_alpha <= 1:
+            raise ValueError("error_ewma_alpha must be in (0, 1]")
+        if not 0 < self.error_ewma_eject <= 1:
+            raise ValueError("error_ewma_eject must be in (0, 1]")
+        if self.max_retries < 0 or self.retry_budget_ratio < 0:
+            raise ValueError("retry knobs must be >= 0")
+        if self.hedge_after_s is not None and self.hedge_after_s <= 0:
+            raise ValueError("hedge_after_s must be > 0 (None disables)")
+
+
+class RetryBudget:
+    """Token-bucket retry budget (Tail at Scale / Finagle style):
+    deposits ride the request rate, so sustained retries are capped at
+    ``ratio`` of traffic; the burst is the cold-start allowance.
+    Thread-safe."""
+
+    def __init__(self, ratio: float, burst: float):
+        self.ratio = float(ratio)
+        self.burst = max(float(burst), 1.0)
+        self._level = self.burst
+        self._lock = threading.Lock()
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._level = min(self.burst, self._level + self.ratio)
+
+    def try_take(self) -> bool:
+        with self._lock:
+            if self._level >= 1.0:
+                self._level -= 1.0
+                return True
+            return False
+
+    @property
+    def level(self) -> float:
+        return self._level
+
+
+class ReplicaHealth:
+    """One replica's health state machine: active probes + passive
+    dispatch outcomes in, ejection/recovery transitions out.  All
+    transitions run under one small lock; nothing inside blocks."""
+
+    def __init__(self, replica_id: str, cfg: FleetConfig):
+        self.id = replica_id
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self.state = ACTIVE
+        self.ejected_cause: Optional[str] = None
+        self.consec_probe_fails = 0
+        self.consec_timeouts = 0
+        self.ewma_error = 0.0
+        self.samples = 0
+        #: one trial request at a time while half-open
+        self.trial_inflight = False
+        #: last healthy-probe payload (queue depth feeds dispatch)
+        self.queue_depth = 0
+        self.heartbeat_age_s: Optional[float] = None
+        self.last_probe_ok: Optional[bool] = None
+        self.stats = {"probes": 0, "probe_fails": 0, "ejections": 0,
+                      "recoveries": 0, "dispatch_ok": 0,
+                      "dispatch_err": 0, "dispatch_timeout": 0}
+
+    # -- transitions (return the event to record OUTSIDE the lock) ---------
+
+    def _eject(self, cause: str) -> str:
+        self.state = EJECTED
+        self.ejected_cause = cause
+        self.consec_probe_fails = 0
+        self.consec_timeouts = 0
+        self.ewma_error = 0.0
+        self.samples = 0
+        self.trial_inflight = False
+        self.stats["ejections"] += 1
+        return cause
+
+    def note_probe(self, healthy: bool, queue_depth: int = 0,
+                   heartbeat_age_s: Optional[float] = None
+                   ) -> Optional[str]:
+        """Record one active-probe verdict; returns an ejection cause
+        or the string ``"half_open"`` on an EJECTED→HALF_OPEN
+        transition (callers emit metrics/logs outside the lock)."""
+        with self._lock:
+            self.stats["probes"] += 1
+            if healthy:
+                self.consec_probe_fails = 0
+                self.queue_depth = queue_depth
+                self.heartbeat_age_s = heartbeat_age_s
+                self.last_probe_ok = True
+                if self.state == EJECTED:
+                    # recovery probe succeeded: one trial request will
+                    # decide reinstatement
+                    self.state = HALF_OPEN
+                    self.trial_inflight = False
+                    return "half_open"
+                return None
+            self.stats["probe_fails"] += 1
+            self.last_probe_ok = False
+            self.consec_probe_fails += 1
+            if self.state == HALF_OPEN:
+                return self._eject("probe")
+            if (self.state == ACTIVE and self.consec_probe_fails
+                    >= self.cfg.probe_fail_threshold):
+                return self._eject("probe")
+            return None
+
+    def begin_dispatch(self) -> Optional[bool]:
+        """Claim the replica for one dispatch: ``False`` for a normal
+        dispatch, ``True`` for the half-open trial, ``None`` when the
+        replica must not take traffic right now."""
+        with self._lock:
+            if self.state == ACTIVE:
+                return False
+            if self.state == HALF_OPEN and not self.trial_inflight:
+                self.trial_inflight = True
+                return True
+            return None
+
+    def note_result(self, ok: bool, *, timeout: bool = False,
+                    trial: bool = False) -> Optional[str]:
+        """Record one dispatch outcome; returns an ejection cause, the
+        string ``"recovered"`` for a successful trial, or None."""
+        with self._lock:
+            if timeout:
+                self.stats["dispatch_timeout"] += 1
+            elif ok:
+                self.stats["dispatch_ok"] += 1
+            else:
+                self.stats["dispatch_err"] += 1
+            if trial:
+                self.trial_inflight = False
+                if self.state != HALF_OPEN:
+                    return None  # a probe transitioned us meanwhile
+                if ok:
+                    self.state = ACTIVE
+                    self.ejected_cause = None
+                    self.stats["recoveries"] += 1
+                    return "recovered"
+                return self._eject("trial")
+            self.consec_timeouts = (self.consec_timeouts + 1
+                                    if timeout else 0)
+            a = self.cfg.error_ewma_alpha
+            self.ewma_error = (a * (0.0 if ok else 1.0)
+                               + (1 - a) * self.ewma_error)
+            self.samples += 1
+            if self.state != ACTIVE:
+                return None
+            if self.consec_timeouts >= self.cfg.timeout_eject:
+                return self._eject("timeouts")
+            if (self.samples >= self.cfg.min_samples
+                    and self.ewma_error >= self.cfg.error_ewma_eject):
+                return self._eject("errors")
+            return None
+
+    def eject(self, cause: str) -> None:
+        """Explicit ejection (rolling restart found a rebuilt replica
+        that never came back healthy)."""
+        with self._lock:
+            self._eject(cause)
+
+    def release_trial(self) -> None:
+        """Un-claim a half-open trial that never reached the replica
+        (an injected router-side dispatch fault) — charging it as a
+        trial failure would eject a replica that saw nothing."""
+        with self._lock:
+            self.trial_inflight = False
+
+    def begin_drain(self) -> None:
+        with self._lock:
+            self.state = DRAINING
+            self.trial_inflight = False
+
+    def force_active(self) -> None:
+        """Rolling restart: the router just rebuilt and probed this
+        replica itself — reinstate without a traffic trial."""
+        with self._lock:
+            self.state = ACTIVE
+            self.ejected_cause = None
+            self.consec_probe_fails = 0
+            self.consec_timeouts = 0
+            self.ewma_error = 0.0
+            self.samples = 0
+            self.trial_inflight = False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self.state,
+                    "ejected_cause": self.ejected_cause,
+                    "queue_depth": self.queue_depth,
+                    "heartbeat_age_s": self.heartbeat_age_s,
+                    "ewma_error": round(self.ewma_error, 4),
+                    "consec_timeouts": self.consec_timeouts,
+                    "consec_probe_fails": self.consec_probe_fails,
+                    **self.stats}
+
+
+class Replica:
+    """One fleet member.  Subclasses supply transport; the router only
+    ever talks through this surface."""
+
+    #: local replicas can be drained/rebuilt in-process; remote pods
+    #: restart via their own orchestrator (kubectl), not this router
+    restartable = False
+
+    def __init__(self, replica_id: str, cfg: FleetConfig, *,
+                 weight: float = 1.0):
+        if weight <= 0:
+            raise ValueError("replica weight must be > 0")
+        self.id = replica_id
+        self.weight = float(weight)
+        self.health = ReplicaHealth(replica_id, cfg)
+        self.inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._m_dispatch = {o: _M_DISPATCH.labels(replica=replica_id,
+                                                  outcome=o)
+                            for o in ("ok", "error", "timeout")}
+        self._m_queue = _M_QUEUE.labels(replica=replica_id)
+        self._m_inflight = _M_INFLIGHT.labels(replica=replica_id)
+
+    # -- transport (subclasses) --------------------------------------------
+
+    def call(self, method: str, path: str, body: bytes,
+             headers: Optional[Mapping[str, str]] = None
+             ) -> tuple[int, dict]:
+        raise NotImplementedError
+
+    def probe(self, timeout: float) -> tuple[int, dict]:
+        """GET /readyz → (status, parsed body); raises on transport
+        failure."""
+        raise NotImplementedError
+
+    def request_phase(self, request_id: Optional[str]) -> Optional[str]:
+        """``"queued"`` / ``"active"`` / None (unknown).  Remote
+        replicas return None — hedging then gates on time alone."""
+        return None
+
+    def cancel(self, request_id: Optional[str]) -> None:
+        """Best-effort cancel-by-id (hedge loser / timeout orphan)."""
+
+    def model_names(self) -> list[str]:
+        return []
+
+    # -- load accounting ---------------------------------------------------
+
+    def inflight_inc(self) -> None:
+        with self._inflight_lock:
+            self.inflight += 1
+        self._m_inflight.set(self.inflight)
+
+    def inflight_dec(self) -> None:
+        with self._inflight_lock:
+            self.inflight -= 1
+        self._m_inflight.set(self.inflight)
+
+    def load_score(self) -> float:
+        """Weighted least-loaded dispatch key: smaller = freer."""
+        return (self.inflight + self.health.queue_depth) / self.weight
+
+    def snapshot(self) -> dict:
+        return {"id": self.id, "weight": self.weight,
+                "inflight": self.inflight, **self.health.snapshot()}
+
+
+class LocalReplica(Replica):
+    """An in-process replica: a fully-formed ``ModelServer`` whose
+    routing is invoked directly (no sockets).  This is what keeps
+    tier-1 and the availability bench CPU-runnable; it is also an
+    honest model of a sidecar-per-process deployment."""
+
+    restartable = True
+
+    def __init__(self, replica_id: str, server: ModelServer,
+                 cfg: FleetConfig, *, weight: float = 1.0):
+        super().__init__(replica_id, cfg, weight=weight)
+        self.server = server
+
+    def load(self) -> None:
+        self.server.load_all()
+
+    def call(self, method: str, path: str, body: bytes,
+             headers: Optional[Mapping[str, str]] = None
+             ) -> tuple[int, dict]:
+        # _route, not handle(): the replica's routing (drain flag,
+        # in-flight accounting, error mapping) without its per-request
+        # HTTP metrics — kct_server_* must count each client request
+        # once, at the router's own handle()
+        return self.server._route(method, path, body, headers)
+
+    def probe(self, timeout: float) -> tuple[int, dict]:
+        status, obj = self.server._route("GET", "/readyz", b"", None)
+        return status, obj if isinstance(obj, dict) else {}
+
+    def engines(self) -> list:
+        out = []
+        for model in self.server.models.values():
+            eng = getattr(model, "engine", None)
+            if eng is not None:
+                out.append(eng)
+        return out
+
+    def request_phase(self, request_id: Optional[str]) -> Optional[str]:
+        best = None
+        for model in self.server.models.values():
+            fn = getattr(model, "request_phase", None)
+            phase = fn(request_id) if fn is not None else None
+            if phase == "active":
+                return "active"
+            best = best or phase
+        return best
+
+    def cancel(self, request_id: Optional[str]) -> None:
+        for model in self.server.models.values():
+            fn = getattr(model, "cancel_request", None)
+            if fn is not None:
+                try:
+                    fn(request_id)
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    log.exception("%s: cancel(%s) failed", self.id,
+                                  request_id)
+
+    def model_names(self) -> list[str]:
+        return sorted(self.server.models)
+
+    def attach_clock(self, clock: FleetClock) -> None:
+        """(Re-)share the fleet virtual clock with every engine's
+        tenant scheduler — idempotent, re-applied after each probe so
+        supervisor/rolling restarts (fresh engines, fresh schedulers)
+        rejoin the fleet ledger automatically."""
+        for eng in self.engines():
+            eng.tenants.attach_fleet_clock(clock)
+
+    def extract_queued(self) -> list[tuple[str, list]]:
+        """``(model_name, [GenRequest, ...])`` of never-claimed queued
+        work, popped for transplant (rolling restart)."""
+        out = []
+        for name, model in self.server.models.items():
+            eng = getattr(model, "engine", None)
+            fn = getattr(eng, "extract_queued", None)
+            if fn is not None:
+                reqs = fn()
+                if reqs:
+                    out.append((name, reqs))
+        return out
+
+    def requeue(self, model_name: str, req) -> bool:
+        model = self.server.models.get(model_name)
+        eng = getattr(model, "engine", None)
+        if eng is None or not eng.alive:
+            return False
+        eng.requeue(req)
+        return True
+
+    def restart(self) -> None:
+        """Drain in-flight slots and rebuild every worker model (stop()
+        → load(); weights and the jit cache survive, the engine and
+        its pool are fresh) — the in-process rendering of a pod
+        rollout."""
+        for model in self.server.models.values():
+            stop = getattr(model, "stop", None)
+            if callable(stop):
+                stop()
+        self.server.load_all()
+
+
+class RemoteReplica(Replica):
+    """A real pod, by base URL (``http://host:port``)."""
+
+    def __init__(self, replica_id: str, base_url: str, cfg: FleetConfig,
+                 *, weight: float = 1.0):
+        super().__init__(replica_id, cfg, weight=weight)
+        self.base_url = base_url.rstrip("/")
+        self.cfg = cfg
+        self._models: list[str] = []
+
+    def _request(self, method: str, path: str, body: bytes,
+                 headers: Optional[Mapping[str, str]],
+                 timeout: float) -> tuple[int, dict]:
+        req = urllib.request.Request(
+            self.base_url + path, data=body if method == "POST" else None,
+            headers={"Content-Type": "application/json",
+                     **(dict(headers) if headers else {})},
+            method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except ValueError:  # ingress HTML error page, not our JSON
+                return e.code, {"error": f"HTTP {e.code}"}
+
+    def call(self, method: str, path: str, body: bytes,
+             headers: Optional[Mapping[str, str]] = None
+             ) -> tuple[int, dict]:
+        return self._request(method, path, body, headers,
+                             self.cfg.dispatch_timeout_s)
+
+    def probe(self, timeout: float) -> tuple[int, dict]:
+        status, obj = self._request("GET", "/readyz", b"", None, timeout)
+        models = obj.get("models")
+        if isinstance(models, dict) and models:
+            self._models = sorted(models)  # learned from the probe
+        return status, obj
+
+    def cancel(self, request_id: Optional[str]) -> None:
+        if not request_id:
+            return
+        body = json.dumps({"request_id": request_id}).encode()
+        for name in self._models or ["lm"]:
+            try:
+                self._request("POST", f"/v1/models/{name}:cancel", body,
+                              None, self.cfg.probe_timeout_s)
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                log.debug("%s: remote cancel failed", self.id)
+
+    def model_names(self) -> list[str]:
+        return list(self._models)
+
+
+def _probe_healthy(status: int, body: Mapping[str, Any],
+                   stale_s: float) -> tuple[bool, int, Optional[float]]:
+    """Evaluate a /readyz answer: (healthy, queue_depth,
+    worst_heartbeat_age).  HTTP 200 alone is not enough — a hung
+    unsupervised engine still answers ready, but its per-model
+    ``heartbeat_age_s`` gives it away."""
+    if status != 200:
+        return False, 0, None
+    depth, worst_age = 0, None
+    for detail in (body.get("models") or {}).values():
+        if not isinstance(detail, dict):
+            continue
+        if not detail.get("ok", True):
+            return False, 0, None
+        depth += int(detail.get("queue_depth") or 0)
+        age = detail.get("heartbeat_age_s")
+        if age is not None:
+            age = float(age)
+            worst_age = age if worst_age is None else max(worst_age, age)
+    if worst_age is not None and worst_age > stale_s:
+        return False, depth, worst_age
+    return True, depth, worst_age
+
+
+class FleetRouter(ModelServer):
+    """N replicas behind the one V1 endpoint clients already speak.
+
+    A ``ModelServer`` with no local models: every data-plane POST the
+    shared ``handle()`` routes lands in the overridden ``_predict`` /
+    ``_completion`` / ``_cancel`` and is dispatched to a replica;
+    ``/readyz`` aggregates replica health; ``/metrics`` and the debug
+    plane come from the base class unchanged."""
+
+    def __init__(self, replicas: Sequence[Replica],
+                 cfg: FleetConfig = FleetConfig(), *,
+                 host: str = "0.0.0.0", port: int = 8080):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        ids = [r.id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        super().__init__([], host=host, port=port)
+        self.replicas = list(replicas)
+        self.cfg = cfg
+        self.retry_budget = RetryBudget(cfg.retry_budget_ratio,
+                                        cfg.retry_budget_burst)
+        #: the fleet-wide WFQ ledger (serve/tenancy.FleetClock)
+        self.clock = FleetClock()
+        self._probe_stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        #: serializes rolling restarts (two sweeps would double-drain)
+        self._restart_lock = threading.Lock()
+        self.stats = {"dispatches": 0, "retries": 0, "retried_ok": 0,
+                      "retry_budget_exhausted": 0, "hedges": 0,
+                      "hedge_wins": 0, "rerouted": 0, "unplaceable": 0,
+                      "transplanted": 0, "rolling_restarts": 0}
+        #: stats increments come from concurrent HTTP dispatch
+        #: threads; dict += is a read-modify-write that loses updates
+        #: without this (the bench reports these numbers)
+        self._stats_lock = threading.Lock()
+        for r in self.replicas:
+            attach = getattr(r, "attach_clock", None)
+            if attach is not None:
+                attach(self.clock)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def load_all(self) -> None:
+        for r in self.replicas:
+            load = getattr(r, "load", None)
+            if callable(load):
+                load()
+
+    def start_probing(self) -> None:
+        if self._probe_thread is not None and self._probe_thread.is_alive():
+            return
+        self._probe_stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True, name="fleet-prober")
+        self._probe_thread.start()
+
+    def stop_probing(self) -> None:
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5.0)
+            self._probe_thread = None
+
+    def start(self) -> None:
+        self.start_probing()
+        super().start()
+
+    def serve_forever(self) -> None:
+        self.start_probing()
+        super().serve_forever()
+
+    def stop(self) -> None:
+        self.stop_probing()
+        super().stop()
+
+    def shutdown(self) -> None:
+        """Stop the router AND its in-process replicas' workers (tests
+        and the bench; a production router never owns remote pods)."""
+        self.stop()
+        for r in self.replicas:
+            server = getattr(r, "server", None)
+            if server is None:
+                continue
+            for model in server.models.values():
+                stop = getattr(model, "stop", None)
+                if callable(stop):
+                    try:
+                        stop()
+                    except Exception:  # noqa: BLE001 - teardown
+                        log.exception("stopping %s/%s failed", r.id,
+                                      model.name)
+
+    # -- health probing ----------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._probe_stop.wait(self.cfg.probe_interval_s):
+            try:
+                self.probe_now()
+            except Exception:  # noqa: BLE001 - the prober never dies
+                log.exception("fleet probe pass failed")
+
+    def probe_now(self) -> None:
+        """One probe pass over every replica (the thread calls this
+        each interval; tests call it synchronously)."""
+        for r in self.replicas:
+            if r.health.state == DRAINING:
+                continue  # deliberate; rolling_restart owns it
+            try:
+                faults.fire("fleet.probe")
+                status, body = r.probe(self.cfg.probe_timeout_s)
+                healthy, depth, age = _probe_healthy(
+                    status, body, self.cfg.heartbeat_stale_s)
+            except Exception as e:  # noqa: BLE001 - a failed probe is
+                # data, not an error: transport refusal, injected
+                # fault, malformed body — all read "unhealthy"
+                healthy, depth, age = False, 0, None
+                log.debug("%s: probe failed: %s", r.id, e)
+            event = r.health.note_probe(healthy, depth, age)
+            if healthy:
+                r._m_queue.set(depth)
+                attach = getattr(r, "attach_clock", None)
+                if attach is not None:
+                    # engines rebuilt by a supervisor restart carry
+                    # fresh schedulers; re-attach is idempotent
+                    attach(self.clock)
+            if event == "half_open":
+                log.info("%s: recovery probe succeeded; half-open", r.id)
+            elif event is not None:
+                log.warning("%s: ejected (cause=%s)", r.id, event)
+                _M_EJECTIONS.labels(replica=r.id, cause=event).inc()
+        self._refresh_state_gauge()
+
+    def _refresh_state_gauge(self) -> None:
+        counts = {s: 0 for s in STATES}
+        for r in self.replicas:
+            counts[r.health.state] += 1
+        for state, n in counts.items():
+            _M_REPLICAS.labels(state=state).set(n)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _pick(self, exclude: Sequence[Replica]
+              ) -> tuple[Optional[Replica], Optional[bool], bool]:
+        """Least-loaded active replica outside ``exclude``; returns
+        (replica, is_trial, skipped_unhealthy).  ``skipped_unhealthy``
+        is True when at least one replica was passed over for health —
+        the honest ``rerouted`` signal load tests report."""
+        skipped = False
+        for r in sorted((r for r in self.replicas if r not in exclude),
+                        key=lambda r: r.load_score()):
+            trial = r.health.begin_dispatch()
+            if trial is None:
+                skipped = True
+                continue
+            return r, trial, skipped
+        return None, None, skipped
+
+    def _call_replica(self, replica: Replica, path: str, body: bytes,
+                      results: "queue.SimpleQueue", tag: str) -> None:
+        """One dispatch on its own thread (bounded waits + hedging need
+        the caller free); the result is tagged onto the shared queue.
+        The thread owns the replica's in-flight count."""
+        replica.inflight_inc()
+        t0 = time.monotonic()
+        try:
+            status, obj = replica.call("POST", path, body)
+        except RetryableError as e:
+            status, obj = 503, {"error": str(e),
+                                "error_kind": type(e).__name__}
+        except Exception as e:  # noqa: BLE001 - transport failure is an
+            # outcome to weigh, never an unwound HTTP thread
+            status, obj = 0, {"error": str(e)}
+        finally:
+            replica.inflight_dec()
+        results.put((tag, replica, status, obj, time.monotonic() - t0))
+
+    @staticmethod
+    def _retryable(status: int, obj: Mapping[str, Any]) -> bool:
+        """The retry gate: transport failure (0), dispatch timeout
+        (-1), or the typed RetryableError 503 ladder — minus the kinds
+        that must not hop replicas (tenant quota).  504 carries a dead
+        deadline; 4xx/500 are the request's or the pod's real fault."""
+        if status in (0, -1):
+            return True
+        if status != 503:
+            return False
+        return obj.get("error_kind") not in _NO_RETRY_KINDS
+
+    def _fleet_call(self, path: str, payload: dict) -> tuple[int, dict]:
+        """Dispatch one data-plane request into the fleet: least-loaded
+        pick, hedging, bounded retries, fleet accounting.  Returns the
+        winning replica's (status, body) with a ``fleet`` annotation on
+        success."""
+        body = json.dumps(payload).encode()
+        rid = payload.get("request_id")
+        self.retry_budget.deposit()
+        retries = dispatches = 0
+        hedged = hedge_win = rerouted = False
+        tried: list[Replica] = []
+
+        def annotate(obj: dict, replica_id: Optional[str]) -> dict:
+            # success AND failure bodies both carry the fleet cost, so
+            # load tests can report retry amplification honestly (a
+            # request that burned 4 dispatches before its 503 must not
+            # read as one)
+            obj = dict(obj)
+            obj["fleet"] = {
+                "replica": replica_id, "retries": retries,
+                "dispatches": dispatches, "retried_ok": False,
+                "hedged": hedged, "hedge_win": hedge_win,
+                "rerouted": rerouted,
+            }
+            return obj
+
+        def fail(status: int, obj: dict, replica_id: str
+                 ) -> tuple[int, dict]:
+            # transport failures (0) and dispatch timeouts (-1) leave
+            # the router as a retryable 503 — the client-facing
+            # contract is the typed ladder, not internal sentinels
+            if status in (0, -1):
+                obj = dict(obj)
+                obj.setdefault("error", "dispatch failed")
+                obj["error_kind"] = "ReplicaUnavailableError"
+                status = 503
+            return status, annotate(obj, replica_id)
+
+        last_failure: Optional[tuple[int, dict, str]] = None
+        while True:
+            replica, trial, skipped = self._pick(tried)
+            rerouted = rerouted or skipped
+            if replica is None:
+                self._bump("unplaceable")
+                _M_UNPLACEABLE.inc()
+                if last_failure is not None:
+                    # candidates ran out mid-retry: the annotated last
+                    # failure keeps the dispatch cost reportable (a
+                    # 503 that burned several attempts must not read
+                    # as one)
+                    return fail(*last_failure)
+                raise ReplicaUnavailableError(
+                    f"no active replica for {path} "
+                    f"({len(self.replicas)} configured, "
+                    f"{len(tried)} already tried); retry",
+                    retry_after_s=self.cfg.probe_interval_s)
+            self._bump("dispatches")
+            dispatches += 1
+            winner = replica.id
+            try:
+                faults.fire("fleet.dispatch")
+                status, obj, was_hedged, won_by_hedge, winner = \
+                    self._dispatch_one(replica, path, body, rid, trial,
+                                       tried)
+            except faults.FaultError as e:
+                # injected dispatch failure: contained to this request
+                # and charged to nobody (the replica never saw it)
+                if trial:
+                    replica.health.release_trial()
+                status, obj = 0, {"error": str(e)}
+                was_hedged = won_by_hedge = False
+            if was_hedged:
+                dispatches += 1
+            hedged = hedged or was_hedged
+            hedge_win = hedge_win or won_by_hedge
+            ok = status == 200
+            if ok or (400 <= status < 500) or status == 504:
+                # 4xx is the request's own problem and 504 a dead
+                # deadline — neither improves on another replica
+                if isinstance(obj, dict):
+                    obj = annotate(obj, winner)
+                    obj["fleet"]["retried_ok"] = ok and retries > 0
+                if ok:
+                    if retries:
+                        self._bump("retried_ok")
+                        _M_RETRIES.labels(outcome="ok").inc()
+                    if rerouted:
+                        self._bump("rerouted")
+                return status, obj
+            # a real failure (winner names the replica whose answer —
+            # possibly the hedge's — this body came from)
+            tried.append(replica)
+            last_failure = (status, obj, winner)
+            if not self._retryable(status, obj):
+                return fail(*last_failure)
+            if retries >= self.cfg.max_retries:
+                _M_RETRIES.labels(outcome="failed").inc()
+                return fail(*last_failure)
+            if not self.retry_budget.try_take():
+                self._bump("retry_budget_exhausted")
+                _M_RETRIES.labels(outcome="budget_exhausted").inc()
+                return fail(*last_failure)
+            retries += 1
+            self._bump("retries")
+
+    def _dispatch_one(self, replica: Replica, path: str, body: bytes,
+                      rid: Optional[str], trial: bool,
+                      tried: list
+                      ) -> tuple[int, dict, bool, bool, str]:
+        """One (possibly hedged) dispatch: primary on a worker thread,
+        a mirror on the least-loaded OTHER replica if the request is
+        still queued-not-admitted at ``hedge_after_s``; first success
+        wins, the loser is cancelled through the ``cancel()`` path.
+        Returns (status, body, hedged, won_by_hedge, winner_id)."""
+        results: "queue.SimpleQueue" = queue.SimpleQueue()
+        threading.Thread(
+            target=self._call_replica,
+            args=(replica, path, body, results, "primary"),
+            daemon=True, name=f"dispatch-{replica.id}").start()
+        pending = {"primary": replica}
+        hedge_replica: Optional[Replica] = None
+        hedge_trial = False
+        deadline = time.monotonic() + self.cfg.dispatch_timeout_s
+        hedge_at = (time.monotonic() + self.cfg.hedge_after_s
+                    if self.cfg.hedge_after_s is not None else None)
+        first_failure: Optional[tuple[int, dict]] = None
+        while pending:
+            now = time.monotonic()
+            wake = deadline if hedge_at is None else min(deadline,
+                                                         hedge_at)
+            try:
+                tag, rep, status, obj, _dt = results.get(
+                    timeout=max(wake - now, 0.001))
+            except queue.Empty:
+                if time.monotonic() >= deadline:
+                    break  # overall dispatch timeout
+                if hedge_at is not None and time.monotonic() >= hedge_at:
+                    hedge_at = None  # fire at most one hedge
+                    hedge_replica, hedge_trial = self._maybe_hedge(
+                        replica, path, body, rid, tried, results)
+                    if hedge_replica is not None:
+                        pending["hedge"] = hedge_replica
+                continue
+            del pending[tag]
+            is_trial = trial if tag == "primary" else hedge_trial
+            ok = status == 200
+            # 4xx and 504 are the *request's* problem — the replica
+            # answered correctly, so its health is not dinged
+            event = rep.health.note_result(
+                ok or (400 <= status < 500) or status == 504,
+                trial=is_trial)
+            self._note_dispatch_metrics(rep, status, event)
+            if ok:
+                # winner: cancel the losing leg through cancel(); a
+                # loser holding a half-open trial claim gets it back —
+                # its result will never be consumed, and a leaked
+                # claim would park the replica in half_open forever
+                for other_tag, other in pending.items():
+                    other.cancel(rid)
+                    if (trial if other_tag == "primary"
+                            else hedge_trial):
+                        other.health.release_trial()
+                if hedge_replica is not None and tag == "primary":
+                    _M_HEDGES.labels(outcome="loss").inc()
+                if tag == "hedge":
+                    self._bump("hedge_wins")
+                    _M_HEDGES.labels(outcome="win").inc()
+                return (status, obj, hedge_replica is not None,
+                        tag == "hedge", rep.id)
+            if first_failure is None or status != 0:
+                first_failure = (status, obj, rep.id)
+            if rep is not replica:
+                # a failed HEDGE replica is just as tried as a failed
+                # primary: the retry ladder must not bounce straight
+                # back onto it
+                tried.append(rep)
+            # a failed leg: keep waiting for the other, if any
+        hedged = hedge_replica is not None
+        if pending:
+            # dispatch timeout: whoever is still pending gets the
+            # timeout strike and a best-effort cancel (their worker
+            # threads finish into the void; in-flight accounting
+            # follows them down)
+            for tag, rep in pending.items():
+                is_trial = trial if tag == "primary" else hedge_trial
+                event = rep.health.note_result(False, timeout=True,
+                                               trial=is_trial)
+                self._note_dispatch_metrics(rep, -1, event)
+                rep.cancel(rid)
+                if rep is not replica:
+                    # a hedge replica pending at the deadline is as
+                    # tried as the primary — the retry must not burn
+                    # another full timeout on a replica that just hung
+                    tried.append(rep)
+            return -1, {"error": f"dispatch timed out after "
+                                 f"{self.cfg.dispatch_timeout_s:.1f}s "
+                                 f"on {replica.id}"}, hedged, False, \
+                replica.id
+        status, obj, failed_id = first_failure or (
+            0, {"error": "dispatch produced no result"}, replica.id)
+        return status, obj, hedged, False, failed_id
+
+    def _maybe_hedge(self, primary: Replica, path: str, body: bytes,
+                     rid: Optional[str], tried: Sequence[Replica],
+                     results: "queue.SimpleQueue"
+                     ) -> tuple[Optional[Replica], bool]:
+        """Fire the hedge if the request is still queued-not-admitted
+        on the primary (phase None = not even submitted yet counts;
+        remote replicas report None and hedge on time alone) and a
+        healthy second replica exists."""
+        if primary.request_phase(rid) == "active":
+            return None, False  # decoding: its tokens are being paid for
+        exclude = list(tried) + [primary]
+        hedge, hedge_trial, _ = self._pick(exclude)
+        if hedge is None:
+            return None, False
+        self._bump("hedges")
+        self._bump("dispatches")
+        threading.Thread(
+            target=self._call_replica,
+            args=(hedge, path, body, results, "hedge"),
+            daemon=True, name=f"hedge-{hedge.id}").start()
+        return hedge, bool(hedge_trial)
+
+    def _note_dispatch_metrics(self, replica: Replica, status: int,
+                               event: Optional[str]) -> None:
+        if status == -1:
+            outcome = "timeout"
+        elif status == 200 or (400 <= status < 500) or status == 504:
+            outcome = "ok"  # the replica answered; the answer may
+            # still be the request's own 4xx/expired-deadline problem
+        else:
+            outcome = "error"
+        replica._m_dispatch[outcome].inc()
+        if event == "recovered":
+            log.info("%s: half-open trial succeeded; active again",
+                     replica.id)
+            _M_RECOVERIES.labels(replica=replica.id).inc()
+        elif event is not None:
+            log.warning("%s: ejected (cause=%s)", replica.id, event)
+            _M_EJECTIONS.labels(replica=replica.id, cause=event).inc()
+        self._refresh_state_gauge()
+
+    # -- data-plane overrides ----------------------------------------------
+
+    def _map_fleet_error(self, e: Exception) -> tuple[int, dict]:
+        body = {"error": str(e), "error_kind": type(e).__name__}
+        retry_after = getattr(e, "retry_after_s", None)
+        if retry_after is not None:
+            body["retry_after_s"] = round(float(retry_after), 3)
+        return 503, body
+
+    def _predict(self, name: str, payload: dict) -> tuple[int, dict]:
+        try:
+            return self._fleet_call(f"/v1/models/{name}:predict", payload)
+        except RetryableError as e:  # ReplicaUnavailableError et al.
+            return self._map_fleet_error(e)
+
+    def _completion(self, payload: dict) -> tuple[int, dict]:
+        try:
+            return self._fleet_call("/completion", payload)
+        except RetryableError as e:
+            return self._map_fleet_error(e)
+
+    def _cancel(self, name: str, payload: dict) -> tuple[int, dict]:
+        """Cancel fans out: the router does not track which replica
+        holds the id (retries/hedges may have touched several)."""
+        rid = payload.get("request_id")
+        cancelled = False
+        path = f"/v1/models/{name}:cancel"
+        for r in self.replicas:
+            try:
+                status, obj = r.call("POST", path,
+                                     json.dumps({"request_id": rid})
+                                     .encode())
+                cancelled = cancelled or bool(
+                    isinstance(obj, dict) and obj.get("cancelled"))
+            except Exception:  # noqa: BLE001 - best-effort fan-out
+                log.debug("%s: cancel fan-out failed", r.id)
+        return 200, {"cancelled": cancelled}
+
+    # -- read-plane overrides ----------------------------------------------
+
+    def _route(self, method: str, path: str, body: bytes,
+               headers: Optional[Mapping[str, str]] = None
+               ) -> tuple[int, dict]:
+        if method == "GET":
+            p = path.partition("?")[0]
+            if p == "/v1/models":
+                names = sorted({n for r in self.replicas
+                                for n in r.model_names()})
+                return 200, {"models": names}
+            if (p.startswith("/v1/models/") and ":" not in p):
+                name = p[len("/v1/models/"):]
+                known = any(name in r.model_names()
+                            for r in self.replicas)
+                if not known:
+                    return 404, {"error": f"model {name} not found"}
+                ready = any(r.health.state in (ACTIVE, HALF_OPEN)
+                            and name in r.model_names()
+                            for r in self.replicas)
+                return 200, {"name": name, "ready": ready}
+        return super()._route(method, path, body, headers)
+
+    def _readyz(self) -> tuple[int, dict]:
+        """The fleet is ready while ANY replica can take traffic; the
+        body carries every replica's health detail plus the shared
+        clock, so ``curl /readyz`` alone tells a brown-out from a
+        rolling restart from a dead fleet."""
+        if self._draining:
+            return 503, {"status": "draining"}
+        detail = {r.id: r.snapshot() for r in self.replicas}
+        ok = any(r.health.state in (ACTIVE, HALF_OPEN)
+                 for r in self.replicas)
+        return (200 if ok else 503), {
+            "status": "ready" if ok else "unready",
+            "fleet": True,
+            "replicas": detail,
+            "retry_budget": round(self.retry_budget.level, 2),
+            "clock": self.clock.snapshot(),
+        }
+
+    # -- rolling restart ---------------------------------------------------
+
+    def rolling_restart(self) -> dict:
+        """Zero-drop rolling restart: drain → transplant → rebuild →
+        probe → reinstate, one replica at a time (a weight/config
+        rollout that never drops a queued request).  Requests racing
+        the drain window fail retryable and are absorbed by the retry
+        ladder.  Remote replicas are skipped — their restarts belong
+        to the cluster orchestrator; this router just routes around
+        them via health."""
+        with self._restart_lock:
+            report = []
+            for r in self.replicas:
+                if not r.restartable:
+                    report.append({"replica": r.id, "skipped": "remote"})
+                    continue
+                t0 = time.monotonic()
+                r.health.begin_drain()
+                self._refresh_state_gauge()
+                moved = self._transplant_from(r)
+                r.restart()
+                healthy = self._wait_healthy(r)
+                if healthy:
+                    r.health.force_active()
+                self._refresh_state_gauge()
+                took = time.monotonic() - t0
+                report.append({"replica": r.id, "transplanted": moved,
+                               "healthy": healthy,
+                               "took_s": round(took, 3)})
+                if not healthy:
+                    # leave the replica ejected and STOP the sweep: a
+                    # rollout that bricks replicas must not march on
+                    r.health.eject("probe")
+                    _M_EJECTIONS.labels(replica=r.id,
+                                        cause="probe").inc()
+                    self._refresh_state_gauge()
+                    log.error("%s: did not come back healthy; rolling "
+                              "restart halted", r.id)
+                    break
+            else:
+                self._bump("rolling_restarts")
+                _M_ROLLING.inc()
+            return {"replicas": report,
+                    "completed": all("skipped" in e or e.get("healthy")
+                                     for e in report)}
+
+    def _transplant_from(self, source: Replica) -> int:
+        """Move the draining replica's never-claimed queue into its
+        peers through the engines' requeue() path — the waiters'
+        ``req.engine`` follows, so their in-flight HTTP threads
+        complete against the new replica transparently."""
+        extract = getattr(source, "extract_queued", None)
+        if extract is None:
+            return 0
+        moved = 0
+        for model_name, reqs in extract():
+            for req in reqs:
+                placed = False
+                for target in sorted(
+                        (t for t in self.replicas
+                         if t is not source
+                         and t.health.state in (ACTIVE, HALF_OPEN)),
+                        key=lambda t: t.load_score()):
+                    requeue = getattr(target, "requeue", None)
+                    if requeue is not None and requeue(model_name, req):
+                        placed = True
+                        break
+                if placed:
+                    moved += 1
+                else:
+                    # no in-process peer serves this model: fail it
+                    # retryable so the waiter's own retry (or the
+                    # client's) re-enters through the router.  The
+                    # engines' failure idiom closes the token stream
+                    # too — a streaming consumer must see the sentinel
+                    # now, not a 60 s StreamTimeoutError later.
+                    from kubernetes_cloud_tpu.serve.continuous import (
+                        _STREAM_END,  # lazy: keeps fleet.py jax-free
+                    )
+
+                    req.error = ReplicaUnavailableError(
+                        "replica draining for rolling restart; retry")
+                    obs.tracing.trace(
+                        req.request_id, "failed", model=model_name,
+                        error=type(req.error).__name__)
+                    req.stream.put(_STREAM_END)
+                    req.event.set()
+        if moved:
+            self._bump("transplanted", moved)
+            _M_TRANSPLANTED.labels(replica=source.id).inc(moved)
+        return moved
+
+    def _wait_healthy(self, r: Replica) -> bool:
+        deadline = time.monotonic() + self.cfg.restart_probe_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                status, body = r.probe(self.cfg.probe_timeout_s)
+                healthy, depth, _age = _probe_healthy(
+                    status, body, self.cfg.heartbeat_stale_s)
+            except Exception:  # noqa: BLE001 - keep probing to deadline
+                healthy, depth = False, 0
+            if healthy:
+                r._m_queue.set(depth)
+                attach = getattr(r, "attach_clock", None)
+                if attach is not None:
+                    attach(self.clock)
+                return True
+            time.sleep(min(0.05, self.cfg.probe_interval_s))
+        return False
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {"replicas": [r.snapshot() for r in self.replicas],
+                "stats": dict(self.stats),
+                "retry_budget": round(self.retry_budget.level, 2),
+                "clock": self.clock.snapshot()}
+
+
+def jain_fairness(values: Sequence[float]) -> float:
+    """Jain index over per-tenant fleet-wide weighted service (the
+    acceptance metric the bench reports); 1.0 = perfectly fair."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals or not any(vals):
+        return 1.0
+    return (sum(vals) ** 2) / (len(vals) * sum(v * v for v in vals))

@@ -84,6 +84,16 @@ class Result:
     #: (paged engine attaches both per prediction); 0 otherwise
     prompt_tokens: int = 0
     cached_tokens: int = 0
+    #: fleet-router accounting (the response's ``fleet`` annotation,
+    #: serve/fleet.py): how many replica dispatches this request cost
+    #: (1 = clean), whether it succeeded only via retry, whether a
+    #: hedge answered first, and whether an unhealthy replica was
+    #: routed around — what makes retry amplification reportable
+    #: honestly instead of hiding inside a green 2xx count
+    fleet_dispatches: int = 0
+    retried_ok: bool = False
+    hedge_win: bool = False
+    rerouted: bool = False
 
     @property
     def ok(self) -> bool:
@@ -189,13 +199,44 @@ class Summary:
             "prefill_tokens_computed_total": prompt - cached,
             # shedding visibility: how every request ended
             "outcomes": outcomes,
+            **self._fleet_stats(),
         }
+
+    def _fleet_stats(self) -> dict:
+        """Fleet-router accounting when the target annotates responses
+        (serve/fleet.py): per-request outcome counts plus the retry
+        amplification — replica dispatches per client request, the
+        honest cost of the green 2xx column."""
+        dispatches = sum(r.fleet_dispatches for r in self.results)
+        if not dispatches:
+            return {}
+        return {"fleet": {
+            "retried_ok": sum(r.retried_ok for r in self.results),
+            "hedge_win": sum(r.hedge_win for r in self.results),
+            "rerouted": sum(r.rerouted for r in self.results),
+            "dispatches_total": dispatches,
+            "retry_amplification": round(dispatches / max(self.n, 1), 4),
+        }}
+
+
+def _parse_fleet(obj) -> dict:
+    """Extract the fleet-router annotation (serve/fleet.py) a routed
+    response — success or failure body — carries."""
+    fleet = obj.get("fleet") if isinstance(obj, dict) else None
+    if not isinstance(fleet, dict):
+        return {}
+    return {
+        "fleet_dispatches": int(fleet.get("dispatches") or 0),
+        "retried_ok": bool(fleet.get("retried_ok")),
+        "hedge_win": bool(fleet.get("hedge_win")),
+        "rerouted": bool(fleet.get("rerouted")),
+    }
 
 
 def _parse_response(body: bytes) -> dict:
     """Extract the LM accounting fields a V1 response attaches per
     prediction (token counts summed, first TTFT + its queue/prefill
-    decomposition); zeros/None otherwise."""
+    decomposition) plus the fleet annotation; zeros/None otherwise."""
     try:
         obj = json.loads(body)
         preds = [p for p in obj.get("predictions", [])
@@ -214,6 +255,7 @@ def _parse_response(body: bytes) -> dict:
                                  for p in preds),
             "cached_tokens": sum(int(p.get("cached_tokens", 0))
                                  for p in preds),
+            **_parse_fleet(obj),
         }
     except (ValueError, TypeError, AttributeError):
         return {}
@@ -231,33 +273,55 @@ def _one_request(url: str, payload: bytes, timeout: float,
                           **_parse_response(body))
     except urllib.error.HTTPError as e:
         # keep the real status — the outcome breakdown needs to tell a
-        # 503 shed from a 504 deadline miss from a genuine 500
+        # 503 shed from a 504 deadline miss from a genuine 500 — and
+        # read the body: a fleet router annotates FAILURES with their
+        # dispatch cost too (a 503 that burned 4 replica attempts must
+        # count toward retry amplification)
+        fleet = {}
+        try:
+            fleet = _parse_fleet(json.loads(e.read() or b"{}"))
+        except (ValueError, TypeError, AttributeError):
+            pass
         return Result(time.monotonic() - t0, e.code,
-                      e.reason or f"HTTP {e.code}")
+                      e.reason or f"HTTP {e.code}", **fleet)
     except Exception as e:  # noqa: BLE001 - goodput counts all failures
         return Result(time.monotonic() - t0, 0, str(e))
 
 
-def run_sync(url: str, payloads: list[bytes], *, timeout: float = 300.0,
+def _norm_urls(url) -> list[str]:
+    """Single-target str, or a list of targets round-robined — the
+    client-side load balancing a naive multi-pod deployment gets (and
+    the baseline arm the fleet router is benchmarked against)."""
+    urls = [url] if isinstance(url, str) else list(url)
+    if not urls:
+        raise ValueError("need at least one target url")
+    return urls
+
+
+def run_sync(url, payloads: list[bytes], *, timeout: float = 300.0,
              headers: Optional[Mapping[str, str]] = None) -> Summary:
+    urls = _norm_urls(url)
     t0 = time.monotonic()
-    results = [_one_request(url, p, timeout, headers) for p in payloads]
+    results = [_one_request(urls[i % len(urls)], p, timeout, headers)
+               for i, p in enumerate(payloads)]
     return Summary(time.monotonic() - t0, results)
 
 
-def run_concurrent(url: str, payloads: list[bytes], *, concurrency: int = 8,
+def run_concurrent(url, payloads: list[bytes], *, concurrency: int = 8,
                    timeout: float = 300.0,
                    headers: Optional[Mapping[str, str]] = None) -> Summary:
     """The async mode: ``concurrency`` in-flight requests until the payload
     list drains (thread pool; stats match the aiohttp original)."""
+    urls = _norm_urls(url)
     t0 = time.monotonic()
     with ThreadPoolExecutor(max_workers=concurrency) as pool:
         results = list(pool.map(
-            lambda p: _one_request(url, p, timeout, headers), payloads))
+            lambda up: _one_request(up[0], up[1], timeout, headers),
+            [(urls[i % len(urls)], p) for i, p in enumerate(payloads)]))
     return Summary(time.monotonic() - t0, results)
 
 
-def run_ramp(url: str, payload_pool: list[bytes], *,
+def run_ramp(url, payload_pool: list[bytes], *,
              stages: list[int], stage_duration: float,
              timeout: float = 300.0,
              headers: Optional[Mapping[str, str]] = None) -> dict:
@@ -268,6 +332,7 @@ def run_ramp(url: str, payload_pool: list[bytes], *,
     throughput/goodput + latency percentiles, so saturation shows up as
     the knee where p90 climbs while goodput flattens."""
     cycle = itertools.cycle(payload_pool)
+    targets = itertools.cycle(_norm_urls(url))
     out = []
     for conc in stages:
         deadline = time.monotonic() + stage_duration
@@ -276,7 +341,8 @@ def run_ramp(url: str, payload_pool: list[bytes], *,
         def worker():
             got = []
             while time.monotonic() < deadline:
-                got.append(_one_request(url, next(cycle), timeout, headers))
+                got.append(_one_request(next(targets), next(cycle),
+                                        timeout, headers))
             return got
 
         t0 = time.monotonic()
@@ -331,7 +397,7 @@ def snapshot_timeline(target_url: str, last: int = 4096,
             for name, entry in dump.get("models", {}).items()}
 
 
-def check_metrics(before: list, after: list, target_url: str,
+def check_metrics(before: list, after: list, target_url,
                   client_count: int,
                   client_responded: Optional[int] = None) -> dict:
     """Client-vs-server bookkeeping cross-check: every request that got
@@ -341,17 +407,26 @@ def check_metrics(before: list, after: list, target_url: str,
     mid-``handle()`` at the after-scrape — or may never have reached
     the server at all — so the delta may exceed ``client_responded``
     but never the total attempted.  ``client_responded=None`` demands
-    exact equality (every request answered — the common case)."""
+    exact equality (every request answered — the common case).
+
+    Multi-target runs pass lists of scrapes (one pair per ``--url``);
+    the server counts are summed — the fleet invariant is that the
+    TARGETS together saw exactly what the client sent."""
     from kubernetes_cloud_tpu import obs
     from kubernetes_cloud_tpu.serve.server import route_label
 
     import urllib.parse
 
+    urls = _norm_urls(target_url)
+    befores = before if isinstance(before[0], list) else [before]
+    afters = after if isinstance(after[0], list) else [after]
     # the server's own vocabulary — one source of truth for the label
-    route = route_label(urllib.parse.urlsplit(target_url).path)
+    route = route_label(urllib.parse.urlsplit(urls[0]).path)
     name = "kct_server_request_seconds_count"
-    server_n = int(obs.sample_value(after, name, {"route": route})
-                   - obs.sample_value(before, name, {"route": route}))
+    server_n = 0
+    for b, a in zip(befores, afters):
+        server_n += int(obs.sample_value(a, name, {"route": route})
+                        - obs.sample_value(b, name, {"route": route}))
     lo = client_count if client_responded is None else client_responded
     return {"route": route, "client_requests": client_count,
             "client_responded": lo,
@@ -402,9 +477,13 @@ def build_payloads(args) -> list[bytes]:
 
 def main(argv=None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--url", default=None,
+    ap.add_argument("--url", action="append", default=None,
                     help="target endpoint (required unless only "
-                         "generating a trace with --trace-out)")
+                         "generating a trace with --trace-out); "
+                         "repeatable — multiple targets are round-"
+                         "robined client-side (the naive multi-pod "
+                         "baseline the fleet router is measured "
+                         "against)")
     ap.add_argument("--requests", type=int, default=100)
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--mode", choices=("async", "sync", "ramp"),
@@ -471,6 +550,7 @@ def main(argv=None) -> dict:
                          "summary (flight-recorder breakdown) in the "
                          "output JSON")
     args = ap.parse_args(argv)
+    urls = args.url or []
 
     headers = None
     if args.deadline_ms is not None:
@@ -491,24 +571,24 @@ def main(argv=None) -> dict:
             out = {"trace": args.trace_out, "requests": len(entries)}
             print(json.dumps(out))
             return out
-        if not args.url:
-            ap.error("--url is required to replay a trace "
-                     "(use --trace-out to only generate one)")
+        if len(urls) != 1:
+            ap.error("trace replay takes exactly one --url "
+                     "(use --trace-out to only generate a trace)")
         stats = trace_mod.replay(
-            args.url, entries, timeout=args.timeout,
+            urls[0], entries, timeout=args.timeout,
             speed=args.trace_speed, headers=headers,
             max_workers=args.trace_workers)
         print(json.dumps(stats))
         return stats
 
-    if not args.url:
+    if not urls:
         ap.error("--url is required")
     payloads = build_payloads(args)
-    before = (scrape_metrics(metrics_endpoint(args.url))
+    before = ([scrape_metrics(metrics_endpoint(u)) for u in urls]
               if args.check_metrics else None)
     if args.mode == "ramp":
         stats = run_ramp(
-            args.url, payloads,
+            urls, payloads,
             stages=[int(s) for s in args.ramp_stages.split(",") if s],
             stage_duration=args.stage_duration, timeout=args.timeout,
             headers=headers)
@@ -519,25 +599,29 @@ def main(argv=None) -> dict:
             s["outcomes"].get("client_timeout", 0)
             + s["outcomes"].get("error", 0) for s in stats["stages"])
     elif args.mode == "sync":
-        summary = run_sync(args.url, payloads, timeout=args.timeout,
+        summary = run_sync(urls, payloads, timeout=args.timeout,
                            headers=headers)
         stats, client_n = summary.stats(), summary.n
         responded = sum(1 for r in summary.results if r.status != 0)
     else:
-        summary = run_concurrent(args.url, payloads,
+        summary = run_concurrent(urls, payloads,
                                  concurrency=args.concurrency,
                                  timeout=args.timeout,
                                  headers=headers)
         stats, client_n = summary.stats(), summary.n
         responded = sum(1 for r in summary.results if r.status != 0)
     if args.check_metrics:
-        after = scrape_metrics(metrics_endpoint(args.url))
+        after = [scrape_metrics(metrics_endpoint(u)) for u in urls]
         stats["metrics_check"] = check_metrics(
-            before, after, args.url, client_n,
+            before, after, urls, client_n,
             client_responded=responded)
     if args.timeline:
         try:
-            stats["timeline"] = snapshot_timeline(args.url)
+            if len(urls) == 1:
+                stats["timeline"] = snapshot_timeline(urls[0])
+            else:
+                stats["timeline"] = {u: snapshot_timeline(u)
+                                     for u in urls}
         except Exception as e:  # noqa: BLE001 - introspection is
             # best-effort: a pod without the debug plane (old build,
             # recorder disabled) must not fail the load test itself

@@ -104,6 +104,8 @@ def route_label(path: str) -> str:
         return "completion"
     if path.endswith(":predict"):
         return "predict"
+    if path.endswith(":cancel"):
+        return "cancel"
     if path.startswith("/v1/models"):
         return "models"
     return "other"
@@ -227,6 +229,10 @@ class ModelServer:
                         "/v1/models/"):
                     name = path[len("/v1/models/"):-len(":predict")]
                     return self._predict(name, payload)
+                if path.endswith(":cancel") and path.startswith(
+                        "/v1/models/"):
+                    name = path[len("/v1/models/"):-len(":cancel")]
+                    return self._cancel(name, payload)
                 if path == "/completion":
                     return self._completion(payload)
                 return 404, {"error": "not found"}
@@ -371,7 +377,10 @@ class ModelServer:
         except DeadlineExceededError as e:  # shed: nobody is waiting
             return 504, {"error": str(e)}
         except RetryableError as e:  # transient overload/restart: retry
-            body = {"error": str(e)}
+            # error_kind = the typed ladder's class name: the fleet
+            # router retries most 503s on another replica but must NOT
+            # launder a TenantQuotaError through a neighbour's bucket
+            body = {"error": str(e), "error_kind": type(e).__name__}
             # tenant-quota sheds carry the bucket's refill estimate —
             # the Retry-After hint a well-behaved client backs off by
             retry_after = getattr(e, "retry_after_s", None)
@@ -389,6 +398,25 @@ class ModelServer:
         if not model.ready:
             return 503, {"error": f"model {name} is not ready"}
         return self._dispatch(model, model.predict, payload, "predict")
+
+    def _cancel(self, name: str, payload: dict) -> tuple[int, dict]:
+        """``POST /v1/models/<name>:cancel {"request_id": ...}`` —
+        cancel an in-flight request by the id the door stamped.  The
+        fleet router's hedge-loser / reroute cleanup path for REMOTE
+        replicas (in-process replicas cancel directly); engines reap
+        the marked request at their next scheduler pass via the
+        existing ``cancel()`` machinery."""
+        model = self.models.get(name)
+        if model is None:
+            return 404, {"error": f"model {name} not found"}
+        fn = getattr(model, "cancel_request", None)
+        if fn is None:
+            return 404, {"error": f"model {name} does not support "
+                                  "cancellation"}
+        # the door stamps a fresh request_id on bodies without one, so
+        # rid always exists; a minted one matches nothing → false
+        rid = payload.get("request_id")
+        return 200, {"cancelled": bool(fn(str(rid)))}
 
     def _completion(self, payload: dict) -> tuple[int, dict]:
         capable = [(n, m) for n, m in self.models.items()

@@ -259,6 +259,72 @@ def parse_tenancy(raw: Optional[Mapping[str, Any]]
         min_batch_progress=int(raw.get("min_batch_progress", 16)))
 
 
+class FleetClock:
+    """Shared per-tenant virtual clocks across the replicas of a fleet
+    (:mod:`kubernetes_cloud_tpu.serve.fleet`).
+
+    PR-9's WFQ fairness is per-engine: each replica's
+    :class:`TenantScheduler` tracks service locally, so a tenant served
+    heavily on replica A still looks freshly arrived to replica B — the
+    router's load balancing would let it collect a fair share *per
+    replica* instead of fleet-wide.  Attaching one ``FleetClock`` to
+    every replica's scheduler (``TenantScheduler.attach_fleet_clock``)
+    makes the virtual clocks — and the no-banked-credit floor — one
+    shared ledger: every charge lands here, every drain-order
+    comparison reads from here, so a tenant's weighted service is
+    equalized across the whole fleet.
+
+    Thread-safety: one small lock; callers are the replicas' scheduler
+    threads (one per engine) plus HTTP submit threads doing the idle
+    lift.  Critical sections are a dict read/write — no blocking calls.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vt: dict[str, float] = {}
+        self._floor = 0.0
+
+    def vt(self, tenant: str) -> float:
+        # deliberately LOCK-FREE: a dict read of a float is GIL-atomic
+        # (the same idiom as the engine's cross-thread vt reads), and
+        # this is every replica's WFQ sort key — taking the fleet lock
+        # O(tenants log tenants) per scheduler pass would convoy all
+        # replicas' hot decode loops on one lock.  Writers still
+        # serialize below.
+        return self._vt.get(tenant, 0.0)
+
+    def advance(self, tenant: str, delta: float) -> float:
+        """Charge ``delta`` weighted service; returns the new clock and
+        raises the fleet floor to it."""
+        with self._lock:
+            v = self._vt.get(tenant, 0.0) + delta
+            self._vt[tenant] = v
+            if v > self._floor:
+                self._floor = v
+            return v
+
+    def lift(self, tenant: str, to: float) -> float:
+        """Monotonic lift (idle re-entry): never moves a clock back."""
+        with self._lock:
+            v = max(self._vt.get(tenant, 0.0), to)
+            self._vt[tenant] = v
+            return v
+
+    def floor(self) -> float:
+        return self._floor  # lock-free float read, like vt()
+
+    def raise_floor(self, v: float) -> None:
+        with self._lock:
+            if v > self._floor:
+                self._floor = v
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"floor": round(self._floor, 3),
+                    "vt": {t: round(v, 3)
+                           for t, v in sorted(self._vt.items())}}
+
+
 class TokenBucket:
     """Monotonic-clock token bucket; thread-safe (admission checks run
     on HTTP threads).  ``rate <= 0`` disables the bucket entirely."""
@@ -369,6 +435,56 @@ class TenantScheduler:
         #: sitting out a quiet period never banks credit against
         #: tenants who worked through it
         self._vt_floor = 0.0
+        #: fleet-wide shared clock (serve/fleet.py); None = standalone
+        #: engine, clocks stay local.  Set via attach_fleet_clock.
+        self.fleet: Optional[FleetClock] = None
+
+    # -- fleet-wide virtual time (serve/fleet.py) --------------------------
+
+    def attach_fleet_clock(self, clock: FleetClock) -> None:
+        """Share virtual clocks (and the no-banked-credit floor) with
+        every other scheduler attached to ``clock``, making WFQ
+        fairness hold fleet-wide instead of per replica.  Idempotent;
+        safe to re-apply after an engine rebuild (the fresh scheduler's
+        zero clocks are lifted to the fleet ledger, never the other
+        way around)."""
+        if self.fleet is clock:
+            return
+        for name, st in self._states.items():
+            clock.lift(name, st.vt)
+        clock.raise_floor(self._vt_floor)
+        self.fleet = clock
+
+    def _vt(self, st: _TenantState) -> float:
+        if self.fleet is not None:
+            return self.fleet.vt(st.spec.name)
+        return st.vt
+
+    def _vt_advance(self, st: _TenantState, delta: float) -> None:
+        if self.fleet is not None:
+            # the mirror keeps snapshot()/debug cheap and lock-local
+            st.vt = self.fleet.advance(st.spec.name, delta)
+        else:
+            st.vt += delta
+            if st.vt > self._vt_floor:
+                self._vt_floor = st.vt
+
+    def _vt_lift(self, st: _TenantState, to: float) -> None:
+        if self.fleet is not None:
+            st.vt = self.fleet.lift(st.spec.name, to)
+        else:
+            st.vt = max(st.vt, to)
+
+    def _floor(self) -> float:
+        if self.fleet is not None:
+            return self.fleet.floor()
+        return self._vt_floor
+
+    def _raise_floor(self, v: float) -> None:
+        if self.fleet is not None:
+            self.fleet.raise_floor(v)
+        elif v > self._vt_floor:
+            self._vt_floor = v
 
     # -- identity / admission (HTTP threads) -------------------------------
 
@@ -439,9 +555,12 @@ class TenantScheduler:
             # credit for time spent away.  With nobody busy, re-enter
             # at the highest clock ever served (the floor): a tenant
             # returning to an idle engine must not drag the fair-share
-            # baseline back to its own ancient clock.
-            busy = [s.vt for s in self._states.values() if s.in_system()]
-            st.vt = max(st.vt, min(busy) if busy else self._vt_floor)
+            # baseline back to its own ancient clock.  With a fleet
+            # clock attached both reads are fleet-wide, so hopping
+            # replicas banks no credit either.
+            busy = [self._vt(s) for s in self._states.values()
+                    if s.in_system()]
+            self._vt_lift(st, min(busy) if busy else self._floor())
         st.queues[req.lane].append(req)
 
     def append_head(self, req: "GenRequest") -> None:
@@ -480,6 +599,15 @@ class TenantScheduler:
             for q in st.queues.values():
                 out.extend(q)
                 q.clear()
+        return out
+
+    def iter_queued(self) -> list:
+        """Flat snapshot of every queued request, no removal (request-
+        phase lookup / cancel-by-id; engine's ``_qlock`` held)."""
+        out: list = []
+        for st in self._states.values():
+            for q in st.queues.values():
+                out.extend(q)
         return out
 
     def purge(self, pred) -> list:
@@ -535,10 +663,10 @@ class TenantScheduler:
         if not cands:
             return None
         total_w = self._busy_weight()
-        cands.sort(key=lambda st: (st.vt, st.spec.name))
+        cands.sort(key=lambda st: (self._vt(st), st.spec.name))
         pick = next((st for st in cands
                      if self._under_quota(st, total_w)), cands[0])
-        self._vt_floor = max(self._vt_floor, pick.vt)
+        self._raise_floor(self._vt(pick))
         for lane in LANES:
             if pick.queues[lane]:
                 req = pick.queues[lane].popleft()
@@ -584,8 +712,7 @@ class TenantScheduler:
 
     def charge_prefill(self, req: "GenRequest", tokens: int) -> None:
         st = self.state(req.tenant)
-        st.vt += tokens / st.spec.weight
-        self._vt_floor = max(self._vt_floor, st.vt)
+        self._vt_advance(st, tokens / st.spec.weight)
         st.m_prefill.inc(tokens)
         st.stats["prefill_tokens"] += tokens
         st.m_admitted[req.lane].inc()
@@ -593,8 +720,7 @@ class TenantScheduler:
 
     def charge_decode(self, req: "GenRequest") -> None:
         st = self.state(req.tenant)
-        st.vt += 1.0 / st.spec.weight
-        self._vt_floor = max(self._vt_floor, st.vt)
+        self._vt_advance(st, 1.0 / st.spec.weight)
         st.m_decode.inc()
         st.stats["decode_tokens"] += 1
 
@@ -621,8 +747,8 @@ class TenantScheduler:
                  and self._under_quota(st, total_w)]
         if not cands:
             return None
-        st = min(cands, key=lambda s: (s.vt, s.spec.name))
-        self._vt_floor = max(self._vt_floor, st.vt)
+        st = min(cands, key=lambda s: (self._vt(s), s.spec.name))
+        self._raise_floor(self._vt(st))
         req = st.queues["interactive"].popleft()
         st.active_slots += 1
         return req
@@ -642,7 +768,7 @@ class TenantScheduler:
             if (len(req.tokens) - req.resume_len
                     < self.cfg.min_batch_progress):
                 continue
-            key = (self.state(req.tenant).vt,
+            key = (self._vt(self.state(req.tenant)),
                    req.admitted_at or 0.0)
             if best_key is None or key > best_key:
                 best, best_key = slot, key
@@ -672,7 +798,7 @@ class TenantScheduler:
                 "queued": {lane: len(st.queues[lane]) for lane in LANES},
                 "active_slots": st.active_slots,
                 "slot_quota": self._quota_slots(st, total_w),
-                "virtual_time": round(st.vt, 3),
+                "virtual_time": round(self._vt(st), 3),
                 **st.stats,
             }
             if self.page_capacity:

@@ -733,6 +733,366 @@ def run_fairness(args, svc) -> int:
     return 0
 
 
+def run_fleet(args, svc) -> int:
+    """--fleet: the availability A/B the acceptance bar names
+    (BENCHMARKS.md "Fleet resilience").  Four scenarios over
+    in-process replicas behind a `FleetRouter`:
+
+    1. **replica-kill MTTR** — under sustained load, one replica's
+       engine is killed (the in-process SIGKILL); clients must see
+       zero errors (retries absorb the blast) and the report times
+       kill → ejection → rebuilt → probed → active again.
+    2. **rolling restart A/B** — the same sustained load over (a) the
+       router running `rolling_restart()` and (b) the naive baseline:
+       N standalone pods with client-side round-robin, restarted one
+       by one with nobody routing around them.  Reports error rate +
+       p95 for both arms.
+    3. **hedged straggler** — one replica answers `--fleet-straggle`
+       seconds late (bench-level injection in front of its routing);
+       the same workload runs with hedging off vs `--fleet-hedge`,
+       reporting the p99 latency win and hedge wins.
+    4. **fleet-wide fairness** — two equal-weight tenants (interactive
+       vs batch flood) through the router with the shared FleetClock;
+       reports the Jain index over fleet-wide weight-normalized
+       service tokens.
+    """
+    import threading
+    import time
+
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingModel,
+        EngineConfig,
+    )
+    from kubernetes_cloud_tpu.serve.errors import EngineRestartedError
+    from kubernetes_cloud_tpu.serve.fleet import (
+        ACTIVE,
+        FleetConfig,
+        FleetRouter,
+        LocalReplica,
+        jain_fairness,
+    )
+    from kubernetes_cloud_tpu.serve.load_test import _one_request
+    from kubernetes_cloud_tpu.serve.server import ModelServer
+    from kubernetes_cloud_tpu.serve.tenancy import (
+        TenancyConfig,
+        TenantSpec,
+    )
+
+    n = args.fleet_replicas
+    dur = args.fleet_duration
+    conc = args.fleet_conc
+
+    def payload(wid, i, max_new=8, n_instances=1):
+        return json.dumps({
+            "instances": [f"fleet bench w{wid} req{i} inst{k}"
+                          for k in range(n_instances)],
+            "parameters": {"max_new_tokens": max_new,
+                           "temperature": 0.0},
+        }).encode()
+
+    class _PodReplica(LocalReplica):
+        """Both arms of the rolling-restart A/B pay the same fixed
+        "pod restart" gap, so the comparison measures ROUTING (drain +
+        transplant + route-around vs clients hitting a dead pod), not
+        how fast an in-process engine rebuilds."""
+
+        def restart(self):
+            for model in self.server.models.values():
+                model.stop()
+            time.sleep(args.fleet_restart_gap)
+            self.server.load_all()
+
+    def build_fleet(hedge=None, tenancy=None, straggle=0.0):
+        fcfg = FleetConfig(
+            probe_interval_s=0.2, dispatch_timeout_s=60.0,
+            hedge_after_s=hedge, heartbeat_stale_s=5.0,
+            retry_budget_ratio=1.0, retry_budget_burst=64.0)
+        replicas = []
+        for i in range(n):
+            m = ContinuousBatchingModel("lm", svc, EngineConfig(
+                slots=args.slots, max_len=args.pool_max_len,
+                tenancy=tenancy))
+            m.load()
+            srv = ModelServer([m], host="127.0.0.1", port=0)
+            if straggle and i == 0:
+                # bench-level straggler: this replica answers late
+                # (slow pod / bad NIC), health and probes untouched
+                orig = srv._route
+
+                def slow_route(method, path, body, headers=None,
+                               _orig=orig):
+                    if method == "POST":
+                        time.sleep(straggle)
+                    return _orig(method, path, body, headers)
+
+                srv._route = slow_route
+            replicas.append(_PodReplica(f"r{i}", srv, fcfg))
+        router = FleetRouter(replicas, fcfg, host="127.0.0.1", port=0)
+        router.start()
+        for r in replicas:  # compile every program pre-clock
+            eng = r.server.models["lm"].engine
+            eng.submit([1, 2, 3], max_new_tokens=2,
+                       temperature=0.0).wait()
+        url = f"http://127.0.0.1:{router.port}/v1/models/lm:predict"
+        for i in range(2 * n):  # warm the router path + workload shape
+            _one_request(url, payload(0, i), 60.0, None)
+        return router, replicas, url
+
+    def closed_loop(url, duration, headers=None, max_new=8,
+                    hook=None, workers=None):
+        """``url`` is a fixed target or a ``(wid, i) -> url`` selector
+        (the naive round-robin arm) — both A/B arms measure under the
+        same client mechanics."""
+        pick = url if callable(url) else (lambda wid, i: url)
+        results, lock = [], threading.Lock()
+        stop = threading.Event()
+
+        def worker(wid):
+            i = 0
+            while not stop.is_set():
+                r = _one_request(pick(wid, i), payload(wid, i, max_new),
+                                 120.0, headers)
+                i += 1
+                with lock:
+                    results.append(r)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(workers or conc)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        try:
+            hook_out = hook(t0) if hook else None
+            while time.monotonic() - t0 < duration:
+                time.sleep(0.02)
+        finally:
+            stop.set()  # a raising hook must not leave workers spinning
+            for t in threads:
+                t.join()
+        return results, hook_out
+
+    def p(results, q, field="latency"):
+        vals = sorted(getattr(r, field) for r in results if r.ok)
+        if not vals:
+            return None
+        return round(vals[min(len(vals) - 1, int(q * len(vals)))], 4)
+
+    def err_rate(results):
+        return round(sum(not r.ok for r in results)
+                     / max(len(results), 1), 4)
+
+    # -- scenario 1: replica-kill MTTR ----------------------------------
+    router, replicas, url = build_fleet()
+
+    def kill_and_recover(t0):
+        time.sleep(1.0)
+        model = replicas[0].server.models["lm"]
+        t_kill = time.monotonic()
+        # the supervisor's abandon idiom: detach FIRST so the rebuild
+        # never waits on the corpse's drain (the in-process SIGKILL)
+        eng, model.engine = model.engine, None
+        eng.abandon(EngineRestartedError("bench: replica SIGKILL"))
+        time.sleep(0.3)  # the "pod restart" gap
+        model.load()  # weights survive in-process
+        while (replicas[0].health.state != ACTIVE
+               and time.monotonic() - t_kill < 30.0):
+            time.sleep(0.01)
+        return {"mttr_s": round(time.monotonic() - t_kill, 3),
+                "recovered": replicas[0].health.state == ACTIVE}
+
+    kill_results, kill_out = closed_loop(url, dur,
+                                         hook=kill_and_recover)
+    kill_stats = dict(router.stats)
+    router.shutdown()
+
+    # -- scenario 2: rolling restart, fleet vs naive --------------------
+    router, replicas, url = build_fleet()
+
+    def do_rolling(t0):
+        time.sleep(1.0)
+        return router.rolling_restart()
+
+    roll_results, roll_report = closed_loop(url, dur, hook=do_rolling)
+    roll_stats = dict(router.stats)
+    router.shutdown()
+
+    # naive baseline: standalone pods, client-side round-robin, nobody
+    # routing around the restarts
+    naive_models, naive_servers, naive_urls = [], [], []
+    for i in range(n):
+        m = ContinuousBatchingModel("lm", svc, EngineConfig(
+            slots=args.slots, max_len=args.pool_max_len))
+        m.load()
+        srv = ModelServer([m], host="127.0.0.1", port=0)
+        srv.start()
+        naive_models.append(m)
+        naive_servers.append(srv)
+        naive_urls.append(
+            f"http://127.0.0.1:{srv.port}/v1/models/lm:predict")
+    for i, u in enumerate(naive_urls):
+        _one_request(u, payload(0, i), 60.0, None)  # warm
+
+    def naive_rollout(t0):
+        time.sleep(1.0)
+        for m in naive_models:  # the same one-at-a-time rollout, with
+            # the same per-pod restart gap the fleet arm pays
+            m.stop()
+            time.sleep(args.fleet_restart_gap)
+            m.load()
+            time.sleep(0.2)
+
+    naive_results, _ = closed_loop(
+        lambda wid, i: naive_urls[i % n], dur, hook=naive_rollout)
+    for srv in naive_servers:
+        srv.stop()
+    for m in naive_models:
+        m.stop()
+
+    # -- scenario 3: hedged straggler -----------------------------------
+    # light load: hedging buys TAIL latency by duplicating work; on a
+    # saturated box the duplicates would steal the cycles they need,
+    # polluting the measurement with compute contention
+    # short generations: the straggler's injected delay must dominate
+    # the compute, or the in-process loser's decode (cancelled too
+    # late to matter, sharing these CPU cores) pollutes the tail
+    hedge_conc = max(2, conc // 2)
+    router, replicas, url = build_fleet(straggle=args.fleet_straggle)
+    plain_results, _ = closed_loop(url, dur, max_new=4,
+                                   workers=hedge_conc)
+    router.shutdown()
+    router, replicas, url = build_fleet(hedge=args.fleet_hedge,
+                                        straggle=args.fleet_straggle)
+    hedged_results, _ = closed_loop(url, dur, max_new=4,
+                                    workers=hedge_conc)
+    hedge_stats = dict(router.stats)
+    router.shutdown()
+
+    # -- scenario 4: fleet-wide fairness --------------------------------
+    # Both tenants share one lane: the lane-preemption QoS story (and
+    # its deliberate resume-overhead asymmetry) is the --fairness
+    # bench's subject; THIS scenario isolates the fleet-wide WFQ
+    # clock — equal weights, very different request shapes, service
+    # must still split evenly ACROSS replicas.
+    tenancy = TenancyConfig(tenants=(
+        TenantSpec("alice", weight=1.0, lane="interactive",
+                   api_keys=("key-alice",)),
+        TenantSpec("bob", weight=1.0, lane="interactive",
+                   api_keys=("key-bob",)),
+    ))
+    router, replicas, url = build_fleet(tenancy=tenancy)
+
+    def tenant_service():
+        out = {"alice": 0.0, "bob": 0.0}
+        for r in replicas:
+            stats = r.server.models["lm"].engine.tenants.stats()
+            for t in out:
+                out[t] += (stats[t]["decode_tokens"]
+                           + stats[t]["prefill_tokens"])
+        return out
+
+    fair_stop = threading.Event()
+
+    # multi-instance payloads keep BOTH tenants saturating (in-flight
+    # sequences >> fleet slots), so the service split is a WFQ
+    # measurement — on an under-contended fleet it would just mirror
+    # demand
+    def tenant_loop(key, max_new):
+        def worker(wid):
+            i = 0
+            while not fair_stop.is_set():
+                _one_request(url, payload(wid, i, max_new,
+                                          n_instances=3),
+                             120.0, {"X-API-Key": key})
+                i += 1
+        return [threading.Thread(target=worker, args=(w,))
+                for w in range(conc)]
+
+    fair_threads = (tenant_loop("key-alice", 8)
+                    + tenant_loop("key-bob", 32))
+    # both tenants enter lifted to the current fleet floor (the warm
+    # requests ran as "default"); subtracting it leaves each tenant's
+    # own weighted service
+    floor0 = router.clock.floor()
+    for t in fair_threads:
+        t.start()
+    # let both tenants saturate AND the shared clocks converge before
+    # the window opens (the first second's admission order is noise
+    # WFQ then spends paying back)
+    time.sleep(3.0)
+    before = tenant_service()
+    time.sleep(dur)
+    after = tenant_service()
+    fair_stop.set()
+    for t in fair_threads:
+        t.join()
+    served = {t: after[t] - before[t] for t in ("alice", "bob")}
+    window_jain = jain_fairness([served["alice"], served["bob"]])
+    clock_snapshot = router.clock.snapshot()
+    # the headline is CUMULATIVE weighted service over the whole busy
+    # period (the VTC guarantee: backlogged tenants' clocks track) —
+    # the windowed split additionally shows payback dynamics after an
+    # uneven admission start
+    fleet_jain = jain_fairness(
+        [router.clock.vt(t) - floor0 for t in ("alice", "bob")])
+    router.shutdown()
+
+    record = {
+        "metric": "serving_fleet_mttr_s",
+        "value": kill_out["mttr_s"],
+        "unit": "s",
+        "replicas": n,
+        "slots": args.slots,
+        "window_s": dur,
+        "replica_kill": {
+            **kill_out,
+            "requests": len(kill_results),
+            "error_rate": err_rate(kill_results),
+            "retried_ok": sum(r.retried_ok for r in kill_results),
+            "p95_s": p(kill_results, 0.95),
+            "router": {k: kill_stats[k] for k in
+                       ("retries", "retried_ok", "unplaceable")},
+        },
+        "rolling_restart": {
+            "fleet": {
+                "requests": len(roll_results),
+                "error_rate": err_rate(roll_results),
+                "p95_s": p(roll_results, 0.95),
+                "transplanted": roll_stats["transplanted"],
+                "retried_ok": roll_stats["retried_ok"],
+                "completed": roll_report["completed"],
+            },
+            "naive_round_robin": {
+                "requests": len(naive_results),
+                "error_rate": err_rate(naive_results),
+                "p95_s": p(naive_results, 0.95),
+            },
+        },
+        "hedging": {
+            "straggle_s": args.fleet_straggle,
+            "hedge_after_s": args.fleet_hedge,
+            "off_p50_s": p(plain_results, 0.50),
+            "off_p99_s": p(plain_results, 0.99),
+            "on_p50_s": p(hedged_results, 0.50),
+            "on_p99_s": p(hedged_results, 0.99),
+            "hedges": hedge_stats["hedges"],
+            "hedge_wins": hedge_stats["hedge_wins"],
+        },
+        "fairness": {
+            "window_service_tokens": {t: round(v)
+                                      for t, v in served.items()},
+            "window_jain": round(window_jain, 4),
+            "fleet_jain": round(fleet_jain, 4),
+            "clock": clock_snapshot,
+        },
+    }
+    off, on = (record["hedging"]["off_p99_s"],
+               record["hedging"]["on_p99_s"])
+    if off and on:
+        record["hedging"]["p99_ratio"] = round(on / off, 3)
+    print(json.dumps(record))
+    return 0
+
+
 def main(argv=None) -> int:
     from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
     from kubernetes_cloud_tpu.serve.batcher import BatcherConfig, BatchingModel
@@ -804,6 +1164,28 @@ def main(argv=None) -> int:
     ap.add_argument("--fairness-overload", type=int, default=10,
                     help="fairness mode: greedy flooder concurrency = "
                          "this x the interactive concurrency")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet availability A/B: replica-kill MTTR, "
+                         "rolling-restart error rate + p95 vs a naive "
+                         "client-side round-robin baseline, hedging "
+                         "p99 on an induced straggler, and the fleet-"
+                         "wide Jain fairness index (BENCHMARKS.md "
+                         "'Fleet resilience')")
+    ap.add_argument("--fleet-replicas", type=int, default=3,
+                    help="fleet mode: in-process replica count")
+    ap.add_argument("--fleet-duration", type=float, default=6.0,
+                    help="fleet mode: measured window seconds per "
+                         "scenario")
+    ap.add_argument("--fleet-conc", type=int, default=4,
+                    help="fleet mode: closed-loop client concurrency")
+    ap.add_argument("--fleet-restart-gap", type=float, default=0.3,
+                    help="fleet mode: fixed per-pod restart outage "
+                         "both rolling-restart arms pay")
+    ap.add_argument("--fleet-straggle", type=float, default=0.25,
+                    help="fleet mode: induced straggler delay for the "
+                         "hedging A/B")
+    ap.add_argument("--fleet-hedge", type=float, default=0.05,
+                    help="fleet mode: hedge_after_s for the hedged arm")
     ap.add_argument("--inject", choices=("hang", "crash"), default=None,
                     help="recovery mode: wedge (hang) or crash the "
                          "decode loop and measure supervisor recovery "
@@ -830,6 +1212,9 @@ def main(argv=None) -> int:
 
     if args.fairness:
         return run_fairness(args, svc)
+
+    if args.fleet:
+        return run_fleet(args, svc)
 
     if args.paged:
         return run_paged_comparison(args, svc, pool, stages)

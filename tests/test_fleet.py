@@ -1,0 +1,720 @@
+"""Fleet-router unit suite: health state machine, retry budget,
+least-loaded dispatch, hedging, rolling restart — over scripted fake
+replicas, so every transition is deterministic and jax-free.  The
+real-engine acceptance scenarios (SIGKILL mid-stream, hung-replica
+ejection/recovery, rolling restart under load) live in
+tests/test_fleet_chaos.py.
+"""
+
+import json
+import threading
+import time
+import types
+
+import pytest
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.serve import fleet as fleet_mod
+from kubernetes_cloud_tpu.serve.errors import (
+    ReplicaUnavailableError,
+    TenantQuotaError,
+)
+from kubernetes_cloud_tpu.serve.fleet import (
+    ACTIVE,
+    DRAINING,
+    EJECTED,
+    HALF_OPEN,
+    FleetConfig,
+    FleetRouter,
+    Replica,
+    ReplicaHealth,
+    RetryBudget,
+    _probe_healthy,
+    jain_fairness,
+)
+from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.server import ModelServer
+from kubernetes_cloud_tpu.serve.tenancy import (
+    FleetClock,
+    TenancyConfig,
+    TenantScheduler,
+    TenantSpec,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+class FakeReplica(Replica):
+    """Scripted replica: pops canned (status, obj) responses (or
+    raises canned exceptions); records calls and cancels."""
+
+    restartable = True
+
+    def __init__(self, rid, cfg, responses=None, weight=1.0):
+        super().__init__(rid, cfg, weight=weight)
+        self.responses = list(responses or [])
+        self.default = (200, {"predictions": [{"generated_text": rid}]})
+        self.calls = []
+        self.cancelled = []
+        self.phase = None
+        self.delay = 0.0
+        self.restarted = 0
+        self.probe_result = (200, {"status": "ready", "models": {
+            "lm": {"ok": True, "queue_depth": 0,
+                   "heartbeat_age_s": 0.01}}})
+
+    def call(self, method, path, body, headers=None):
+        self.calls.append((method, path))
+        if self.delay:
+            time.sleep(self.delay)
+        item = (self.responses.pop(0) if self.responses
+                else self.default)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def probe(self, timeout):
+        if isinstance(self.probe_result, Exception):
+            raise self.probe_result
+        return self.probe_result
+
+    def request_phase(self, request_id):
+        return self.phase
+
+    def cancel(self, request_id):
+        self.cancelled.append(request_id)
+
+    def model_names(self):
+        return ["lm"]
+
+    def restart(self):
+        self.restarted += 1
+
+
+def make_router(n=2, cfg=None, **replica_kw):
+    cfg = cfg or FleetConfig(dispatch_timeout_s=5.0)
+    reps = [FakeReplica(f"r{i}", cfg, **replica_kw) for i in range(n)]
+    return FleetRouter(reps, cfg, host="127.0.0.1", port=0), reps
+
+
+# ---------------------------------------------------------------------------
+# retry budget
+# ---------------------------------------------------------------------------
+
+def test_retry_budget_caps_at_burst_and_ratio():
+    b = RetryBudget(ratio=0.5, burst=2.0)
+    assert b.try_take() and b.try_take()  # the cold-start allowance
+    assert not b.try_take()               # drained
+    b.deposit()                           # +0.5: still below one token
+    assert not b.try_take()
+    b.deposit()                           # +0.5 → 1.0
+    assert b.try_take()
+    for _ in range(100):                  # deposits cap at burst
+        b.deposit()
+    assert b.level == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# health state machine
+# ---------------------------------------------------------------------------
+
+def _health(**kw):
+    return ReplicaHealth("r0", FleetConfig(**kw))
+
+
+def test_probe_failures_eject_then_half_open_then_trial_recovers():
+    h = _health(probe_fail_threshold=2)
+    assert h.note_probe(False) is None
+    assert h.note_probe(False) == "probe"
+    assert h.state == EJECTED
+    # probes keep running while ejected; a success opens the half-door
+    assert h.note_probe(True, queue_depth=3) == "half_open"
+    assert h.state == HALF_OPEN
+    # exactly one concurrent trial
+    assert h.begin_dispatch() is True
+    assert h.begin_dispatch() is None
+    assert h.note_result(True, trial=True) == "recovered"
+    assert h.state == ACTIVE
+
+
+def test_half_open_trial_failure_re_ejects():
+    h = _health(probe_fail_threshold=1)
+    h.note_probe(False)
+    h.note_probe(True)
+    assert h.begin_dispatch() is True
+    assert h.note_result(False, trial=True) == "trial"
+    assert h.state == EJECTED
+
+
+def test_passive_error_ewma_ejects():
+    h = _health(min_samples=3, error_ewma_eject=0.5,
+                error_ewma_alpha=0.5)
+    assert h.note_result(False) is None  # below min_samples
+    assert h.note_result(False) is None
+    assert h.note_result(False) == "errors"
+    assert h.state == EJECTED
+
+
+def test_consecutive_timeouts_eject_and_success_resets():
+    h = _health(timeout_eject=2)
+    assert h.note_result(False, timeout=True) is None
+    assert h.note_result(True) is None  # success breaks the streak
+    assert h.note_result(False, timeout=True) is None
+    assert h.note_result(False, timeout=True) == "timeouts"
+    assert h.state == EJECTED
+
+
+def test_probe_healthy_reads_heartbeat_and_depth():
+    body = {"models": {"a": {"ok": True, "queue_depth": 2,
+                             "heartbeat_age_s": 0.1},
+                       "b": {"ok": True, "queue_depth": 3,
+                             "heartbeat_age_s": 0.2}}}
+    ok, depth, age = _probe_healthy(200, body, stale_s=10.0)
+    assert ok and depth == 5 and age == pytest.approx(0.2)
+    # HTTP 200 with a stale heartbeat is a HUNG pod, not a healthy one
+    body["models"]["b"]["heartbeat_age_s"] = 99.0
+    ok, _, age = _probe_healthy(200, body, stale_s=10.0)
+    assert not ok and age == pytest.approx(99.0)
+    assert _probe_healthy(503, {}, 10.0)[0] is False
+
+
+# ---------------------------------------------------------------------------
+# dispatch / retry / reroute
+# ---------------------------------------------------------------------------
+
+def test_dispatch_annotates_response():
+    router, reps = make_router(2)
+    status, obj = router._predict("lm", {"request_id": "x",
+                                         "instances": ["hi"]})
+    assert status == 200
+    assert obj["fleet"]["dispatches"] == 1
+    assert obj["fleet"]["retried_ok"] is False
+    assert obj["fleet"]["replica"] in ("r0", "r1")
+
+
+def test_retry_on_typed_503_succeeds_on_peer():
+    router, reps = make_router(2)
+    reps[0].responses = [(503, {"error": "full",
+                                "error_kind": "QueueFullError"})]
+    reps[1].responses = [(200, {"predictions": [{"generated_text":
+                                                 "peer"}]})]
+    # force the pick order: r0 looks freer
+    reps[1].health.queue_depth = 5
+    status, obj = router._predict("lm", {"request_id": "x"})
+    assert status == 200
+    assert obj["fleet"]["retried_ok"] is True
+    assert obj["fleet"]["dispatches"] == 2
+    assert router.stats["retried_ok"] == 1
+
+
+def test_500_and_tenant_quota_503_never_retry():
+    router, reps = make_router(2)
+    reps[0].responses = [(500, {"error": "boom"})]
+    reps[1].health.queue_depth = 5
+    status, obj = router._predict("lm", {"request_id": "x"})
+    assert status == 500 and obj["fleet"]["dispatches"] == 1
+
+    router2, reps2 = make_router(2)
+    reps2[0].responses = [(503, {"error": "quota",
+                                 "error_kind": "TenantQuotaError"})]
+    reps2[1].health.queue_depth = 5
+    status, obj = router2._predict("lm", {"request_id": "x"})
+    assert status == 503 and obj["error_kind"] == "TenantQuotaError"
+    assert obj["fleet"]["dispatches"] == 1
+    assert reps2[1].calls == []  # quota sheds must not hop replicas
+
+
+def test_retry_budget_exhaustion_stops_retrying():
+    cfg = FleetConfig(dispatch_timeout_s=5.0, retry_budget_ratio=0.0,
+                      retry_budget_burst=1.0, max_retries=5)
+    router, reps = make_router(3, cfg=cfg)
+    err = (503, {"error": "full", "error_kind": "QueueFullError"})
+    reps[0].responses = [err, err]
+    reps[1].responses = [err, err]
+    reps[2].responses = [err, err]
+    # first request: one retry allowed (burst), then budget dry
+    status, obj = router._predict("lm", {"request_id": "a"})
+    assert status == 503
+    assert router.stats["retries"] == 1
+    assert router.stats["retry_budget_exhausted"] == 1
+    # second request: no budget at all
+    status, obj = router._predict("lm", {"request_id": "b"})
+    assert status == 503 and obj["fleet"]["dispatches"] == 1
+    assert router.stats["retry_budget_exhausted"] == 2
+
+
+def test_transport_failure_maps_to_retryable_503():
+    cfg = FleetConfig(dispatch_timeout_s=5.0, max_retries=0)
+    router, reps = make_router(1, cfg=cfg)
+    reps[0].responses = [OSError("connection refused")]
+    status, obj = router._predict("lm", {"request_id": "x"})
+    assert status == 503
+    assert obj["error_kind"] == "ReplicaUnavailableError"
+
+
+def test_unplaceable_when_all_ejected_is_typed_503():
+    router, reps = make_router(2)
+    for r in reps:
+        r.health.note_probe(False)
+        r.health.note_probe(False)
+        r.health.note_probe(False)
+        assert r.health.state == EJECTED
+    status, obj = router._predict("lm", {"request_id": "x"})
+    assert status == 503
+    assert obj["error_kind"] == "ReplicaUnavailableError"
+    assert "retry_after_s" in obj
+    assert router.stats["unplaceable"] == 1
+    # and over the HTTP routing layer (shared handle()):
+    status, obj = router.handle(
+        "POST", "/v1/models/lm:predict",
+        json.dumps({"instances": ["x"]}).encode(), None)
+    assert status == 503 and obj["error_kind"] == "ReplicaUnavailableError"
+
+
+def test_least_loaded_pick_and_rerouted_flag():
+    router, reps = make_router(3)
+    reps[0].health.queue_depth = 9
+    reps[1].health.queue_depth = 1
+    reps[2].health.queue_depth = 4
+    status, obj = router._predict("lm", {"request_id": "x"})
+    assert obj["fleet"]["replica"] == "r1"
+    assert obj["fleet"]["rerouted"] is False
+    # eject the freest replica: dispatch skips it and says so
+    reps[1].health.note_probe(False)
+    reps[1].health.note_probe(False)
+    reps[1].health.note_probe(False)
+    status, obj = router._predict("lm", {"request_id": "y"})
+    assert obj["fleet"]["replica"] == "r2"
+    assert obj["fleet"]["rerouted"] is True
+    assert router.stats["rerouted"] == 1
+
+
+def test_weight_scales_load_score():
+    cfg = FleetConfig()
+    heavy = FakeReplica("big", cfg, weight=4.0)
+    light = FakeReplica("small", cfg, weight=1.0)
+    heavy.health.queue_depth = 4
+    light.health.queue_depth = 2
+    assert heavy.load_score() < light.load_score()
+
+
+# ---------------------------------------------------------------------------
+# hedging
+# ---------------------------------------------------------------------------
+
+def test_hedge_wins_and_cancels_slow_primary():
+    cfg = FleetConfig(dispatch_timeout_s=5.0, hedge_after_s=0.05)
+    router, reps = make_router(2, cfg=cfg)
+    reps[0].delay = 1.0
+    reps[0].phase = "queued"  # still queued-not-admitted: hedgeable
+    reps[1].health.queue_depth = 1  # primary pick is r0
+    status, obj = router._predict("lm", {"request_id": "rid-h"})
+    assert status == 200
+    assert obj["fleet"]["replica"] == "r1"  # annotated with the winner's
+    # fleet view: the response body came from the hedge replica
+    assert obj["predictions"][0]["generated_text"] == "r1"
+    assert obj["fleet"]["hedged"] and obj["fleet"]["hedge_win"]
+    assert obj["fleet"]["dispatches"] == 2
+    assert "rid-h" in reps[0].cancelled  # loser cancelled via cancel()
+    assert router.stats["hedge_wins"] == 1
+
+
+def test_no_hedge_once_request_is_decoding():
+    cfg = FleetConfig(dispatch_timeout_s=5.0, hedge_after_s=0.05)
+    router, reps = make_router(2, cfg=cfg)
+    reps[0].delay = 0.3
+    reps[0].phase = "active"  # tokens are being paid for: never mirror
+    reps[1].health.queue_depth = 1
+    status, obj = router._predict("lm", {"request_id": "rid-a"})
+    assert status == 200
+    assert obj["fleet"]["hedged"] is False
+    assert reps[1].calls == []
+    assert router.stats["hedges"] == 0
+
+
+def test_hung_replica_times_out_ejects_and_retry_succeeds():
+    cfg = FleetConfig(dispatch_timeout_s=0.2, timeout_eject=1)
+    router, reps = make_router(2, cfg=cfg)
+    reps[0].delay = 2.0  # hung: never answers inside the timeout
+    reps[1].health.queue_depth = 1
+    status, obj = router._predict("lm", {"request_id": "rid-t"})
+    assert status == 200
+    assert obj["fleet"]["replica"] == "r1"
+    assert obj["fleet"]["retried_ok"] is True
+    assert reps[0].health.state == EJECTED
+    assert reps[0].cancelled == ["rid-t"]  # orphan cleanup
+
+
+def test_unplaceable_mid_retry_keeps_fleet_annotation():
+    """Candidates running out mid-retry must return the annotated last
+    failure — a 503 that burned dispatches cannot read as zero cost."""
+    router, reps = make_router(2)
+    err = (503, {"error": "full", "error_kind": "QueueFullError"})
+    reps[0].responses = [err]
+    for _ in range(3):
+        reps[1].health.note_probe(False)
+    assert reps[1].health.state == EJECTED
+    status, obj = router._predict("lm", {"request_id": "x"})
+    assert status == 503
+    assert obj["fleet"]["dispatches"] == 1
+    assert obj["fleet"]["retries"] == 1  # budget charged, nobody left
+    assert router.stats["unplaceable"] == 1
+
+
+def test_hedge_win_releases_losing_trial_claim():
+    """A half-open primary losing its hedge race gets its trial claim
+    back — a leaked claim would park the replica in half_open forever
+    (no traffic, healthy probes never resetting it)."""
+    cfg = FleetConfig(dispatch_timeout_s=5.0, hedge_after_s=0.05,
+                      probe_fail_threshold=1)
+    router, reps = make_router(2, cfg=cfg)
+    reps[0].health.note_probe(False)
+    reps[0].health.note_probe(True)
+    assert reps[0].health.state == HALF_OPEN
+    reps[0].delay = 0.5
+    reps[0].phase = "queued"
+    status, obj = router._predict("lm", {"request_id": "rid-trial"})
+    assert status == 200 and obj["fleet"]["hedge_win"] is True
+    assert reps[0].health.state == HALF_OPEN  # aborted, not failed
+    assert reps[0].health.trial_inflight is False
+    # the replica can still run its (real) trial afterwards
+    assert reps[0].health.begin_dispatch() is True
+
+
+def test_failed_hedge_replica_excluded_from_retry():
+    """A hedge replica that just failed is as tried as the primary:
+    the retry must land on a third replica, and the failure body is
+    attributed to the replica that actually produced it."""
+    cfg = FleetConfig(dispatch_timeout_s=5.0, hedge_after_s=0.05)
+    router, reps = make_router(3, cfg=cfg)
+    err = (503, {"error": "full", "error_kind": "QueueFullError"})
+    reps[0].delay = 0.4
+    reps[0].phase = "queued"
+    reps[0].responses = [err]
+    reps[1].responses = [err]
+    reps[2].health.queue_depth = 1  # r0 primary, r1 hedge, r2 last
+    status, obj = router._predict("lm", {"request_id": "rid-x"})
+    assert status == 200
+    assert obj["fleet"]["replica"] == "r2"
+    assert obj["fleet"]["retried_ok"] is True
+    assert len(reps[1].calls) == 1  # the failed hedge was not retried
+
+
+def test_timed_out_hedge_replica_excluded_from_retry():
+    """A hedge replica still pending at the dispatch deadline is as
+    tried as the primary: the retry must not burn another full
+    timeout on a replica that just hung."""
+    cfg = FleetConfig(dispatch_timeout_s=0.2, hedge_after_s=0.05)
+    router, reps = make_router(3, cfg=cfg)
+    reps[0].delay = 2.0  # primary: hung
+    reps[0].phase = "queued"
+    reps[1].delay = 2.0  # hedge: also hung
+    reps[2].health.queue_depth = 5  # worst score: only reachable once
+    # the hung pair is excluded
+    status, obj = router._predict("lm", {"request_id": "rid-to"})
+    assert status == 200
+    assert obj["fleet"]["replica"] == "r2"
+    assert len(reps[1].calls) == 1  # the hung hedge was not re-picked
+
+
+def test_transplant_unplaceable_fails_request_with_closed_stream():
+    """With no peer serving the model, a transplant failure must close
+    the token stream (the engines' failure idiom) — a streaming
+    consumer gets its retryable error now, not a stream timeout."""
+    import queue as _q
+
+    from kubernetes_cloud_tpu.serve.continuous import _STREAM_END
+
+    cfg = FleetConfig()
+
+    class DrainReplica(FakeReplica):
+        def __init__(self, rid, cfg, req):
+            super().__init__(rid, cfg)
+            self._req = req
+
+        def extract_queued(self):
+            return [("lm", [self._req])]
+
+    req = types.SimpleNamespace(stream=_q.SimpleQueue(),
+                                event=threading.Event(), error=None,
+                                request_id="t-1")
+    rep = DrainReplica("r0", cfg, req)
+    router = FleetRouter([rep], cfg)
+    assert router._transplant_from(rep) == 0
+    assert isinstance(req.error, ReplicaUnavailableError)
+    assert req.event.is_set()
+    assert req.stream.get_nowait() is _STREAM_END
+
+
+# ---------------------------------------------------------------------------
+# read plane + rolling restart
+# ---------------------------------------------------------------------------
+
+def test_readyz_aggregates_and_lists_models():
+    router, reps = make_router(2)
+    status, obj = router.handle("GET", "/readyz", b"", None)
+    assert status == 200 and obj["fleet"] is True
+    assert set(obj["replicas"]) == {"r0", "r1"}
+    status, obj = router.handle("GET", "/v1/models", b"", None)
+    assert status == 200 and obj["models"] == ["lm"]
+    status, obj = router.handle("GET", "/v1/models/lm", b"", None)
+    assert status == 200 and obj["ready"] is True
+    for r in reps:
+        for _ in range(3):
+            r.health.note_probe(False)
+    status, obj = router.handle("GET", "/readyz", b"", None)
+    assert status == 503 and obj["status"] == "unready"
+
+
+def test_probe_now_updates_health_and_ejects_on_fault():
+    router, reps = make_router(2)
+    reps[0].probe_result = (200, {"status": "ready", "models": {
+        "lm": {"ok": True, "queue_depth": 7, "heartbeat_age_s": 0.1}}})
+    router.probe_now()
+    assert reps[0].health.queue_depth == 7
+    # an injected probe fault reads as a failed probe (containment:
+    # data, not a crashed prober)
+    with faults.inject(faults.FaultSpec("fleet.probe", times=-1)):
+        for _ in range(3):
+            router.probe_now()
+    assert reps[0].health.state == EJECTED
+    assert reps[1].health.state == EJECTED
+
+
+def test_rolling_restart_sweeps_and_reinstates():
+    router, reps = make_router(3)
+    out = router.rolling_restart()
+    assert out["completed"] is True
+    assert [r.restarted for r in reps] == [1, 1, 1]
+    assert all(r.health.state == ACTIVE for r in reps)
+    assert router.stats["rolling_restarts"] == 1
+
+
+def test_rolling_restart_halts_when_replica_stays_sick():
+    router, reps = make_router(3)
+    reps[1].probe_result = (503, {})
+    router.cfg = FleetConfig(restart_probe_timeout_s=0.2)
+    out = router.rolling_restart()
+    assert out["completed"] is False
+    assert reps[2].restarted == 0  # the sweep stopped at the sick one
+    assert reps[1].health.state != ACTIVE
+
+
+def test_draining_replica_takes_no_traffic():
+    router, reps = make_router(2)
+    reps[0].health.begin_drain()
+    assert reps[0].health.state == DRAINING
+    for i in range(4):
+        _, obj = router._predict("lm", {"request_id": f"q{i}"})
+        assert obj["fleet"]["replica"] == "r1"
+    assert reps[0].calls == []
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide virtual clock
+# ---------------------------------------------------------------------------
+
+def _sched(model):
+    cfg = TenancyConfig(tenants=(TenantSpec("a", weight=1.0),
+                                 TenantSpec("b", weight=1.0)))
+    return TenantScheduler(cfg, slots=4, model=model)
+
+
+def _req(tenant, lane="interactive"):
+    return types.SimpleNamespace(tenant=tenant, lane=lane,
+                                 pinned_pages=None)
+
+
+def test_fleet_clock_orders_across_replicas():
+    clock = FleetClock()
+    s1, s2 = _sched("m1"), _sched("m2")
+    s1.attach_fleet_clock(clock)
+    s2.attach_fleet_clock(clock)
+    # both tenants enter the fleet together (clocks 0), then "a"
+    # consumes heavily on replica 1 while "b" works lightly on 2
+    ra, rb = _req("a"), _req("b")
+    s1.append(ra)
+    s2.append(rb)
+    assert s1.pop_next() is ra
+    s1.charge_prefill(ra, 1000)
+    assert s2.pop_next() is rb
+    s2.charge_prefill(rb, 10)
+    # both tenants now queue on replica 2 (b never left the system):
+    # "b" must drain first — "a" already collected 1000 weighted
+    # tokens FLEET-wide, even though replica 2 never served it.
+    # Without the shared clock, replica 2 would see "a" at local 0
+    # and let it double-dip.
+    qa, qb = _req("a"), _req("b")
+    s2.append(qa)
+    s2.append(qb)
+    assert s2.pop_next() is qb
+    assert clock.vt("a") == pytest.approx(1000.0)
+
+
+def test_fleet_clock_floor_blocks_idle_credit_across_replicas():
+    clock = FleetClock()
+    s1, s2 = _sched("m1"), _sched("m2")
+    s1.attach_fleet_clock(clock)
+    s2.attach_fleet_clock(clock)
+    ra = _req("a")
+    s1.append(ra)
+    s1.pop_next()
+    s1.charge_prefill(ra, 500)
+    s1.note_finished(ra)
+    # "a" hops to an idle replica 2: its clock must NOT reset — the
+    # fleet floor lifts it to the highest service ever delivered
+    ra2 = _req("a")
+    s2.append(ra2)
+    assert clock.vt("a") >= 500.0
+
+
+def test_attach_is_idempotent_and_seeds_from_local():
+    clock = FleetClock()
+    s1 = _sched("m1")
+    ra = _req("a")
+    s1.append(ra)
+    s1.pop_next()
+    s1.charge_prefill(ra, 42)  # pre-attach local service
+    s1.attach_fleet_clock(clock)
+    s1.attach_fleet_clock(clock)
+    assert clock.vt("a") == pytest.approx(42.0)
+
+
+def test_jain_fairness_index():
+    assert jain_fairness([1, 1, 1]) == pytest.approx(1.0)
+    assert jain_fairness([1, 0, 0]) == pytest.approx(1 / 3)
+    assert jain_fairness([]) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# load_test fleet accounting
+# ---------------------------------------------------------------------------
+
+def test_load_test_parses_and_sums_fleet_accounting():
+    from kubernetes_cloud_tpu.serve.load_test import (
+        Result,
+        Summary,
+        _parse_response,
+    )
+
+    body = json.dumps({
+        "predictions": [{"generated_text": "x", "tokens_out": 3}],
+        "fleet": {"replica": "r1", "retries": 1, "dispatches": 3,
+                  "retried_ok": True, "hedged": True,
+                  "hedge_win": True, "rerouted": False},
+    }).encode()
+    parsed = _parse_response(body)
+    assert parsed["fleet_dispatches"] == 3
+    assert parsed["retried_ok"] and parsed["hedge_win"]
+    assert not parsed["rerouted"]
+
+    results = [
+        Result(0.1, 200, tokens_out=3, fleet_dispatches=3,
+               retried_ok=True, hedge_win=True),
+        Result(0.1, 200, tokens_out=3, fleet_dispatches=1),
+        # a failed request's dispatch cost counts too (the router
+        # annotates failure bodies)
+        Result(0.1, 503, "shed", fleet_dispatches=4),
+    ]
+    stats = Summary(1.0, results).stats()
+    fleet = stats["fleet"]
+    assert fleet["dispatches_total"] == 8
+    assert fleet["retried_ok"] == 1
+    assert fleet["hedge_win"] == 1
+    assert fleet["retry_amplification"] == pytest.approx(8 / 3, abs=1e-3)
+    # non-fleet runs stay byte-identical: no fleet key at all
+    plain = Summary(1.0, [Result(0.1, 200)]).stats()
+    assert "fleet" not in plain
+
+
+def test_load_test_multi_url_round_robins():
+    from kubernetes_cloud_tpu.serve import load_test as lt
+
+    seen = []
+    orig = lt._one_request
+
+    def fake(url, payload, timeout, headers=None):
+        seen.append(url)
+        return lt.Result(0.01, 200)
+
+    lt._one_request = fake
+    try:
+        lt.run_sync(["http://a/predict", "http://b/predict"],
+                    [b"{}"] * 4, timeout=1.0)
+    finally:
+        lt._one_request = orig
+    assert seen == ["http://a/predict", "http://b/predict"] * 2
+
+
+# ---------------------------------------------------------------------------
+# ReplicaUnavailableError parity (stdlib + native front-ends)
+# ---------------------------------------------------------------------------
+
+class _UnavailableModel(Model):
+    def __init__(self):
+        super().__init__("lm")
+        self.ready = True
+
+    def predict(self, payload):
+        raise ReplicaUnavailableError("fleet has no replica; retry",
+                                      retry_after_s=1.5)
+
+
+def test_replica_unavailable_maps_503_stdlib():
+    server = ModelServer([_UnavailableModel()], host="127.0.0.1",
+                         port=0)
+    status, obj = server.handle(
+        "POST", "/v1/models/lm:predict",
+        json.dumps({"instances": ["x"]}).encode(), None)
+    assert status == 503
+    assert obj["error_kind"] == "ReplicaUnavailableError"
+    assert obj["retry_after_s"] == pytest.approx(1.5)
+
+
+def test_replica_unavailable_maps_503_native_parity():
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_cloud_tpu.serve import native_server
+
+    if not native_server.available():
+        pytest.skip("no C++ toolchain for the native front-end")
+    server = native_server.NativeModelServer(
+        [_UnavailableModel()], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/models/lm:predict",
+            data=json.dumps({"instances": ["x"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 503
+        body = json.loads(e.value.read())
+        assert body["error_kind"] == "ReplicaUnavailableError"
+        assert body["retry_after_s"] == pytest.approx(1.5)
+    finally:
+        server.stop()
+
+
+def test_quota_error_still_types_its_kind():
+    class _QuotaModel(Model):
+        def __init__(self):
+            super().__init__("lm")
+            self.ready = True
+
+        def predict(self, payload):
+            raise TenantQuotaError("tenant dry", retry_after_s=0.25)
+
+    server = ModelServer([_QuotaModel()], host="127.0.0.1", port=0)
+    status, obj = server.handle(
+        "POST", "/v1/models/lm:predict",
+        json.dumps({"instances": ["x"]}).encode(), None)
+    assert status == 503 and obj["error_kind"] == "TenantQuotaError"

@@ -1,0 +1,459 @@
+"""Fleet-router chaos: the ISSUE's acceptance scenarios over real
+engines.
+
+* a replica SIGKILLed mid-stream → the request completes via retry on
+  a peer, token-identical to one-shot greedy ``generate``;
+* a hung replica is ejected (dispatch timeout + stale-heartbeat probe)
+  and recovered through the half-open trial once the hang releases;
+* a 3-replica rolling restart under sustained load finishes with ZERO
+  failed requests (queued work transplanted through the router);
+* ``fleet.dispatch`` / ``fleet.probe`` hold the raise/hang containment
+  contract.
+
+Deterministic throughout: the injector fires on exact hit counts, and
+the router's pick order is pinned by probing/queue-depth state — never
+timing dice.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.models.generate import generate
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingModel,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.errors import EngineRestartedError
+from kubernetes_cloud_tpu.serve.fleet import (
+    ACTIVE,
+    EJECTED,
+    HALF_OPEN,
+    FleetConfig,
+    FleetRouter,
+    LocalReplica,
+)
+from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+pytestmark = [pytest.mark.chaos, pytest.mark.fleet]
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def service(params):
+    svc = CausalLMService("lm", CFG, params=params, dtype=jnp.float32)
+    svc.load()
+    return svc
+
+
+def make_fleet(service, n, fcfg, engine_kw=None):
+    """N in-process replicas (each its own engine over the shared
+    weights) behind one router.  Engines are warmed by the caller."""
+    kw = {"slots": 2, "max_len": 96}
+    kw.update(engine_kw or {})
+    replicas = []
+    for i in range(n):
+        model = ContinuousBatchingModel("lm", service,
+                                        EngineConfig(**kw))
+        model.load()
+        server = ModelServer([model], host="127.0.0.1", port=0)
+        replicas.append(LocalReplica(f"r{i}", server, fcfg))
+    router = FleetRouter(replicas, fcfg, host="127.0.0.1", port=0)
+    return router, replicas
+
+
+def warm_all(replicas):
+    """Compile every program each engine will hit BEFORE arming
+    faults: a first-iteration XLA compile is indistinguishable from a
+    wedge, and these tests are about injected failures."""
+    for r in replicas:
+        eng = r.server.models["lm"].engine
+        eng.submit([1, 2, 3], max_new_tokens=2, temperature=0.0).wait()
+
+
+def shutdown(router):
+    router.shutdown()
+
+
+def _predict(port, prompt, max_new, timeout=60, rid=None):
+    payload = {"instances": [prompt],
+               "parameters": {"max_new_tokens": max_new,
+                              "temperature": 0.0}}
+    if rid:
+        payload["request_id"] = rid
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/lm:predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def greedy_reference(service, prompt, n):
+    opts = {"MAX_NEW_TOKENS": n, "TEMPERATURE": 0.0, "TOP_K": 0,
+            "TOP_P": 1.0, "SEED": 0, "ECHO_PROMPT": False}
+    return service.generate_texts([prompt], opts)[0]
+
+
+def _wait_until(cond, timeout=15.0, interval=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_replica_killed_mid_stream_completes_via_retry_token_identical(
+        service):
+    """ISSUE acceptance: the serving replica crashes mid-generation
+    (decode program dies — the in-process SIGKILL) → the router
+    retries the request on a peer → the client sees ONE 200 whose
+    output is token-identical to one-shot greedy generate."""
+    fcfg = FleetConfig(dispatch_timeout_s=30.0, probe_interval_s=30.0)
+    router, replicas = make_fleet(service, 2, fcfg)
+    warm_all(replicas)
+    router.start()
+    try:
+        want = greedy_reference(service, "after the storm", 6)
+        # crash the SECOND decode iteration of whichever engine takes
+        # the request: one token is already out internally (mid-
+        # stream), none was delivered to the client (buffered JSON) —
+        # the retry is safe and must reproduce the exact tokens
+        faults.install(faults.FaultInjector(
+            [FaultSpec("decode_step", at=2, times=1)]))
+        status, obj = _predict(router.port, "after the storm", 6)
+        assert status == 200
+        pred = obj["predictions"][0]
+        assert pred["generated_text"] == want  # token-identical
+        assert obj["fleet"]["retried_ok"] is True
+        assert obj["fleet"]["dispatches"] == 2
+        assert router.stats["retried_ok"] == 1
+        # exactly one engine died; the fleet stayed available
+        dead = [r for r in replicas
+                if not r.server.models["lm"].engine.alive]
+        assert len(dead) == 1
+    finally:
+        faults.uninstall()
+        shutdown(router)
+
+
+def test_hung_replica_ejected_then_recovered_via_half_open(service):
+    """ISSUE acceptance: a wedged replica (decode hang) times out the
+    dispatch → retry succeeds on the peer → the hung replica is
+    ejected; its stale heartbeat keeps probes failing while wedged;
+    once the hang releases, a probe success takes it to half-open and
+    the next dispatched request is the trial that reinstates it."""
+    fcfg = FleetConfig(dispatch_timeout_s=1.0, timeout_eject=1,
+                       probe_interval_s=30.0,  # probes driven by hand
+                       heartbeat_stale_s=0.5,
+                       probe_fail_threshold=1)
+    router, replicas = make_fleet(service, 2, fcfg)
+    warm_all(replicas)
+    router.start()
+    victim = replicas[0]  # equal load scores: list order breaks the tie
+    try:
+        faults.install(faults.FaultInjector(
+            [FaultSpec("decode_step", mode="hang", at=1, times=1,
+                       delay_s=60.0)]))
+        status, obj = _predict(router.port, "wedge me", 6, timeout=30)
+        assert status == 200  # retried onto the healthy peer
+        assert obj["fleet"]["retried_ok"] is True
+        assert obj["fleet"]["replica"] == "r1"
+        assert victim.health.state == EJECTED
+        assert victim.health.snapshot()["ejected_cause"] == "timeouts"
+        # wedged: the heartbeat is stale, so probes must NOT half-open
+        _wait_until(
+            lambda: victim.server.models["lm"].engine.heartbeat.age
+            > fcfg.heartbeat_stale_s, what="heartbeat to go stale")
+        router.probe_now()
+        assert victim.health.state == EJECTED
+        # release the hang: the engine loop resumes, heartbeat freshens
+        faults.uninstall()
+        _wait_until(
+            lambda: victim.server.models["lm"].engine.heartbeat.age
+            < fcfg.heartbeat_stale_s, what="heartbeat to freshen")
+        router.probe_now()
+        assert victim.health.state == HALF_OPEN
+        # the victim reads as freer (no probed queue) → next dispatch
+        # is its half-open trial; success reinstates it
+        status, obj = _predict(router.port, "trial run", 4, timeout=30)
+        assert status == 200
+        _wait_until(lambda: victim.health.state == ACTIVE,
+                    what="half-open trial to reinstate the replica")
+        assert victim.health.snapshot()["recoveries"] == 1
+    finally:
+        faults.uninstall()
+        shutdown(router)
+
+
+def test_rolling_restart_under_load_zero_failed_requests(service):
+    """ISSUE acceptance: a 3-replica rolling restart under sustained
+    load finishes with zero failed requests — queued work is
+    transplanted through the router, drain-window races are absorbed
+    by the retry ladder, and every output stays token-identical."""
+    fcfg = FleetConfig(dispatch_timeout_s=60.0, probe_interval_s=0.1,
+                       retry_budget_burst=32.0, retry_budget_ratio=1.0)
+    router, replicas = make_fleet(service, 3, fcfg)
+    warm_all(replicas)
+    router.start()
+    prompt = "rolling restart survivor"
+    want = greedy_reference(service, prompt, 5)
+    results, failures = [], []
+    stop = threading.Event()
+
+    def client(wid):
+        i = 0
+        while not stop.is_set():
+            try:
+                status, obj = _predict(router.port, prompt, 5,
+                                       timeout=60,
+                                       rid=f"w{wid}-{i}")
+                results.append((status, obj))
+            except Exception as e:  # noqa: BLE001 - recorded, asserted
+                failures.append(repr(e))
+            i += 1
+
+    workers = [threading.Thread(target=client, args=(w,))
+               for w in range(4)]
+    for t in workers:
+        t.start()
+    try:
+        time.sleep(0.5)  # reach steady load first
+        report = router.rolling_restart()
+        time.sleep(0.5)  # and keep serving after the sweep
+    finally:
+        stop.set()
+        for t in workers:
+            t.join(timeout=60)
+    try:
+        assert report["completed"] is True
+        assert failures == []  # ZERO transport/unhandled failures
+        assert results, "load loop never completed a request"
+        bad = [s for s, _ in results if s != 200]
+        assert bad == []  # ZERO failed requests
+        assert all(o["predictions"][0]["generated_text"] == want
+                   for _, o in results)
+        assert all(r.health.state == ACTIVE for r in replicas)
+        assert all(r.server.models["lm"].engine.alive
+                   for r in replicas)
+        assert router.stats["rolling_restarts"] == 1
+    finally:
+        shutdown(router)
+
+
+def test_transplant_moves_queued_request_to_peer(service):
+    """The zero-drop mechanism in isolation: a request queued (never
+    claimed) on a draining replica is re-admitted into a peer through
+    the router, its waiter follows, and the output is token-identical."""
+    fcfg = FleetConfig(probe_interval_s=30.0)
+    router, replicas = make_fleet(service, 2, fcfg,
+                                  engine_kw={"slots": 1})
+    warm_all(replicas)
+    eng0 = replicas[0].server.models["lm"].engine
+    eng1 = replicas[1].server.models["lm"].engine
+    try:
+        # the one-shot reference compiles BEFORE the clock-sensitive
+        # part (a fresh XLA compile takes tens of seconds on a cold
+        # box — the queued request would drain while we wait on it)
+        want = np.asarray(generate(
+            CFG, service.params, jnp.asarray([[7, 8, 9]], jnp.int32),
+            max_new_tokens=4, temperature=0.0, pad_token_id=0)
+        )[0, 3:7].tolist()
+        # occupy r0's only slot, slowly, then queue a second request
+        faults.install(faults.FaultInjector(
+            [FaultSpec("iteration", mode="slow", delay_s=0.05,
+                       times=-1)]))
+        long_req = eng0.submit(list(range(1, 9)), max_new_tokens=40,
+                               temperature=0.0)
+        queued = eng0.submit([7, 8, 9], max_new_tokens=4,
+                             temperature=0.0)
+        _wait_until(lambda: eng0.queue_depth() == 1,
+                    what="second request to be queued")
+        replicas[0].health.begin_drain()
+        moved = router._transplant_from(replicas[0])
+        assert moved == 1
+        assert queued.engine is eng1  # the waiter follows its request
+        assert queued.wait() == want  # token-identical on the peer
+        assert router.stats["transplanted"] == 1
+        assert len(long_req.wait()) == 40  # bystander unaffected
+    finally:
+        faults.uninstall()
+        shutdown(router)
+
+
+def test_fleet_dispatch_fault_contained_to_request(service):
+    """fleet.dispatch containment: an injected raise at the dispatch
+    site fails that one attempt (counted, retried within budget) —
+    the replicas never see it and the next attempt succeeds."""
+    fcfg = FleetConfig(dispatch_timeout_s=30.0, probe_interval_s=30.0)
+    router, replicas = make_fleet(service, 2, fcfg)
+    warm_all(replicas)
+    router.start()
+    try:
+        want = greedy_reference(service, "contained", 4)
+        faults.install(faults.FaultInjector(
+            [FaultSpec("fleet.dispatch", at=1, times=1)]))
+        status, obj = _predict(router.port, "contained", 4)
+        assert status == 200
+        assert obj["predictions"][0]["generated_text"] == want
+        assert obj["fleet"]["retried_ok"] is True
+        # both engines healthy: the fault never reached a replica
+        assert all(r.server.models["lm"].engine.alive
+                   for r in replicas)
+    finally:
+        faults.uninstall()
+        shutdown(router)
+
+
+def test_fleet_probe_hang_parks_only_the_prober(service):
+    """fleet.probe containment: a hanging probe parks the prober
+    thread only — dispatch keeps routing on last-known health, and
+    the data plane never stalls."""
+    fcfg = FleetConfig(dispatch_timeout_s=30.0, probe_interval_s=0.05)
+    router, replicas = make_fleet(service, 2, fcfg)
+    warm_all(replicas)
+    router.start()
+    try:
+        faults.install(faults.FaultInjector(
+            [FaultSpec("fleet.probe", mode="hang", times=-1,
+                       delay_s=30.0)]))
+        time.sleep(0.2)  # let the prober park in the hang
+        t0 = time.monotonic()
+        status, obj = _predict(router.port, "still serving", 4)
+        assert status == 200
+        assert time.monotonic() - t0 < 10.0  # never waited on the probe
+        assert obj["fleet"]["dispatches"] == 1
+    finally:
+        faults.uninstall()
+        shutdown(router)
+
+
+def test_cancel_route_reaps_in_flight_request(service):
+    """The new ``:cancel`` route (the hedge-loser path for remote
+    replicas): cancelling by request id marks the in-flight request
+    dead and the scheduler reaps it at its next pass."""
+    model = ContinuousBatchingModel("lm", service,
+                                    EngineConfig(slots=2, max_len=96))
+    model.load()
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        warm = model.engine.submit([1, 2, 3], max_new_tokens=2,
+                                   temperature=0.0)
+        warm.wait()
+        faults.install(faults.FaultInjector(
+            [FaultSpec("iteration", mode="slow", delay_s=0.05,
+                       times=-1)]))
+        got = {}
+
+        def doomed():
+            try:
+                got["resp"] = _predict(server.port, "cancel me", 60,
+                                       timeout=60, rid="doomed-1")
+            except urllib.error.HTTPError as e:
+                got["status"] = e.code
+
+        t = threading.Thread(target=doomed)
+        t.start()
+        _wait_until(
+            lambda: model.engine.request_phase("doomed-1") == "active",
+            what="request to start decoding")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/models/lm:cancel",
+            data=json.dumps({"request_id": "doomed-1"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["cancelled"] is True
+        t.join(timeout=30)
+        assert got.get("status") == 500  # RequestCancelled surfaces
+        assert model.engine.stats["cancelled"] >= 1
+        assert model.engine.request_phase("doomed-1") is None
+    finally:
+        faults.uninstall()
+        server.stop()
+        model.stop()
+
+
+def test_cancel_reaches_request_mid_admission(service):
+    """cancel_request must see the claimed-but-not-yet-slotted window
+    (a request wedged inside its prefill) — request_phase already
+    calls it 'active', so a hedge loser caught there must be
+    cancellable too."""
+    model = ContinuousBatchingModel("lm", service,
+                                    EngineConfig(slots=2, max_len=96))
+    model.load()
+    eng = model.engine
+    try:
+        eng.submit([1, 2, 3], max_new_tokens=2, temperature=0.0).wait()
+        faults.install(faults.FaultInjector(
+            [FaultSpec("model_fn", mode="hang", at=1, times=1,
+                       delay_s=60.0)]))
+        req = eng.submit([4, 5, 6, 7], max_new_tokens=4,
+                         temperature=0.0, request_id="adm-1")
+        _wait_until(lambda: req.claimed and eng.queue_depth() == 0,
+                    what="request claimed by the wedged admission")
+        assert eng.request_phase("adm-1") == "active"
+        assert eng.cancel_request("adm-1") is True
+        faults.uninstall()  # prefill completes; the reaper evicts
+        with pytest.raises(Exception, match="cancelled"):
+            req.wait()
+        assert eng.stats["cancelled"] >= 1
+    finally:
+        faults.uninstall()
+        model.stop()
+
+
+def test_engine_request_phase_lifecycle(service):
+    """request_phase: queued → active → None (the hedging gate's
+    exact vocabulary), including the multi-instance rid suffix."""
+    model = ContinuousBatchingModel("lm", service,
+                                    EngineConfig(slots=1, max_len=96))
+    model.load()
+    eng = model.engine
+    try:
+        warm = eng.submit([1, 2, 3], max_new_tokens=2, temperature=0.0)
+        warm.wait()
+        faults.install(faults.FaultInjector(
+            [FaultSpec("iteration", mode="slow", delay_s=0.05,
+                       times=-1)]))
+        first = eng.submit(list(range(1, 9)), max_new_tokens=30,
+                           temperature=0.0, request_id="rid-a-0")
+        second = eng.submit([4, 5], max_new_tokens=2, temperature=0.0,
+                            request_id="rid-b")
+        _wait_until(lambda: eng.request_phase("rid-a") == "active",
+                    what="first request active (suffix match)")
+        assert eng.request_phase("rid-b") == "queued"
+        assert eng.request_phase("rid-zzz") is None
+        first.wait()
+        second.wait()
+        assert eng.request_phase("rid-b") is None
+    finally:
+        faults.uninstall()
+        model.stop()
