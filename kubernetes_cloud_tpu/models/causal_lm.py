@@ -300,17 +300,25 @@ def _project_qkv(cfg: CausalLMConfig, p: Params, x: jax.Array, *,
 def _finish_block(cfg: CausalLMConfig, p: Params, x: jax.Array,
                   attn_vec: jax.Array, attn_in: jax.Array,
                   token_mask: Optional[jax.Array] = None,
-                  moe_no_drop: bool = False) -> tuple[jax.Array, jax.Array]:
+                  moe_no_drop: bool = False,
+                  attn_out: Optional[jax.Array] = None
+                  ) -> tuple[jax.Array, jax.Array]:
     """Block back half: output projection + residual wiring + MLP/MoE.
 
     Returns ``(out, aux)`` where ``aux`` is the MoE load-balancing loss
     (0.0 for dense blocks).  ``token_mask`` [B, S] keeps padding from
     routing/claiming MoE capacity; ``moe_no_drop`` (decode path) raises
-    capacity so co-batched requests can't perturb each other's logits."""
-    attn_out = jnp.einsum("bsnk,nkd->bsd", attn_vec,
-                          p["attn"]["wo"].astype(cfg.dtype))
-    if cfg.use_bias:
-        attn_out = attn_out + p["attn"]["bo"].astype(cfg.dtype)
+    capacity so co-batched requests can't perturb each other's logits.
+    A caller that already projected the attention output (the fused
+    paged-decode kernel folds ``W_o`` into the attention sweep; the
+    caller must also have added ``bo`` when ``use_bias``) passes it as
+    ``attn_out`` [B, S, D] — projection AND bias here are skipped;
+    ``attn_vec`` may then be None."""
+    if attn_out is None:
+        attn_out = jnp.einsum("bsnk,nkd->bsd", attn_vec,
+                              p["attn"]["wo"].astype(cfg.dtype))
+        if cfg.use_bias:
+            attn_out = attn_out + p["attn"]["bo"].astype(cfg.dtype)
 
     if cfg.parallel_residual:
         # GPT-NeoX/GPT-J: x + attn(ln1(x)) + mlp(ln2(x))

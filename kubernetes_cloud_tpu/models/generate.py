@@ -23,10 +23,11 @@ The decode block mirrors :func:`causal_lm.forward` exactly;
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubernetes_cloud_tpu.models.causal_lm import (
     CausalLMConfig,
@@ -63,7 +64,14 @@ def prefill(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
             attention_mask: jax.Array, cache: dict) -> tuple[jax.Array, dict]:
     """Run the prompt through the model, filling cache positions
     ``0..S-1``.  Prompts are right-padded; ``attention_mask`` marks real
-    tokens.  Returns (last-real-token logits [B, V], cache)."""
+    tokens.  Returns (last-real-token logits [B, V], cache).
+
+    Attention dispatches ``impl="auto"``: on TPU with flash-eligible
+    shapes (rope positions, 2-D padding mask) the prefill — the
+    MXU-heavy half of every prefill-bearing engine iteration the
+    flight recorder flags — runs the fused flash kernel; everywhere
+    else (CPU tier-1, ALiBi bias, odd shapes) it falls back to the XLA
+    path unchanged."""
     b, s = input_ids.shape
     max_len = cache["k"].shape[2]
     lengths = attention_mask.sum(-1).astype(jnp.int32)
@@ -83,7 +91,7 @@ def prefill(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
         q, k_new, v_new, attn_in = _project_qkv(
             cfg, p, x, rope=rope, q_positions=positions)
         attn_vec = attention(q, k_new, v_new, causal=True, bias=bias,
-                             mask=attention_mask, impl="xla")
+                             mask=attention_mask, impl="auto")
         x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
                                 token_mask=attention_mask, moe_no_drop=True)
         return x, (k_new, v_new)
@@ -191,17 +199,41 @@ def decode_step_slots(cfg: CausalLMConfig, params: Params, tokens: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+#: int8 quantization range (symmetric; -128 unused so the scale maps
+#: the per-(page, head) absmax exactly onto the grid edge)
+INT8_MAX = 127.0
+#: scale floor so an all-zero page can never divide by zero
+_SCALE_EPS = 1e-8
+
+
 def init_page_arena(cfg: CausalLMConfig, num_pages: int, page_size: int,
-                    dtype=None) -> dict[str, jax.Array]:
+                    dtype=None, kv_dtype: str = "fp32"
+                    ) -> dict[str, jax.Array]:
     """Block-granular KV arena: ``[L, NUM_PAGES, page_size, Hkv, Dh]``.
 
     Physical page 0 is the *null page* (``serve.paged_kv.NULL_PAGE``):
     free slots' page-table entries point at it, so the all-slots decode
     program has somewhere harmless to park masked garbage writes.  No
     per-row ``length`` lives on device — the paged scheduler owns
-    lengths host-side and passes them as program arguments."""
+    lengths host-side and passes them as program arguments.
+
+    ``kv_dtype="int8"`` stores K/V quantized (symmetric int8) with
+    per-page, per-kv-head fp32 scales in parallel ``k_scale``/
+    ``v_scale`` buffers ``[L, NUM_PAGES, Hkv]`` — roughly quartering
+    (vs fp32; halving vs bf16) the HBM each resident token costs, at a
+    measured logit-error budget instead of bitwise token identity
+    (:func:`kv_quant_probe`)."""
     shape = (cfg.num_layers, num_pages, page_size, cfg.kv_heads,
              cfg.head_dim)
+    if kv_dtype == "int8":
+        sshape = (cfg.num_layers, num_pages, cfg.kv_heads)
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32)}
+    if kv_dtype != "fp32":
+        raise ValueError(f"kv_dtype must be 'fp32' or 'int8', got "
+                         f"{kv_dtype!r}")
     return {"k": jnp.zeros(shape, dtype or cfg.dtype),
             "v": jnp.zeros(shape, dtype or cfg.dtype)}
 
@@ -209,9 +241,69 @@ def init_page_arena(cfg: CausalLMConfig, num_pages: int, page_size: int,
 def copy_pages(arena: dict, src: jax.Array, dst: jax.Array) -> dict:
     """Copy physical pages ``src[i] -> dst[i]`` across every layer —
     the device half of the allocator's copy-on-write: a shared prefix
-    page goes private before the tail prefill writes into it."""
-    return {"k": arena["k"].at[:, dst].set(arena["k"][:, src]),
-            "v": arena["v"].at[:, dst].set(arena["v"][:, src])}
+    page goes private before the tail prefill writes into it.  A
+    quantized arena's scale rows travel with their pages."""
+    out = {"k": arena["k"].at[:, dst].set(arena["k"][:, src]),
+           "v": arena["v"].at[:, dst].set(arena["v"][:, src])}
+    if "k_scale" in arena:
+        out["k_scale"] = arena["k_scale"].at[:, dst].set(
+            arena["k_scale"][:, src])
+        out["v_scale"] = arena["v_scale"].at[:, dst].set(
+            arena["v_scale"][:, src])
+    return out
+
+
+def _quant_decode_write(pages: jax.Array, scale: jax.Array,
+                        phys: jax.Array, rows: jax.Array,
+                        new: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Write one fp row per slot into an int8 arena (one layer).
+
+    The per-(page, head) scale is monotone: when a new row's absmax
+    exceeds the page's current scale, the page's resident int8 values
+    are re-quantized to the grown scale first (losing at most half a
+    quantization step — the drift the logit-error budget prices in);
+    an unchanged scale makes the rescale ``round(q * 1.0)`` — exact.
+    ``pages`` [NP, ps, Hkv, D] int8, ``scale`` [NP, Hkv] fp32,
+    ``phys``/``rows`` [S], ``new`` [S, Hkv, D] fp."""
+    new = new.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(new), axis=-1)                  # [S, Hkv]
+    old = scale[phys]                                        # [S, Hkv]
+    ns = jnp.maximum(old, jnp.maximum(absmax / INT8_MAX, _SCALE_EPS))
+    ratio = jnp.where(ns > 0, old / ns, 1.0)[:, None, :, None]
+    blk = jnp.clip(jnp.round(pages[phys].astype(jnp.float32) * ratio),
+                   -INT8_MAX, INT8_MAX)                      # [S, ps, Hkv, D]
+    blk = blk.at[jnp.arange(phys.shape[0]), rows].set(
+        jnp.clip(jnp.round(new / ns[..., None]), -INT8_MAX, INT8_MAX))
+    return (pages.at[phys].set(blk.astype(jnp.int8)),
+            scale.at[phys].set(ns))
+
+
+def _quant_prefill_write(pages: jax.Array, scale: jax.Array,
+                         page_tables: jax.Array, phys_f: jax.Array,
+                         rows_f: jax.Array, new_f: jax.Array,
+                         valid_f: jax.Array
+                         ) -> tuple[jax.Array, jax.Array]:
+    """Scatter a prefill tail's rows into an int8 arena (one layer).
+
+    Scales grow by scatter-max over every written row, then each
+    touched request's resident pages re-quantize to the grown scales
+    (untouched pages see ratio 1.0 — an exact no-op; shared prefix
+    pages are never written so their scales never change).  ``phys_f``/
+    ``rows_f``/``valid_f`` [B*T], ``new_f`` [B*T, Hkv, D] fp,
+    ``page_tables`` [B, P]."""
+    new_f = new_f.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(new_f), axis=-1) / INT8_MAX     # [B*T, Hkv]
+    absmax = jnp.where(valid_f[:, None], absmax, 0.0)
+    ns = jnp.maximum(scale.at[phys_f].max(absmax), _SCALE_EPS)
+    ratio = jnp.where(ns > 0, scale / ns, 1.0)               # [NP, Hkv]
+    blk = jnp.clip(
+        jnp.round(pages[page_tables].astype(jnp.float32)
+                  * ratio[page_tables][:, :, None, :, None]),
+        -INT8_MAX, INT8_MAX)                                 # [B, P, ...]
+    pages = pages.at[page_tables].set(blk.astype(jnp.int8))
+    q = jnp.clip(jnp.round(new_f / ns[phys_f][..., None]),
+                 -INT8_MAX, INT8_MAX)
+    return pages.at[phys_f, rows_f].set(q.astype(jnp.int8)), ns
 
 
 def _page_scatter_indices(page_tables: jax.Array, positions: jax.Array,
@@ -265,38 +357,61 @@ def prefill_into_pages(cfg: CausalLMConfig, params: Params,
                                        attention_mask != 0, ps)
     phys_f = phys.reshape(b * t)
     rows_f = rows.reshape(b * t)
+    valid_f = (attention_mask != 0).reshape(b * t)
+    quant = "k_scale" in arena
 
     x = _embed(cfg, params, input_ids, positions)
 
     def body(carry, layer):
         x = carry
-        p, ck, cv = layer
+        if quant:
+            p, ck, cv, sk, sv = layer
+        else:
+            p, ck, cv = layer
+            sk = sv = None
         q, k_new, v_new, attn_in = _project_qkv(
             cfg, p, x, rope=rope, q_positions=positions)
-        ck = ck.at[phys_f, rows_f].set(
-            k_new.reshape(b * t, cfg.kv_heads, cfg.head_dim
-                          ).astype(ck.dtype))
-        cv = cv.at[phys_f, rows_f].set(
-            v_new.reshape(b * t, cfg.kv_heads, cfg.head_dim
-                          ).astype(cv.dtype))
-        dense_k = ck[page_tables].reshape(b, max_len, cfg.kv_heads,
-                                          cfg.head_dim)
-        dense_v = cv[page_tables].reshape(b, max_len, cfg.kv_heads,
-                                          cfg.head_dim)
+        k_flat = k_new.reshape(b * t, cfg.kv_heads, cfg.head_dim)
+        v_flat = v_new.reshape(b * t, cfg.kv_heads, cfg.head_dim)
+        if quant:
+            ck, sk = _quant_prefill_write(ck, sk, page_tables, phys_f,
+                                          rows_f, k_flat, valid_f)
+            cv, sv = _quant_prefill_write(cv, sv, page_tables, phys_f,
+                                          rows_f, v_flat, valid_f)
+            from kubernetes_cloud_tpu.ops.paged_attention import (
+                gather_pages,
+            )
+
+            dense_k = gather_pages(ck, page_tables, sk)
+            dense_v = gather_pages(cv, page_tables, sv)
+        else:
+            ck = ck.at[phys_f, rows_f].set(k_flat.astype(ck.dtype))
+            cv = cv.at[phys_f, rows_f].set(v_flat.astype(cv.dtype))
+            dense_k = ck[page_tables].reshape(b, max_len, cfg.kv_heads,
+                                              cfg.head_dim)
+            dense_v = cv[page_tables].reshape(b, max_len, cfg.kv_heads,
+                                              cfg.head_dim)
         attn_vec = attention(q, dense_k.astype(cfg.dtype),
                              dense_v.astype(cfg.dtype), causal=False,
                              bias=bias, mask=key_mask, impl="xla")
         x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
                                 token_mask=attention_mask,
                                 moe_no_drop=True)
-        return x, (ck, cv)
+        return x, ((ck, cv, sk, sv) if quant else (ck, cv))
 
-    x, (ks, vs) = jax.lax.scan(body, x,
-                               (params["blocks"], arena["k"], arena["v"]))
+    if quant:
+        xs = (params["blocks"], arena["k"], arena["v"],
+              arena["k_scale"], arena["v_scale"])
+        x, (ks, vs, ssk, ssv) = jax.lax.scan(body, x, xs)
+        new_arena = {"k": ks, "v": vs, "k_scale": ssk, "v_scale": ssv}
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["blocks"], arena["k"], arena["v"]))
+        new_arena = {"k": ks, "v": vs}
     logits = _unembed(cfg, params, x)
     last = jnp.take_along_axis(
         logits, (tail_lens - 1)[:, None, None].clip(0), axis=1)[:, 0]
-    return last, {"k": ks, "v": vs}
+    return last, new_arena
 
 
 def decode_step_pages(cfg: CausalLMConfig, params: Params,
@@ -310,16 +425,22 @@ def decode_step_pages(cfg: CausalLMConfig, params: Params,
     occupies), ``page_table`` [S, P] the per-slot indirection.  Free
     slots carry an all-null table and length 0, so their (garbage) K/V
     write lands in the null page and their logits row is never read.
-    ``impl`` selects the attention gather: ``"gather"`` (pure jnp,
+    ``impl`` selects the attention path: ``"gather"`` (pure jnp,
     bit-identical to :func:`decode_step` over the equivalent dense
-    pool) or ``"pallas"`` (the Mosaic paged-attention kernel in
-    :mod:`kubernetes_cloud_tpu.ops.paged_attention`).  Returns
-    (logits [S, V], arena)."""
+    pool), ``"pallas"`` (the Mosaic paged-attention kernel in
+    :mod:`kubernetes_cloud_tpu.ops.paged_attention`), or ``"fused"``
+    (:mod:`kubernetes_cloud_tpu.ops.fused_decode`: gather + attention
+    + output projection in ONE kernel).  Off-TPU the kernels run in
+    interpreter mode so the whole surface stays CPU-testable.  A
+    quantized arena (``k_scale`` present) dequantizes in whichever
+    path is selected.  Returns (logits [S, V], arena)."""
     s = tokens.shape[0]
     ps = arena["k"].shape[2]
     max_len = page_table.shape[1] * ps
     pos = lengths
     positions = pos[:, None]
+    quant = "k_scale" in arena
+    interpret = jax.default_backend() != "tpu"
 
     rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
             if cfg.pos_emb == "rope" else None)
@@ -338,20 +459,60 @@ def decode_step_pages(cfg: CausalLMConfig, params: Params,
 
     def body(carry, layer):
         x = carry
-        p, ck, cv = layer
+        if quant:
+            p, ck, cv, sk, sv = layer
+        else:
+            p, ck, cv = layer
+            sk = sv = None
         q, k_new, v_new, attn_in = _project_qkv(
             cfg, p, x, rope=rope, q_positions=positions)
-        ck = ck.at[phys, rows].set(k_new[:, 0].astype(ck.dtype))
-        cv = cv.at[phys, rows].set(v_new[:, 0].astype(cv.dtype))
+        if quant:
+            ck, sk = _quant_decode_write(ck, sk, phys, rows, k_new[:, 0])
+            cv, sv = _quant_decode_write(cv, sv, phys, rows, v_new[:, 0])
+        else:
+            ck = ck.at[phys, rows].set(k_new[:, 0].astype(ck.dtype))
+            cv = cv.at[phys, rows].set(v_new[:, 0].astype(cv.dtype))
+        if impl == "fused":
+            from kubernetes_cloud_tpu.ops.fused_decode import (
+                fused_paged_decode,
+            )
+
+            attn_out = fused_paged_decode(
+                q[:, 0],
+                ck if quant else ck.astype(cfg.dtype),
+                cv if quant else cv.astype(cfg.dtype),
+                page_table, pos + 1,
+                p["attn"]["wo"].astype(cfg.dtype),
+                k_scale=sk, v_scale=sv, slopes=slopes, impl="pallas",
+                interpret=interpret)
+            if cfg.use_bias:
+                attn_out = attn_out + p["attn"]["bo"].astype(cfg.dtype)
+            x, _aux = _finish_block(cfg, p, x, None, attn_in,
+                                    moe_no_drop=True,
+                                    attn_out=attn_out[:, None, :])
+            return x, ((ck, cv, sk, sv) if quant else (ck, cv))
         if impl == "pallas":
             from kubernetes_cloud_tpu.ops.paged_attention import (
                 paged_decode_attention,
             )
 
             attn_vec = paged_decode_attention(
-                q[:, 0], ck.astype(cfg.dtype), cv.astype(cfg.dtype),
-                page_table, pos + 1, slopes=slopes, impl="pallas",
+                q[:, 0],
+                ck if quant else ck.astype(cfg.dtype),
+                cv if quant else cv.astype(cfg.dtype),
+                page_table, pos + 1, k_scale=sk, v_scale=sv,
+                slopes=slopes, impl="pallas", interpret=interpret,
             )[:, None]
+        elif quant:
+            from kubernetes_cloud_tpu.ops.paged_attention import (
+                gather_pages,
+            )
+
+            dense_k = gather_pages(ck, page_table, sk)
+            dense_v = gather_pages(cv, page_table, sv)
+            attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                                 dense_v.astype(cfg.dtype), causal=False,
+                                 bias=bias, mask=key_mask, impl="xla")
         else:
             dense_k = ck[page_table].reshape(s, max_len, cfg.kv_heads,
                                              cfg.head_dim)
@@ -362,11 +523,73 @@ def decode_step_pages(cfg: CausalLMConfig, params: Params,
                                  bias=bias, mask=key_mask, impl="xla")
         x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
                                 moe_no_drop=True)
-        return x, (ck, cv)
+        return x, ((ck, cv, sk, sv) if quant else (ck, cv))
 
-    x, (ks, vs) = jax.lax.scan(body, x,
-                               (params["blocks"], arena["k"], arena["v"]))
-    return _unembed(cfg, params, x)[:, 0], {"k": ks, "v": vs}
+    if quant:
+        xs = (params["blocks"], arena["k"], arena["v"],
+              arena["k_scale"], arena["v_scale"])
+        x, (ks, vs, ssk, ssv) = jax.lax.scan(body, x, xs)
+        new_arena = {"k": ks, "v": vs, "k_scale": ssk, "v_scale": ssv}
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["blocks"], arena["k"], arena["v"]))
+        new_arena = {"k": ks, "v": vs}
+    return _unembed(cfg, params, x)[:, 0], new_arena
+
+
+def kv_quant_probe(cfg: CausalLMConfig, params: Params,
+                   prompts: Sequence[Sequence[int]], *,
+                   max_new_tokens: int = 16, page_size: int = 16,
+                   impl: str = "gather",
+                   kv_dtype: str = "int8") -> dict:
+    """Measured logit-error budget for a quantized arena.
+
+    Runs every prompt through an fp32 paged arena and a ``kv_dtype``
+    arena side by side, teacher-forced on the fp32 path's greedy
+    tokens, and reports per-position greedy top-1 agreement plus the
+    max/mean absolute logit error — the numbers the int8 acceptance
+    bar (top-1 agreement ≥ 99% on the fixed eval set) is asserted
+    against in tests and recorded by ``scripts/bench_serving.py
+    --kv-dtype int8``.  Teacher-forcing makes the comparison
+    per-position exact: both paths always score the SAME context, so a
+    single early disagreement cannot cascade into meaningless
+    downstream comparisons."""
+    agree = total = 0
+    max_err = 0.0
+    err_sum = 0.0
+    for prompt in prompts:
+        plen = len(prompt)
+        n_pages = -(-(plen + max_new_tokens) // page_size)
+        tables = jnp.asarray([list(range(1, n_pages + 1))], jnp.int32)
+        arenas, logits = {}, {}
+        ids = jnp.asarray([list(prompt)], jnp.int32)
+        mask = jnp.ones((1, plen), jnp.int32)
+        start = jnp.zeros((1,), jnp.int32)
+        for kd in ("fp32", kv_dtype):
+            arena = init_page_arena(cfg, n_pages + 1, page_size,
+                                    kv_dtype=kd)
+            lg, arena = prefill_into_pages(cfg, params, ids, mask,
+                                           arena, tables, start)
+            arenas[kd], logits[kd] = arena, lg
+        for step in range(max_new_tokens):
+            ref = np.asarray(logits["fp32"])[0]
+            got = np.asarray(logits[kv_dtype])[0]
+            err = float(np.abs(ref - got).max())
+            max_err = max(max_err, err)
+            err_sum += float(np.abs(ref - got).mean())
+            agree += int(ref.argmax() == got.argmax())
+            total += 1
+            if step == max_new_tokens - 1:
+                break
+            tok = jnp.asarray([int(ref.argmax())], jnp.int32)
+            ln = jnp.asarray([plen + step], jnp.int32)
+            for kd in ("fp32", kv_dtype):
+                logits[kd], arenas[kd] = decode_step_pages(
+                    cfg, params, tok, arenas[kd], tables, ln, impl=impl)
+    return {"kv_dtype": kv_dtype, "positions": total,
+            "top1_agreement": round(agree / max(total, 1), 6),
+            "max_logit_err": round(max_err, 6),
+            "mean_logit_err": round(err_sum / max(total, 1), 8)}
 
 
 def sample_token(logits: jax.Array, rng: jax.Array, *, temperature: float,

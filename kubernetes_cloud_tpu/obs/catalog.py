@@ -67,6 +67,10 @@ METRIC_FAMILIES = {
         "prompt tokens served from the prefix cache",
     "kct_engine_kv_cow_total":
         "shared pages copied on write before a private prefill",
+    "kct_engine_kv_bytes_per_token":
+        "device KV bytes per resident token row (int8 incl. scales)",
+    "kct_engine_quant_logit_err":
+        "max logit error from the last quantization-quality probe",
     # multi-tenant traffic plane (serve/tenancy.py)
     "kct_tenant_admitted_total":
         "requests admitted into slots per tenant and QoS lane",

@@ -48,8 +48,12 @@ from typing import Any, Optional
 #: joins on — a scheduler pass is decomposed into these named slices;
 #: time in none of them (slot bookkeeping, gauge refresh) is the
 #: analyzer's "other" bucket
-PHASES = ("admit", "cow_copy", "prefill", "decode", "sample", "stream",
-          "host_sync")
+#: "decode" and "fused_decode" are the same slice of the pass — the
+#: per-token device step — split by which kernel ran it: the label
+#: makes a fused-kernel rollout visible in the phase-share rate
+#: without a config scrape
+PHASES = ("admit", "cow_copy", "prefill", "decode", "fused_decode",
+          "sample", "stream", "host_sync")
 
 
 class IterationRecord:

@@ -1,0 +1,216 @@
+"""Fused paged-decode kernel: gather + attention + output projection.
+
+The paged decode step pays three dispatches per layer on its hottest
+path: the page gather (or the paged-attention kernel), the attention
+itself, and the ``[S, H·Dh] @ [H·Dh, hidden]`` output projection.  This
+module folds all three into ONE Mosaic kernel, using the
+:mod:`kubernetes_cloud_tpu.ops.paged_attention` kernel as the template:
+
+* grid ``(slot, kv_head, page)`` with the page table as a scalar-
+  prefetch operand — each step streams exactly one resident KV page
+  per (slot, kv-head), never the whole arena;
+* flash-style online softmax across the page sweep (identical
+  accumulator discipline to the unfused kernel);
+* when a (slot, kv-head)'s sweep finishes, its normalized ``[G, Dh]``
+  attention block is immediately contracted against that head group's
+  ``[G·Dh, hidden]`` slice of ``W_o`` and accumulated into a per-slot
+  fp32 ``[1, hidden]`` scratch — the ``[S, H, Dh]`` attention tensor is
+  never materialized in HBM, and the projection matmul rides the same
+  kernel invocation;
+* int8 arenas dequantize in-kernel exactly like the unfused path
+  (score scale folds the K page scale; the V scale applies post-matmul).
+
+``impl="ref"`` is the jnp fallback — the unfused gather attention
+followed by an einsum — which defines the semantics and keeps tier-1
+CPU-runnable; ``scripts/kernel_parity.py`` locks kernel vs ref vs a
+dense reference on hardware, ``tests/test_quantized_kv.py`` in
+interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubernetes_cloud_tpu.ops.paged_attention import (
+    NEG_INF,
+    paged_decode_attention,
+)
+
+
+def _ref_impl(q, k_pages, v_pages, page_table, ctx_lens, wo, slopes,
+              scale, k_scale, v_scale):
+    attn = paged_decode_attention(
+        q, k_pages, v_pages, page_table, ctx_lens, k_scale=k_scale,
+        v_scale=v_scale, slopes=slopes, scale=scale, impl="gather")
+    return jnp.einsum("shd,hdo->so", attn, wo.astype(attn.dtype))
+
+
+def _kernel(pt_ref, len_ref, slopes_ref, q_ref, k_ref, v_ref, *rest,
+            group: int, page_size: int, n_pages: int, n_kv: int,
+            scale: float, have_slopes: bool, have_scales: bool):
+    if have_scales:
+        ks_ref, vs_ref, wo_ref, o_ref, acc_ref, m_ref, l_ref, oacc_ref \
+            = rest
+    else:
+        wo_ref, o_ref, acc_ref, m_ref, l_ref, oacc_ref = rest
+        ks_ref = vs_ref = None
+    s, kh, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when((kh == 0) & (p == 0))
+    def _():
+        oacc_ref[...] = jnp.zeros_like(oacc_ref)
+
+    @pl.when(p == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = len_ref[s]
+    q = q_ref[0, 0].astype(jnp.float32)          # [G, D]
+    kblk = k_ref[0, :, 0, :]                     # [ps, D]
+    vblk = v_ref[0, :, 0, :]
+    k_scale = ks_ref[0, 0] * scale if have_scales else scale
+    scores = jax.lax.dot_general(
+        q, kblk.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * k_scale  # [G, ps]
+    kpos = (p * page_size
+            + jax.lax.broadcasted_iota(jnp.int32, (group, page_size), 1))
+    if have_slopes:
+        slope = slopes_ref[pl.ds(kh * group, group)]  # [G]
+        scores = scores + slope[:, None] * kpos.astype(jnp.float32)
+    scores = jnp.where(kpos < ctx, scores, NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    probs = jnp.where(scores > NEG_INF * 0.5, jnp.exp(scores - m_new), 0.0)
+    l_new = l_prev * alpha + jnp.sum(probs, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        probs, vblk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if have_scales:
+        pv = pv * vs_ref[0, 0]
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == n_pages - 1)
+    def _():
+        # this head group's sweep is done: normalize and fold its
+        # projection slice into the per-slot output accumulator (the
+        # attention vector never leaves VMEM)
+        attn = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)  # [G, D]
+        d = attn.shape[1]
+        part = jnp.zeros_like(oacc_ref)                # [1, hidden]
+        for g in range(group):  # static unroll; slices are static
+            part = part + jax.lax.dot_general(
+                attn[g:g + 1, :],
+                wo_ref[0, g * d:(g + 1) * d, :].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        oacc_ref[...] = oacc_ref[...] + part
+
+    @pl.when((kh == n_kv - 1) & (p == n_pages - 1))
+    def _():
+        o_ref[...] = oacc_ref[...].astype(o_ref.dtype)
+
+
+def _pallas_impl(q, k_pages, v_pages, page_table, ctx_lens, wo, slopes,
+                 scale, k_scale, v_scale, interpret):
+    s, h, d = q.shape
+    _, ps, hkv, _ = k_pages.shape
+    p_per = page_table.shape[1]
+    g = h // hkv
+    hidden = wo.shape[-1]
+    have_slopes = slopes is not None
+    have_scales = k_scale is not None
+    qg = q.reshape(s, hkv, g, d)
+    # [H, Dh, hidden] → per-kv-head-group projection slices
+    wo3 = wo.reshape(hkv, g * d, hidden)
+
+    kernel = functools.partial(
+        _kernel, group=g, page_size=ps, n_pages=p_per, n_kv=hkv,
+        scale=scale, have_slopes=have_slopes, have_scales=have_scales)
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d),
+                     lambda s_, kh, p_, pt, ln, sl: (s_, kh, 0, 0)),
+        pl.BlockSpec((1, ps, 1, d),
+                     lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], 0,
+                                                     kh, 0)),
+        pl.BlockSpec((1, ps, 1, d),
+                     lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], 0,
+                                                     kh, 0)),
+    ]
+    if have_scales:
+        in_specs += [
+            pl.BlockSpec((1, 1),
+                         lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], kh)),
+            pl.BlockSpec((1, 1),
+                         lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], kh)),
+        ]
+    in_specs.append(
+        pl.BlockSpec((1, g * d, hidden),
+                     lambda s_, kh, p_, pt, ln, sl: (kh, 0, 0)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s, hkv, p_per),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, hidden), lambda s_, kh, p_, pt, ln, sl: (s_, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((1, hidden), jnp.float32),
+        ],
+    )
+    slopes_arg = (slopes.astype(jnp.float32) if have_slopes
+                  else jnp.zeros((h,), jnp.float32))
+    args = [qg, k_pages, v_pages]
+    if have_scales:
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    args.append(wo3)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, hidden), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      slopes_arg, *args)
+
+
+def fused_paged_decode(
+    q: jax.Array,            # [S, H, D] one query token per slot
+    k_pages: jax.Array,      # [NP, ps, Hkv, D] arena (one layer)
+    v_pages: jax.Array,
+    page_table: jax.Array,   # [S, P] physical page per slot block
+    ctx_lens: jax.Array,     # [S] valid keys per slot (incl. current)
+    wo: jax.Array,           # [H, Dh, hidden] output projection
+    *,
+    k_scale: Optional[jax.Array] = None,  # [NP, Hkv] int8 dequant
+    v_scale: Optional[jax.Array] = None,
+    slopes: Optional[jax.Array] = None,   # [H] ALiBi slopes
+    scale: Optional[float] = None,
+    impl: str = "ref",
+    interpret: bool = False,
+) -> jax.Array:
+    """One decode token per slot → projected attention output
+    ``[S, hidden]`` (``W_o`` applied; the caller adds its bias).  Free
+    slots (``ctx_lens == 0``) return unspecified values, like the
+    unfused kernel."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if impl == "pallas":
+        return _pallas_impl(q, k_pages, v_pages, page_table, ctx_lens,
+                            wo, slopes, float(scale), k_scale, v_scale,
+                            interpret)
+    return _ref_impl(q, k_pages, v_pages, page_table, ctx_lens, wo,
+                     slopes, float(scale), k_scale, v_scale)

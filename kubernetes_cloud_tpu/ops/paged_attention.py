@@ -21,9 +21,19 @@ implementations:
   resident KV page (same trick as ``ops/flash_kernel``); ALiBi comes in
   as per-head slopes computed against absolute key positions in-kernel.
 
-``scripts/kernel_parity.py --paged`` locks the two (plus a dense
-reference) together on real hardware; ``tests/test_paged_kv.py`` runs
-the kernel in interpreter mode on CPU.
+**Quantized arenas** (``kv_dtype="int8"``): both implementations accept
+int8 ``k_pages``/``v_pages`` with per-page, per-kv-head fp32 scales
+(``k_scale``/``v_scale`` shaped ``[num_pages, Hkv]``) and dequantize
+*in the kernel*: the score matmul runs on the raw int8 block (cast to
+fp32 in registers) and the page's scale folds into the score scale —
+``q·(s·k) = s·(q·k)`` — so the dequantized KV tensor is never
+materialized in HBM.  The gather fallback dequantizes its dense view
+the same way, so the two stay within fp-rounding of each other.
+
+``scripts/kernel_parity.py`` locks kernel vs gather vs a dense
+reference (fp32 and int8 cases) on real hardware;
+``tests/test_paged_kv.py`` / ``tests/test_quantized_kv.py`` run the
+kernel in interpreter mode on CPU.
 """
 
 from __future__ import annotations
@@ -39,20 +49,28 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # matches ops/flash_kernel: exp() stays NaN-free
 
 
-def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:
-    """[NP, ps, Hkv, D] arena + [S, P] table → dense [S, P*ps, Hkv, D]."""
+def gather_pages(pages: jax.Array, page_table: jax.Array,
+                 scale: Optional[jax.Array] = None) -> jax.Array:
+    """[NP, ps, Hkv, D] arena + [S, P] table → dense [S, P*ps, Hkv, D].
+
+    With ``scale`` ([NP, Hkv] per-page per-head dequant factors, int8
+    arenas) the dense view is dequantized to fp32 on the way out."""
     s, p = page_table.shape
     ps = pages.shape[1]
     dense = pages[page_table]  # [S, P, ps, Hkv, D]
+    if scale is not None:
+        dense = (dense.astype(jnp.float32)
+                 * scale[page_table][:, :, None, :, None])
     return dense.reshape(s, p * ps, *pages.shape[2:])
 
 
-def _gather_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes, scale):
+def _gather_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes, scale,
+                 k_scale=None, v_scale=None):
     from kubernetes_cloud_tpu.ops.attention import attention
 
     max_len = page_table.shape[1] * k_pages.shape[1]
-    dense_k = gather_pages(k_pages, page_table)
-    dense_v = gather_pages(v_pages, page_table)
+    dense_k = gather_pages(k_pages, page_table, k_scale)
+    dense_v = gather_pages(v_pages, page_table, v_scale)
     mask = (jnp.arange(max_len)[None, :] < ctx_lens[:, None]).astype(
         jnp.int32)
     out = attention(q[:, None], dense_k.astype(q.dtype),
@@ -61,9 +79,14 @@ def _gather_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes, scale):
     return out[:, 0]
 
 
-def _kernel(pt_ref, len_ref, slopes_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, group: int, page_size: int,
-            n_pages: int, scale: float, have_slopes: bool):
+def _kernel(pt_ref, len_ref, slopes_ref, q_ref, k_ref, v_ref, *rest,
+            group: int, page_size: int, n_pages: int, scale: float,
+            have_slopes: bool, have_scales: bool):
+    if have_scales:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+        ks_ref = vs_ref = None
     s, kh, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(p == 0)
@@ -76,9 +99,12 @@ def _kernel(pt_ref, len_ref, slopes_ref, q_ref, k_ref, v_ref, o_ref,
     q = q_ref[0, 0].astype(jnp.float32)          # [G, D]
     kblk = k_ref[0, :, 0, :]                     # [ps, D]
     vblk = v_ref[0, :, 0, :]
+    # dequant folds into the score scale: q·(s_k·k) = s_k·(q·k), so the
+    # int8 block feeds the MXU raw (cast in registers, never in HBM)
+    k_scale = ks_ref[0, 0] * scale if have_scales else scale
     scores = jax.lax.dot_general(
         q, kblk.astype(jnp.float32), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # [G, ps]
+        preferred_element_type=jnp.float32) * k_scale  # [G, ps]
     kpos = (p * page_size
             + jax.lax.broadcasted_iota(jnp.int32, (group, page_size), 1))
     if have_slopes:
@@ -97,6 +123,8 @@ def _kernel(pt_ref, len_ref, slopes_ref, q_ref, k_ref, v_ref, o_ref,
     pv = jax.lax.dot_general(
         probs, vblk.astype(jnp.float32), (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
+    if have_scales:
+        pv = pv * vs_ref[0, 0]  # per-page V dequant, post-matmul
     acc_ref[...] = acc_ref[...] * alpha + pv
     m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -108,30 +136,40 @@ def _kernel(pt_ref, len_ref, slopes_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _pallas_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes, scale,
-                 interpret):
+                 interpret, k_scale=None, v_scale=None):
     s, h, d = q.shape
     np_, ps, hkv, _ = k_pages.shape
     p_per = page_table.shape[1]
     g = h // hkv
     have_slopes = slopes is not None
+    have_scales = k_scale is not None
     qg = q.reshape(s, hkv, g, d)
 
     kernel = functools.partial(
         _kernel, group=g, page_size=ps, n_pages=p_per, scale=scale,
-        have_slopes=have_slopes)
+        have_slopes=have_slopes, have_scales=have_scales)
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d),
+                     lambda s_, kh, p_, pt, ln, sl: (s_, kh, 0, 0)),
+        pl.BlockSpec((1, ps, 1, d),
+                     lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], 0,
+                                                     kh, 0)),
+        pl.BlockSpec((1, ps, 1, d),
+                     lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], 0,
+                                                     kh, 0)),
+    ]
+    if have_scales:
+        # [NP, Hkv] dequant factors, one scalar block per (page, head)
+        in_specs += [
+            pl.BlockSpec((1, 1),
+                         lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], kh)),
+            pl.BlockSpec((1, 1),
+                         lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], kh)),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(s, hkv, p_per),
-        in_specs=[
-            pl.BlockSpec((1, 1, g, d),
-                         lambda s_, kh, p_, pt, ln, sl: (s_, kh, 0, 0)),
-            pl.BlockSpec((1, ps, 1, d),
-                         lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], 0,
-                                                         kh, 0)),
-            pl.BlockSpec((1, ps, 1, d),
-                         lambda s_, kh, p_, pt, ln, sl: (pt[s_, p_], 0,
-                                                         kh, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, g, d), lambda s_, kh, p_, pt, ln, sl: (s_, kh, 0, 0)),
         scratch_shapes=[
@@ -142,13 +180,16 @@ def _pallas_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes, scale,
     )
     slopes_arg = (slopes.astype(jnp.float32) if have_slopes
                   else jnp.zeros((h,), jnp.float32))
+    args = [qg, k_pages, v_pages]
+    if have_scales:
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s, hkv, g, d), q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), ctx_lens.astype(jnp.int32),
-      slopes_arg, qg, k_pages, v_pages)
+      slopes_arg, *args)
     return out.reshape(s, h, d)
 
 
@@ -159,6 +200,8 @@ def paged_decode_attention(
     page_table: jax.Array,   # [S, P] physical page per slot block
     ctx_lens: jax.Array,     # [S] valid keys per slot (incl. current)
     *,
+    k_scale: Optional[jax.Array] = None,  # [NP, Hkv] int8 dequant
+    v_scale: Optional[jax.Array] = None,
     slopes: Optional[jax.Array] = None,  # [H] ALiBi slopes
     scale: Optional[float] = None,
     impl: str = "gather",
@@ -167,11 +210,13 @@ def paged_decode_attention(
     """Attention of one decode token per slot over its paged context;
     returns [S, H, D].  Rows with ``ctx_lens == 0`` (free slots) return
     unspecified values — callers mask them (the engine never reads a
-    free slot's logits)."""
+    free slot's logits).  ``k_scale``/``v_scale`` mark an int8 arena:
+    pages dequantize in-kernel (module docstring)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if impl == "pallas":
         return _pallas_impl(q, k_pages, v_pages, page_table, ctx_lens,
-                            slopes, float(scale), interpret)
+                            slopes, float(scale), interpret,
+                            k_scale=k_scale, v_scale=v_scale)
     return _gather_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes,
-                        float(scale))
+                        float(scale), k_scale=k_scale, v_scale=v_scale)

@@ -181,6 +181,17 @@ _M_COW = obs.counter(
     "kct_engine_kv_cow_total",
     "Shared prefix pages copied on write before a private tail "
     "prefill.", ("model",))
+_M_KV_BYTES = obs.gauge(
+    "kct_engine_kv_bytes_per_token",
+    "Device KV-cache bytes one resident token row costs across every "
+    "layer (int8 arenas include their per-page scale rows) — the "
+    "capacity-planning constant behind pages-per-HBM-byte math.",
+    ("model",))
+_M_QUANT_ERR = obs.gauge(
+    "kct_engine_quant_logit_err",
+    "Max absolute logit error measured by the most recent "
+    "quantization-quality probe against an fp32 arena (0 until a "
+    "probe ran; 0 forever on fp32 replicas).", ("model",))
 
 
 class RequestCancelled(RuntimeError):
@@ -215,9 +226,19 @@ class EngineConfig:
     #: arena pages INCLUDING the reserved null page; 0 = equal bytes
     #: with the slot pool it replaces (slots * max_len rows) + null
     num_pages: int = 0
-    #: paged decode attention: "gather" (pure jnp, runs anywhere) or
-    #: "pallas" (Mosaic paged-attention kernel, TPU)
+    #: paged decode attention: "gather" (pure jnp, runs anywhere),
+    #: "pallas" (Mosaic paged-attention kernel), or "fused" (ONE
+    #: Mosaic kernel folding page gather + attention + output
+    #: projection — ops/fused_decode.py; kernels run interpreted
+    #: off-TPU so every impl stays CPU-testable)
     attn_impl: str = "gather"
+    #: paged KV storage: "fp32" keeps the model's cache dtype (token-
+    #: identical to the slot pool), "int8" stores quantized K/V with
+    #: per-page per-head scales — ~4x (fp32) / ~2x (bf16) the resident
+    #: pages at equal arena bytes, under a measured logit-error budget
+    #: instead of bitwise identity (deploy/README.md "Quantized KV &
+    #: fused kernels")
+    kv_dtype: str = "fp32"
     #: flight-recorder ring capacity: per-iteration phase records kept
     #: in bounded memory for ``GET /debug/timeline``.  Always on by
     #: default (the recorder is memory-only); 0 disables it — the A/B
@@ -249,8 +270,12 @@ class EngineConfig:
                 raise ValueError(
                     f"max_len ({self.max_len}) must be a multiple of "
                     f"page_size ({self.page_size})")
-            if self.attn_impl not in ("gather", "pallas"):
-                raise ValueError("attn_impl must be 'gather' or 'pallas'")
+            if self.attn_impl not in ("gather", "pallas", "fused"):
+                raise ValueError("attn_impl must be 'gather', 'pallas' "
+                                 "or 'fused'")
+            if self.kv_dtype not in paged_kv.KV_DTYPES:
+                raise ValueError(
+                    f"kv_dtype must be one of {paged_kv.KV_DTYPES}")
             if self.num_pages and self.num_pages < 2:
                 raise ValueError("num_pages must be >= 2 (page 0 is "
                                  "the null page)")
@@ -262,11 +287,37 @@ class EngineConfig:
 
     @property
     def effective_num_pages(self) -> int:
-        """Arena size; default matches the slot pool's row count so
-        paged-vs-slot comparisons are equal-HBM by construction."""
+        """Arena size at fp32 storage; default matches the slot pool's
+        row count so paged-vs-slot comparisons are equal-HBM by
+        construction.  :meth:`arena_pages` is the kv_dtype-aware form
+        the engine actually allocates."""
         if self.num_pages:
             return self.num_pages
         return self.slots * self.pages_per_slot + 1
+
+    def arena_pages(self, model_cfg) -> int:
+        """Arena size INCLUDING the null page, at equal BYTES.
+
+        An explicit ``num_pages`` wins.  Otherwise the budget is the
+        slot pool this config would have allocated (``slots × max_len``
+        rows at the model's cache dtype), converted into pages at the
+        configured ``kv_dtype`` — so flipping int8 on turns the same
+        HBM bill into ~4x (fp32 cache) / ~2x (bf16) the resident
+        pages instead of shrinking the footprint.  One source of
+        truth: ``bench_serving --kv-dtype`` A/Bs and the deploy/README
+        capacity math both reduce to this arithmetic."""
+        if self.num_pages:
+            return self.num_pages
+        if self.kv_dtype == "fp32":
+            return self.slots * self.pages_per_slot + 1
+        cache_bytes = jnp.dtype(model_cfg.dtype).itemsize
+        budget = self.slots * self.pages_per_slot * paged_kv.kv_page_bytes(
+            self.page_size, model_cfg.kv_heads, model_cfg.head_dim,
+            "fp32", cache_bytes)
+        page_b = paged_kv.kv_page_bytes(
+            self.page_size, model_cfg.kv_heads, model_cfg.head_dim,
+            self.kv_dtype)
+        return max(2, budget // page_b + 1)
 
 
 class GenRequest:
@@ -493,6 +544,11 @@ class ContinuousBatchingEngine:
         self.name = name
         self.pool: Optional[dict] = None
         self._slots: list[Optional[GenRequest]] = [None] * engine_cfg.slots
+        #: arena size INCLUDING the null page, kv_dtype-aware (equal
+        #: bytes with the slot pool unless num_pages pins it); 0 for
+        #: the dense pool
+        self._num_pages = (engine_cfg.arena_pages(cfg)
+                           if engine_cfg.paged else 0)
         # Per-tenant queues + WFQ drain order instead of one global
         # deque (serve/tenancy.py); _qlock still guards every queue
         # mutation AND the virtual-time/occupancy accounting, so the
@@ -501,7 +557,7 @@ class ContinuousBatchingEngine:
         # unlimited FIFO tenant — the legacy behavior exactly.
         self.tenants = TenantScheduler(
             engine_cfg.tenancy, slots=engine_cfg.slots,
-            page_capacity=(engine_cfg.effective_num_pages - 1
+            page_capacity=(self._num_pages - 1
                            if engine_cfg.paged else 0),
             model=name)
         self._qlock = threading.Lock()
@@ -580,6 +636,19 @@ class ContinuousBatchingEngine:
         self._flops_base, self._flops_per_ctx = \
             obs_flops.decode_flops_coeffs(cfg)
         self._peak_flops = obs_flops.peak_flops_per_s()
+        # the same coefficients price the WFQ service clock per token
+        # KIND (VTC's deferred weighted-cost item): a prefill token at
+        # context c costs (base + per_ctx*c)/base decode-equivalents
+        self.tenants.set_cost_model(self._flops_base, self._flops_per_ctx)
+        #: which phase label the decode step bills to — "fused_decode"
+        #: makes a fused-kernel rollout visible in the phase-share rate
+        self._decode_phase = ("fused_decode"
+                              if self.paged
+                              and engine_cfg.attn_impl == "fused"
+                              else "decode")
+        #: last kv_quant_probe result attached via note_quant_probe
+        #: (bench / operator tooling); surfaces in /debug/pages
+        self.last_quant_probe: Optional[dict] = None
         self._rates_at = 0.0  # last MFU/goodput gauge refresh (gated)
         # scrape-facing mirror: label-bound children resolved once so the
         # per-iteration cost is attribute access, not dict lookups
@@ -606,6 +675,18 @@ class ContinuousBatchingEngine:
         self._m_prefix_hits = _M_PREFIX_HITS.labels(**m)
         self._m_prefix_tokens = _M_PREFIX_TOKENS.labels(**m)
         self._m_cow = _M_COW.labels(**m)
+        self._m_quant_err = _M_QUANT_ERR.labels(**m)
+        self._m_quant_err.set(0.0)
+        cache_bytes = jnp.dtype(cfg.dtype).itemsize
+        if self.paged:
+            bpt = paged_kv.kv_bytes_per_token(
+                engine_cfg.page_size, cfg.kv_heads, cfg.head_dim,
+                cfg.num_layers, engine_cfg.kv_dtype, cache_bytes)
+        else:
+            bpt = (cfg.num_layers * 2 * cfg.kv_heads * cfg.head_dim
+                   * cache_bytes)
+        self.kv_bytes_per_token = float(bpt)
+        _M_KV_BYTES.labels(**m).set(self.kv_bytes_per_token)
         _M_SLOTS.labels(**m).set(engine_cfg.slots)
 
     # -- lifecycle ---------------------------------------------------------
@@ -698,14 +779,16 @@ class ContinuousBatchingEngine:
     def _init_arena(self) -> dict:
         """Paged mode: fixed page arena + fresh allocator and cleared
         host-side indirection (restart = cold prefix cache)."""
-        self.allocator = PageAllocator(self.ecfg.effective_num_pages,
-                                       self.ecfg.page_size)
+        self.allocator = PageAllocator(self._num_pages,
+                                       self.ecfg.page_size,
+                                       kv_dtype=self.ecfg.kv_dtype)
         self._page_table[:] = 0
         self._page_table_dirty = True
         self._lengths[:] = 0
         self._slot_pages = [None] * self.ecfg.slots
-        arena = init_page_arena(self.cfg, self.ecfg.effective_num_pages,
-                                self.ecfg.page_size)
+        arena = init_page_arena(self.cfg, self._num_pages,
+                                self.ecfg.page_size,
+                                kv_dtype=self.ecfg.kv_dtype)
         if self.mesh is not None:
             # pages replicate (the indirection gather is position-
             # blind); only KV heads shard, mirroring the slot pool.
@@ -722,8 +805,14 @@ class ContinuousBatchingEngine:
                      % max(self.mesh.shape.get(AXIS_MODEL, 1), 1) == 0
                      else None)
             kv = P(None, None, None, heads, None)
-            arena = jax.device_put(arena, logical_to_physical(
-                {"k": kv, "v": kv}, self.mesh))
+            spec = {"k": kv, "v": kv}
+            if "k_scale" in arena:
+                # [L, NP, Hkv] scale buffers shard like their pages'
+                # head axis (tiny either way — 4 bytes per page-head)
+                sc = P(None, None, heads)
+                spec.update(k_scale=sc, v_scale=sc)
+            arena = jax.device_put(arena,
+                                   logical_to_physical(spec, self.mesh))
         return arena
 
     # -- request side ------------------------------------------------------
@@ -735,6 +824,15 @@ class ContinuousBatchingEngine:
         could land inside the scheduler's read-modify-write of the
         same key and be overwritten."""
         self._peak_reset.set()
+
+    def note_quant_probe(self, probe: Mapping[str, Any]) -> None:
+        """Attach a :func:`~kubernetes_cloud_tpu.models.generate.
+        kv_quant_probe` result to this engine: feeds the
+        ``kct_engine_quant_logit_err`` gauge and ``/debug/pages`` so a
+        scrape can see the replica's measured error budget, not just
+        its dtype."""
+        self.last_quant_probe = dict(probe)
+        self._m_quant_err.set(float(probe.get("max_logit_err", 0.0)))
 
     def _device_page_table(self) -> jax.Array:
         """Host→device upload of the indirection table, paid only when
@@ -799,7 +897,7 @@ class ContinuousBatchingEngine:
         if self.paged:
             needed = paged_kv.pages_needed(len(prompt_ids), max_new_tokens,
                                            self.ecfg.page_size)
-            cap = self.ecfg.effective_num_pages - 1
+            cap = self._num_pages - 1
             if needed > cap:
                 # can never be satisfied, even by a drained arena: a
                 # config error, not transient backpressure
@@ -1018,10 +1116,13 @@ class ContinuousBatchingEngine:
                 "flops_per_ctx": self._flops_per_ctx,
                 "peak_flops_per_s": self._peak_flops,
                 "iter_s_ewma": self.iter_s,
+                "kv_bytes_per_token": self.kv_bytes_per_token,
                 "flight_records": self.ecfg.flight_records}
         if self.paged:
             meta["page_size"] = self.ecfg.page_size
-            meta["num_pages"] = self.ecfg.effective_num_pages
+            meta["num_pages"] = self._num_pages
+            meta["attn_impl"] = self.ecfg.attn_impl
+            meta["kv_dtype"] = self.ecfg.kv_dtype
         return meta
 
     def debug_slots(self) -> list[dict]:
@@ -1073,6 +1174,12 @@ class ContinuousBatchingEngine:
                 continue
         if snap is None:
             return {"error": "allocator busy; retry"}
+        # fleet probes tell a quantized replica from an fp32 one here
+        # (and in /readyz model detail) during rolling restarts
+        snap["attn_impl"] = self.ecfg.attn_impl
+        snap["kv_bytes_per_token"] = self.kv_bytes_per_token
+        if self.last_quant_probe is not None:
+            snap["quant_probe"] = dict(self.last_quant_probe)
         live_rows = int(sum(int(n) for n in self._lengths))
         reserved_rows = snap["used_pages"] * self.ecfg.page_size
         snap["live_rows"] = live_rows
@@ -1248,8 +1355,8 @@ class ContinuousBatchingEngine:
         self.stats["active_slot_steps"] += len(active)
         self._m_iters.inc()
         if rec is not None:
-            rec.phases["decode"] = rec.phases.get("decode", 0.0) \
-                + (t1 - t0)
+            ph = self._decode_phase  # "fused_decode" under the fused kernel
+            rec.phases[ph] = rec.phases.get(ph, 0.0) + (t1 - t0)
             rec.phases["host_sync"] = rec.phases.get("host_sync", 0.0) \
                 + (t2 - t1)
             rec.active = len(active)
@@ -1737,7 +1844,10 @@ class ContinuousBatchingEngine:
                 with self._qlock:
                     self.tenants.note_pages(req.tenant, len(res.pages))
                     if not resumed:
-                        self.tenants.charge_prefill(req, computed)
+                        # cache hits charge the computed tail only, at
+                        # its true deep-context FLOP price
+                        self.tenants.charge_prefill(
+                            req, computed, start=res.cached_tokens)
                 if rec is not None:
                     rec.admitted += 1
                     rec.prefill_tokens += computed
@@ -1832,7 +1942,9 @@ class ContinuousBatchingEngine:
         # this tenant (a tenant with an active slot is in_system, so
         # the lift is skipped); GIL-atomic float reads make the
         # cross-thread vt *reads* in pop ordering safe.
-        self.tenants.charge_decode(req)
+        self.tenants.charge_decode(
+            req, ctx=min(len(req.prompt_ids) + len(req.tokens),
+                         self.ecfg.max_len))
         if faults.fire("stream") != "drop":  # "drop" loses the delivery
             req.stream.put(tok)
         rec = self._rec
@@ -2025,7 +2137,21 @@ class ContinuousBatchingModel(Model):
             return {"ok": False, "reason": "engine dead"}
         return {"ok": True, "reason": "ok",
                 "heartbeat_age_s": round(eng.heartbeat.age, 3),
-                "queue_depth": eng.queue_depth()}
+                "queue_depth": eng.queue_depth(),
+                **self.serving_metadata()}
+
+    def serving_metadata(self) -> dict:
+        """Rollout metadata carried in every ``/readyz`` verdict (the
+        supervisor merges it into its own detail): a fleet probe can
+        tell a quantized replica — and which decode kernel it runs —
+        from an fp32 one during a rolling restart, instead of
+        discovering the mismatch in its logit budget."""
+        eng = self.engine
+        if eng is None:
+            return {}
+        return {"kv_dtype": (eng.ecfg.kv_dtype if eng.paged else "fp32"),
+                "attn_impl": (eng.ecfg.attn_impl if eng.paged
+                              else "dense")}
 
     # -- request side ------------------------------------------------------
 
@@ -2091,7 +2217,12 @@ class ContinuousBatchingModel(Model):
                # on these
                "tenant": req.tenant,
                "lane": req.lane,
-               "preemptions": req.preemptions}
+               "preemptions": req.preemptions,
+               # how this prediction's KV was stored: "int8" means the
+               # tokens came from the quantized arena under its
+               # measured logit-error budget, not bitwise fp identity
+               "kv_dtype": (self.cfg.kv_dtype if self.cfg.paged
+                            else "fp32")}
         if req.first_token_at is not None:
             # client-visible TTFT (load_test reports its distribution
             # and checks it against the server-side histogram),
@@ -2161,6 +2292,7 @@ def load_engine_config(model_dir: str) -> EngineConfig:
         page_size=int(cb.get("page_size", base.page_size)),
         num_pages=int(cb.get("num_pages", base.num_pages)),
         attn_impl=str(cb.get("attn_impl", base.attn_impl)),
+        kv_dtype=str(cb.get("kv_dtype", base.kv_dtype)),
         flight_records=int(cb.get("flight_records", base.flight_records)),
         tenancy=parse_tenancy(raw.get("tenancy")),
     )

@@ -306,6 +306,17 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--num-pages", type=int, default=0,
                     help="paged mode: arena pages incl. the null page "
                          "(0 = equal bytes with the slot pool)")
+    ap.add_argument("--kv-dtype", choices=("fp32", "int8"), default=None,
+                    help="paged mode: KV storage — int8 quantizes the "
+                         "arena (per-page per-head scales) for ~2-4x "
+                         "resident pages at equal bytes under a "
+                         "measured logit-error budget (deploy/README "
+                         "'Quantized KV & fused kernels')")
+    ap.add_argument("--attn-impl",
+                    choices=("gather", "pallas", "fused"), default=None,
+                    help="paged mode: decode attention kernel — "
+                         "'fused' folds gather+attention+output "
+                         "projection into one Mosaic kernel")
     ap.add_argument("--flight-records", type=int, default=-1,
                     help="continuous batching: flight-recorder ring "
                          "capacity (per-iteration phase records for "
@@ -394,6 +405,10 @@ def main(argv: Optional[list] = None) -> int:
             overrides["page_size"] = args.page_size
         if args.num_pages > 0:
             overrides["num_pages"] = args.num_pages
+        if args.kv_dtype:
+            overrides["kv_dtype"] = args.kv_dtype
+        if args.attn_impl:
+            overrides["attn_impl"] = args.attn_impl
         if args.flight_records >= 0:
             overrides["flight_records"] = args.flight_records
         if args.tenancy:

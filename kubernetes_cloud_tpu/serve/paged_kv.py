@@ -49,6 +49,38 @@ from kubernetes_cloud_tpu.serve.errors import KVPagesExhaustedError
 #: there.  Never allocated, never cached.
 NULL_PAGE = 0
 
+#: arena storage modes: "fp32" keeps K/V at the model's cache dtype
+#: (the pre-quantization behavior), "int8" stores symmetric int8 with
+#: per-page per-kv-head fp32 scales (models/generate.init_page_arena)
+KV_DTYPES = ("fp32", "int8")
+
+
+def kv_page_bytes(page_size: int, kv_heads: int, head_dim: int,
+                  kv_dtype: str = "fp32", cache_bytes: int = 4) -> int:
+    """Device bytes ONE physical page costs per layer: K + V rows plus,
+    for int8, the two fp32 ``[Hkv]`` scale rows riding alongside.
+    ``cache_bytes`` is the unquantized cache dtype's width (4 = fp32,
+    2 = bf16).  The equal-arena-bytes sizing in
+    ``serve.continuous.EngineConfig.arena_pages`` — and the capacity
+    math in deploy/README.md "Quantized KV & fused kernels" — both
+    read this, so the A/B benchmark and the docs can never disagree."""
+    if kv_dtype == "int8":
+        return 2 * (page_size * kv_heads * head_dim + 4 * kv_heads)
+    if kv_dtype != "fp32":
+        raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got "
+                         f"{kv_dtype!r}")
+    return 2 * page_size * kv_heads * head_dim * cache_bytes
+
+
+def kv_bytes_per_token(page_size: int, kv_heads: int, head_dim: int,
+                       num_layers: int, kv_dtype: str = "fp32",
+                       cache_bytes: int = 4) -> float:
+    """Whole-model KV bytes one resident token row costs (the
+    ``kct_engine_kv_bytes_per_token`` gauge): per-layer page bytes
+    amortized over the page's rows, times layers."""
+    return num_layers * kv_page_bytes(page_size, kv_heads, head_dim,
+                                      kv_dtype, cache_bytes) / page_size
+
 
 def pages_needed(prompt_len: int, max_new_tokens: int,
                  page_size: int) -> int:
@@ -101,14 +133,21 @@ class PageAllocator:
     allocates/releases (the same ownership discipline as the slot
     list), so no lock is taken here."""
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int,
+                 kv_dtype: str = "fp32"):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "null page)")
         if page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, "
+                             f"got {kv_dtype!r}")
         self.num_pages = num_pages
         self.page_size = page_size
+        #: how the arena this allocator fronts stores K/V — carried so
+        #: /debug/pages can tell a quantized replica from an fp32 one
+        self.kv_dtype = kv_dtype
         self._free: list[int] = list(range(num_pages - 1, NULL_PAGE, -1))
         self._refcnt = [0] * num_pages
         #: chain hash -> physical page holding that block's K/V
@@ -272,6 +311,7 @@ class PageAllocator:
         return {
             "num_pages": self.num_pages,
             "page_size": self.page_size,
+            "kv_dtype": self.kv_dtype,
             "capacity": self.capacity,
             "used_pages": self.used_pages(),
             "free_pages": self.free_pages(),

@@ -403,6 +403,11 @@ class ServingSupervisor:
                 "circuit": "open" if w.circuit_open else "closed",
                 "restarts": w.total_restarts,
                 "queue_depth": depth,
+                # model-declared rollout metadata (kv_dtype/attn_impl
+                # on the continuous engine) rides along so supervising
+                # a model never hides what its unsupervised /readyz
+                # would have said about its serving configuration
+                **getattr(model, "serving_metadata", dict)(),
             }
 
             def verdict(ok: bool, reason: str) -> dict:

@@ -158,6 +158,11 @@ class TenancyConfig:
     #: into bounded overhead: a request of N completion tokens suffers
     #: at most N / min_batch_progress preemptions
     min_batch_progress: int = 16
+    #: price the WFQ service clock by each token's analytical FLOPs
+    #: (prefill-at-context vs decode-at-context, obs/flops.py) instead
+    #: of counting every token as one tick — VTC's deferred per-kind
+    #: weighted-cost item, closed.  Off = the legacy equal-count clock.
+    flop_weighted_cost: bool = True
 
     def __post_init__(self):
         names = [t.name for t in self.tenants]
@@ -256,7 +261,8 @@ def parse_tenancy(raw: Optional[Mapping[str, Any]]
         tenants=tenants, default=default,
         preemption=bool(raw.get("preemption", True)),
         max_preempt_per_step=int(raw.get("max_preempt_per_step", 2)),
-        min_batch_progress=int(raw.get("min_batch_progress", 16)))
+        min_batch_progress=int(raw.get("min_batch_progress", 16)),
+        flop_weighted_cost=bool(raw.get("flop_weighted_cost", True)))
 
 
 class FleetClock:
@@ -438,6 +444,36 @@ class TenantScheduler:
         #: fleet-wide shared clock (serve/fleet.py); None = standalone
         #: engine, clocks stay local.  Set via attach_fleet_clock.
         self.fleet: Optional[FleetClock] = None
+        #: per-kind FLOP pricing coefficients (obs/flops.py affine
+        #: decode cost, set by the engine via set_cost_model) — None
+        #: until wired, which leaves the legacy count-tokens-equally
+        #: charge, as does cfg.flop_weighted_cost=False
+        self._cost_base: Optional[float] = None
+        self._cost_per_ctx: float = 0.0
+
+    def set_cost_model(self, base: float, per_ctx: float) -> None:
+        """Arm exact per-kind FLOP pricing of the WFQ service clock
+        (VTC, OSDI '24, closed its deferred weighted-cost item here):
+        a token at context ``c`` costs ``(base + per_ctx·c) / base``
+        decode-token-equivalents, so a long-context prefill burst pays
+        its true attention cost instead of one clock tick per token.
+        Normalizing by ``base`` keeps the virtual-time units ≈ tokens
+        — weights, floors, and the fleet ledger need no rescaling, and
+        ``flop_weighted_cost=False`` degrades to the legacy charge
+        continuously rather than to a different clock regime."""
+        if base > 0:
+            self._cost_base = float(base)
+            self._cost_per_ctx = float(per_ctx)
+
+    def _token_cost(self, start: int, tokens: int) -> float:
+        """Decode-token-equivalents for ``tokens`` consecutive tokens
+        whose contexts grow from ``start+1``: span_flops / base."""
+        if tokens <= 0:
+            return 0.0
+        if self._cost_base is None or not self.cfg.flop_weighted_cost:
+            return float(tokens)
+        r = self._cost_per_ctx / self._cost_base
+        return tokens + r * (tokens * start + tokens * (tokens + 1) / 2.0)
 
     # -- fleet-wide virtual time (serve/fleet.py) --------------------------
 
@@ -710,17 +746,27 @@ class TenantScheduler:
 
     # -- service accounting (virtual time) ---------------------------------
 
-    def charge_prefill(self, req: "GenRequest", tokens: int) -> None:
+    def charge_prefill(self, req: "GenRequest", tokens: int,
+                       start: int = 0) -> None:
+        """Charge ``tokens`` computed prefill tokens whose contexts
+        begin past ``start`` cached ones (cache hits charge only the
+        computed tail — AND, under FLOP pricing, at the tail's true
+        deep-context cost)."""
         st = self.state(req.tenant)
-        self._vt_advance(st, tokens / st.spec.weight)
+        self._vt_advance(st,
+                         self._token_cost(start, tokens) / st.spec.weight)
         st.m_prefill.inc(tokens)
         st.stats["prefill_tokens"] += tokens
         st.m_admitted[req.lane].inc()
         st.stats["admitted"] += 1
 
-    def charge_decode(self, req: "GenRequest") -> None:
+    def charge_decode(self, req: "GenRequest",
+                      ctx: Optional[int] = None) -> None:
+        """Charge one decoded token at context ``ctx`` (None = legacy
+        flat charge — also what flop_weighted_cost=False yields)."""
         st = self.state(req.tenant)
-        self._vt_advance(st, 1.0 / st.spec.weight)
+        cost = (self._token_cost(ctx - 1, 1) if ctx is not None else 1.0)
+        self._vt_advance(st, cost / st.spec.weight)
         st.m_decode.inc()
         st.stats["decode_tokens"] += 1
 

@@ -147,6 +147,12 @@ def build_parser() -> DashParser:
                              "lands (point at a mounted volume on "
                              "ephemeral pods; matches serving's "
                              "--profile-dir)")
+    parser.add_argument("--prefetch-batches", type=val.non_negative(int),
+                        default=2,
+                        help="Input-pipeline double buffering: batches "
+                             "materialized ahead of the step loop so "
+                             "data_load overlaps device compute "
+                             "(0 = synchronous iterator)")
     return parser
 
 
@@ -347,7 +353,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         flight_records=args.flight_records,
         eval_every=args.eval_every,
         divergence_policy=args.divergence_policy,
-        profile_dir=args.profile_dir)
+        profile_dir=args.profile_dir,
+        prefetch_batches=args.prefetch_batches)
 
     tokenizer = None
     if args.prompt_file:

@@ -27,6 +27,8 @@ import dataclasses
 import logging
 import math
 import os
+import queue
+import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
@@ -124,6 +126,12 @@ class TrainerConfig:
     top_k: int = 50
     top_p: float = 0.95
     temperature: float = 1.0
+    #: input-pipeline double buffering: a background thread keeps up to
+    #: this many batches materialized (host assembly + host→device
+    #: transfer) AHEAD of the step loop, so the ``data_load`` phase
+    #: overlaps the previous step's device compute instead of serializing
+    #: with it.  0 disables (the pre-overlap synchronous iterator).
+    prefetch_batches: int = 2
     # Observability (deploy/README.md "Training observability")
     flight_records: int = 1024   # step flight-recorder ring (0 = off)
     #: rank-0 /metrics + /debug sidecar port; None disables, 0 binds an
@@ -148,6 +156,8 @@ class TrainerConfig:
                 f"{self.divergence_policy!r}")
         if self.flight_records < 0:
             raise ValueError("flight_records must be >= 0")
+        if self.prefetch_batches < 0:
+            raise ValueError("prefetch_batches must be >= 0")
 
     @property
     def run_dir(self) -> str:
@@ -268,6 +278,72 @@ def read_prompts(path: str) -> list[str]:
         return [line.rstrip("\n") for line in fh if line.strip()]
 
 
+class _BatchPrefetcher:
+    """Double-buffered input pipeline (``TrainerConfig.prefetch_batches``).
+
+    A background thread pulls from the ``sharded_batches`` iterator —
+    host-side gather/stack AND the host→device transfer it enqueues —
+    up to ``depth`` batches ahead, so by the time the step loop asks,
+    the next batch is already resident and ``data_load`` collapses to a
+    queue pop.  The consumer's measured ``data_load`` phase then reports
+    only the *residual* stall (pipeline slower than the step), which is
+    exactly the number the perf_report phase shares should show.
+
+    Ordering is preserved (single producer, single consumer), so resume
+    fast-forward and the rollback don't-rewind-data contract are
+    untouched: batches handed out are consumed in the same sequence the
+    synchronous iterator would have produced."""
+
+    _END = object()
+
+    def __init__(self, it, depth: int):
+        self._it = it
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._fill, daemon=True,
+                                        name="batch-prefetch")
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Stop-aware bounded put; False once close() was called."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self) -> None:
+        try:
+            for item in self._it:
+                if not self._put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 - re-raised on the
+            self._err = e           # consumer thread in __next__
+        self._put(self._END)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._END:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop the producer (train() teardown); safe to call twice."""
+        self._stop.set()
+        try:  # unblock a producer parked on a full queue
+            self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
 class Trainer:
     """Sharded training loop with resume, perf metrics and sampling."""
 
@@ -316,6 +392,16 @@ class Trainer:
         def accum(acc, grads):
             return jax.tree.map(jnp.add, acc, grads)
 
+        def grad_micro_accum(params, acc, batch):
+            # micro-grad + accumulate fused into ONE program: halves
+            # the per-microstep dispatch count vs grad_micro→accum and
+            # lets XLA add each gradient into the (donated) running sum
+            # as it is produced instead of materializing both trees
+            (l, metrics), grads = jax.value_and_grad(
+                self._loss, argnums=1, has_aux=True)(model_cfg, params,
+                                                     batch)
+            return jax.tree.map(jnp.add, acc, grads), metrics
+
         def apply(state, grads, denom):
             grads = jax.tree.map(lambda g: g / denom, grads)
             grad_norm = optax.global_norm(grads)
@@ -327,6 +413,8 @@ class Trainer:
 
         self._grad_micro = jax.jit(grad_micro)
         self._accum = jax.jit(accum, donate_argnums=0)
+        self._grad_micro_accum = jax.jit(grad_micro_accum,
+                                         donate_argnums=1)
         self._apply = jax.jit(apply, donate_argnums=(0, 1),
                               static_argnums=2)
         # gas == 1: the one shared step implementation (train_step.py).
@@ -373,6 +461,7 @@ class Trainer:
         #: rank-0 HTTP sidecar, started/stopped by train()
         self.metrics_server = None
         self._batches = None
+        self._prefetcher: Optional[_BatchPrefetcher] = None
         self._eval_loss = None
         self._last_step = 0
         self._flops_cache: dict[tuple[int, int], float] = {}
@@ -551,10 +640,17 @@ class Trainer:
     # -- step-loop observability helpers -----------------------------------
 
     def _make_batches(self, start_step: int, gas: int) -> None:
-        self._batches = sharded_batches(
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        it = sharded_batches(
             self.dataset, self.cfg.batch_size, self.mesh,
             shuffle=self.cfg.shuffle, seed=self.cfg.seed, epochs=None,
             skip_batches=start_step * gas)  # cheap resume fast-forward
+        if self.cfg.prefetch_batches > 0:
+            it = self._prefetcher = _BatchPrefetcher(
+                it, self.cfg.prefetch_batches)
+        self._batches = it
 
     def _next_batch(self):
         """One micro-batch, timed: the ``data_load`` phase /
@@ -752,6 +848,9 @@ class Trainer:
             return self._train_loop(cfg, gas, start_step,
                                     steps_per_epoch, total_steps, world)
         finally:
+            if self._prefetcher is not None:
+                self._prefetcher.close()
+                self._prefetcher = None
             if server is not None:
                 server.stop()
 
@@ -813,9 +912,12 @@ class Trainer:
                     data_s += d
                     tokens += int(batch["input_ids"].size)
                     flops += self._micro_flops(batch)
-                    g, metrics = self._grad_micro(self.state["params"],
-                                                  batch)
-                    grads = g if grads is None else self._accum(grads, g)
+                    if grads is None:
+                        grads, metrics = self._grad_micro(
+                            self.state["params"], batch)
+                    else:
+                        grads, metrics = self._grad_micro_accum(
+                            self.state["params"], grads, batch)
                     loss_acc += metrics["loss"]
                 jax.block_until_ready(loss_acc)
                 t_gas = time.perf_counter() - t0

@@ -89,11 +89,24 @@ def _case(name, *, b=1, h=8, hkv=8, s=2048, d=64, use_alibi=False,
     return all_ok
 
 
+def _quantize_arena(pages):
+    """Symmetric int8 per-(page, kv-head) quantization (the serving
+    arena's storage contract): returns (int8 pages, [NP, Hkv] scales)."""
+    absmax = jnp.max(jnp.abs(pages), axis=(1, 3))
+    scale = jnp.maximum(absmax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(pages / scale[:, None, :, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
 def _paged_case(name, *, s=8, h=8, hkv=2, d=64, npages=64, ps=16,
-                p_per=8, use_alibi=False, seed=0):
+                p_per=8, use_alibi=False, seed=0, kv_dtype="fp32"):
     """Paged-attention decode parity: Mosaic kernel vs the jnp gather
     fallback vs a dense reference over the manually-flattened pages —
-    the three implementations the serving stack can dispatch."""
+    the three implementations the serving stack can dispatch.
+    ``kv_dtype="int8"`` quantizes the arena first: kernel and gather
+    must agree within fp tolerance on the SAME int8 content (they
+    dequantize the identical values), while the dense-fp32 comparison
+    is reported as the quantization-noise figure, not gated."""
     from kubernetes_cloud_tpu.ops.paged_attention import (
         gather_pages,
         paged_decode_attention,
@@ -114,15 +127,71 @@ def _paged_case(name, *, s=8, h=8, hkv=2, d=64, npages=64, ps=16,
     dv = gather_pages(vp, pt).transpose(0, 2, 1, 3)
     ref = _ref(q[:, :, None, :], dk, dv, slopes=slopes, mask=mask,
                causal=False)[:, :, 0, :]
+    scales = {}
+    if kv_dtype == "int8":
+        kp, ks = _quantize_arena(kp)
+        vp, vs = _quantize_arena(vp)
+        scales = {"k_scale": ks, "v_scale": vs}
     gather = paged_decode_attention(q, kp, vp, pt, ctx, slopes=slopes,
-                                    impl="gather")
+                                    impl="gather", **scales)
     kernel = paged_decode_attention(
         q, kp, vp, pt, ctx, slopes=slopes, impl="pallas",
-        interpret=jax.devices()[0].platform != "tpu")
+        interpret=jax.devices()[0].platform != "tpu", **scales)
 
     errs = {"gather vs dense": float(jnp.abs(gather - ref).max()),
             "kernel vs dense": float(jnp.abs(kernel - ref).max()),
             "kernel vs gather": float(jnp.abs(kernel - gather).max())}
+    if kv_dtype == "int8":
+        # int8: kernel and gather read identical quantized content and
+        # must agree to fp tolerance; the gap to the fp32 dense ref is
+        # the quantization noise the logit-error budget prices
+        all_ok = errs["kernel vs gather"] < FWD_TOL
+        errs["quant noise (vs fp32 dense)"] = errs.pop("gather vs dense")
+        errs.pop("kernel vs dense")
+    else:
+        all_ok = all(e < FWD_TOL for e in errs.values())
+    print(f"[{'OK ' if all_ok else 'FAIL'}] {name}")
+    for k, e in errs.items():
+        print(f"  {k} max err: {e:.2e}")
+    return all_ok
+
+
+def _fused_case(name, *, s=8, h=8, hkv=2, d=64, npages=64, ps=16,
+                p_per=8, hidden=256, use_alibi=False, seed=0,
+                kv_dtype="fp32"):
+    """Fused decode parity: the gather+attention+projection Mosaic
+    kernel vs its jnp ref vs the unfused kernel followed by the einsum
+    — the dispatch surface behind ``attn_impl="fused"``."""
+    from kubernetes_cloud_tpu.ops.fused_decode import fused_paged_decode
+    from kubernetes_cloud_tpu.ops.paged_attention import (
+        paged_decode_attention,
+    )
+
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((s, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((npages, ps, hkv, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((npages, ps, hkv, d)), jnp.float32)
+    wo = jnp.asarray(rng.standard_normal((h, d, hidden)) / d, jnp.float32)
+    pt = jnp.asarray(rng.integers(1, npages, (s, p_per)), jnp.int32)
+    ctx = jnp.asarray(rng.integers(1, p_per * ps + 1, (s,)), jnp.int32)
+    slopes = alibi_slopes(h) if use_alibi else None
+    scales = {}
+    if kv_dtype == "int8":
+        kp, ks = _quantize_arena(kp)
+        vp, vs = _quantize_arena(vp)
+        scales = {"k_scale": ks, "v_scale": vs}
+
+    ref = fused_paged_decode(q, kp, vp, pt, ctx, wo, slopes=slopes,
+                             impl="ref", **scales)
+    kernel = fused_paged_decode(
+        q, kp, vp, pt, ctx, wo, slopes=slopes, impl="pallas",
+        interpret=jax.devices()[0].platform != "tpu", **scales)
+    attn = paged_decode_attention(q, kp, vp, pt, ctx, slopes=slopes,
+                                  impl="gather", **scales)
+    unfused = jnp.einsum("shd,hdo->so", attn, wo)
+
+    errs = {"kernel vs ref": float(jnp.abs(kernel - ref).max()),
+            "kernel vs unfused": float(jnp.abs(kernel - unfused).max())}
     all_ok = all(e < FWD_TOL for e in errs.values())
     print(f"[{'OK ' if all_ok else 'FAIL'}] {name}")
     for k, e in errs.items():
@@ -153,6 +222,20 @@ def main() -> int:
                           seed=10)
         ok &= _paged_case("paged gqa 8/4 ps128 d128", hkv=4, ps=128,
                           p_per=4, npages=32, d=128, seed=11)
+        # int8 quantized arenas (kv_dtype="int8"): dequant-in-kernel
+        ok &= _paged_case("paged int8 gqa 8/2 ps16", kv_dtype="int8",
+                          seed=12)
+        ok &= _paged_case("paged int8 mha alibi ps16", hkv=8,
+                          use_alibi=True, kv_dtype="int8", seed=13)
+        # fused decode (attn_impl="fused"): gather+attention+projection
+        ok &= _fused_case("fused gqa 8/2 ps16 (serving default)", seed=14)
+        ok &= _fused_case("fused mha alibi ps16", hkv=8, use_alibi=True,
+                          seed=15)
+        ok &= _fused_case("fused int8 gqa 8/2 ps16", kv_dtype="int8",
+                          seed=16)
+        ok &= _fused_case("fused int8 d128 hidden1024", d=128, ps=32,
+                          p_per=4, npages=32, hidden=1024,
+                          kv_dtype="int8", seed=17)
     print("PARITY:", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 

@@ -1,0 +1,392 @@
+"""Int8 quantized paged-KV arena + fused decode kernel: correctness lock.
+
+The quantization tentpole relaxes the repo's token-identity discipline
+to a MEASURED logit-error budget, so this file locks exactly that
+contract:
+
+1. arena round-trip quantization error stays within the half-step
+   bound the per-(page, head) scale implies;
+2. the dequant-in-kernel Mosaic path (interpret mode), the jnp gather
+   fallback, and the fused gather+attention+projection kernel agree on
+   the same quantized content;
+3. the fixed-eval-set quality probe holds greedy top-1 agreement ≥ 99%
+   vs fp32 (the ISSUE acceptance bar) and fp32-vs-fp32 is exact;
+4. the engine end to end: int8 and fused sweeps complete and match the
+   fp32 gather engine's greedy tokens on the bench workload, the
+   equal-bytes sizing multiplies resident pages, and kv_dtype /
+   attn_impl surface in /debug and /readyz metadata;
+5. the WFQ FLOP-priced service clock (VTC's closed deferred item)
+   charges prefill and deep-context decode their true cost, and
+   degrades to equal-count when flagged off.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.models.generate import (
+    INT8_MAX,
+    _quant_decode_write,
+    generate,
+    init_page_arena,
+    kv_quant_probe,
+)
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingEngine,
+    EngineConfig,
+    load_engine_config,
+)
+from kubernetes_cloud_tpu.serve.paged_kv import (
+    kv_bytes_per_token,
+    kv_page_bytes,
+)
+from kubernetes_cloud_tpu.serve.tenancy import (
+    TenancyConfig,
+    TenantScheduler,
+)
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+PROMPTS = [list(range(1, 9)), list(range(40, 45)),
+           list(range(100, 120)), [7, 8, 9]]
+MAX_NEW = [6, 9, 4, 7]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def eval_prompts():
+    # THE fixed eval set — imported from the bench so the >=99% bar
+    # asserted here and the one bench_serving records can never
+    # diverge (conftest puts the repo root on sys.path)
+    from scripts.bench_serving import _eval_prompts
+
+    return _eval_prompts()
+
+
+# ---------------------------------------------------------------------------
+# arena round-trip quantization bounds
+# ---------------------------------------------------------------------------
+
+
+def test_quant_roundtrip_error_bound():
+    """A written row dequantizes within half a quantization step of the
+    original, and rescale drift (scale growth re-quantizing resident
+    rows) stays within one further step."""
+    rng = np.random.default_rng(0)
+    np_pages, ps, hkv, d = 4, 8, 2, 16
+    pages = jnp.zeros((np_pages, ps, hkv, d), jnp.int8)
+    scale = jnp.zeros((np_pages, hkv), jnp.float32)
+    originals = []
+    # grow magnitudes so every later write forces a page rescale
+    for row in range(ps):
+        new = jnp.asarray(rng.standard_normal((1, hkv, d)) * (1 + row),
+                          jnp.float32)
+        originals.append(np.asarray(new[0]))
+        pages, scale = _quant_decode_write(
+            pages, scale, jnp.asarray([1]), jnp.asarray([row]), new)
+    deq = np.asarray(pages[1].astype(jnp.float32)
+                     * scale[1][None, :, None])
+    final_step = np.asarray(scale[1])  # fp per int8 step, per head
+    for row, orig in enumerate(originals):
+        err = np.abs(deq[row] - orig)
+        # half a step for the final write; one extra step of rescale
+        # drift for rows written before the scale grew
+        assert (err <= 1.5 * final_step[:, None] + 1e-7).all(), row
+    # scale is the per-head absmax / 127 of the biggest write
+    assert float(scale[1].min()) > 0
+
+
+def test_quantized_arena_structure():
+    arena = init_page_arena(CFG, 8, 4, kv_dtype="int8")
+    assert arena["k"].dtype == jnp.int8
+    assert arena["k_scale"].shape == (CFG.num_layers, 8, CFG.kv_heads)
+    with pytest.raises(ValueError):
+        init_page_arena(CFG, 8, 4, kv_dtype="fp8")
+
+
+def test_kv_page_bytes_math():
+    # fp32 cache: 2 tensors * ps*Hkv*Dh*4 bytes; int8: 1 byte + scales
+    assert kv_page_bytes(16, 2, 64, "fp32", 4) == 2 * 16 * 2 * 64 * 4
+    assert kv_page_bytes(16, 2, 64, "int8") == 2 * (16 * 2 * 64 + 4 * 2)
+    # int8 quarters the per-token bytes (modulo scale overhead)
+    ratio = (kv_bytes_per_token(16, 2, 64, 4, "fp32", 4)
+             / kv_bytes_per_token(16, 2, 64, 4, "int8"))
+    assert 3.8 < ratio < 4.0
+
+
+# ---------------------------------------------------------------------------
+# quality probe: the measured logit-error budget
+# ---------------------------------------------------------------------------
+
+
+def test_probe_fp32_is_exact(params, eval_prompts):
+    probe = kv_quant_probe(CFG, params, eval_prompts[:2],
+                           max_new_tokens=4, page_size=8,
+                           kv_dtype="fp32")
+    assert probe["top1_agreement"] == 1.0
+    assert probe["max_logit_err"] == 0.0
+
+
+def test_probe_int8_meets_budget(params, eval_prompts):
+    """The ISSUE acceptance bar: greedy top-1 agreement >= 99% vs fp32
+    on the fixed eval set, with the logit error actually measured."""
+    probe = kv_quant_probe(CFG, params, eval_prompts,
+                           max_new_tokens=10, page_size=8)
+    assert probe["top1_agreement"] >= 0.99, probe
+    assert probe["max_logit_err"] < 0.1, probe
+    assert probe["positions"] == 10 * len(eval_prompts)
+
+
+@pytest.mark.parametrize("impl", ["pallas", "fused"])
+def test_probe_kernels_match_budget(params, eval_prompts, impl):
+    """The kernel paths (interpret mode on CPU) honor the same budget
+    as the gather fallback — dequant-in-kernel is not a second
+    numerics regime."""
+    probe = kv_quant_probe(CFG, params, eval_prompts[:2],
+                           max_new_tokens=6, page_size=8, impl=impl)
+    assert probe["top1_agreement"] >= 0.99, probe
+    assert probe["max_logit_err"] < 0.1, probe
+
+
+# ---------------------------------------------------------------------------
+# engine: int8 + fused sweeps
+# ---------------------------------------------------------------------------
+
+
+def make_engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("paged", True)
+    kw.setdefault("page_size", 8)
+    eng = ContinuousBatchingEngine(CFG, params, EngineConfig(**kw),
+                                   eos_token_id=None, pad_token_id=0)
+    eng.start()
+    return eng
+
+
+def _sweep(eng):
+    try:
+        reqs = [eng.submit(p, max_new_tokens=n, temperature=0.0)
+                for p, n in zip(PROMPTS, MAX_NEW)]
+        return [r.wait(eng) for r in reqs]
+    finally:
+        eng.stop()
+
+
+@pytest.fixture(scope="module")
+def reference(params):
+    refs = []
+    for p, n in zip(PROMPTS, MAX_NEW):
+        out = np.asarray(generate(CFG, params, jnp.asarray([p], jnp.int32),
+                                  max_new_tokens=n, temperature=0.0,
+                                  pad_token_id=0))
+        refs.append(out[0, len(p):len(p) + n].tolist())
+    return refs
+
+
+def test_int8_engine_sweep_agreement(params, reference):
+    """End-to-end int8 engine: every request completes, and on this
+    model the measured budget is tight enough that the greedy tokens
+    come out identical to fp32 one-shot generation (the probe above is
+    the contractual >= 99% bar; identity here is the measured fact for
+    this fixed workload)."""
+    eng = make_engine(params, kv_dtype="int8")
+    outs = _sweep(eng)
+    total = agree = 0
+    for got, ref in zip(outs, reference):
+        assert len(got) == len(ref)
+        total += len(ref)
+        agree += sum(int(a == b) for a, b in zip(got, ref))
+    assert agree / total >= 0.99, (outs, reference)
+    assert eng.stats["evictions"] == len(PROMPTS)
+    # equal-bytes sizing: the int8 arena holds ~4x the fp32 pages
+    fp_pages = EngineConfig(slots=2, max_len=64, paged=True,
+                            page_size=8).arena_pages(CFG)
+    assert eng._num_pages >= 3.5 * fp_pages
+
+
+def test_fused_engine_fp32_token_identical(params, reference):
+    """attn_impl="fused" (interpret mode on CPU) over an fp32 arena is
+    a kernel swap, not a numerics change big enough to flip greedy
+    argmax on this workload: tokens match the gather engine's."""
+    eng = make_engine(params, attn_impl="fused")
+    assert _sweep(eng) == reference
+
+
+def test_int8_fused_engine_sweep(params, reference):
+    """Both tentpole halves composed: quantized arena + fused kernel."""
+    eng = make_engine(params, kv_dtype="int8", attn_impl="fused")
+    outs = _sweep(eng)
+    total = sum(len(r) for r in reference)
+    agree = sum(int(a == b) for got, ref in zip(outs, reference)
+                for a, b in zip(got, ref))
+    assert agree / total >= 0.99
+
+
+def test_int8_prefix_cache_sharing(params):
+    """Prefix pages quantized once are reused across requests: sharing
+    still dedups prefill under int8, and shared-page scales are never
+    rewritten by the borrowing request (outputs stay within budget)."""
+    shared = list(range(200, 224))  # 3 full pages at page_size=8
+    prompts = [shared + [t] for t in (5, 6)]
+    eng = make_engine(params, kv_dtype="int8")
+    try:
+        outs = [eng.submit(p, max_new_tokens=5,
+                           temperature=0.0).wait(eng) for p in prompts]
+        assert eng.stats["prefix_hits"] == 1
+        assert eng.stats["prefix_tokens_saved"] == 24
+        assert all(len(o) == 5 for o in outs)
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# metadata surfacing: /debug + /readyz can tell replicas apart
+# ---------------------------------------------------------------------------
+
+
+def test_engine_surfaces_kv_dtype(params):
+    eng = make_engine(params, kv_dtype="int8", attn_impl="fused")
+    try:
+        meta = eng.debug_meta()
+        assert meta["kv_dtype"] == "int8"
+        assert meta["attn_impl"] == "fused"
+        assert meta["kv_bytes_per_token"] == eng.kv_bytes_per_token
+        pages = eng.debug_pages()
+        assert pages["kv_dtype"] == "int8"
+        assert pages["attn_impl"] == "fused"
+        assert "quant_probe" not in pages
+        eng.note_quant_probe({"top1_agreement": 1.0,
+                              "max_logit_err": 0.001})
+        assert eng.debug_pages()["quant_probe"]["max_logit_err"] == 0.001
+    finally:
+        eng.stop()
+
+
+def test_model_health_carries_rollout_metadata(params):
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingModel,
+    )
+
+    class _Svc:
+        cfg = CFG
+        ready = True
+        mesh = None
+        tokenizer = None
+
+        def __init__(self, p):
+            self.params = p
+
+        def load(self):
+            pass
+
+    model = ContinuousBatchingModel(
+        "lm", _Svc(params),
+        EngineConfig(slots=2, max_len=64, paged=True, page_size=8,
+                     kv_dtype="int8"))
+    model.load()
+    try:
+        h = model.health()
+        assert h["ok"] and h["kv_dtype"] == "int8"
+        assert h["attn_impl"] == "gather"
+        assert model.serving_metadata() == {"kv_dtype": "int8",
+                                            "attn_impl": "gather"}
+    finally:
+        model.stop()
+
+
+def test_prediction_reports_kv_dtype(params):
+    eng = make_engine(params, kv_dtype="int8")
+    try:
+        assert eng.ecfg.kv_dtype == "int8"
+    finally:
+        eng.stop()
+    # the per-prediction field rides ContinuousBatchingModel._finish;
+    # its value is the engine config's kv_dtype (fp32 when dense)
+    assert EngineConfig().kv_dtype == "fp32"
+
+
+def test_engine_config_kv_dtype_validation(tmp_path):
+    with pytest.raises(ValueError):
+        EngineConfig(paged=True, kv_dtype="fp8")
+    with pytest.raises(ValueError):
+        EngineConfig(paged=True, attn_impl="mosaic")
+    # model_config.json plumbing
+    import json
+
+    (tmp_path / "model_config.json").write_text(json.dumps({
+        "continuous_batching": {"paged": True, "kv_dtype": "int8",
+                                "attn_impl": "fused", "page_size": 8,
+                                "max_len": 64}}))
+    cfg = load_engine_config(str(tmp_path))
+    assert cfg.kv_dtype == "int8" and cfg.attn_impl == "fused"
+
+
+# ---------------------------------------------------------------------------
+# WFQ per-kind FLOP pricing (VTC deferred item, closed)
+# ---------------------------------------------------------------------------
+
+
+class _Req:
+    def __init__(self, tenant="default", lane="interactive"):
+        self.tenant = tenant
+        self.lane = lane
+
+
+def test_flop_weighted_prefill_charge():
+    sched = TenantScheduler(TenancyConfig(), slots=4)
+    sched.set_cost_model(base=1000.0, per_ctx=10.0)
+    st = sched.state("default")
+    sched.charge_prefill(_Req(), 8)
+    # span cost: 8 + (10/1000) * (8*9/2) = 8.36 decode-equivalents
+    assert sched._vt(st) == pytest.approx(8.36)
+    # a cache hit charges only the tail, but at its DEEP context price
+    sched2 = TenantScheduler(TenancyConfig(), slots=4)
+    sched2.set_cost_model(base=1000.0, per_ctx=10.0)
+    sched2.charge_prefill(_Req(), 8, start=100)
+    # 8 + 0.01*(8*100 + 36) = 16.36
+    assert sched2._vt(sched2.state("default")) == pytest.approx(16.36)
+
+
+def test_flop_weighted_decode_charge():
+    sched = TenantScheduler(TenancyConfig(), slots=4)
+    sched.set_cost_model(base=1000.0, per_ctx=10.0)
+    st = sched.state("default")
+    sched.charge_decode(_Req(), ctx=101)
+    # one token at context 101: 1 + 0.01*101 = 2.01
+    assert sched._vt(st) == pytest.approx(2.01)
+    sched.charge_decode(_Req())  # legacy flat charge without ctx
+    assert sched._vt(st) == pytest.approx(3.01)
+
+
+def test_flop_pricing_flag_off_is_legacy():
+    cfg = TenancyConfig(flop_weighted_cost=False)
+    sched = TenantScheduler(cfg, slots=4)
+    sched.set_cost_model(base=1000.0, per_ctx=10.0)
+    sched.charge_prefill(_Req(), 8, start=100)
+    sched.charge_decode(_Req(), ctx=101)
+    assert sched._vt(sched.state("default")) == pytest.approx(9.0)
+
+
+def test_unwired_cost_model_is_legacy():
+    sched = TenantScheduler(TenancyConfig(), slots=4)
+    sched.charge_prefill(_Req(), 8, start=100)
+    assert sched._vt(sched.state("default")) == pytest.approx(8.0)
+
+
+def test_parse_tenancy_flag():
+    from kubernetes_cloud_tpu.serve.tenancy import parse_tenancy
+
+    cfg = parse_tenancy({"tenants": []})
+    assert cfg.flop_weighted_cost is True
+    cfg = parse_tenancy({"flop_weighted_cost": False})
+    assert cfg.flop_weighted_cost is False
