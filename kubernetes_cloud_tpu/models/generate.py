@@ -253,6 +253,38 @@ def copy_pages(arena: dict, src: jax.Array, dst: jax.Array) -> dict:
     return out
 
 
+def extract_pages(arena: dict, pages: Sequence[int]) -> dict:
+    """Pull physical pages out of an arena as host arrays — the
+    extract half of the prefill→decode KV handover
+    (``serve/disagg.py``): ``[L, n, ps, Hkv, Dh]`` per K/V (plus the
+    ``[L, n, Hkv]`` scale rows of an int8 arena).  Must run on the
+    arena owner's scheduler thread, between program dispatches —
+    the decode/prefill jits donate the arena buffer, so a concurrent
+    reader would hold a deleted array."""
+    idx = jnp.asarray(list(pages), jnp.int32)
+    out = {"k": np.asarray(arena["k"][:, idx]),
+           "v": np.asarray(arena["v"][:, idx])}
+    if "k_scale" in arena:
+        out["k_scale"] = np.asarray(arena["k_scale"][:, idx])
+        out["v_scale"] = np.asarray(arena["v_scale"][:, idx])
+    return out
+
+
+def install_pages(arena: dict, dst: jax.Array, payload: dict) -> dict:
+    """Write transferred page content into ``dst`` physical pages —
+    the install half of the KV handover.  Jit-friendly (the engine
+    wraps it with a donated arena); on a mesh-sharded arena the head
+    axis re-shards under GSPMD on the way in."""
+    out = {"k": arena["k"].at[:, dst].set(
+               payload["k"].astype(arena["k"].dtype)),
+           "v": arena["v"].at[:, dst].set(
+               payload["v"].astype(arena["v"].dtype))}
+    if "k_scale" in arena:
+        out["k_scale"] = arena["k_scale"].at[:, dst].set(payload["k_scale"])
+        out["v_scale"] = arena["v_scale"].at[:, dst].set(payload["v_scale"])
+    return out
+
+
 def _quant_decode_write(pages: jax.Array, scale: jax.Array,
                         phys: jax.Array, rows: jax.Array,
                         new: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -541,7 +573,7 @@ def kv_quant_probe(cfg: CausalLMConfig, params: Params,
                    prompts: Sequence[Sequence[int]], *,
                    max_new_tokens: int = 16, page_size: int = 16,
                    impl: str = "gather",
-                   kv_dtype: str = "int8") -> dict:
+                   kv_dtype: str = "int8", mesh=None) -> dict:
     """Measured logit-error budget for a quantized arena.
 
     Runs every prompt through an fp32 paged arena and a ``kv_dtype``
@@ -553,7 +585,33 @@ def kv_quant_probe(cfg: CausalLMConfig, params: Params,
     --kv-dtype int8``.  Teacher-forcing makes the comparison
     per-position exact: both paths always score the SAME context, so a
     single early disagreement cannot cascade into meaningless
-    downstream comparisons."""
+    downstream comparisons.
+
+    With ``mesh`` (model axis > 1), both arenas shard over the kv-head
+    axis and the probe drives the ``shard_map`` TP programs
+    (:mod:`kubernetes_cloud_tpu.models.tp_decode`) instead — the
+    sharded acceptance bar for a quantized mesh replica."""
+    run_prefill = (lambda kd, a, i_, m_, t_, s_: prefill_into_pages(
+        cfg, params, i_, m_, a, t_, s_))
+    run_decode = (lambda kd, a, tok, t_, ln: decode_step_pages(
+        cfg, params, tok, a, t_, ln, impl=impl))
+    place = lambda a: a  # noqa: E731 - trivial identity default
+    if mesh is not None:
+        from kubernetes_cloud_tpu.models import tp_decode
+
+        if tp_decode.tp_shards(mesh) > 1:
+            reason = tp_decode.tp_unsupported_reason(cfg, mesh)
+            if reason is not None:
+                raise ValueError(f"sharded quant probe: {reason}")
+            params_tp = tp_decode.place_tp_params(cfg, params, mesh)
+            progs = {kd: tp_decode.build_tp_programs(
+                cfg, mesh, params_tp, kv_dtype=kd, attn_impl=impl)
+                for kd in ("fp32", kv_dtype)}
+            run_prefill = (lambda kd, a, i_, m_, t_, s_:
+                           progs[kd][0](params_tp, i_, m_, a, t_, s_))
+            run_decode = (lambda kd, a, tok, t_, ln:
+                          progs[kd][1](params_tp, tok, a, t_, ln))
+            place = lambda a: tp_decode.place_arena(a, mesh)  # noqa: E731
     agree = total = 0
     max_err = 0.0
     err_sum = 0.0
@@ -566,10 +624,9 @@ def kv_quant_probe(cfg: CausalLMConfig, params: Params,
         mask = jnp.ones((1, plen), jnp.int32)
         start = jnp.zeros((1,), jnp.int32)
         for kd in ("fp32", kv_dtype):
-            arena = init_page_arena(cfg, n_pages + 1, page_size,
-                                    kv_dtype=kd)
-            lg, arena = prefill_into_pages(cfg, params, ids, mask,
-                                           arena, tables, start)
+            arena = place(init_page_arena(cfg, n_pages + 1, page_size,
+                                          kv_dtype=kd))
+            lg, arena = run_prefill(kd, arena, ids, mask, tables, start)
             arenas[kd], logits[kd] = arena, lg
         for step in range(max_new_tokens):
             ref = np.asarray(logits["fp32"])[0]
@@ -584,8 +641,8 @@ def kv_quant_probe(cfg: CausalLMConfig, params: Params,
             tok = jnp.asarray([int(ref.argmax())], jnp.int32)
             ln = jnp.asarray([plen + step], jnp.int32)
             for kd in ("fp32", kv_dtype):
-                logits[kd], arenas[kd] = decode_step_pages(
-                    cfg, params, tok, arenas[kd], tables, ln, impl=impl)
+                logits[kd], arenas[kd] = run_decode(
+                    kd, arenas[kd], tok, tables, ln)
     return {"kv_dtype": kv_dtype, "positions": total,
             "top1_agreement": round(agree / max(total, 1), 6),
             "max_logit_err": round(max_err, 6),

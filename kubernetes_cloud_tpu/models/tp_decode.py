@@ -1,0 +1,538 @@
+"""Tensor-parallel paged decode — ONE ``shard_map``ped program per
+engine iteration (ROADMAP item 1; the BLOOM-176B serving pattern).
+
+The serving engine's flagship reference workload is a model that cannot
+fit one chip, yet ``serve/continuous.py``'s device programs were
+single-chip: a mesh only sharded them implicitly through GSPMD.  This
+module makes the parallelism *explicit* Megatron-style intra-layer TP
+(PAPERS.md, Megatron-LM): every prefill and decode iteration is one
+``shard_map`` over the ``model`` axis in which each shard owns
+
+* a contiguous slice of the attention heads — ``wq``/``wk``/``wv``
+  sharded on the head dim (the fused ``wqkv`` is split at load so the
+  ``[H + 2·Hkv]`` dim chunks cleanly; rules live in the
+  :mod:`kubernetes_cloud_tpu.parallel.sharding` table), the paged KV
+  arena sharded on its kv-head axis
+  (:func:`~kubernetes_cloud_tpu.parallel.sharding.kv_arena_specs`),
+  and an int8 arena's per-page scale buffers following their pages'
+  head axis;
+* a row slice of ``W_o`` and a column slice of ``W_in`` — the two
+  ``psum`` points per block (attention output, MLP output), exactly
+  Megatron's ``g``/``f`` operators;
+* a vocab slice of the (tied or untied) embedding: the token lookup is
+  a masked-gather + ``psum`` (one shard contributes per token, so the
+  sum is exact) and the LM head emits a logits slice that one
+  ``all_gather`` reassembles.
+
+Everything the scheduler owns — page tables, lengths, sampling —
+stays replicated host state; per-shard attention math is bitwise the
+single-chip math per head (contractions over heads/ffn are the only
+reassociated sums), so greedy decode is token-identical to the
+unsharded engine (``tests/test_sharded_engine.py`` locks it for fp32
+AND int8 arenas, 2- and 4-way).  The jnp fallbacks (and interpreted
+Pallas kernels) keep every impl CPU-testable on a host-platform mesh
+of virtual devices, so tier-1 exercises real ≥2-way sharding.
+
+Scope: pure-TP serving meshes (every axis but ``model`` must be 1 —
+batch/fsdp sharding of a decode batch belongs to the fleet layer, not
+the kernel).  MoE experts run replicated inside the program (the
+routing all-to-all of true expert parallelism is deferred; the config
+still serves correctly).  :func:`tp_unsupported_reason` names the
+constraint violated so the engine can fall back to GSPMD loudly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubernetes_cloud_tpu.core.mesh import AXIS_MODEL
+from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig, _norm
+from kubernetes_cloud_tpu.models.generate import (
+    _page_scatter_indices,
+    _quant_decode_write,
+    _quant_prefill_write,
+)
+from kubernetes_cloud_tpu.ops.attention import attention
+from kubernetes_cloud_tpu.ops.layers import (
+    alibi_slopes,
+    apply_rotary,
+    rope_cache,
+)
+from kubernetes_cloud_tpu.parallel.sharding import (
+    kv_arena_specs,
+    logical_to_physical,
+    param_specs,
+)
+from kubernetes_cloud_tpu.utils.compat import shard_map
+
+Params = dict[str, Any]
+
+
+def tp_shards(mesh) -> int:
+    """How many ways the ``model`` axis shards the decode program."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(AXIS_MODEL, 1))
+
+
+def tp_unsupported_reason(cfg: CausalLMConfig, mesh) -> Optional[str]:
+    """None when the shard_map TP decode program can serve this
+    (config, mesh) pair; otherwise the constraint violated — the
+    engine logs it and falls back to GSPMD placement."""
+    m = tp_shards(mesh)
+    if m < 2:
+        return "model axis is 1 (nothing to shard)"
+    for ax, size in mesh.shape.items():
+        if ax != AXIS_MODEL and size > 1:
+            return (f"mesh axis {ax!r} has size {size}; the TP decode "
+                    f"program shards only 'model'")
+    if cfg.num_heads % m:
+        return f"num_heads ({cfg.num_heads}) not divisible by {m} shards"
+    if cfg.kv_heads % m:
+        return f"kv_heads ({cfg.kv_heads}) not divisible by {m} shards"
+    if cfg.vocab_size % m:
+        return f"vocab_size ({cfg.vocab_size}) not divisible by {m} shards"
+    if not cfg.moe_experts and cfg.ffn_size % m:
+        return f"ffn_size ({cfg.ffn_size}) not divisible by {m} shards"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# parameter layout: fused wqkv split so heads chunk cleanly over `model`
+# ---------------------------------------------------------------------------
+
+
+def split_qkv_params(cfg: CausalLMConfig, params: Params) -> Params:
+    """Serving decode layout: ``attn.wqkv`` → ``wq``/``wk``/``wv``
+    (and ``bqkv`` → ``bq``/``bk``/``bv``).  The fused ``[H + 2·Hkv]``
+    projection dim cannot be chunked evenly over shards without mixing
+    q heads into a k/v shard, so the split happens once at engine
+    init; everything else is shared by reference."""
+    h, hkv = cfg.num_heads, cfg.kv_heads
+    attn = dict(params["blocks"]["attn"])
+    wqkv = attn.pop("wqkv")
+    attn["wq"] = wqkv[:, :, :h]
+    attn["wk"] = wqkv[:, :, h:h + hkv]
+    attn["wv"] = wqkv[:, :, h + hkv:]
+    if "bqkv" in attn:
+        b = attn.pop("bqkv")
+        attn["bq"] = b[:, :h]
+        attn["bk"] = b[:, h:h + hkv]
+        attn["bv"] = b[:, h + hkv:]
+    blocks = dict(params["blocks"])
+    blocks["attn"] = attn
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
+
+
+def tp_param_specs(params_split: Params) -> Any:
+    """PartitionSpec tree for the split layout, straight from the
+    :mod:`parallel.sharding` rule table — with one serving override:
+    MoE expert weights stay replicated inside the shard_map program
+    (true expert parallelism's dispatch all-to-all is deferred; a
+    replicated-expert block computes a replicated output, so no psum
+    is needed and correctness is untouched)."""
+    specs = param_specs(params_split)
+
+    def fix(path, spec):
+        for part in path:
+            if getattr(part, "key", getattr(part, "name", None)) == "moe":
+                return P()
+        return spec
+
+    return jax.tree_util.tree_map_with_path(
+        fix, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def place_tp_params(cfg: CausalLMConfig, params: Params, mesh) -> Params:
+    """Split + place the parameter pytree for the TP decode program."""
+    split = split_qkv_params(cfg, params)
+    return jax.device_put(split,
+                          logical_to_physical(tp_param_specs(split), mesh))
+
+
+def place_arena(arena: dict, mesh) -> dict:
+    """Place a page arena per :func:`kv_arena_specs` (kv heads over
+    ``model``; int8 scales follow their pages' head axis)."""
+    return jax.device_put(
+        arena, logical_to_physical(kv_arena_specs("k_scale" in arena),
+                                   mesh))
+
+
+# ---------------------------------------------------------------------------
+# per-shard block math (mirrors models/generate.py; psum where the rule
+# table splits a contraction)
+# ---------------------------------------------------------------------------
+
+
+def _tp_embed(cfg: CausalLMConfig, params: Params, input_ids: jax.Array,
+              positions: jax.Array, idx: jax.Array, m: int) -> jax.Array:
+    """Vocab-sharded embedding lookup: each shard holds ``V/m`` rows;
+    exactly one shard contributes per token, so the psum is exact."""
+    v_loc = cfg.vocab_size // m
+    wte = params["embed"]["wte"]
+    loc = input_ids - idx * v_loc
+    valid = (loc >= 0) & (loc < v_loc)
+    rows = wte[jnp.clip(loc, 0, v_loc - 1)]
+    x = jax.lax.psum(jnp.where(valid[..., None], rows,
+                               jnp.zeros_like(rows)), AXIS_MODEL)
+    x = x.astype(cfg.dtype)
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["wpe"][positions].astype(cfg.dtype)
+    if cfg.embed_layernorm:
+        x = _norm(cfg, params["embed"]["ln"], x)
+    return x
+
+
+def _tp_qkv(cfg: CausalLMConfig, p: Params, x: jax.Array, *,
+            rope, q_positions):
+    """Head-sliced mirror of ``causal_lm._project_qkv``: this shard's
+    q/k/v heads only (contraction over hidden is intact, so per-head
+    values are bitwise the single-chip ones)."""
+    attn_in = _norm(cfg, p["ln1"], x)
+    q = jnp.einsum("bsd,dnk->bsnk", attn_in,
+                   p["attn"]["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dnk->bsnk", attn_in,
+                   p["attn"]["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dnk->bsnk", attn_in,
+                   p["attn"]["wv"].astype(cfg.dtype))
+    if cfg.use_bias:
+        q = q + p["attn"]["bq"].astype(cfg.dtype)
+        k = k + p["attn"]["bk"].astype(cfg.dtype)
+        v = v + p["attn"]["bv"].astype(cfg.dtype)
+    if rope is not None:
+        cos, sin = rope
+        q = apply_rotary(q, cos, sin, positions=q_positions,
+                         interleaved=cfg.rope_interleaved)
+        k = apply_rotary(k, cos, sin, positions=q_positions,
+                         interleaved=cfg.rope_interleaved)
+    return q, k, v
+
+
+def _tp_wo(cfg: CausalLMConfig, p: Params, attn_vec: jax.Array
+           ) -> jax.Array:
+    """Row-parallel output projection: partial per-shard contraction
+    over this shard's heads, psummed; bias added once post-psum."""
+    part = jnp.einsum("bsnk,nkd->bsd", attn_vec,
+                      p["attn"]["wo"].astype(cfg.dtype))
+    out = jax.lax.psum(part, AXIS_MODEL)
+    if cfg.use_bias:
+        out = out + p["attn"]["bo"].astype(cfg.dtype)
+    return out
+
+
+def _tp_finish(cfg: CausalLMConfig, p: Params, x: jax.Array,
+               attn_out: jax.Array, token_mask, moe_no_drop: bool
+               ) -> jax.Array:
+    """Mirror of ``causal_lm._finish_block``'s residual wiring with a
+    column/row-parallel MLP (psum on the down projection); ``attn_out``
+    arrives already psummed + biased.  MoE blocks run replicated (see
+    :func:`tp_param_specs`)."""
+    if cfg.parallel_residual:
+        mlp_in = _norm(cfg, p["ln2"], x)
+    else:
+        x = x + attn_out
+        mlp_in = _norm(cfg, p["ln2"], x)
+    if "moe" in p:
+        from kubernetes_cloud_tpu.ops.moe import moe_ffn
+
+        if token_mask is not None and token_mask.ndim != 2:
+            token_mask = None
+        mlp_out, _aux = moe_ffn(
+            mlp_in, p["moe"]["router"], p["moe"]["wi"], p["moe"]["wo"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            act=cfg.act, dtype=cfg.dtype, token_mask=token_mask,
+            group_size=cfg.moe_group_size, no_drop=moe_no_drop)
+    else:
+        hmid = jnp.einsum("bsd,df->bsf", mlp_in,
+                          p["mlp"]["wi"].astype(cfg.dtype))
+        if cfg.use_bias:
+            hmid = hmid + p["mlp"]["bi"].astype(cfg.dtype)
+        hmid = jax.nn.gelu(hmid, approximate=cfg.act == "gelu_tanh")
+        mlp_out = jax.lax.psum(
+            jnp.einsum("bsf,fd->bsd", hmid,
+                       p["mlp"]["wo"].astype(cfg.dtype)), AXIS_MODEL)
+        if cfg.use_bias:
+            mlp_out = mlp_out + p["mlp"]["bo"].astype(cfg.dtype)
+    if cfg.parallel_residual:
+        return x + attn_out + mlp_out
+    return x + mlp_out
+
+
+def _tp_unembed(cfg: CausalLMConfig, params: Params, x: jax.Array,
+                idx: jax.Array, m: int) -> jax.Array:
+    """final_ln + vocab-sliced LM head; one all_gather reassembles the
+    full fp32 logits in shard order (= the unsharded vocab order)."""
+    x = _norm(cfg, params["final_ln"], x)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed"]["wte"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["lm_head"].astype(cfg.dtype))
+    if "lm_head_bias" in params:  # GPT-J imports; kept replicated
+        v_loc = cfg.vocab_size // m
+        logits = logits + jax.lax.dynamic_slice_in_dim(
+            params["lm_head_bias"], idx * v_loc, v_loc).astype(cfg.dtype)
+    logits = logits.astype(jnp.float32)
+    return jax.lax.all_gather(logits, AXIS_MODEL, axis=logits.ndim - 1,
+                              tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# the two shard-mapped programs
+# ---------------------------------------------------------------------------
+
+
+def _decode_shard_fn(cfg: CausalLMConfig, m: int, impl: str,
+                     interpret: bool, params: Params, tokens: jax.Array,
+                     arena: dict, page_table: jax.Array,
+                     lengths: jax.Array) -> tuple[jax.Array, dict]:
+    """Per-shard body of one decode iteration (mirrors
+    ``generate.decode_step_pages`` with head-local KV writes and the
+    two Megatron psum points per block)."""
+    idx = jax.lax.axis_index(AXIS_MODEL)
+    h_loc = cfg.num_heads // m
+    s = tokens.shape[0]
+    ps = arena["k"].shape[2]
+    max_len = page_table.shape[1] * ps
+    pos = lengths
+    positions = pos[:, None]
+    quant = "k_scale" in arena
+
+    rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
+            if cfg.pos_emb == "rope" else None)
+    kpos_all = jnp.broadcast_to(jnp.arange(max_len), (s, max_len))
+    slopes_loc = bias = None
+    if cfg.pos_emb == "alibi":
+        slopes_loc = jax.lax.dynamic_slice_in_dim(
+            alibi_slopes(cfg.num_heads), idx * h_loc, h_loc)
+        bias = (slopes_loc[None, :, None, None]
+                * kpos_all.astype(jnp.float32)[:, None, None, :])
+    key_mask = (kpos_all <= pos[:, None]).astype(jnp.int32)
+
+    phys = jnp.take_along_axis(page_table, (pos // ps)[:, None],
+                               axis=1)[:, 0]
+    rows = pos % ps
+
+    x = _tp_embed(cfg, params, tokens[:, None], positions, idx, m)
+
+    def body(carry, layer):
+        x = carry
+        if quant:
+            p, ck, cv, sk, sv = layer
+        else:
+            p, ck, cv = layer
+            sk = sv = None
+        q, k_new, v_new = _tp_qkv(cfg, p, x, rope=rope,
+                                  q_positions=positions)
+        if quant:
+            ck, sk = _quant_decode_write(ck, sk, phys, rows, k_new[:, 0])
+            cv, sv = _quant_decode_write(cv, sv, phys, rows, v_new[:, 0])
+        else:
+            ck = ck.at[phys, rows].set(k_new[:, 0].astype(ck.dtype))
+            cv = cv.at[phys, rows].set(v_new[:, 0].astype(cv.dtype))
+        if impl == "fused":
+            from kubernetes_cloud_tpu.ops.fused_decode import (
+                fused_paged_decode,
+            )
+
+            part = fused_paged_decode(
+                q[:, 0],
+                ck if quant else ck.astype(cfg.dtype),
+                cv if quant else cv.astype(cfg.dtype),
+                page_table, pos + 1,
+                p["attn"]["wo"].astype(cfg.dtype),
+                k_scale=sk, v_scale=sv, slopes=slopes_loc,
+                impl="pallas", interpret=interpret)
+            attn_out = jax.lax.psum(part, AXIS_MODEL)
+            if cfg.use_bias:
+                attn_out = attn_out + p["attn"]["bo"].astype(cfg.dtype)
+            attn_out = attn_out[:, None, :]
+        else:
+            if impl == "pallas":
+                from kubernetes_cloud_tpu.ops.paged_attention import (
+                    paged_decode_attention,
+                )
+
+                attn_vec = paged_decode_attention(
+                    q[:, 0],
+                    ck if quant else ck.astype(cfg.dtype),
+                    cv if quant else cv.astype(cfg.dtype),
+                    page_table, pos + 1, k_scale=sk, v_scale=sv,
+                    slopes=slopes_loc, impl="pallas",
+                    interpret=interpret)[:, None]
+            else:
+                from kubernetes_cloud_tpu.ops.paged_attention import (
+                    gather_pages,
+                )
+
+                dense_k = gather_pages(ck, page_table, sk)
+                dense_v = gather_pages(cv, page_table, sv)
+                attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                                     dense_v.astype(cfg.dtype),
+                                     causal=False, bias=bias,
+                                     mask=key_mask, impl="xla")
+            attn_out = _tp_wo(cfg, p, attn_vec)
+        x = _tp_finish(cfg, p, x, attn_out, None, True)
+        return x, ((ck, cv, sk, sv) if quant else (ck, cv))
+
+    if quant:
+        xs = (params["blocks"], arena["k"], arena["v"],
+              arena["k_scale"], arena["v_scale"])
+        x, (ks, vs, ssk, ssv) = jax.lax.scan(body, x, xs)
+        new_arena = {"k": ks, "v": vs, "k_scale": ssk, "v_scale": ssv}
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["blocks"], arena["k"], arena["v"]))
+        new_arena = {"k": ks, "v": vs}
+    logits = _tp_unembed(cfg, params, x, idx, m)[:, 0]
+    return logits, new_arena
+
+
+def _prefill_shard_fn(cfg: CausalLMConfig, m: int, interpret: bool,
+                      params: Params, input_ids: jax.Array,
+                      attention_mask: jax.Array, arena: dict,
+                      page_tables: jax.Array, start: jax.Array
+                      ) -> tuple[jax.Array, dict]:
+    """Per-shard body of one prefill pass (mirrors
+    ``generate.prefill_into_pages``: tail-only prefill at absolute
+    positions, attending to the cached prefix through each shard's
+    gathered head-slice view)."""
+    idx = jax.lax.axis_index(AXIS_MODEL)
+    h_loc = cfg.num_heads // m
+    b, t = input_ids.shape
+    ps = arena["k"].shape[2]
+    max_len = page_tables.shape[1] * ps
+    tail_lens = attention_mask.sum(-1).astype(jnp.int32)
+    positions = start[:, None] + jnp.clip(
+        jnp.cumsum(attention_mask, 1) - 1, 0)
+    quant = "k_scale" in arena
+
+    rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
+            if cfg.pos_emb == "rope" else None)
+    kpos_all = jnp.broadcast_to(jnp.arange(max_len), (b, max_len))
+    bias = None
+    if cfg.pos_emb == "alibi":
+        slopes_loc = jax.lax.dynamic_slice_in_dim(
+            alibi_slopes(cfg.num_heads), idx * h_loc, h_loc)
+        bias = (slopes_loc[None, :, None, None]
+                * kpos_all.astype(jnp.float32)[:, None, None, :])
+    key_mask = (kpos_all[:, None, None, :]
+                <= positions[:, None, :, None]).astype(jnp.int32)
+
+    phys, rows = _page_scatter_indices(page_tables, positions,
+                                       attention_mask != 0, ps)
+    phys_f = phys.reshape(b * t)
+    rows_f = rows.reshape(b * t)
+    valid_f = (attention_mask != 0).reshape(b * t)
+    hkv_loc = cfg.kv_heads // m
+
+    x = _tp_embed(cfg, params, input_ids, positions, idx, m)
+
+    def body(carry, layer):
+        x = carry
+        if quant:
+            p, ck, cv, sk, sv = layer
+        else:
+            p, ck, cv = layer
+            sk = sv = None
+        q, k_new, v_new = _tp_qkv(cfg, p, x, rope=rope,
+                                  q_positions=positions)
+        k_flat = k_new.reshape(b * t, hkv_loc, cfg.head_dim)
+        v_flat = v_new.reshape(b * t, hkv_loc, cfg.head_dim)
+        if quant:
+            ck, sk = _quant_prefill_write(ck, sk, page_tables, phys_f,
+                                          rows_f, k_flat, valid_f)
+            cv, sv = _quant_prefill_write(cv, sv, page_tables, phys_f,
+                                          rows_f, v_flat, valid_f)
+            from kubernetes_cloud_tpu.ops.paged_attention import (
+                gather_pages,
+            )
+
+            dense_k = gather_pages(ck, page_tables, sk)
+            dense_v = gather_pages(cv, page_tables, sv)
+        else:
+            ck = ck.at[phys_f, rows_f].set(k_flat.astype(ck.dtype))
+            cv = cv.at[phys_f, rows_f].set(v_flat.astype(cv.dtype))
+            dense_k = ck[page_tables].reshape(b, max_len, hkv_loc,
+                                              cfg.head_dim)
+            dense_v = cv[page_tables].reshape(b, max_len, hkv_loc,
+                                              cfg.head_dim)
+        attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                             dense_v.astype(cfg.dtype), causal=False,
+                             bias=bias, mask=key_mask, impl="xla")
+        attn_out = _tp_wo(cfg, p, attn_vec)
+        x = _tp_finish(cfg, p, x, attn_out, attention_mask, True)
+        return x, ((ck, cv, sk, sv) if quant else (ck, cv))
+
+    if quant:
+        xs = (params["blocks"], arena["k"], arena["v"],
+              arena["k_scale"], arena["v_scale"])
+        x, (ks, vs, ssk, ssv) = jax.lax.scan(body, x, xs)
+        new_arena = {"k": ks, "v": vs, "k_scale": ssk, "v_scale": ssv}
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["blocks"], arena["k"], arena["v"]))
+        new_arena = {"k": ks, "v": vs}
+    logits = _tp_unembed(cfg, params, x, idx, m)
+    last = jnp.take_along_axis(
+        logits, (tail_lens - 1)[:, None, None].clip(0), axis=1)[:, 0]
+    return last, new_arena
+
+
+#: (cfg, mesh, kv_dtype, attn_impl) → (prefill_jit, decode_jit); one
+#: compilation cache shared by every engine incarnation (a supervisor
+#: restart builds a new engine but reuses the programs)
+_PROGRAMS: dict = {}
+
+
+def build_tp_programs(cfg: CausalLMConfig, mesh, params_split: Params, *,
+                      kv_dtype: str = "fp32", attn_impl: str = "gather"):
+    """The two jitted shard_map programs for one (config, mesh) pair.
+
+    ``params_split`` supplies the tree STRUCTURE the in_specs must
+    match (use_bias / moe / tied-embeddings variants); the cache
+    assumes one structure per config, which ``split_qkv_params``
+    guarantees for framework-initialized parameters.  Signatures match
+    the single-chip programs minus the static config:
+
+    * ``prefill(params, ids, mask, arena, tables, start)``
+    * ``decode(params, tokens, arena, table, lengths)``
+
+    The arena argument is donated, like the single-chip jits."""
+    key = (cfg, mesh, kv_dtype, attn_impl)
+    if key in _PROGRAMS:
+        return _PROGRAMS[key]
+    reason = tp_unsupported_reason(cfg, mesh)
+    if reason is not None:
+        raise ValueError(f"TP decode program unsupported: {reason}")
+    m = tp_shards(mesh)
+    interpret = jax.default_backend() != "tpu"
+    quant = kv_dtype == "int8"
+    pspecs = tp_param_specs(params_split)
+    arena_spec = kv_arena_specs(quant)
+    rep = P()
+
+    decode = shard_map(
+        functools.partial(_decode_shard_fn, cfg, m, attn_impl, interpret),
+        mesh=mesh,
+        in_specs=(pspecs, rep, arena_spec, rep, rep),
+        out_specs=(rep, arena_spec),
+        check_rep=False)
+    prefill = shard_map(
+        functools.partial(_prefill_shard_fn, cfg, m, interpret),
+        mesh=mesh,
+        in_specs=(pspecs, rep, rep, arena_spec, rep, rep),
+        out_specs=(rep, arena_spec),
+        check_rep=False)
+    programs = (jax.jit(prefill, donate_argnums=(3,)),
+                jax.jit(decode, donate_argnums=(2,)))
+    _PROGRAMS[key] = programs
+    return programs

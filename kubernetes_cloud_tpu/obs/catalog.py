@@ -71,6 +71,12 @@ METRIC_FAMILIES = {
         "device KV bytes per resident token row (int8 incl. scales)",
     "kct_engine_quant_logit_err":
         "max logit error from the last quantization-quality probe",
+    "kct_engine_mesh_shards":
+        "model-axis mesh shards the decode program runs across",
+    "kct_engine_kv_transfer_seconds":
+        "prefill-to-decode KV handover latency (extract to install)",
+    "kct_engine_kv_transfer_pages_total":
+        "KV pages moved between disaggregated arenas, by direction",
     # multi-tenant traffic plane (serve/tenancy.py)
     "kct_tenant_admitted_total":
         "requests admitted into slots per tenant and QoS lane",

@@ -52,8 +52,10 @@ from typing import Any, Optional
 #: per-token device step — split by which kernel ran it: the label
 #: makes a fused-kernel rollout visible in the phase-share rate
 #: without a config scrape
+#: "kv_transfer" is the disaggregated handover (serve/disagg.py):
+#: page extract on the prefill side, page install on the decode side
 PHASES = ("admit", "cow_copy", "prefill", "decode", "fused_decode",
-          "sample", "stream", "host_sync")
+          "sample", "stream", "host_sync", "kv_transfer")
 
 
 class IterationRecord:

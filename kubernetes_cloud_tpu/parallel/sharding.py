@@ -40,6 +40,16 @@ from kubernetes_cloud_tpu.core.mesh import BATCH_AXES
 _RULES: dict[str, P] = {
     "wqkv": P("fsdp", "model", None),
     "bqkv": P("model", None),
+    # Serving decode layout (models/tp_decode.py): the fused wqkv is
+    # split into per-projection leaves so a manual shard_map program
+    # can shard q/k/v by HEADS over ``model`` — the fused [H + 2*Hkv]
+    # dim cannot be chunked evenly without splitting q from k/v.
+    "attn.wq": P("fsdp", "model", None),
+    "attn.wk": P("fsdp", "model", None),
+    "attn.wv": P("fsdp", "model", None),
+    "bq": P("model", None),
+    "bk": P("model", None),
+    "bv": P("model", None),
     "attn.wo": P("model", None, "fsdp"),
     "bo": P(None),
     "mlp.wi": P("fsdp", "model"),
@@ -126,6 +136,26 @@ def shard_params(params: Any, mesh: Mesh) -> Any:
     """Place a parameter pytree onto the mesh per the policy rules."""
     shardings = logical_to_physical(param_specs(params), mesh)
     return jax.device_put(params, shardings)
+
+
+def kv_arena_specs(quantized: bool) -> dict:
+    """PartitionSpecs for a paged serving KV arena: KV heads shard
+    over ``model`` (Megatron TP — the kv-head axis is the only dim a
+    decode step touches head-locally), pages/positions replicate (the
+    page indirection gather is position-blind), and an int8 arena's
+    ``[L, NP, Hkv]`` scale buffers follow their pages' head axis.  One
+    source of truth for the engine's ``device_put`` placement AND the
+    ``shard_map`` in/out specs of the TP decode program
+    (:mod:`kubernetes_cloud_tpu.models.tp_decode`), so the two can
+    never disagree about where a KV head lives."""
+    from kubernetes_cloud_tpu.core.mesh import AXIS_MODEL
+
+    kv = P(None, None, None, AXIS_MODEL, None)
+    spec = {"k": kv, "v": kv}
+    if quantized:
+        sc = P(None, None, AXIS_MODEL)
+        spec.update(k_scale=sc, v_scale=sc)
+    return spec
 
 
 def batch_spec(ndim: int = 2, *, seq_axis: Optional[int] = 1,

@@ -69,8 +69,10 @@ from kubernetes_cloud_tpu.models.generate import (
     copy_pages,
     decode_step_pages,
     decode_step_slots,
+    extract_pages,
     init_cache,
     init_page_arena,
+    install_pages,
     prefill_into_pages,
     prefill_into_slots,
 )
@@ -115,7 +117,9 @@ _M_ITER_S = obs.histogram(
     "Wall time of one scheduler pass, split by kind: phase=\"prefill\" "
     "passes admitted at least one request (prefill stalls live here), "
     "phase=\"decode\" ran the decode step only (= per-token latency "
-    "for every active request).", ("model", "phase"))
+    "for every active request).  The role label names which side of a "
+    "disaggregated deployment the pass ran on (colocated | prefill | "
+    "decode).", ("model", "phase", "role"))
 _M_PHASE_S = obs.counter(
     "kct_engine_phase_seconds_total",
     "Seconds accumulated in each named scheduler phase (admit | "
@@ -192,6 +196,21 @@ _M_QUANT_ERR = obs.gauge(
     "Max absolute logit error measured by the most recent "
     "quantization-quality probe against an fp32 arena (0 until a "
     "probe ran; 0 forever on fp32 replicas).", ("model",))
+_M_MESH_SHARDS = obs.gauge(
+    "kct_engine_mesh_shards",
+    "Model-axis mesh shards the decode program runs across (1 = "
+    "single-chip; >1 = the shard_map TP program or GSPMD placement "
+    "splits every KV head group over that many devices).", ("model",))
+_M_KV_TRANSFER_S = obs.histogram(
+    "kct_engine_kv_transfer_seconds",
+    "Prefill→decode KV handover latency, extract-start to "
+    "install-complete, observed on the decode side (disaggregated "
+    "serving only).", ("model",))
+_M_KV_TRANSFER_PAGES = obs.counter(
+    "kct_engine_kv_transfer_pages_total",
+    "KV pages moved between disaggregated arenas, by direction "
+    "(out = handed off by a prefill-role engine, in = installed by a "
+    "decode-role engine).", ("model", "direction"))
 
 
 class RequestCancelled(RuntimeError):
@@ -251,6 +270,19 @@ class EngineConfig:
     #: preemption.  None = one unlimited default tenant, which is
     #: byte-for-byte the pre-tenancy FIFO behavior.
     tenancy: Optional[TenancyConfig] = None
+    #: prefill/decode disaggregation (DistServe, OSDI '24 — see
+    #: PAPERS.md).  "colocated" is the classic engine.  "prefill"
+    #: admits + prefills only: after a request's first token it hands
+    #: its prompt KV over page-granularly (serve/disagg.py) instead of
+    #: decoding, so prefill bursts never occupy a decode iteration.
+    #: "decode" runs the iteration loop over adopted requests whose KV
+    #: arrived by page transfer (zero re-prefill on the happy path).
+    role: str = "colocated"
+    #: role="prefill" model-level wiring: how many in-process decode
+    #: engines the prefill engine feeds (each owns its own arena —
+    #: on hardware, its own slice group; see deploy/README.md
+    #: "Sharded & disaggregated serving")
+    decode_slices: int = 1
 
     def __post_init__(self):
         if self.slots < 1:
@@ -263,6 +295,15 @@ class EngineConfig:
             raise ValueError("max_queue_size must be >= 1")
         if self.max_admit_per_step < 1:
             raise ValueError("max_admit_per_step must be >= 1")
+        if self.role not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                "role must be 'colocated', 'prefill' or 'decode'")
+        if self.role != "colocated" and not self.paged:
+            raise ValueError(
+                "prefill/decode roles require paged=True (the KV "
+                "hand-over between roles is page-granular)")
+        if self.decode_slices < 1:
+            raise ValueError("decode_slices must be >= 1")
         if self.paged:
             if self.page_size < 1:
                 raise ValueError("page_size must be >= 1")
@@ -318,6 +359,24 @@ class EngineConfig:
             self.page_size, model_cfg.kv_heads, model_cfg.head_dim,
             self.kv_dtype)
         return max(2, budget // page_b + 1)
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """Page-granular KV payload a prefill-role engine hands to the
+    decode plane (host-staged here; on hardware the same page indices
+    would address an ICI/DMA transfer).  ``data`` holds the prompt's
+    resident pages as host arrays (``extract_pages``), ``prompt_len``
+    the positions they cover (``0..prompt_len-1``), ``hashes`` the
+    chain hashes of every FULL block so the receiving arena can
+    publish transferred pages into its prefix cache."""
+
+    data: dict
+    prompt_len: int
+    hashes: list
+    #: monotonic extract start — the decode side observes
+    #: ``kct_engine_kv_transfer_seconds`` against it at install
+    started_at: float
 
 
 class GenRequest:
@@ -573,6 +632,51 @@ class ContinuousBatchingEngine:
         self._prefill_pages = _jit_prefill_pages()
         self._decode_pages = _jit_decode_pages()
         self._copy_pages = _jit_copy_pages()
+        #: mesh-sharded decode (ROADMAP item 1): with a model axis > 1
+        #: and a dividing config, the paged programs are replaced by
+        #: ONE shard_map TP program per iteration
+        #: (models/tp_decode.py) — params split q/k/v and sharded by
+        #: heads, the arena (and its int8 scales) sharded over the
+        #: kv-head axis, scheduler state replicated on the host.
+        #: Otherwise a mesh still shards pool + params via GSPMD
+        #: placement (the pre-TP behavior).
+        self.mesh_shards = 1
+        self._tp_active = False
+        if mesh is not None:
+            from kubernetes_cloud_tpu.core.mesh import AXIS_MODEL
+
+            self.mesh_shards = int(mesh.shape.get(AXIS_MODEL, 1))
+        if engine_cfg.paged and self.mesh_shards > 1:
+            from kubernetes_cloud_tpu.models import tp_decode
+
+            reason = tp_decode.tp_unsupported_reason(cfg, mesh)
+            if reason is None:
+                self.params = tp_decode.place_tp_params(cfg, params, mesh)
+                _tp_pf, _tp_dec = tp_decode.build_tp_programs(
+                    cfg, mesh, self.params,
+                    kv_dtype=engine_cfg.kv_dtype,
+                    attn_impl=engine_cfg.attn_impl)
+                # same call signature as the single-chip jits (cfg is
+                # baked into the shard_map closure; impl likewise)
+                self._prefill_pages = (
+                    lambda _c, p, ids, msk, pool, tbl, st:
+                    _tp_pf(p, ids, msk, pool, tbl, st))
+                self._decode_pages = (
+                    lambda _c, p, tok, pool, tbl, ln, impl=None:
+                    _tp_dec(p, tok, pool, tbl, ln))
+                self._tp_active = True
+            else:
+                log.warning(
+                    "engine %s: shard_map TP decode unavailable (%s); "
+                    "falling back to GSPMD placement", name, reason)
+        #: prefill/decode disaggregation (serve/disagg.py): a prefill-
+        #: role engine hands requests over after their first token;
+        #: a decode-role engine adopts transferred KV at pass start
+        self.role = engine_cfg.role
+        self._handoff_cb = None
+        self._adopt_lock = threading.Lock()
+        self._adopt: list[tuple[GenRequest, KVHandoff]] = []
+        self._install_pages = jax.jit(install_pages, donate_argnums=0)
         self._page_table = np.zeros(
             (engine_cfg.slots, engine_cfg.pages_per_slot), np.int32)
         self._lengths = np.zeros((engine_cfg.slots,), np.int32)
@@ -618,7 +722,15 @@ class ContinuousBatchingEngine:
                       "deadline_shed": 0, "prefill_tokens": 0,
                       "prompt_tokens": 0, "prefix_hits": 0,
                       "prefix_tokens_saved": 0, "cow_copies": 0,
-                      "peak_active": 0, "preemptions": 0, "resumed": 0}
+                      "peak_active": 0, "preemptions": 0, "resumed": 0,
+                      # disaggregation accounting: handoffs a prefill-
+                      # role engine exported, requests a decode-role
+                      # engine adopted, pages moved either way, and
+                      # prompt tokens RE-prefilled for resumes whose
+                      # KV was lost (the happy-path handover keeps
+                      # this at 0 — the acceptance bar)
+                      "handoffs": 0, "adopted": 0,
+                      "kv_transfer_pages": 0, "reprefill_tokens": 0}
         #: always-on flight recorder: bounded ring of per-iteration
         #: phase timings + batch composition (GET /debug/timeline);
         #: flight_records=0 disables it for overhead A/Bs.  A restart
@@ -655,9 +767,11 @@ class ContinuousBatchingEngine:
         m = {"model": self.name}
         self._m_iters = _M_ITERS.labels(**m)
         self._m_iter_prefill = _M_ITER_S.labels(model=self.name,
-                                                phase="prefill")
+                                                phase="prefill",
+                                                role=engine_cfg.role)
         self._m_iter_decode = _M_ITER_S.labels(model=self.name,
-                                               phase="decode")
+                                               phase="decode",
+                                               role=engine_cfg.role)
         self._m_phase = {p: _M_PHASE_S.labels(model=self.name, phase=p)
                          for p in PHASES}
         self._m_mfu = _M_MFU.labels(**m)
@@ -677,6 +791,12 @@ class ContinuousBatchingEngine:
         self._m_cow = _M_COW.labels(**m)
         self._m_quant_err = _M_QUANT_ERR.labels(**m)
         self._m_quant_err.set(0.0)
+        self._m_kv_transfer_s = _M_KV_TRANSFER_S.labels(**m)
+        self._m_kv_transfer_out = _M_KV_TRANSFER_PAGES.labels(
+            model=self.name, direction="out")
+        self._m_kv_transfer_in = _M_KV_TRANSFER_PAGES.labels(
+            model=self.name, direction="in")
+        _M_MESH_SHARDS.labels(**m).set(self.mesh_shards)
         cache_bytes = jnp.dtype(cfg.dtype).itemsize
         if self.paged:
             bpt = paged_kv.kv_bytes_per_token(
@@ -791,26 +911,28 @@ class ContinuousBatchingEngine:
                                 kv_dtype=self.ecfg.kv_dtype)
         if self.mesh is not None:
             # pages replicate (the indirection gather is position-
-            # blind); only KV heads shard, mirroring the slot pool.
-            # Batch-axis sharding of slots belongs to the mesh-serving
-            # work (ROADMAP item 2).
+            # blind); only KV heads shard — the one rule table
+            # (parallel/sharding.kv_arena_specs) also defines the TP
+            # program's shard_map specs, so placement and program can
+            # never disagree.  An int8 arena's [L, NP, Hkv] scale
+            # buffers follow their pages' head axis.
             from jax.sharding import PartitionSpec as P
 
             from kubernetes_cloud_tpu.core.mesh import AXIS_MODEL
             from kubernetes_cloud_tpu.parallel.sharding import (
+                kv_arena_specs,
                 logical_to_physical,
             )
 
-            heads = (AXIS_MODEL if self.cfg.kv_heads
-                     % max(self.mesh.shape.get(AXIS_MODEL, 1), 1) == 0
-                     else None)
-            kv = P(None, None, None, heads, None)
-            spec = {"k": kv, "v": kv}
-            if "k_scale" in arena:
-                # [L, NP, Hkv] scale buffers shard like their pages'
-                # head axis (tiny either way — 4 bytes per page-head)
-                sc = P(None, None, heads)
-                spec.update(k_scale=sc, v_scale=sc)
+            if self.cfg.kv_heads % max(
+                    self.mesh.shape.get(AXIS_MODEL, 1), 1) == 0:
+                spec = kv_arena_specs("k_scale" in arena)
+            else:  # heads don't divide: replicate (GSPMD fallback)
+                kv = P(None, None, None, None, None)
+                spec = {"k": kv, "v": kv}
+                if "k_scale" in arena:
+                    sc = P(None, None, None)
+                    spec.update(k_scale=sc, v_scale=sc)
             arena = jax.device_put(arena,
                                    logical_to_physical(spec, self.mesh))
         return arena
@@ -834,6 +956,132 @@ class ContinuousBatchingEngine:
         self.last_quant_probe = dict(probe)
         self._m_quant_err.set(float(probe.get("max_logit_err", 0.0)))
 
+    def set_handoff(self, cb) -> None:
+        """Wire the prefill→decode coupling (serve/disagg.py): on a
+        prefill-role engine, ``cb(req, KVHandoff)`` fires on the
+        scheduler thread right after a request's first token, instead
+        of the request keeping its slot for decode."""
+        self._handoff_cb = cb
+
+    def adopt(self, req: GenRequest, payload: KVHandoff) -> None:
+        """Decode-role intake: take over a request whose prompt KV
+        arrives by page transfer instead of prefill compute.
+        Thread-safe; the scheduler installs the pages at its next pass
+        (it is the arena's single owner — installing from this thread
+        would race the decode program's donated buffer)."""
+        if not self.paged:
+            raise ValueError("adopt() requires the paged engine")
+        if self._stop.is_set() or not self.alive:
+            raise RetryableError("engine stopped")
+        req.engine = self
+        req.claimed = False
+        with self._adopt_lock:
+            self._adopt.append((req, payload))
+        self._work.set()
+        if self._stop.is_set():
+            # lost the race with stop(): the scheduler may already
+            # have run its final drain (same shape as submit())
+            self._fail_adoptions(RetryableError("engine stopped"))
+
+    def _fail_adoptions(self, err: Exception) -> None:
+        with self._adopt_lock:
+            pending, self._adopt = self._adopt, []
+        for req, _payload in pending:
+            if req.event.is_set():
+                continue
+            req.error = err
+            trace(req.request_id, "failed", model=self.name,
+                  error=type(err).__name__)
+            req.stream.put(_STREAM_END)
+            req.event.set()
+
+    def _process_adoptions(self) -> None:
+        """Install transferred KV into freshly reserved pages and
+        queue the adopted requests (scheduler thread — single owner of
+        arena + allocator).  The request resumes through the existing
+        pinned-pages path: its indirection re-installs at
+        ``prompt + tokens - 1`` with ZERO re-prefill tokens.  Page
+        exhaustion keeps the remainder pending — pages free as
+        decoding slots evict, exactly like waiting admission."""
+        with self._adopt_lock:
+            pending, self._adopt = self._adopt, []
+        if not pending:
+            return
+        for i, (req, payload) in enumerate(pending):
+            if req.cancelled:
+                self.stats["cancelled"] += 1
+                self._m_cancelled.inc()
+                trace(req.request_id, "cancelled", model=self.name)
+                req.error = RequestCancelled("request cancelled")
+                req.stream.put(_STREAM_END)
+                req.event.set()
+                continue
+            plen = payload.prompt_len
+            vnew = req.max_new_tokens - len(req.tokens) + 1
+            n_total = paged_kv.pages_needed(plen, vnew, self.ecfg.page_size)
+            try:
+                pages = self.allocator.reserve_blank(n_total)
+            except KVPagesExhaustedError:
+                # Backpressure, NOT the pinned-reclaim valve: every
+                # pinned queue entry here is itself adoption/preempt
+                # state, and stripping one to page another in converts
+                # transferred KV into future re-prefill one for one
+                # (pure churn, measured in the disagg bench).  Pinned
+                # requests resume without reserving, so waiting for a
+                # slot eviction always makes progress.
+                with self._adopt_lock:  # retry next pass, order kept
+                    self._adopt = list(pending[i:]) + self._adopt
+                break
+            t0 = time.perf_counter()
+            n_payload = payload.data["k"].shape[1]
+            # Bucket the install shape (power-of-two page count) so
+            # varied prompt lengths reuse one compiled program per
+            # bucket instead of paying a blocking XLA compile on the
+            # decode scheduler thread per distinct page count — the
+            # same rationale as _bucket() for prefill shapes.  Pad
+            # rows write into the null page (garbage by design).
+            bucket = 1
+            while bucket < n_payload:
+                bucket *= 2
+            if bucket > n_payload:
+                pad = bucket - n_payload
+                data = {k: np.concatenate(
+                    [v, np.zeros((v.shape[0], pad) + v.shape[2:],
+                                 v.dtype)], axis=1)
+                    for k, v in payload.data.items()}
+                dst = pages[:n_payload] + [paged_kv.NULL_PAGE] * pad
+            else:
+                data, dst = payload.data, pages[:n_payload]
+            self.pool = self._install_pages(
+                self.pool, jnp.asarray(dst, jnp.int32), data)
+            dt = time.perf_counter() - t0
+            # full prompt blocks become prefix-cache entries on this
+            # arena too, so later requests sharing the prefix dedup
+            # against transferred content.  Never the partial last
+            # page: the next decode write lands at position plen,
+            # i.e. page plen // page_size, which is only part of the
+            # published set when plen is page-aligned — and then the
+            # write goes to the FOLLOWING (blank) page.
+            n_pub = plen // self.ecfg.page_size
+            self.allocator.register_blocks(payload.hashes[:n_pub],
+                                           pages[:n_pub])
+            req.pinned_pages = pages
+            req.resume_len = len(req.tokens)
+            with self._qlock:
+                self.tenants.note_pages(req.tenant, len(pages))
+                # bypasses the queue bound like requeue(): the request
+                # already won admission on the prefill side
+                self.tenants.append(req)
+            self.stats["adopted"] += 1
+            self.stats["kv_transfer_pages"] += n_payload
+            self._m_kv_transfer_in.inc(n_payload)
+            self._m_kv_transfer_s.observe(
+                time.monotonic() - payload.started_at)
+            rec = self._rec
+            if rec is not None:
+                rec.phases["kv_transfer"] = \
+                    rec.phases.get("kv_transfer", 0.0) + dt
+
     def _device_page_table(self) -> jax.Array:
         """Host→device upload of the indirection table, paid only when
         admission/eviction changed it (decode iterations between
@@ -849,7 +1097,11 @@ class ContinuousBatchingEngine:
         shed threshold, and the queue-depth gauge all read, so the
         traffic plane cannot hide queued work from any of them."""
         with self._qlock:
-            return self.tenants.depth()
+            depth = self.tenants.depth()
+        with self._adopt_lock:
+            # pending adoptions are queued work too: they hold a KV
+            # payload and a waiting client, they just haven't paged in
+            return depth + len(self._adopt)
 
     def estimated_queue_delay(self, tenant: Optional[str] = None
                               ) -> float:
@@ -1039,6 +1291,10 @@ class ContinuousBatchingEngine:
             for req in self.tenants.iter_queued():
                 if self._rid_matches(req, request_id):
                     return "queued"
+        with self._adopt_lock:
+            for req, _ in self._adopt:
+                if self._rid_matches(req, request_id):
+                    return "queued"
         return None
 
     def cancel_request(self, request_id: Optional[str]) -> bool:
@@ -1068,6 +1324,11 @@ class ContinuousBatchingEngine:
                 if self._rid_matches(req, request_id):
                     req.cancel()
                     hit = True
+        with self._adopt_lock:
+            for req, _ in self._adopt:
+                if self._rid_matches(req, request_id):
+                    req.cancel()
+                    hit = True
         if hit:
             self._work.set()
         return hit
@@ -1082,6 +1343,9 @@ class ContinuousBatchingEngine:
         intact."""
         with self._qlock:
             queued = [r for r in self.tenants.drain() if not r.cancelled]
+        with self._adopt_lock:
+            adopts, self._adopt = self._adopt, []
+        queued.extend(r for r, _ in adopts if not r.cancelled)
         for req in queued:
             req.pinned_pages = None  # old arena; see requeue()
             req.claimed = False
@@ -1099,6 +1363,13 @@ class ContinuousBatchingEngine:
         self._work.set()
         with self._qlock:
             queued = [r for r in self.tenants.drain() if not r.cancelled]
+        with self._adopt_lock:
+            adopts, self._adopt = self._adopt, []
+        queued.extend(r for r, _ in adopts if not r.cancelled)
+        for req in queued:
+            # pinned claims (and pending adoption payloads) belonged
+            # to THIS engine's arena; the replacement re-prefills
+            req.pinned_pages = None
         self._fail_active(err)
         return queued
 
@@ -1112,6 +1383,7 @@ class ContinuousBatchingEngine:
         """Config + analytical constants the timeline analyzer needs."""
         meta = {"slots": self.ecfg.slots, "max_len": self.ecfg.max_len,
                 "paged": self.paged, "alive": self.alive,
+                "role": self.role, "mesh_shards": self.mesh_shards,
                 "flops_base": self._flops_base,
                 "flops_per_ctx": self._flops_per_ctx,
                 "peak_flops_per_s": self._peak_flops,
@@ -1213,6 +1485,7 @@ class ContinuousBatchingEngine:
             if stopping:
                 self._fail_queued(RetryableError("engine stopped"),
                                   release_pinned=True)
+                self._fail_adoptions(RetryableError("engine stopped"))
             if stopping and not any(s is not None for s in self._slots):
                 return
             try:
@@ -1291,6 +1564,11 @@ class ContinuousBatchingEngine:
         self._reap_cancelled()
         admitted = 0
         if not stopping:
+            if self.paged:
+                # disaggregation intake first: adopted requests join
+                # the queue with their KV already installed, so this
+                # pass's admission can place them (zero re-prefill)
+                self._process_adoptions()
             t_admit = time.perf_counter()
             admitted = self._admit()
             if rec is not None:
@@ -1376,7 +1654,7 @@ class ContinuousBatchingEngine:
         if rec is None:
             return
         if not (rec.active or rec.admitted or rec.evicted
-                or rec.decode_tokens):
+                or rec.decode_tokens or rec.phases.get("kv_transfer")):
             return
         rec.dur_s = time.perf_counter() - t_pass
         for phase, secs in rec.phases.items():
@@ -1701,9 +1979,60 @@ class ContinuousBatchingEngine:
         # tokens once, and preemption overhead is the preemptor's
         # fault, not the victim's service
         self.stats["prefill_tokens"] += len(ids_list)
+        self.stats["reprefill_tokens"] += len(ids_list)
         trace(req.request_id, "prefill", model=self.name, slot=slot,
               resumed=True)
         trace(req.request_id, "decode", model=self.name, slot=slot)
+
+    def _handoff_slot(self, slot: int) -> None:
+        """Prefill role: the request's first token is out — extract
+        its prompt KV page-granularly and hand the request to the
+        decode plane instead of keeping the slot for decode.
+        Scheduler thread only: reading the arena between program
+        dispatches is what makes the extract safe against buffer
+        donation.  The slot's claim is fully released here (shared
+        prefix pages survive in this arena's cache; the decode side
+        holds its own claim)."""
+        req = self._slots[slot]
+        pages = self._slot_pages[slot]
+        plen = int(self._lengths[slot])
+        ps = self.ecfg.page_size
+        n_prompt = -(-plen // ps)
+        t0 = time.perf_counter()
+        started = time.monotonic()
+        data = extract_pages(self.pool, pages[:n_prompt])
+        dt = time.perf_counter() - t0
+        vprompt = req.prompt_ids + req.tokens[:-1]
+        payload = KVHandoff(data=data, prompt_len=plen,
+                            hashes=paged_kv.chain_hashes(vprompt, ps),
+                            started_at=started)
+        self._slots[slot] = None
+        self._slot_pages[slot] = None
+        self.allocator.release(pages)
+        self._page_table[slot, :] = 0
+        self._page_table_dirty = True
+        self._lengths[slot] = 0
+        with self._qlock:
+            self.tenants.note_finished(req, len(pages))
+        req.claimed = False
+        self.stats["handoffs"] += 1
+        self.stats["kv_transfer_pages"] += n_prompt
+        self._m_kv_transfer_out.inc(n_prompt)
+        rec = self._rec
+        if rec is not None:
+            rec.phases["kv_transfer"] = \
+                rec.phases.get("kv_transfer", 0.0) + dt
+        cb = self._handoff_cb
+        if cb is None:
+            # a prefill-role engine with no decode plane attached must
+            # not strand the stream mid-request (the first token is
+            # already out; the retry recomputes it elsewhere)
+            req.error = RetryableError("no decode replica attached; "
+                                       "retry")
+            req.stream.put(_STREAM_END)
+            req.event.set()
+            return
+        cb(req, payload)
 
     def _admit_paged(self, free: list[int], budget: int,
                      forced: Optional[list] = None) -> int:
@@ -1745,6 +2074,11 @@ class ContinuousBatchingEngine:
                        else req.prompt_ids + req.tokens[:-1])
             vnew = (req.max_new_tokens if not resumed
                     else req.max_new_tokens - len(req.tokens) + 1)
+            if self.role == "prefill":
+                # a prefill-role engine never decodes: reserve only
+                # the prompt's own pages (the decode plane holds the
+                # full prompt+completion claim after the handoff)
+                vnew = 0
             res = None
             while res is None:
                 try:
@@ -1863,8 +2197,15 @@ class ContinuousBatchingEngine:
                     # pay for preemption overhead
                     req.resume_len = len(req.tokens)
                     self.stats["resumed"] += 1
+                    self.stats["reprefill_tokens"] += computed
                     trace(req.request_id, "prefill", model=self.name,
                           slot=slot, resumed=True)
+                    if self.role == "prefill":
+                        # a requeued mid-decode request (decode-
+                        # replica death) re-prefilled here; hand its
+                        # re-derived KV to a surviving decode slice
+                        self._handoff_slot(slot)
+                        continue
                     trace(req.request_id, "decode", model=self.name,
                           slot=slot)
                     continue
@@ -1885,6 +2226,11 @@ class ContinuousBatchingEngine:
                       cached_tokens=res.cached_tokens)
                 trace(req.request_id, "decode", model=self.name, slot=slot)
                 self._emit(slot, logits[r])
+                if self.role == "prefill" and self._slots[slot] is not None:
+                    # first token emitted and more are wanted: the
+                    # decode plane takes it from here, KV and all
+                    # (an EOS / max-1 request already finished above)
+                    self._handoff_slot(slot)
         for req in pinned:
             # prefill-free resume: the pinned pages still hold KV for
             # every consumed position; re-installing the indirection
@@ -2100,11 +2446,24 @@ class ContinuousBatchingModel(Model):
             self.service.load()
         if self.engine is None or not self.engine.alive:
             tok = self.service.tokenizer
-            self.engine = ContinuousBatchingEngine(
-                self.service.cfg, self.service.params, self.cfg,
-                eos_token_id=getattr(tok, "eos_token_id", None),
-                pad_token_id=getattr(tok, "pad_token_id", 0) or 0,
-                mesh=self.service.mesh, name=self.name)
+            kw = dict(eos_token_id=getattr(tok, "eos_token_id", None),
+                      pad_token_id=getattr(tok, "pad_token_id", 0) or 0,
+                      mesh=self.service.mesh, name=self.name)
+            if self.cfg.role == "prefill":
+                # disaggregated pod: one prefill engine feeding
+                # cfg.decode_slices decode engines through page-
+                # granular KV handoff (serve/disagg.py)
+                from kubernetes_cloud_tpu.serve.disagg import (
+                    build_disaggregated_engine,
+                )
+
+                self.engine = build_disaggregated_engine(
+                    self.service.cfg, self.service.params, self.cfg,
+                    **kw)
+            else:
+                self.engine = ContinuousBatchingEngine(
+                    self.service.cfg, self.service.params, self.cfg,
+                    **kw)
             self.engine.start()
         self.ready = True
 
@@ -2151,7 +2510,13 @@ class ContinuousBatchingModel(Model):
             return {}
         return {"kv_dtype": (eng.ecfg.kv_dtype if eng.paged else "fp32"),
                 "attn_impl": (eng.ecfg.attn_impl if eng.paged
-                              else "dense")}
+                              else "dense"),
+                # the fleet router learns roles from probe bodies:
+                # decode-role replicas take no admission traffic
+                # (serve/fleet.py), and a probe can tell a sharded
+                # replica from a single-chip one mid-rolling-restart
+                "role": eng.ecfg.role,
+                "mesh_shards": getattr(eng, "mesh_shards", 1)}
 
     # -- request side ------------------------------------------------------
 
@@ -2294,5 +2659,7 @@ def load_engine_config(model_dir: str) -> EngineConfig:
         attn_impl=str(cb.get("attn_impl", base.attn_impl)),
         kv_dtype=str(cb.get("kv_dtype", base.kv_dtype)),
         flight_records=int(cb.get("flight_records", base.flight_records)),
+        role=str(cb.get("role", base.role)),
+        decode_slices=int(cb.get("decode_slices", base.decode_slices)),
         tenancy=parse_tenancy(raw.get("tenancy")),
     )

@@ -1,0 +1,379 @@
+"""Prefill/decode disaggregation — DistServe-style role coupling
+(OSDI '24; see PAPERS.md).
+
+A long prefill occupies a whole engine iteration, so every admission
+burst inflates the inter-token latency of every ACTIVE request — the
+micro-partition interference PAPERS.md's Tail-at-Scale entry deferred
+to this layer.  This module splits the Orca loop across roles:
+
+* a **prefill-role** :class:`~kubernetes_cloud_tpu.serve.continuous.
+  ContinuousBatchingEngine` owns admission (tenancy buckets, WFQ,
+  prefix cache) and runs prefill only — after a request's first token
+  it extracts the prompt's KV pages and hands the request over;
+* one or more **decode-role** engines adopt the request: the pages
+  install into their own arena's free list and the request resumes
+  through the existing pinned-pages path — page-granular transfer,
+  ZERO re-prefill tokens on the happy path (``stats["reprefill_
+  tokens"]`` is the acceptance counter);
+* :class:`DisaggregatedEngine` is the coupler: it presents the same
+  duck-typed surface as a single engine (``ContinuousBatchingModel``
+  and the debug plane cannot tell), routes handoffs to the least-
+  loaded live decode slice, and runs a small monitor that transplants
+  a dead decode slice's queued requests onto a survivor — which
+  re-prefills them (token-identically, via the virtual-prompt resume)
+  rather than losing them.
+
+In-process the "transfer" is host-staged (device→host→device); on
+hardware the same page indices address per-slice arenas and the
+payload rides DCN/ICI — the deploy story (prefill and decode slice
+groups with distinct ``gke-tpu-topology`` selectors) lives in
+deploy/README.md "Sharded & disaggregated serving".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Optional, Sequence
+
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingEngine,
+    EngineConfig,
+    GenRequest,
+    KVHandoff,
+    _STREAM_END,
+)
+from kubernetes_cloud_tpu.serve.errors import (
+    EngineRestartedError,
+    RetryableError,
+)
+from kubernetes_cloud_tpu.obs.tracing import trace
+
+log = logging.getLogger(__name__)
+
+
+class _CombinedHeartbeat:
+    """Worst-of view over the member engines' heartbeats — what the
+    supervisor's staleness watchdog should see: the pair is only as
+    live as its sickest scheduler."""
+
+    def __init__(self, engines: Sequence[ContinuousBatchingEngine]):
+        self._engines = list(engines)
+
+    def beat(self) -> None:  # the members beat themselves
+        pass
+
+    @property
+    def age(self) -> float:
+        return max(e.heartbeat.age for e in self._engines)
+
+
+class DisaggregatedEngine:
+    """One prefill engine + N decode engines behind the single-engine
+    surface ``ContinuousBatchingModel`` (and the debug plane, the
+    supervisor's duck-typed probes, the fleet's clock attach) already
+    speaks."""
+
+    def __init__(self, prefill: ContinuousBatchingEngine,
+                 decodes: Sequence[ContinuousBatchingEngine], *,
+                 name: str = "engine",
+                 monitor_interval_s: float = 0.1):
+        if not decodes:
+            raise ValueError("a disaggregated engine needs at least "
+                             "one decode slice")
+        self.name = name
+        self.prefill = prefill
+        self.decodes = list(decodes)
+        self.monitor_interval_s = monitor_interval_s
+        #: config surface: the prefill side is the admission door, so
+        #: its config answers capacity/identity questions
+        self.ecfg = prefill.ecfg
+        self.cfg = prefill.cfg
+        self.paged = True
+        self.mesh_shards = prefill.mesh_shards
+        self.heartbeat = _CombinedHeartbeat([prefill, *self.decodes])
+        #: supervisor duck-typing (`_EngineTarget.deliberately_stopped`
+        #: reads engine._stop): the pair's stop() runs through the
+        #: prefill engine first, so its event IS the pair's intent
+        self._stop = prefill._stop
+        self.stats_extra = {"transplants": 0, "handoff_failed": 0}
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._monitor_stop = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+        #: decode engines whose death was already transplanted
+        self._dead_handled: set[int] = set()
+        prefill.set_handoff(self._handoff)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def engines(self) -> list[ContinuousBatchingEngine]:
+        return [self.prefill, *self.decodes]
+
+    @property
+    def alive(self) -> bool:
+        """Serving requires the admission door AND at least one decode
+        slice; dead minority slices are the monitor's problem."""
+        return self.prefill.alive and any(d.alive for d in self.decodes)
+
+    @property
+    def draining(self) -> bool:
+        return any(e.draining for e in self.engines)
+
+    @property
+    def grace_until(self) -> float:
+        return max(e.grace_until for e in self.engines)
+
+    @property
+    def last_error(self) -> Optional[Exception]:
+        for e in self.engines:
+            if e.last_error is not None:
+                return e.last_error
+        return None
+
+    @property
+    def iter_s(self) -> Optional[float]:
+        return self.prefill.iter_s
+
+    @property
+    def tenants(self):
+        """Admission-side scheduler (fleet-clock attach point)."""
+        return self.prefill.tenants
+
+    @property
+    def allocator(self):
+        return self.prefill.allocator
+
+    @property
+    def flight(self):
+        """The prefill ring backs ``/debug/timeline`` for the pair;
+        per-slice rings stay reachable through ``debug_meta``'s
+        engine listing."""
+        return self.prefill.flight
+
+    def start(self) -> None:
+        # decode slices first: a handoff fired during prefill warmup
+        # must have a live target
+        for eng in self.decodes:
+            eng.start()
+        self.prefill.start()
+        if self._monitor_thread is None or \
+                not self._monitor_thread.is_alive():
+            self._monitor_stop.clear()
+            self._monitor_thread = threading.Thread(
+                target=self._monitor, daemon=True,
+                name="disagg-monitor")
+            self._monitor_thread.start()
+
+    def stop(self) -> None:
+        self._monitor_stop.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=5.0)
+            self._monitor_thread = None
+        # prefill first: its drain flushes in-flight handoffs into the
+        # decode slices, which then drain their slots to completion
+        self.prefill.stop()
+        for eng in self.decodes:
+            eng.stop()
+
+    # -- request side (the ContinuousBatchingModel surface) ----------------
+
+    def submit(self, *args, **kwargs) -> GenRequest:
+        return self.prefill.submit(*args, **kwargs)
+
+    def requeue(self, req: GenRequest) -> None:
+        """Supervisor/fleet transplant intake: re-admit through the
+        prefill door (it re-prefills the virtual prompt and hands the
+        KV to a decode slice, token-identity intact)."""
+        self.prefill.requeue(req)
+
+    def extract_queued(self) -> list[GenRequest]:
+        out = []
+        for eng in self.engines:
+            out.extend(eng.extract_queued())
+        return out
+
+    def abandon(self, err: Exception) -> list[GenRequest]:
+        out = []
+        for eng in self.engines:
+            out.extend(eng.abandon(err))
+        return out
+
+    def queue_depth(self) -> int:
+        return sum(e.queue_depth() for e in self.engines)
+
+    def estimated_queue_delay(self, tenant: Optional[str] = None
+                              ) -> float:
+        return self.prefill.estimated_queue_delay(tenant)
+
+    def reset_peak_active(self) -> None:
+        for eng in self.engines:
+            eng.reset_peak_active()
+
+    def note_quant_probe(self, probe) -> None:
+        for eng in self.engines:
+            eng.note_quant_probe(probe)
+
+    def request_phase(self, request_id: Optional[str]) -> Optional[str]:
+        phase = None
+        for eng in self.engines:
+            got = eng.request_phase(request_id)
+            if got == "active":
+                return "active"
+            phase = phase or got
+        return phase
+
+    def cancel_request(self, request_id: Optional[str]) -> bool:
+        hit = False
+        for eng in self.engines:
+            hit = eng.cancel_request(request_id) or hit
+        return hit
+
+    @property
+    def stats(self) -> dict:
+        """Summed member stats plus coupler counters; per-engine dicts
+        ride along under ``engines`` for the bench's A/B breakdowns.
+        ``kv_transfer_pages`` counts each page ONCE (the decode-side
+        install) — a blind sum would add the prefill side's export of
+        the very same pages and double the figure."""
+        agg: dict[str, Any] = dict(self.stats_extra)
+        for eng in self.engines:
+            for k, v in eng.stats.items():
+                agg[k] = agg.get(k, 0) + v
+        agg["kv_transfer_pages"] = sum(
+            e.stats["kv_transfer_pages"] for e in self.decodes)
+        agg["engines"] = {e.name: dict(e.stats) for e in self.engines}
+        return agg
+
+    # -- debug plane -------------------------------------------------------
+
+    def debug_meta(self) -> dict:
+        meta = self.prefill.debug_meta()
+        meta["role"] = "disaggregated"
+        meta["decode_slices"] = len(self.decodes)
+        meta["slices"] = {e.name: {"role": e.role, "alive": e.alive}
+                          for e in self.engines}
+        return meta
+
+    def debug_slots(self) -> list[dict]:
+        out = []
+        for eng in self.engines:
+            for entry in eng.debug_slots():
+                out.append({"engine": eng.name, "role": eng.role,
+                            **entry})
+        return out
+
+    def debug_tenants(self) -> dict:
+        return self.prefill.debug_tenants()
+
+    def debug_pages(self) -> Optional[dict]:
+        snap = self.prefill.debug_pages() or {}
+        snap["slices"] = {e.name: e.debug_pages() for e in self.decodes}
+        return snap
+
+    # -- coupling ----------------------------------------------------------
+
+    def _pick_decode(self, exclude: Optional[set] = None
+                     ) -> Optional[ContinuousBatchingEngine]:
+        """Least-loaded live decode slice (active slots + queued),
+        round-robin on ties so a cold pair interleaves."""
+        live = [e for e in self.decodes if e.alive
+                and not e._stop.is_set()
+                and (not exclude or id(e) not in exclude)]
+        if not live:
+            return None
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        return min(
+            (e for e in live),
+            key=lambda e: (sum(1 for s in e._slots if s is not None)
+                           + e.queue_depth(),
+                           (self.decodes.index(e) + rr)
+                           % max(len(self.decodes), 1)))
+
+    def _handoff(self, req: GenRequest, payload: KVHandoff) -> None:
+        """Runs on the prefill engine's scheduler thread.  A slice
+        that dies between pick and adopt is failed over: every live
+        slice gets a try before the request is bounced back to the
+        client."""
+        tried: set[int] = set()
+        while True:
+            eng = self._pick_decode(exclude=tried)
+            if eng is None:
+                break
+            try:
+                eng.adopt(req, payload)
+                return
+            except Exception as e:  # noqa: BLE001 - a dead slice is an
+                # outcome to fail over, never an unwound scheduler
+                tried.add(id(eng))
+                log.warning("%s: handoff to %s failed: %s", self.name,
+                            eng.name, e)
+        with self._lock:
+            self.stats_extra["handoff_failed"] += 1
+        if not req.event.is_set():
+            req.error = RetryableError(
+                "no live decode slice to adopt the request; retry")
+            trace(req.request_id, "failed", model=self.name,
+                  error="RetryableError")
+            req.stream.put(_STREAM_END)
+            req.event.set()
+
+    def _monitor(self) -> None:
+        """Transplant a dead decode slice's queued work onto a
+        survivor: the survivor re-prefills each request's virtual
+        prompt (prompt + emitted tokens) and continues token-
+        identically — mid-decode actives already failed with the
+        typed retryable 503 when the slice died (the client retry
+        path), exactly like a supervisor crash."""
+        while not self._monitor_stop.wait(self.monitor_interval_s):
+            for i, eng in enumerate(self.decodes):
+                if eng.alive or i in self._dead_handled:
+                    continue
+                self._dead_handled.add(i)
+                orphans = eng.abandon(EngineRestartedError(
+                    f"decode slice {eng.name} died; retry"))
+                survivors = [d for d in self.decodes if d.alive]
+                moved = 0
+                for req in orphans:
+                    if req.cancelled:
+                        continue
+                    if survivors:
+                        survivors[0].requeue(req)
+                        moved += 1
+                    elif not req.event.is_set():
+                        req.error = RetryableError(
+                            "every decode slice is down; retry")
+                        req.stream.put(_STREAM_END)
+                        req.event.set()
+                with self._lock:
+                    self.stats_extra["transplants"] += moved
+                log.warning(
+                    "%s: decode slice %s died; transplanted %d queued "
+                    "request(s) to %s", self.name, eng.name, moved,
+                    survivors[0].name if survivors else "nobody")
+
+
+def build_disaggregated_engine(cfg, params, engine_cfg: EngineConfig, *,
+                               eos_token_id=None, pad_token_id: int = 0,
+                               mesh=None, name: str = "engine"
+                               ) -> DisaggregatedEngine:
+    """One prefill engine + ``engine_cfg.decode_slices`` decode
+    engines over shared weights (in-process; on hardware each engine
+    maps to its own slice group), coupled by page-granular KV
+    handoff."""
+    pcfg = dataclasses.replace(engine_cfg, role="prefill")
+    dcfg = dataclasses.replace(engine_cfg, role="decode")
+    prefill = ContinuousBatchingEngine(
+        cfg, params, pcfg, eos_token_id=eos_token_id,
+        pad_token_id=pad_token_id, mesh=mesh, name=f"{name}-prefill")
+    decodes = [
+        ContinuousBatchingEngine(
+            cfg, params, dcfg, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, mesh=mesh,
+            name=f"{name}-decode{i}")
+        for i in range(engine_cfg.decode_slices)]
+    return DisaggregatedEngine(prefill, decodes, name=name)

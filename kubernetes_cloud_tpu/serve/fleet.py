@@ -255,6 +255,11 @@ class ReplicaHealth:
         self.queue_depth = 0
         self.heartbeat_age_s: Optional[float] = None
         self.last_probe_ok: Optional[bool] = None
+        #: serving role learned from probe bodies (serve/continuous.py
+        #: serving_metadata): decode-role replicas take requests only
+        #: through their prefill partner's KV handoff, so the router
+        #: never dispatches admission traffic to them
+        self.role = "colocated"
         self.stats = {"probes": 0, "probe_fails": 0, "ejections": 0,
                       "recoveries": 0, "dispatch_ok": 0,
                       "dispatch_err": 0, "dispatch_timeout": 0}
@@ -273,8 +278,8 @@ class ReplicaHealth:
         return cause
 
     def note_probe(self, healthy: bool, queue_depth: int = 0,
-                   heartbeat_age_s: Optional[float] = None
-                   ) -> Optional[str]:
+                   heartbeat_age_s: Optional[float] = None,
+                   role: Optional[str] = None) -> Optional[str]:
         """Record one active-probe verdict; returns an ejection cause
         or the string ``"half_open"`` on an EJECTED→HALF_OPEN
         transition (callers emit metrics/logs outside the lock)."""
@@ -285,6 +290,8 @@ class ReplicaHealth:
                 self.queue_depth = queue_depth
                 self.heartbeat_age_s = heartbeat_age_s
                 self.last_probe_ok = True
+                if role is not None:
+                    self.role = role
                 if self.state == EJECTED:
                     # recovery probe succeeded: one trial request will
                     # decide reinstatement
@@ -383,6 +390,7 @@ class ReplicaHealth:
     def snapshot(self) -> dict:
         with self._lock:
             return {"state": self.state,
+                    "role": self.role,
                     "ejected_cause": self.ejected_cause,
                     "queue_depth": self.queue_depth,
                     "heartbeat_age_s": self.heartbeat_age_s,
@@ -615,28 +623,35 @@ class RemoteReplica(Replica):
         return list(self._models)
 
 
-def _probe_healthy(status: int, body: Mapping[str, Any],
-                   stale_s: float) -> tuple[bool, int, Optional[float]]:
+def _probe_healthy(status: int, body: Mapping[str, Any], stale_s: float
+                   ) -> tuple[bool, int, Optional[float], Optional[str]]:
     """Evaluate a /readyz answer: (healthy, queue_depth,
-    worst_heartbeat_age).  HTTP 200 alone is not enough — a hung
+    worst_heartbeat_age, role).  HTTP 200 alone is not enough — a hung
     unsupervised engine still answers ready, but its per-model
-    ``heartbeat_age_s`` gives it away."""
+    ``heartbeat_age_s`` gives it away.  ``role`` is the serving role
+    the replica's models declare (serving_metadata): a "decode"-role
+    replica serves only through its prefill partner's KV handoff, so
+    the router learns to keep admission traffic off it."""
     if status != 200:
-        return False, 0, None
-    depth, worst_age = 0, None
+        return False, 0, None, None
+    depth, worst_age, role = 0, None, None
     for detail in (body.get("models") or {}).values():
         if not isinstance(detail, dict):
             continue
         if not detail.get("ok", True):
-            return False, 0, None
+            return False, 0, None, None
         depth += int(detail.get("queue_depth") or 0)
+        got = detail.get("role")
+        if got is not None:
+            # one admission-taking model makes the replica routable
+            role = got if role in (None, "decode") else role
         age = detail.get("heartbeat_age_s")
         if age is not None:
             age = float(age)
             worst_age = age if worst_age is None else max(worst_age, age)
     if worst_age is not None and worst_age > stale_s:
-        return False, depth, worst_age
-    return True, depth, worst_age
+        return False, depth, worst_age, role
+    return True, depth, worst_age, role
 
 
 class FleetRouter(ModelServer):
@@ -753,14 +768,14 @@ class FleetRouter(ModelServer):
             try:
                 faults.fire("fleet.probe")
                 status, body = r.probe(self.cfg.probe_timeout_s)
-                healthy, depth, age = _probe_healthy(
+                healthy, depth, age, role = _probe_healthy(
                     status, body, self.cfg.heartbeat_stale_s)
             except Exception as e:  # noqa: BLE001 - a failed probe is
                 # data, not an error: transport refusal, injected
                 # fault, malformed body — all read "unhealthy"
-                healthy, depth, age = False, 0, None
+                healthy, depth, age, role = False, 0, None, None
                 log.debug("%s: probe failed: %s", r.id, e)
-            event = r.health.note_probe(healthy, depth, age)
+            event = r.health.note_probe(healthy, depth, age, role)
             if healthy:
                 r._m_queue.set(depth)
                 attach = getattr(r, "attach_clock", None)
@@ -789,9 +804,14 @@ class FleetRouter(ModelServer):
         """Least-loaded active replica outside ``exclude``; returns
         (replica, is_trial, skipped_unhealthy).  ``skipped_unhealthy``
         is True when at least one replica was passed over for health —
-        the honest ``rerouted`` signal load tests report."""
+        the honest ``rerouted`` signal load tests report.  Decode-role
+        replicas (learned from probe bodies) are not admission targets
+        at all — requests reach them through their prefill partner's
+        KV handoff — so they are filtered up front, not counted as
+        reroutes."""
         skipped = False
-        for r in sorted((r for r in self.replicas if r not in exclude),
+        for r in sorted((r for r in self.replicas if r not in exclude
+                         and r.health.role != "decode"),
                         key=lambda r: r.load_score()):
             trial = r.health.begin_dispatch()
             if trial is None:
@@ -1250,7 +1270,7 @@ class FleetRouter(ModelServer):
         while time.monotonic() < deadline:
             try:
                 status, body = r.probe(self.cfg.probe_timeout_s)
-                healthy, depth, _age = _probe_healthy(
+                healthy, depth, _age, _role = _probe_healthy(
                     status, body, self.cfg.heartbeat_stale_s)
             except Exception:  # noqa: BLE001 - keep probing to deadline
                 healthy, depth = False, 0

@@ -317,6 +317,20 @@ def main(argv: Optional[list] = None) -> int:
                     help="paged mode: decode attention kernel — "
                          "'fused' folds gather+attention+output "
                          "projection into one Mosaic kernel")
+    ap.add_argument("--role",
+                    choices=("colocated", "prefill", "decode"),
+                    default=None,
+                    help="paged mode: prefill/decode disaggregation "
+                         "(DistServe-style) — 'prefill' admits and "
+                         "prefills, handing KV page-granularly to "
+                         "in-process decode engines (--decode-slices); "
+                         "'decode' marks a dedicated decode replica "
+                         "the fleet router keeps admission traffic "
+                         "off; default 'colocated'")
+    ap.add_argument("--decode-slices", type=int, default=0,
+                    help="role=prefill: how many decode engines the "
+                         "prefill engine feeds (each owns its own "
+                         "arena / slice group)")
     ap.add_argument("--flight-records", type=int, default=-1,
                     help="continuous batching: flight-recorder ring "
                          "capacity (per-iteration phase records for "
@@ -409,6 +423,10 @@ def main(argv: Optional[list] = None) -> int:
             overrides["kv_dtype"] = args.kv_dtype
         if args.attn_impl:
             overrides["attn_impl"] = args.attn_impl
+        if args.role:
+            overrides["role"] = args.role
+        if args.decode_slices > 0:
+            overrides["decode_slices"] = args.decode_slices
         if args.flight_records >= 0:
             overrides["flight_records"] = args.flight_records
         if args.tenancy:

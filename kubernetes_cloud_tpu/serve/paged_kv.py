@@ -281,13 +281,39 @@ class PageAllocator:
         return PageReservation(pages=pages, cached_tokens=cached_tokens,
                                prompt_len=plen, cow=cow, hashes=hashes)
 
+    def reserve_blank(self, n: int) -> list[int]:
+        """Claim ``n`` fresh pages with no prefix-cache matching — the
+        disaggregation adopt path (``serve/disagg.py``): page content
+        arrives by device transfer from a prefill-role arena, not by
+        prefill compute, so there is nothing to match yet.  Raises
+        :class:`KVPagesExhaustedError` with nothing claimed (transient
+        when the arena could drain into the claim; permanent when it
+        can never hold it)."""
+        if n > self.capacity:
+            raise KVPagesExhaustedError(
+                f"adoption needs {n} KV pages; the arena has "
+                f"{self.capacity} (raise num_pages)")
+        if n > self.free_pages():
+            raise KVPagesExhaustedError(
+                f"KV pages exhausted: adoption needs {n} free, have "
+                f"{self.free_pages()}")
+        return [self._take_page() for _ in range(n)]
+
     def register(self, res: PageReservation) -> None:
         """Publish the reservation's full prompt blocks into the prefix
         cache (call *after* the prefill wrote them).  Already-cached
         blocks — including a COW copy whose content duplicates the
         original — keep their existing entry."""
-        for i, h in enumerate(res.hashes):
-            page = res.pages[i]
+        self.register_blocks(res.hashes, res.pages)
+
+    def register_blocks(self, hashes: Sequence[int],
+                        pages: Sequence[int]) -> None:
+        """Publish ``pages[i]`` as the cached copy of chain block
+        ``hashes[i]`` — the shared tail of :meth:`register` and the
+        adopt path (transferred prompt pages become prefix-cache
+        entries on the receiving arena, so later requests sharing the
+        prefix dedup against transferred content)."""
+        for h, page in zip(hashes, pages):
             if h not in self._cached and page not in self._page_hash:
                 self._cached[h] = page
                 self._page_hash[page] = h

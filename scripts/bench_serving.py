@@ -46,8 +46,22 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
+import pathlib
 import random
 import sys
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:  # runnable from anywhere
+    sys.path.insert(0, str(_REPO_ROOT))
+
+# --mesh N simulates N devices on a CPU host (harmless on real TPU:
+# the flag only affects the host platform); must land before jax init
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count"
+                                 "=8").strip()
 
 import jax
 import jax.numpy as jnp
@@ -852,6 +866,251 @@ def run_fairness(args, svc) -> int:
     return 0
 
 
+def run_mesh_comparison(args, pool, stages) -> int:
+    """Sharded vs single-chip at EQUAL PER-CHIP arena bytes.
+
+    An m-way TP mesh splits every KV head group over m devices, so the
+    same per-chip HBM budget holds m× the pages — the capacity story
+    that lets a model (and a batch) that cannot fit one chip serve at
+    all.  The A/B: a single-chip engine whose arena is one chip's
+    budget (N/m pages) vs the ``shard_map`` TP engine whose N-page
+    arena costs each chip exactly the same bytes.  Reported: peak
+    concurrent sequences (the capacity headline), tokens/s (on CPU the
+    shard_map program pays emulation overhead — the honest number; on
+    hardware the psums ride ICI), and the sharded quality probe when
+    the arena is int8."""
+    from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+    from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingModel,
+        EngineConfig,
+    )
+    from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+
+    m = args.mesh
+    devs = jax.devices()
+    if len(devs) < m:
+        print(f"need {m} devices, have {len(devs)}", file=sys.stderr)
+        return 1
+    mesh = build_mesh(MeshSpec(data=1, model=m), devices=devs[:m])
+    cfg = dataclasses.replace(PRESETS[args.preset], dtype=jnp.float32)
+    params = init_params(cfg, jax.random.key(0))
+    kv_dtype = args.kv_dtype or "fp32"
+
+    n_pages = args.slots * args.pool_max_len // args.page_size
+    base = dict(max_len=args.pool_max_len, paged=True,
+                page_size=args.page_size, kv_dtype=kv_dtype,
+                attn_impl=args.attn_impl or "gather")
+    single_cfg = EngineConfig(slots=args.slots,
+                              num_pages=max(2, n_pages // m + 1), **base)
+    shard_cfg = EngineConfig(slots=args.slots * args.overcommit,
+                             num_pages=n_pages + 1, **base)
+
+    arms = {}
+    for name, ecfg, use_mesh in (("single_chip", single_cfg, None),
+                                 ("sharded", shard_cfg, mesh)):
+        svc = CausalLMService("lm", cfg, params=params, mesh=use_mesh,
+                              dtype=jnp.float32)
+        svc.load()
+        arms[name] = _drive(ContinuousBatchingModel("lm", svc, ecfg),
+                            pool, stages, args.stage_duration,
+                            metrics_snapshot=args.metrics_snapshot,
+                            timeline=args.timeline)
+    se, sh = arms["single_chip"]["engine"], arms["sharded"]["engine"]
+    record = {
+        "metric": "serving_mesh_capacity",
+        # the headline: concurrent sequences at equal per-chip bytes
+        "value": round(sh["peak_active"] / max(se["peak_active"], 1), 3),
+        "unit": "x_concurrent_seqs",
+        "mesh_shards": m,
+        "kv_dtype": kv_dtype,
+        "per_chip_pages": n_pages // m,
+        "single_chip": {"num_pages": single_cfg.effective_num_pages,
+                        **arms["single_chip"]},
+        "sharded": {"num_pages": shard_cfg.effective_num_pages,
+                    **arms["sharded"]},
+        "tokens_per_sec_ratio": round(
+            arms["sharded"]["tokens_out_per_sec"]
+            / max(arms["single_chip"]["tokens_out_per_sec"], 1e-9), 3),
+    }
+    if kv_dtype == "int8":
+        from kubernetes_cloud_tpu.models.generate import kv_quant_probe
+
+        record["quality_probe"] = kv_quant_probe(
+            cfg, params, _eval_prompts(), page_size=args.page_size,
+            mesh=mesh)
+    print(json.dumps(record))
+    return 0
+
+
+def run_disagg_comparison(args, svc) -> int:
+    """Colocated vs disaggregated decode tail under prefill bursts, at
+    equal total resources.
+
+    Steady streaming clients decode long generations while a burst
+    thread keeps submitting long-prompt requests.  Colocated, every
+    burst prefill occupies a whole engine iteration and every active
+    stream's inter-token gap eats it; disaggregated, bursts prefill on
+    the prefill engine and the decode engine pays only the page
+    install.  The colocated arm gets BOTH arms' slots and arena in one
+    engine (the generous baseline), the disaggregated arm splits the
+    same total between its prefill and decode engines.  Acceptance:
+    disaggregated inter-token p95 ≤ 0.7× colocated, with the handover
+    page-granular and zero re-prefill tokens (engine counters)."""
+    import threading
+    import time
+
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingEngine,
+        EngineConfig,
+    )
+    from kubernetes_cloud_tpu.serve.disagg import (
+        build_disaggregated_engine,
+    )
+
+    cfg = svc.cfg
+    params = svc.params
+    rng = random.Random(args.seed)
+    slots = max(2, args.slots // 2)
+    max_len = args.pool_max_len
+    ps = args.page_size
+    n_pages = slots * max_len // ps + 1
+    steady_n = max(2, slots // 2)
+    burst_prompt = max_len - 8  # long prefills: the interference source
+    burst_n = 3                 # prompts per burst wave
+    duration = args.disagg_duration
+
+    def steady_prompt(i):
+        return [rng.randint(1, 200) for _ in range(6 + i)]
+
+    def burst_prompts():
+        return [[rng.randint(1, 200) for _ in range(burst_prompt)]
+                for _ in range(burst_n)]
+
+    def measure(make_engine, stop_engine, label):
+        eng = make_engine()
+        gaps: list[float] = []
+        stop = threading.Event()
+        threads = []
+        try:
+            # warmup: compile steady + burst-wave shapes (and the
+            # burst-group prefill bucket) before the clock starts
+            for i in range(steady_n):
+                eng.submit(steady_prompt(i), max_new_tokens=2,
+                           temperature=0.0).wait()
+            warm = [eng.submit(p, max_new_tokens=4, temperature=0.0)
+                    for p in burst_prompts()]
+            for r in warm:
+                r.wait()
+
+            def steady(i):
+                # one long-lived decode stream, resubmitted for the
+                # whole window: its inter-token gaps ARE the metric
+                while not stop.is_set():
+                    p = steady_prompt(i)
+                    req = eng.submit(p, temperature=0.0,
+                                     max_new_tokens=max_len - len(p) - 1)
+                    last = None
+                    try:
+                        for _ in req.iter_tokens(timeout=60.0):
+                            now = time.monotonic()
+                            if last is not None and not stop.is_set():
+                                gaps.append(now - last)
+                            last = now
+                            if stop.is_set():
+                                req.cancel()
+                    except Exception:  # noqa: BLE001 - bench load
+                        return
+
+            for i in range(steady_n):
+                t = threading.Thread(target=steady, args=(i,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+
+            def burster():
+                # closed-loop but gapless: a burst wave is always in
+                # flight, so prefill pressure is continuous — the
+                # interference the colocated engine cannot hide
+                while not stop.is_set():
+                    brs = [eng.submit(p, max_new_tokens=4,
+                                      temperature=0.0)
+                           for p in burst_prompts()]
+                    for r in brs:
+                        try:
+                            r.wait()
+                        except Exception:  # noqa: BLE001 - bench load
+                            pass
+
+            bt = threading.Thread(target=burster, daemon=True)
+            time.sleep(0.5)  # steady streams decoding before the storm
+            bt.start()
+            time.sleep(duration)
+            stop.set()
+            bt.join(timeout=30)
+            for t in threads:
+                t.join(timeout=30)
+            stats = dict(eng.stats)
+        finally:
+            stop_engine(eng)
+        gaps.sort()
+
+        def q(p):
+            return (round(gaps[min(int(p * len(gaps)),
+                                   len(gaps) - 1)], 6)
+                    if gaps else None)
+
+        out = {"label": label, "inter_token_p50_s": q(0.50),
+               "inter_token_p95_s": q(0.95),
+               "inter_token_p99_s": q(0.99), "gap_samples": len(gaps),
+               "reprefill_tokens": stats.get("reprefill_tokens", 0),
+               "kv_transfer_pages": stats.get("kv_transfer_pages", 0),
+               "handoffs": stats.get("handoffs", 0),
+               "adopted": stats.get("adopted", 0)}
+        print(json.dumps(out), file=sys.stderr)
+        return out
+
+    def _checked(out):
+        if out["inter_token_p95_s"] is None:
+            print(json.dumps({"error": "no inter-token samples",
+                              "arm": out["label"], **out}))
+            raise SystemExit(1)
+        return out
+
+    base = dict(max_len=max_len, paged=True, page_size=ps)
+    colocated = _checked(measure(
+        lambda: _started(ContinuousBatchingEngine(
+            cfg, params,
+            EngineConfig(slots=2 * slots, num_pages=2 * n_pages, **base),
+            eos_token_id=None, pad_token_id=0)),
+        lambda e: e.stop(), "colocated"))
+    disagg = _checked(measure(
+        lambda: _started(build_disaggregated_engine(
+            cfg, params,
+            EngineConfig(slots=slots, num_pages=n_pages, role="prefill",
+                         decode_slices=1, **base),
+            eos_token_id=None, pad_token_id=0, name="lm")),
+        lambda e: e.stop(), "disaggregated"))
+
+    record = {
+        "metric": "serving_disagg_decode_p95",
+        # the acceptance ratio: disaggregated / colocated p95 gap
+        "value": round(disagg["inter_token_p95_s"]
+                       / max(colocated["inter_token_p95_s"], 1e-9), 3),
+        "unit": "x_colocated_p95",
+        "burst_prompt_tokens": burst_prompt,
+        "colocated": colocated,
+        "disagg": disagg,
+    }
+    print(json.dumps(record))
+    return 0
+
+
+def _started(eng):
+    eng.start()
+    return eng
+
+
 def run_fleet(args, svc) -> int:
     """--fleet: the availability A/B the acceptance bar names
     (BENCHMARKS.md "Fleet resilience").  Four scenarios over
@@ -1319,6 +1578,19 @@ def main(argv=None) -> int:
                          "hedging A/B")
     ap.add_argument("--fleet-hedge", type=float, default=0.05,
                     help="fleet mode: hedge_after_s for the hedged arm")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="mesh mode: run the shard_map TP engine on an "
+                         "N-way model-axis mesh vs a single chip at "
+                         "equal PER-CHIP arena bytes (composes with "
+                         "--kv-dtype int8 for the sharded quality "
+                         "probe)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregation mode: colocated vs prefill/"
+                         "decode split — inter-token p95 of steady "
+                         "decode streams under a long-prompt prefill "
+                         "burst, at equal total slots+arena")
+    ap.add_argument("--disagg-duration", type=float, default=10.0,
+                    help="disagg mode: measured burst window seconds")
     ap.add_argument("--inject", choices=("hang", "crash"), default=None,
                     help="recovery mode: wedge (hang) or crash the "
                          "decode loop and measure supervisor recovery "
@@ -1337,11 +1609,18 @@ def main(argv=None) -> int:
                          prefix_len=args.prefix_len)
     stages = [int(s) for s in args.stages.split(",") if s]
 
+    if args.mesh > 1:
+        # builds its own (sharded + unsharded) services
+        return run_mesh_comparison(args, pool, stages)
+
     cfg = dataclasses.replace(PRESETS[args.preset], dtype=jnp.float32)
     svc = CausalLMService("lm", cfg,
                           params=init_params(cfg, jax.random.key(0)),
                           dtype=jnp.float32)
     svc.load()
+
+    if args.disagg:
+        return run_disagg_comparison(args, svc)
 
     if args.fairness:
         return run_fairness(args, svc)

@@ -1,0 +1,329 @@
+"""Prefill/decode disaggregation: identity, zero re-prefill, chaos.
+
+DistServe-style role split (``serve/disagg.py``): a prefill-role
+engine admits + prefills, then hands each request's prompt KV over
+page-granularly to a decode-role engine that resumes it through the
+pinned-pages path.  The locks:
+
+* greedy output through the disaggregated pair is token-identical to
+  one-shot ``generate`` for any admission order (incl. prefix
+  sharing on the prefill side);
+* the happy-path handover re-prefills NOTHING — ``stats
+  ["reprefill_tokens"] == 0`` while pages move (the acceptance
+  counter);
+* a decode-slice death transplants its queued requests onto a
+  survivor, which re-prefills them token-identically (actives fail
+  with the typed retryable 503 — the client-retry contract);
+* the composition with the mesh: the pair over a 2-shard TP mesh is
+  still token-identical (sharded extract → sharded install);
+* the fleet router learns roles from probe bodies and keeps
+  admission traffic off decode-role replicas.
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu import obs
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.models.generate import generate
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingModel,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.disagg import build_disaggregated_engine
+from kubernetes_cloud_tpu.serve.errors import RetryableError
+from kubernetes_cloud_tpu.serve.fleet import (
+    FleetConfig,
+    ReplicaHealth,
+    _probe_healthy,
+)
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+PROMPTS = [list(range(1, 9)), list(range(40, 45)),
+           list(range(100, 120)), [7, 8, 9]]
+MAX_NEW = [6, 9, 4, 7]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+def greedy_ref(params, prompt, n):
+    out = np.asarray(generate(CFG, params,
+                              jnp.asarray([prompt], jnp.int32),
+                              max_new_tokens=n, temperature=0.0,
+                              pad_token_id=0))
+    return out[0, len(prompt):len(prompt) + n].tolist()
+
+
+def make_pair(params, mesh=None, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("paged", True)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("role", "prefill")
+    kw.setdefault("decode_slices", 1)
+    pair = build_disaggregated_engine(
+        CFG, params, EngineConfig(**kw), eos_token_id=None,
+        pad_token_id=0, mesh=mesh, name="pair")
+    pair.start()
+    return pair
+
+
+# ---------------------------------------------------------------------------
+# identity + zero re-prefill
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("order", [[0, 1, 2, 3], [3, 2, 1, 0]])
+def test_disagg_token_identical_to_generate(params, order):
+    refs = {i: greedy_ref(params, PROMPTS[i], MAX_NEW[i]) for i in order}
+    pair = make_pair(params)
+    try:
+        reqs = {i: pair.submit(PROMPTS[i], max_new_tokens=MAX_NEW[i],
+                               temperature=0.0) for i in order}
+        got = {i: reqs[i].wait() for i in order}
+    finally:
+        pair.stop()
+    assert got == refs
+    st = pair.stats
+    # page-granular handover, zero re-prefill on the happy path
+    assert st["engines"]["pair-prefill"]["handoffs"] == len(order)
+    assert st["adopted"] == len(order)
+    assert st["reprefill_tokens"] == 0
+    assert st["kv_transfer_pages"] > 0
+    # the decode side computed no prefill at all
+    decode_stats = st["engines"]["pair-decode0"]
+    assert decode_stats["prefill_tokens"] == 0
+    assert decode_stats["emitted_tokens"] > 0
+
+
+def test_disagg_prefix_sharing_on_prefill_side(params):
+    """The prefix cache lives where admission lives: sharing dedups
+    prefill compute BEFORE the handover, and outputs stay identical."""
+    shared = list(range(200, 224))
+    prompts = [shared + [t] for t in (5, 6)]
+    refs = [greedy_ref(params, p, 5) for p in prompts]
+    pair = make_pair(params)
+    try:
+        for p, ref in zip(prompts, refs):
+            assert pair.submit(p, max_new_tokens=5,
+                               temperature=0.0).wait() == ref
+        st = pair.stats["engines"]["pair-prefill"]
+        assert st["prefix_hits"] == 1
+        assert st["prefix_tokens_saved"] == 24
+    finally:
+        pair.stop()
+
+
+def test_single_token_request_never_hands_off(params):
+    """max_new_tokens=1 completes inside the prefill engine (its one
+    token IS the prefill logits' sample) — no transfer, no decode."""
+    ref = greedy_ref(params, PROMPTS[0], 1)
+    pair = make_pair(params)
+    try:
+        assert pair.submit(PROMPTS[0], max_new_tokens=1,
+                           temperature=0.0).wait() == ref
+        st = pair.stats
+        assert st["engines"]["pair-prefill"]["handoffs"] == 0
+        assert st["adopted"] == 0
+    finally:
+        pair.stop()
+
+
+def test_disagg_over_mesh_token_identical(params):
+    """The full composition: disaggregated pair where every engine is
+    a 2-shard TP mesh engine — sharded prefill, sharded extract,
+    sharded install, sharded decode."""
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("need 2 cpu devices")
+    mesh = build_mesh(MeshSpec(data=1, model=2), devices=devs[:2])
+    refs = {i: greedy_ref(params, PROMPTS[i], MAX_NEW[i])
+            for i in (0, 3)}
+    pair = make_pair(params, mesh=mesh)
+    assert pair.prefill._tp_active
+    try:
+        reqs = {i: pair.submit(PROMPTS[i], max_new_tokens=MAX_NEW[i],
+                               temperature=0.0) for i in (0, 3)}
+        got = {i: reqs[i].wait() for i in (0, 3)}
+    finally:
+        pair.stop()
+    assert got == refs
+    assert pair.stats["reprefill_tokens"] == 0
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_kv_transfer_metrics_and_phase(params):
+    pair = make_pair(params)
+    try:
+        pair.submit(PROMPTS[1], max_new_tokens=6, temperature=0.0).wait()
+        decode = pair.decodes[0]
+        recs = decode.flight.tail(64)
+        assert any("kv_transfer" in r["phases"] for r in recs)
+        samples = obs.parse_text(obs.render_text())
+        assert obs.sample_value(
+            samples, "kct_engine_kv_transfer_pages_total",
+            {"model": "pair-prefill", "direction": "out"}) > 0
+        assert obs.sample_value(
+            samples, "kct_engine_kv_transfer_pages_total",
+            {"model": "pair-decode0", "direction": "in"}) > 0
+        assert obs.sample_value(
+            samples, "kct_engine_kv_transfer_seconds_count",
+            {"model": "pair-decode0"}) >= 1
+        # role-labeled iteration histogram: both sides visible
+        assert obs.sample_value(
+            samples, "kct_engine_iteration_seconds_count",
+            {"model": "pair-prefill", "role": "prefill"}) >= 1
+        assert obs.sample_value(
+            samples, "kct_engine_iteration_seconds_count",
+            {"model": "pair-decode0", "role": "decode"}) >= 1
+        assert obs.sample_value(samples, "kct_engine_mesh_shards",
+                                {"model": "pair-prefill"}) == 1
+    finally:
+        pair.stop()
+
+
+def test_model_level_disagg_and_metadata(params):
+    class _Svc:
+        cfg = CFG
+        ready = True
+        mesh = None
+        tokenizer = None
+
+        def __init__(self, p):
+            self.params = p
+
+        def load(self):
+            pass
+
+    model = ContinuousBatchingModel(
+        "lm", _Svc(params),
+        EngineConfig(slots=2, max_len=64, paged=True, page_size=8,
+                     role="prefill", decode_slices=1))
+    model.load()
+    try:
+        h = model.health()
+        assert h["ok"] and h["role"] == "prefill"
+        meta = model.engine.debug_meta()
+        assert meta["role"] == "disaggregated"
+        assert meta["decode_slices"] == 1
+    finally:
+        model.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: decode-slice death → transplant to a survivor (re-prefill)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_decode_slice_death_reprefills_on_survivor(params):
+    refs = {i: greedy_ref(params, PROMPTS[i], 40) for i in range(4)}
+    pair = make_pair(params, decode_slices=2)
+    victim = pair.decodes[0]
+    try:
+        # arm the kill AFTER a couple of decode iterations so some
+        # requests are mid-decode and some still queued behind them
+        orig = victim._decode_pages
+        state = {"n": 0}
+
+        def boom(*a, **kw):
+            state["n"] += 1
+            if state["n"] > 2:
+                raise RuntimeError("injected decode-slice death")
+            return orig(*a, **kw)
+
+        victim._decode_pages = boom
+        reqs = {i: pair.submit(PROMPTS[i], max_new_tokens=40,
+                               temperature=0.0) for i in range(4)}
+        outcomes = {}
+        for i, r in reqs.items():
+            try:
+                outcomes[i] = r.wait()
+            except RetryableError as e:
+                outcomes[i] = e
+        ok = {i: v for i, v in outcomes.items() if isinstance(v, list)}
+        failed = {i: v for i, v in outcomes.items()
+                  if not isinstance(v, list)}
+        # the dead slice's ACTIVE requests fail retryably (the client
+        # retry path); everything that completed is token-identical
+        assert failed, "the injected death should fail some actives"
+        for i, toks in ok.items():
+            assert toks == refs[i], f"request {i} diverged"
+        # and the dead slice's QUEUED work moved to the survivor and
+        # re-prefilled there (the one place reprefill_tokens may rise)
+        deadline = time.monotonic() + 5
+        while (pair.stats_extra["transplants"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        survivor = pair.decodes[1]
+        if pair.stats_extra["transplants"]:
+            assert survivor.stats["resumed"] >= 1
+            assert survivor.stats["reprefill_tokens"] > 0
+        assert not victim.alive
+        assert pair.alive  # the pair still serves through the survivor
+        post = pair.submit(PROMPTS[0], max_new_tokens=6,
+                           temperature=0.0)
+        assert post.wait() == greedy_ref(params, PROMPTS[0], 6)
+    finally:
+        pair.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet: roles learned from probe bodies
+# ---------------------------------------------------------------------------
+
+
+def test_probe_healthy_learns_role():
+    body = {"models": {"lm": {"ok": True, "queue_depth": 2,
+                              "heartbeat_age_s": 0.01,
+                              "role": "decode"}}}
+    healthy, depth, _age, role = _probe_healthy(200, body, 5.0)
+    assert healthy and depth == 2 and role == "decode"
+    # any admission-taking model makes the replica routable
+    body["models"]["lm2"] = {"ok": True, "role": "prefill"}
+    assert _probe_healthy(200, body, 5.0)[3] == "prefill"
+
+
+def test_replica_health_tracks_role_and_pick_filters():
+    from tests.test_fleet import FakeReplica
+
+    cfg = FleetConfig(probe_interval_s=60.0)
+    h = ReplicaHealth("r0", cfg)
+    assert h.role == "colocated"
+    h.note_probe(True, 0, 0.0, "decode")
+    assert h.role == "decode"
+    assert h.snapshot()["role"] == "decode"
+    # a router never dispatches admission traffic to a decode replica
+    from kubernetes_cloud_tpu.serve.fleet import FleetRouter
+
+    r_dec = FakeReplica("dec", cfg)
+    r_dec.probe_result = (200, {"models": {
+        "lm": {"ok": True, "queue_depth": 0, "heartbeat_age_s": 0.01,
+               "role": "decode"}}})
+    r_col = FakeReplica("col", cfg)
+    router = FleetRouter([r_dec, r_col], cfg)
+    router.probe_now()
+    assert r_dec.health.role == "decode"
+    picked, _trial, skipped = router._pick([])
+    assert picked is r_col
+    assert not skipped  # role filtering is not a health reroute
+    status, body = router._fleet_call(
+        "/v1/models/lm:predict", {"instances": ["x"]})
+    assert status == 200
+    assert body["fleet"]["replica"] == "col"
+    assert not r_dec.calls

@@ -299,7 +299,9 @@ def test_model_health_carries_rollout_metadata(params):
         assert h["ok"] and h["kv_dtype"] == "int8"
         assert h["attn_impl"] == "gather"
         assert model.serving_metadata() == {"kv_dtype": "int8",
-                                            "attn_impl": "gather"}
+                                            "attn_impl": "gather",
+                                            "role": "colocated",
+                                            "mesh_shards": 1}
     finally:
         model.stop()
 

@@ -1,0 +1,272 @@
+"""Mesh-sharded decode engine: the token-identity lock (ROADMAP item 1).
+
+The continuous-batching engine on a tensor-parallel mesh runs ONE
+``shard_map``ped program per iteration (``models/tp_decode.py``): params
+split q/k/v and sharded by heads, the paged arena (and its int8 scale
+buffers) sharded over the kv-head/``model`` axis, scheduler state
+replicated on the host.  The acceptance bar: **sharded greedy decode is
+token-identical to single-chip for any admission order** — on the
+CPU host-platform mesh at 2 AND 4 shards, for fp32 and int8 arenas,
+including prefix sharing, copy-on-write, and preempt/resume round
+trips.  A miswired psum, a head-slice off-by-one, or a scale buffer
+that stopped following its page all surface as divergence here.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.core.mesh import MeshSpec, build_mesh
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.models.generate import generate, kv_quant_probe
+from kubernetes_cloud_tpu.models.tp_decode import (
+    tp_shards,
+    tp_unsupported_reason,
+)
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingEngine,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.tenancy import TenancyConfig, TenantSpec
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+PROMPTS = [list(range(1, 9)), list(range(40, 45)),
+           list(range(100, 120)), [7, 8, 9]]
+MAX_NEW = [6, 9, 4, 7]
+
+TEN = TenancyConfig(
+    tenants=(
+        TenantSpec("batchy", lane="batch", api_keys=("k-batchy",)),
+        TenantSpec("inter", lane="interactive", api_keys=("k-inter",)),
+    ),
+    min_batch_progress=2,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def mesh2():
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("need 2 cpu devices")
+    return build_mesh(MeshSpec(data=1, model=2), devices=devs[:2])
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    devs = jax.devices("cpu")
+    if len(devs) < 4:
+        pytest.skip("need 4 cpu devices")
+    return build_mesh(MeshSpec(data=1, model=4), devices=devs[:4])
+
+
+def greedy_ref(params, prompt, n):
+    out = np.asarray(generate(CFG, params,
+                              jnp.asarray([prompt], jnp.int32),
+                              max_new_tokens=n, temperature=0.0,
+                              pad_token_id=0))
+    return out[0, len(prompt):len(prompt) + n].tolist()
+
+
+def make_engine(params, mesh=None, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("paged", True)
+    kw.setdefault("page_size", 8)
+    eng = ContinuousBatchingEngine(CFG, params, EngineConfig(**kw),
+                                   eos_token_id=None, pad_token_id=0,
+                                   mesh=mesh)
+    eng.start()
+    return eng
+
+
+def run_workload(eng, order, prompts=PROMPTS, max_new=MAX_NEW):
+    reqs = {i: eng.submit(prompts[i], max_new_tokens=max_new[i],
+                          temperature=0.0) for i in order}
+    return {i: reqs[i].wait(eng) for i in order}
+
+
+# ---------------------------------------------------------------------------
+# fp32: sharded == one-shot generate, any admission order
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("order", [[0, 1, 2, 3], [3, 2, 1, 0]])
+def test_sharded_fp32_token_identical_to_generate(params, mesh2, order):
+    refs = {i: greedy_ref(params, PROMPTS[i], MAX_NEW[i]) for i in order}
+    eng = make_engine(params, mesh=mesh2)
+    assert eng._tp_active and eng.mesh_shards == 2
+    try:
+        got = run_workload(eng, order)
+    finally:
+        eng.stop()
+    assert got == refs
+
+
+def test_sharded_4way_token_identical(params, mesh4):
+    """Same lock at 4 shards (every head group on its own device)."""
+    order = [0, 3]
+    refs = {i: greedy_ref(params, PROMPTS[i], MAX_NEW[i]) for i in order}
+    eng = make_engine(params, mesh=mesh4)
+    assert eng._tp_active and eng.mesh_shards == 4
+    try:
+        got = run_workload(eng, order)
+    finally:
+        eng.stop()
+    assert got == refs
+
+
+def test_arena_and_params_actually_shard(params, mesh2):
+    """Real ≥2-way sharding, not a replicated no-op: each device holds
+    half the kv heads of the arena and half the q heads of wq."""
+    eng = make_engine(params, mesh=mesh2)
+    try:
+        k = eng.pool["k"]  # [L, NP, ps, Hkv, Dh]
+        shard_heads = max(s.data.shape[3] for s in k.addressable_shards)
+        assert shard_heads == CFG.kv_heads // 2
+        wq = eng.params["blocks"]["attn"]["wq"]  # [L, D, H, Dh]
+        assert max(s.data.shape[2] for s in wq.addressable_shards) \
+            == CFG.num_heads // 2
+        assert eng.debug_meta()["mesh_shards"] == 2
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# int8 arena: sharded == single-chip int8 (same quantization math per
+# head slice), scale buffers following their pages' head axis
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_int8_matches_single_chip_int8(params, mesh2):
+    outs = {}
+    for mesh in (None, mesh2):
+        eng = make_engine(params, mesh=mesh, kv_dtype="int8")
+        if mesh is not None:
+            assert eng._tp_active
+            sc = eng.pool["k_scale"]  # [L, NP, Hkv]
+            assert max(s.data.shape[2] for s in sc.addressable_shards) \
+                == CFG.kv_heads // 2
+        try:
+            outs[mesh is None] = run_workload(eng, [0, 1, 2, 3])
+        finally:
+            eng.stop()
+    assert outs[True] == outs[False]
+
+
+def test_sharded_kv_quant_probe_holds_bar(params, mesh2):
+    """PR-11's deferred item closed: the int8 quality probe runs
+    through the shard_map TP programs and the top-1 agreement bar
+    holds on the mesh."""
+    probe = kv_quant_probe(CFG, params, [PROMPTS[0], PROMPTS[2]],
+                           max_new_tokens=6, page_size=8, mesh=mesh2)
+    assert probe["positions"] == 12
+    assert probe["top1_agreement"] >= 0.99
+    assert probe["max_logit_err"] < 0.05
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing + COW on the sharded arena
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("order", [[0, 1, 2], [2, 0, 1]])
+def test_sharded_prefix_sharing_identity(params, mesh2, order):
+    shared = list(range(200, 224))  # 3 full pages at page_size=8
+    prompts = [shared + [t] for t in (5, 6, 7)]
+    refs = [greedy_ref(params, p, 5) for p in prompts]
+    eng = make_engine(params, mesh=mesh2)
+    try:
+        for i in order:
+            got = eng.submit(prompts[i], max_new_tokens=5,
+                             temperature=0.0).wait(eng)
+            assert got == refs[i], f"prompt {i} diverged under sharing"
+        assert eng.stats["prefix_hits"] == 2
+        assert eng.stats["prefix_tokens_saved"] == 48
+    finally:
+        eng.stop()
+
+
+def test_sharded_cow_identity(params, mesh2):
+    """Page-aligned fully-matched prompt: the COW device copy runs on
+    the sharded arena (scales travel with their pages) and the
+    recomputed last token still matches one-shot generate."""
+    aligned = list(range(300, 316))  # exactly 2 pages
+    ref = greedy_ref(params, aligned, 4)
+    eng = make_engine(params, mesh=mesh2)
+    try:
+        assert eng.submit(aligned, max_new_tokens=4,
+                          temperature=0.0).wait(eng) == ref
+        assert eng.submit(aligned, max_new_tokens=4,
+                          temperature=0.0).wait(eng) == ref
+        assert eng.stats["cow_copies"] == 1
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# preempt / resume across the mesh
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_preempt_resume_identity(params, mesh2):
+    """An interactive arrival evicts a batch slot mid-decode on the
+    SHARDED engine; the victim's pinned pages resume prefill-free and
+    its output stays bitwise-identical to one-shot generate."""
+    eng = make_engine(params, mesh=mesh2, tenancy=TEN)
+    b_prompts = [list(range(1, 9)), list(range(40, 45))]
+    i_prompt = [7, 8, 9]
+    try:
+        victims = [eng.submit(p, max_new_tokens=40, temperature=0.0,
+                              api_key="k-batchy") for p in b_prompts]
+        for v in victims:
+            next(v.iter_tokens(timeout=60))
+        pre = eng.submit(i_prompt, max_new_tokens=7, temperature=0.0,
+                         api_key="k-inter")
+        assert pre.wait(eng) == greedy_ref(params, i_prompt, 7)
+        for p, v in zip(b_prompts, victims):
+            assert v.wait(eng) == greedy_ref(params, p, 40)
+        assert eng.stats["preemptions"] >= 1
+        assert eng.stats["resumed"] == eng.stats["preemptions"]
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# fallback honesty
+# ---------------------------------------------------------------------------
+
+
+def test_non_dividing_heads_fall_back_to_gspmd(mesh4):
+    """kv_heads that don't divide the model axis must not break the
+    engine: the shard_map path declines with a named reason and the
+    engine still serves (GSPMD placement, replicated heads)."""
+    cfg = dataclasses.replace(CFG, num_heads=4, num_kv_heads=2)
+    assert tp_shards(mesh4) == 4
+    assert "kv_heads" in tp_unsupported_reason(cfg, mesh4)
+    params = init_params(cfg, jax.random.key(1))
+    eng = ContinuousBatchingEngine(
+        cfg, params, EngineConfig(slots=2, max_len=64, paged=True,
+                                  page_size=8),
+        eos_token_id=None, pad_token_id=0, mesh=mesh4)
+    assert not eng._tp_active and eng.mesh_shards == 4
+    eng.start()
+    try:
+        out = np.asarray(generate(cfg, params,
+                                  jnp.asarray([PROMPTS[0]], jnp.int32),
+                                  max_new_tokens=5, temperature=0.0,
+                                  pad_token_id=0))
+        ref = out[0, len(PROMPTS[0]):len(PROMPTS[0]) + 5].tolist()
+        assert eng.submit(PROMPTS[0], max_new_tokens=5,
+                          temperature=0.0).wait(eng) == ref
+    finally:
+        eng.stop()
