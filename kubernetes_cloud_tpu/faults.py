@@ -129,6 +129,14 @@ SITES = {
     "train.checkpoint": "inside the trainer's checkpoint save (raise "
                         "= failed save surfaces loudly; hang = wedged "
                         "storage during the save window)",
+    "spec.verify": "before the speculative-decoding batched "
+                   "verification dispatch, on the scheduler thread "
+                   "(raise = crashed verify program -> engine crash, "
+                   "supervisor restart, in-flight requests fail "
+                   "retryable; hang = wedged device caught by the "
+                   "heartbeat watchdog — identical containment to "
+                   "decode_step, chaos-locked so speculation can "
+                   "never weaken the self-healing contract)",
 }
 
 

@@ -446,6 +446,169 @@ def prefill_into_pages(cfg: CausalLMConfig, params: Params,
     return last, new_arena
 
 
+def prefill_chunk_into_slots(cfg: CausalLMConfig, params: Params,
+                             input_ids: jax.Array,
+                             attention_mask: jax.Array, pool: dict,
+                             slot_ids: jax.Array, start: jax.Array
+                             ) -> tuple[jax.Array, dict]:
+    """Prefill a *chunk* of prompt tokens at absolute positions into
+    slot rows — the dense-pool half of Sarathi-style chunked prefill
+    (``EngineConfig.prefill_chunk_tokens``).
+
+    ``input_ids`` [B, T] holds each request's next chunk (right-
+    padded); ``start`` [B] is the absolute position of each chunk's
+    first token (0 for the first chunk, the resident context length
+    after).  Chunk queries attend to the slot's already-prefilled
+    positions *and* causally within the chunk through the same pool
+    view decode uses, so splitting a prompt into chunks is numerically
+    the one-shot prefill — the same mechanism ``prefill_into_pages``
+    proves for prefix-cache tail prefill, on the dense pool.  Pad
+    columns write at their own (beyond-context) positions, which are
+    never attended and are overwritten by their eventual real write.
+    Returns (last-real-token logits [B, V], pool); the pool's
+    ``length`` rows advance to ``start + chunk_len``."""
+    b, t = input_ids.shape
+    max_len = pool["k"].shape[2]
+    chunk_lens = attention_mask.sum(-1).astype(jnp.int32)
+    positions = jnp.minimum(start[:, None] + jnp.arange(t)[None, :],
+                            max_len - 1)
+
+    rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
+            if cfg.pos_emb == "rope" else None)
+    kpos_all = jnp.broadcast_to(jnp.arange(max_len), (b, max_len))
+    bias = (_alibi_bias(cfg, kpos_all.astype(jnp.float32))
+            if cfg.pos_emb == "alibi" else None)
+    # key j visible to chunk query i iff j <= its absolute position:
+    # covers the resident prefix and the causal triangle in the chunk
+    key_mask = (kpos_all[:, None, None, :]
+                <= positions[:, None, :, None]).astype(jnp.int32)
+
+    x = _embed(cfg, params, input_ids, positions)
+
+    def body(carry, layer):
+        x = carry
+        p, ck, cv = layer
+        q, k_new, v_new, attn_in = _project_qkv(
+            cfg, p, x, rope=rope, q_positions=positions)
+        rows = ck[slot_ids]                       # [B, max_len, Hkv, D]
+        rows = rows.at[jnp.arange(b)[:, None], positions].set(
+            k_new.astype(ck.dtype))
+        ck = ck.at[slot_ids].set(rows)
+        rowsv = cv[slot_ids]
+        rowsv = rowsv.at[jnp.arange(b)[:, None], positions].set(
+            v_new.astype(cv.dtype))
+        cv = cv.at[slot_ids].set(rowsv)
+        attn_vec = attention(q, ck[slot_ids].astype(cfg.dtype),
+                             cv[slot_ids].astype(cfg.dtype),
+                             causal=False, bias=bias, mask=key_mask,
+                             impl="xla")
+        x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
+                                token_mask=attention_mask,
+                                moe_no_drop=True)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x,
+                               (params["blocks"], pool["k"], pool["v"]))
+    pool = {"k": ks, "v": vs,
+            "length": pool["length"].at[slot_ids].set(start + chunk_lens)}
+    logits = _unembed(cfg, params, x)
+    last = jnp.take_along_axis(
+        logits, (chunk_lens - 1)[:, None, None].clip(0), axis=1)[:, 0]
+    return last, pool
+
+
+def verify_step_pages(cfg: CausalLMConfig, params: Params,
+                      tokens: jax.Array, mask: jax.Array, arena: dict,
+                      page_table: jax.Array, lengths: jax.Array
+                      ) -> tuple[jax.Array, dict]:
+    """ONE batched target step verifying speculative drafts through the
+    paged arena (Leviathan et al.; see PAPERS.md).
+
+    ``tokens`` [S, T] carries, per slot, its previously sampled token
+    in column 0 and draft proposals in columns 1..T-1; ``mask`` [S, T]
+    marks fed columns (all-zero for inactive slots).  Every fed token's
+    K/V is written at absolute positions ``lengths .. lengths+T-1``
+    through the per-slot page indirection — EXACTLY where sequential
+    decode steps would write them, so the gathered attention view (and
+    therefore every logits row) is the one sequential decode computes.
+    The host accepts the longest prefix where the target's greedy
+    argmax agrees with the drafts and rolls back by truncating its
+    host-side lengths: pages are append-only per slot, so rejected-
+    token KV is simply dead rows the next real write overwrites (null-
+    page routed when beyond the slot's reservation).  Returns (logits
+    [S, T, V] — one row per fed position — and the arena)."""
+    s, t = tokens.shape
+    ps = arena["k"].shape[2]
+    max_len = page_table.shape[1] * ps
+    positions = jnp.minimum(lengths[:, None] + jnp.arange(t)[None, :],
+                            max_len - 1)
+    valid = (mask != 0) & (lengths[:, None] + jnp.arange(t)[None, :]
+                           < max_len)
+    quant = "k_scale" in arena
+
+    rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
+            if cfg.pos_emb == "rope" else None)
+    kpos_all = jnp.broadcast_to(jnp.arange(max_len), (s, max_len))
+    bias = (_alibi_bias(cfg, kpos_all.astype(jnp.float32))
+            if cfg.pos_emb == "alibi" else None)
+    key_mask = (kpos_all[:, None, None, :]
+                <= positions[:, None, :, None]).astype(jnp.int32)
+
+    phys, rows = _page_scatter_indices(page_table, positions, valid, ps)
+    phys_f = phys.reshape(s * t)
+    rows_f = rows.reshape(s * t)
+    valid_f = valid.reshape(s * t)
+
+    x = _embed(cfg, params, tokens, positions)
+
+    def body(carry, layer):
+        x = carry
+        if quant:
+            p, ck, cv, sk, sv = layer
+        else:
+            p, ck, cv = layer
+            sk = sv = None
+        q, k_new, v_new, attn_in = _project_qkv(
+            cfg, p, x, rope=rope, q_positions=positions)
+        k_flat = k_new.reshape(s * t, cfg.kv_heads, cfg.head_dim)
+        v_flat = v_new.reshape(s * t, cfg.kv_heads, cfg.head_dim)
+        if quant:
+            ck, sk = _quant_prefill_write(ck, sk, page_table, phys_f,
+                                          rows_f, k_flat, valid_f)
+            cv, sv = _quant_prefill_write(cv, sv, page_table, phys_f,
+                                          rows_f, v_flat, valid_f)
+            from kubernetes_cloud_tpu.ops.paged_attention import (
+                gather_pages,
+            )
+
+            dense_k = gather_pages(ck, page_table, sk)
+            dense_v = gather_pages(cv, page_table, sv)
+        else:
+            ck = ck.at[phys_f, rows_f].set(k_flat.astype(ck.dtype))
+            cv = cv.at[phys_f, rows_f].set(v_flat.astype(cv.dtype))
+            dense_k = ck[page_table].reshape(s, max_len, cfg.kv_heads,
+                                             cfg.head_dim)
+            dense_v = cv[page_table].reshape(s, max_len, cfg.kv_heads,
+                                             cfg.head_dim)
+        attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                             dense_v.astype(cfg.dtype), causal=False,
+                             bias=bias, mask=key_mask, impl="xla")
+        x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
+                                token_mask=mask, moe_no_drop=True)
+        return x, ((ck, cv, sk, sv) if quant else (ck, cv))
+
+    if quant:
+        xs = (params["blocks"], arena["k"], arena["v"],
+              arena["k_scale"], arena["v_scale"])
+        x, (ks, vs, ssk, ssv) = jax.lax.scan(body, x, xs)
+        new_arena = {"k": ks, "v": vs, "k_scale": ssk, "v_scale": ssv}
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["blocks"], arena["k"], arena["v"]))
+        new_arena = {"k": ks, "v": vs}
+    return _unembed(cfg, params, x), new_arena
+
+
 def decode_step_pages(cfg: CausalLMConfig, params: Params,
                       tokens: jax.Array, arena: dict,
                       page_table: jax.Array, lengths: jax.Array,

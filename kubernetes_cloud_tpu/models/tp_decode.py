@@ -487,9 +487,98 @@ def _prefill_shard_fn(cfg: CausalLMConfig, m: int, interpret: bool,
     return last, new_arena
 
 
-#: (cfg, mesh, kv_dtype, attn_impl) → (prefill_jit, decode_jit); one
-#: compilation cache shared by every engine incarnation (a supervisor
-#: restart builds a new engine but reuses the programs)
+def _verify_shard_fn(cfg: CausalLMConfig, m: int, params: Params,
+                     tokens: jax.Array, mask: jax.Array, arena: dict,
+                     page_table: jax.Array, lengths: jax.Array
+                     ) -> tuple[jax.Array, dict]:
+    """Per-shard body of one speculative verification step (mirrors
+    ``generate.verify_step_pages``: every slot's pending token + its
+    draft proposals score in ONE multi-query pass at their true
+    absolute positions, K/V written through the page indirection so
+    the gathered view is bitwise the sequential-decode one)."""
+    idx = jax.lax.axis_index(AXIS_MODEL)
+    h_loc = cfg.num_heads // m
+    s, t = tokens.shape
+    ps = arena["k"].shape[2]
+    max_len = page_table.shape[1] * ps
+    positions = jnp.minimum(lengths[:, None] + jnp.arange(t)[None, :],
+                            max_len - 1)
+    valid = (mask != 0) & (lengths[:, None] + jnp.arange(t)[None, :]
+                           < max_len)
+    quant = "k_scale" in arena
+
+    rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
+            if cfg.pos_emb == "rope" else None)
+    kpos_all = jnp.broadcast_to(jnp.arange(max_len), (s, max_len))
+    bias = None
+    if cfg.pos_emb == "alibi":
+        slopes_loc = jax.lax.dynamic_slice_in_dim(
+            alibi_slopes(cfg.num_heads), idx * h_loc, h_loc)
+        bias = (slopes_loc[None, :, None, None]
+                * kpos_all.astype(jnp.float32)[:, None, None, :])
+    key_mask = (kpos_all[:, None, None, :]
+                <= positions[:, None, :, None]).astype(jnp.int32)
+
+    phys, rows = _page_scatter_indices(page_table, positions, valid, ps)
+    phys_f = phys.reshape(s * t)
+    rows_f = rows.reshape(s * t)
+    valid_f = valid.reshape(s * t)
+    hkv_loc = cfg.kv_heads // m
+
+    x = _tp_embed(cfg, params, tokens, positions, idx, m)
+
+    def body(carry, layer):
+        x = carry
+        if quant:
+            p, ck, cv, sk, sv = layer
+        else:
+            p, ck, cv = layer
+            sk = sv = None
+        q, k_new, v_new = _tp_qkv(cfg, p, x, rope=rope,
+                                  q_positions=positions)
+        k_flat = k_new.reshape(s * t, hkv_loc, cfg.head_dim)
+        v_flat = v_new.reshape(s * t, hkv_loc, cfg.head_dim)
+        if quant:
+            ck, sk = _quant_prefill_write(ck, sk, page_table, phys_f,
+                                          rows_f, k_flat, valid_f)
+            cv, sv = _quant_prefill_write(cv, sv, page_table, phys_f,
+                                          rows_f, v_flat, valid_f)
+            from kubernetes_cloud_tpu.ops.paged_attention import (
+                gather_pages,
+            )
+
+            dense_k = gather_pages(ck, page_table, sk)
+            dense_v = gather_pages(cv, page_table, sv)
+        else:
+            ck = ck.at[phys_f, rows_f].set(k_flat.astype(ck.dtype))
+            cv = cv.at[phys_f, rows_f].set(v_flat.astype(cv.dtype))
+            dense_k = ck[page_table].reshape(s, max_len, hkv_loc,
+                                             cfg.head_dim)
+            dense_v = cv[page_table].reshape(s, max_len, hkv_loc,
+                                             cfg.head_dim)
+        attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                             dense_v.astype(cfg.dtype), causal=False,
+                             bias=bias, mask=key_mask, impl="xla")
+        attn_out = _tp_wo(cfg, p, attn_vec)
+        x = _tp_finish(cfg, p, x, attn_out, mask, True)
+        return x, ((ck, cv, sk, sv) if quant else (ck, cv))
+
+    if quant:
+        xs = (params["blocks"], arena["k"], arena["v"],
+              arena["k_scale"], arena["v_scale"])
+        x, (ks, vs, ssk, ssv) = jax.lax.scan(body, x, xs)
+        new_arena = {"k": ks, "v": vs, "k_scale": ssk, "v_scale": ssv}
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["blocks"], arena["k"], arena["v"]))
+        new_arena = {"k": ks, "v": vs}
+    return _tp_unembed(cfg, params, x, idx, m), new_arena
+
+
+#: (cfg, mesh, kv_dtype, attn_impl) → (prefill_jit, decode_jit,
+#: verify_jit); one compilation cache shared by every engine
+#: incarnation (a supervisor restart builds a new engine but reuses
+#: the programs)
 _PROGRAMS: dict = {}
 
 
@@ -505,6 +594,8 @@ def build_tp_programs(cfg: CausalLMConfig, mesh, params_split: Params, *,
 
     * ``prefill(params, ids, mask, arena, tables, start)``
     * ``decode(params, tokens, arena, table, lengths)``
+    * ``verify(params, tokens, mask, arena, table, lengths)`` —
+      the speculative-decoding multi-query step
 
     The arena argument is donated, like the single-chip jits."""
     key = (cfg, mesh, kv_dtype, attn_impl)
@@ -532,7 +623,14 @@ def build_tp_programs(cfg: CausalLMConfig, mesh, params_split: Params, *,
         in_specs=(pspecs, rep, rep, arena_spec, rep, rep),
         out_specs=(rep, arena_spec),
         check_rep=False)
+    verify = shard_map(
+        functools.partial(_verify_shard_fn, cfg, m),
+        mesh=mesh,
+        in_specs=(pspecs, rep, rep, arena_spec, rep, rep),
+        out_specs=(rep, arena_spec),
+        check_rep=False)
     programs = (jax.jit(prefill, donate_argnums=(3,)),
-                jax.jit(decode, donate_argnums=(2,)))
+                jax.jit(decode, donate_argnums=(2,)),
+                jax.jit(verify, donate_argnums=(3,)))
     _PROGRAMS[key] = programs
     return programs

@@ -77,6 +77,12 @@ METRIC_FAMILIES = {
         "prefill-to-decode KV handover latency (extract to install)",
     "kct_engine_kv_transfer_pages_total":
         "KV pages moved between disaggregated arenas, by direction",
+    "kct_engine_spec_accept_ratio":
+        "lifetime fraction of speculative drafts the target accepted",
+    "kct_engine_spec_tokens_total":
+        "speculative draft tokens by verification result",
+    "kct_engine_prefill_chunks_total":
+        "chunked-prefill slices dispatched (Sarathi co-scheduling)",
     # multi-tenant traffic plane (serve/tenancy.py)
     "kct_tenant_admitted_total":
         "requests admitted into slots per tenant and QoS lane",

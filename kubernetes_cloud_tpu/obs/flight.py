@@ -54,8 +54,13 @@ from typing import Any, Optional
 #: without a config scrape
 #: "kv_transfer" is the disaggregated handover (serve/disagg.py):
 #: page extract on the prefill side, page install on the decode side
+#: "draft" and "verify" are the speculative-decoding split of the
+#: per-token step (serve/spec_decode.py): draft-model proposal steps
+#: vs the ONE batched target verification dispatch that replaces the
+#: decode dispatch on speculative rounds
 PHASES = ("admit", "cow_copy", "prefill", "decode", "fused_decode",
-          "sample", "stream", "host_sync", "kv_transfer")
+          "draft", "verify", "sample", "stream", "host_sync",
+          "kv_transfer")
 
 
 class IterationRecord:
@@ -68,7 +73,8 @@ class IterationRecord:
     __slots__ = ("seq", "ts", "dur_s", "phases", "active", "admitted",
                  "evicted", "queue_depth", "decode_tokens",
                  "prefill_tokens", "cached_tokens", "prefix_hits",
-                 "pages_reserved", "pages_freed", "flops")
+                 "pages_reserved", "pages_freed", "flops",
+                 "prefilling", "spec_drafted", "spec_accepted")
 
     def __init__(self) -> None:
         self.seq = 0            # assigned by commit(), monotonically
@@ -86,6 +92,9 @@ class IterationRecord:
         self.pages_reserved = 0  # paged mode: pages claimed this pass
         self.pages_freed = 0    # paged mode: pages released this pass
         self.flops = 0.0        # analytical model FLOPs this pass
+        self.prefilling = 0     # slots mid-chunked-prefill this pass
+        self.spec_drafted = 0   # draft tokens fed to verification
+        self.spec_accepted = 0  # drafts the target's argmax confirmed
 
     def to_dict(self) -> dict[str, Any]:
         d = {s: getattr(self, s) for s in self.__slots__

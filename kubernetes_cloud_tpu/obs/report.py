@@ -87,9 +87,16 @@ def analyze(entry: dict, *, peak_flops: Optional[float] = None,
     phase_share = {p: v / denom for p, v in phase_seconds.items()}
     phase_share["other"] = other / denom
 
-    prefill_bearing = [r for r in iters if r.get("admitted", 0) > 0]
+    # a chunked-prefill continuation pass carries prefill compute with
+    # no admission that pass — it must classify as prefill-bearing or
+    # the stall detector would compare chunk passes against themselves
+    prefill_bearing = [r for r in iters
+                       if r.get("admitted", 0) > 0
+                       or r.get("prefill_tokens", 0) > 0]
     decode_only = [r for r in iters
-                   if not r.get("admitted", 0) and r.get("active", 0)]
+                   if not r.get("admitted", 0)
+                   and not r.get("prefill_tokens", 0)
+                   and r.get("active", 0)]
 
     # span: first record start -> last record end (idle gaps included),
     # the honest denominator for goodput/MFU rates

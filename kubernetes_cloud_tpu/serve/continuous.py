@@ -73,8 +73,10 @@ from kubernetes_cloud_tpu.models.generate import (
     init_cache,
     init_page_arena,
     install_pages,
+    prefill_chunk_into_slots,
     prefill_into_pages,
     prefill_into_slots,
+    verify_step_pages,
 )
 from kubernetes_cloud_tpu.serve.errors import (
     DeadlineExceededError,
@@ -87,6 +89,11 @@ from kubernetes_cloud_tpu.serve.errors import (
 )
 from kubernetes_cloud_tpu.serve import paged_kv
 from kubernetes_cloud_tpu.serve.paged_kv import PageAllocator
+from kubernetes_cloud_tpu.serve.spec_decode import (
+    DraftSource,
+    ModelDraft,
+    NgramDraft,
+)
 from kubernetes_cloud_tpu.serve.tenancy import (
     LANES,
     TenancyConfig,
@@ -116,6 +123,9 @@ _M_ITER_S = obs.histogram(
     "kct_engine_iteration_seconds",
     "Wall time of one scheduler pass, split by kind: phase=\"prefill\" "
     "passes admitted at least one request (prefill stalls live here), "
+    "phase=\"chunked_prefill\" passes carried budget-bounded prefill "
+    "chunks co-scheduled with decode (Sarathi mode — these should "
+    "track the decode distribution, not the prefill one), "
     "phase=\"decode\" ran the decode step only (= per-token latency "
     "for every active request).  The role label names which side of a "
     "disaggregated deployment the pass ran on (colocated | prefill | "
@@ -211,6 +221,22 @@ _M_KV_TRANSFER_PAGES = obs.counter(
     "KV pages moved between disaggregated arenas, by direction "
     "(out = handed off by a prefill-role engine, in = installed by a "
     "decode-role engine).", ("model", "direction"))
+_M_SPEC_ACCEPT = obs.gauge(
+    "kct_engine_spec_accept_ratio",
+    "Lifetime fraction of speculative draft tokens the target's "
+    "greedy verification accepted (0 until the first speculative "
+    "round; the headline draft-quality signal — decode speedup is "
+    "roughly 1 + ratio * spec_k per target dispatch).", ("model",))
+_M_SPEC_TOKENS = obs.counter(
+    "kct_engine_spec_tokens_total",
+    "Speculative draft tokens by verification result (accepted = "
+    "emitted without their own target dispatch, rejected = rolled "
+    "back by host-side length truncation).", ("model", "result"))
+_M_PREFILL_CHUNKS = obs.counter(
+    "kct_engine_prefill_chunks_total",
+    "Chunked-prefill slices dispatched (Sarathi co-scheduling): a "
+    "long prompt admits as several bounded chunks interleaved with "
+    "decode steps instead of one stall-length prefill.", ("model",))
 
 
 class RequestCancelled(RuntimeError):
@@ -283,6 +309,31 @@ class EngineConfig:
     #: on hardware, its own slice group; see deploy/README.md
     #: "Sharded & disaggregated serving")
     decode_slices: int = 1
+    #: Sarathi-style chunked prefill (deploy/README.md "Latency:
+    #: chunked prefill & speculative decoding"): per-scheduler-pass
+    #: prefill token budget.  0 = unchunked — every admission prefills
+    #: its whole uncached tail in one dispatch (the legacy behavior).
+    #: >0: prefill work is sliced into chunks of at most this many
+    #: tokens co-scheduled with decode steps, so one long prompt can
+    #: no longer stall every active decode slot for its whole prefill;
+    #: a partially-prefilled request keeps its slot (and, paged, its
+    #: pages) and resumes at its absolute position next pass,
+    #: attending to its own prior chunks through the same gathered
+    #: view prefix-cache tail prefill uses.  Also chunks the
+    #: preemption-resume re-prefill, softening that cost.
+    prefill_chunk_tokens: int = 0
+    #: speculative decoding draft source (serve/spec_decode.py):
+    #: None = off; "ngram" = built-in prompt-lookup drafting (no draft
+    #: model); any other string = a model dir the serving layer loads
+    #: as the draft LM (engines built directly pass the draft via
+    #: their ``draft=`` kwarg instead).  Paged engines only; greedy
+    #: (temperature 0) requests only — stochastic slots in the same
+    #: batch keep decoding one token per step through the same
+    #: verification dispatch.
+    spec_draft: Optional[str] = None
+    #: draft tokens proposed (and verified in ONE batched target
+    #: step) per speculative round
+    spec_k: int = 4
 
     def __post_init__(self):
         if self.slots < 1:
@@ -304,6 +355,17 @@ class EngineConfig:
                 "hand-over between roles is page-granular)")
         if self.decode_slices < 1:
             raise ValueError("decode_slices must be >= 1")
+        if self.prefill_chunk_tokens < 0:
+            raise ValueError("prefill_chunk_tokens must be >= 0 "
+                             "(0 disables chunking)")
+        if not 1 <= self.spec_k <= 64:
+            raise ValueError("spec_k must be in [1, 64]")
+        if self.spec_draft is not None and not self.paged:
+            raise ValueError(
+                "speculative decoding requires paged=True (draft "
+                "verification runs through the paged arena; rollback "
+                "is host-side length truncation over append-only "
+                "pages)")
         if self.paged:
             if self.page_size < 1:
                 raise ValueError("page_size must be >= 1")
@@ -387,7 +449,8 @@ class GenRequest:
                  "claimed", "cancelled", "submitted_at", "admitted_at",
                  "first_token_at", "done_at", "deadline", "engine",
                  "request_id", "cached_tokens", "tenant", "lane",
-                 "pinned_pages", "preemptions", "resume_len")
+                 "pinned_pages", "preemptions", "resume_len",
+                 "prefill_pos")
 
     def __init__(self, prompt_ids: Sequence[int], *, max_new_tokens: int,
                  temperature: float, top_k: int, top_p: float, seed: int,
@@ -443,6 +506,12 @@ class GenRequest:
         #: preemption progress guard reads the delta (a batch slot is
         #: only preemptable after min_batch_progress fresh tokens)
         self.resume_len = 0
+        #: chunked prefill: absolute context positions already resident
+        #: in this request's KV claim (cached prefix included).  A
+        #: request preempted MID-CHUNK keeps it alongside its pinned
+        #: pages, so resume continues prefilling from here instead of
+        #: recomputing delivered chunks; 0 whenever the claim is gone.
+        self.prefill_pos = 0
 
     def cancel(self) -> None:
         """Mark the request dead (client gone).  The scheduler purges it
@@ -579,6 +648,20 @@ def _jit_copy_pages():
     return _JITTED["copy_pages"]
 
 
+def _jit_chunk_slots():
+    if "chunk_slots" not in _JITTED:
+        _JITTED["chunk_slots"] = jax.jit(
+            prefill_chunk_into_slots, static_argnums=0, donate_argnums=4)
+    return _JITTED["chunk_slots"]
+
+
+def _jit_verify_pages():
+    if "verify_pages" not in _JITTED:
+        _JITTED["verify_pages"] = jax.jit(
+            verify_step_pages, static_argnums=0, donate_argnums=4)
+    return _JITTED["verify_pages"]
+
+
 class ContinuousBatchingEngine:
     """Owns the slot pool and the scheduler thread.
 
@@ -591,7 +674,7 @@ class ContinuousBatchingEngine:
     def __init__(self, cfg: CausalLMConfig, params: Any,
                  engine_cfg: EngineConfig = EngineConfig(), *,
                  eos_token_id: Optional[int] = None, pad_token_id: int = 0,
-                 mesh=None, name: str = "engine"):
+                 mesh=None, name: str = "engine", draft: Any = None):
         self.cfg = cfg
         self.params = params
         self.ecfg = engine_cfg
@@ -632,6 +715,15 @@ class ContinuousBatchingEngine:
         self._prefill_pages = _jit_prefill_pages()
         self._decode_pages = _jit_decode_pages()
         self._copy_pages = _jit_copy_pages()
+        self._chunk_slots = _jit_chunk_slots()
+        self._verify_pages = _jit_verify_pages()
+        #: chunked prefill (Sarathi co-scheduling): slots mid-prefill,
+        #: slot -> {"req", "vprompt", "resumed", "res"}; the request's
+        #: ``prefill_pos`` tracks delivered positions.  Chunking slots
+        #: hold their slot + pages but are excluded from the decode
+        #: batch until their final chunk lands.
+        self._chunking: dict[int, dict] = {}
+        self._budget_left: Optional[int] = None  # per-pass chunk budget
         #: mesh-sharded decode (ROADMAP item 1): with a model axis > 1
         #: and a dividing config, the paged programs are replaced by
         #: ONE shard_map TP program per iteration
@@ -652,7 +744,7 @@ class ContinuousBatchingEngine:
             reason = tp_decode.tp_unsupported_reason(cfg, mesh)
             if reason is None:
                 self.params = tp_decode.place_tp_params(cfg, params, mesh)
-                _tp_pf, _tp_dec = tp_decode.build_tp_programs(
+                _tp_pf, _tp_dec, _tp_vf = tp_decode.build_tp_programs(
                     cfg, mesh, self.params,
                     kv_dtype=engine_cfg.kv_dtype,
                     attn_impl=engine_cfg.attn_impl)
@@ -664,11 +756,66 @@ class ContinuousBatchingEngine:
                 self._decode_pages = (
                     lambda _c, p, tok, pool, tbl, ln, impl=None:
                     _tp_dec(p, tok, pool, tbl, ln))
+                self._verify_pages = (
+                    lambda _c, p, tok, msk, pool, tbl, ln:
+                    _tp_vf(p, tok, msk, pool, tbl, ln))
                 self._tp_active = True
             else:
                 log.warning(
                     "engine %s: shard_map TP decode unavailable (%s); "
                     "falling back to GSPMD placement", name, reason)
+        #: speculative decoding (serve/spec_decode.py): a draft source
+        #: proposes spec_k tokens per greedy slot, verified in ONE
+        #: batched target step.  ``draft`` may be a DraftSource, a
+        #: (cfg, params) pair for the small draft LM, or None (then
+        #: spec_draft == "ngram" still activates prompt-lookup
+        #: drafting).  Prefill-role engines never decode, so they
+        #: never speculate.
+        self.draft: Optional[DraftSource] = None
+        self._draft_flops = (0.0, 0.0)
+        if engine_cfg.paged and engine_cfg.role != "prefill":
+            src = None
+            if isinstance(draft, DraftSource):
+                src = draft
+            elif draft is not None:
+                dcfg, dparams = draft
+                src = ModelDraft(dcfg, dparams, slots=engine_cfg.slots,
+                                 max_len=engine_cfg.max_len,
+                                 pad_token_id=pad_token_id)
+            elif engine_cfg.spec_draft == "ngram":
+                src = NgramDraft()
+            if src is not None:
+                self.draft = src
+                dc = getattr(src, "cfg", None)
+                if dc is not None:
+                    self._draft_flops = obs_flops.decode_flops_coeffs(dc)
+                if engine_cfg.attn_impl in ("pallas", "fused"):
+                    log.warning(
+                        "%s: speculative verification always runs the "
+                        "XLA attention path while decode runs "
+                        "attn_impl=%r; greedy identity then rests on "
+                        "cross-kernel argmax agreement — which "
+                        "kernel_parity.py only gates against the "
+                        "gather/xla pair — and a stochastic slot "
+                        "co-batched with a greedy one samples from "
+                        "the verification logits, so its seeded "
+                        "output can depend on co-batched traffic "
+                        "near softmax ties.  Validate with "
+                        "bench_serving --spec-decode on this hardware "
+                        "before trusting bitwise identity.",
+                        name, engine_cfg.attn_impl)
+        #: slots the draft source currently holds context for — filled
+        #: lazily at the first speculative round a slot joins (covers
+        #: fresh admission, every resume flavor, and adoption with one
+        #: hook), dropped on finish/preempt
+        self._spec_ready: set[int] = set()
+        #: False until the (spec_k+1)-wide verify program has compiled:
+        #: the first speculative round raises grace_until around its
+        #: dispatch (plus the draft LM's own first compiles) exactly
+        #: like _prefill_cold_guard, so a 20-40s cold-cache XLA compile
+        #: on the scheduler thread doesn't read as a wedge to the
+        #: supervisor watchdog
+        self._spec_warm = False
         #: prefill/decode disaggregation (serve/disagg.py): a prefill-
         #: role engine hands requests over after their first token;
         #: a decode-role engine adopts transferred KV at pass start
@@ -730,7 +877,13 @@ class ContinuousBatchingEngine:
                       # KV was lost (the happy-path handover keeps
                       # this at 0 — the acceptance bar)
                       "handoffs": 0, "adopted": 0,
-                      "kv_transfer_pages": 0, "reprefill_tokens": 0}
+                      "kv_transfer_pages": 0, "reprefill_tokens": 0,
+                      # latency offensive: chunked-prefill slices
+                      # dispatched, and the speculative-decoding
+                      # ledger (drafted vs accepted is the accept
+                      # ratio; rounds = verification dispatches)
+                      "prefill_chunks": 0, "spec_rounds": 0,
+                      "spec_drafted": 0, "spec_accepted": 0}
         #: always-on flight recorder: bounded ring of per-iteration
         #: phase timings + batch composition (GET /debug/timeline);
         #: flight_records=0 disables it for overhead A/Bs.  A restart
@@ -772,6 +925,9 @@ class ContinuousBatchingEngine:
         self._m_iter_decode = _M_ITER_S.labels(model=self.name,
                                                phase="decode",
                                                role=engine_cfg.role)
+        self._m_iter_chunked = _M_ITER_S.labels(model=self.name,
+                                                phase="chunked_prefill",
+                                                role=engine_cfg.role)
         self._m_phase = {p: _M_PHASE_S.labels(model=self.name, phase=p)
                          for p in PHASES}
         self._m_mfu = _M_MFU.labels(**m)
@@ -791,6 +947,14 @@ class ContinuousBatchingEngine:
         self._m_cow = _M_COW.labels(**m)
         self._m_quant_err = _M_QUANT_ERR.labels(**m)
         self._m_quant_err.set(0.0)
+        self._m_spec_accept = _M_SPEC_ACCEPT.labels(**m)
+        self._m_spec_accepted = _M_SPEC_TOKENS.labels(
+            model=self.name, result="accepted")
+        self._m_spec_rejected = _M_SPEC_TOKENS.labels(
+            model=self.name, result="rejected")
+        self._m_prefill_chunks = _M_PREFILL_CHUNKS.labels(**m)
+        if self.draft is not None:
+            self._m_spec_accept.set(0.0)
         self._m_kv_transfer_s = _M_KV_TRANSFER_S.labels(**m)
         self._m_kv_transfer_out = _M_KV_TRANSFER_PAGES.labels(
             model=self.name, direction="out")
@@ -1066,6 +1230,10 @@ class ContinuousBatchingEngine:
             self.allocator.register_blocks(payload.hashes[:n_pub],
                                            pages[:n_pub])
             req.pinned_pages = pages
+            # the transferred pages hold every position through
+            # prompt_len: a chunking engine's pinned-resume check must
+            # see the claim as fully delivered (zero re-prefill)
+            req.prefill_pos = payload.prompt_len
             req.resume_len = len(req.tokens)
             with self._qlock:
                 self.tenants.note_pages(req.tenant, len(pages))
@@ -1257,6 +1425,7 @@ class ContinuousBatchingEngine:
         # claim) belonged to the ABANDONED engine's arena — the
         # replacement re-prefills its context instead
         req.pinned_pages = None
+        req.prefill_pos = 0
         with self._qlock:
             self.tenants.append(req)
         self._work.set()
@@ -1348,6 +1517,7 @@ class ContinuousBatchingEngine:
         queued.extend(r for r, _ in adopts if not r.cancelled)
         for req in queued:
             req.pinned_pages = None  # old arena; see requeue()
+            req.prefill_pos = 0
             req.claimed = False
         return queued
 
@@ -1370,6 +1540,7 @@ class ContinuousBatchingEngine:
             # pinned claims (and pending adoption payloads) belonged
             # to THIS engine's arena; the replacement re-prefills
             req.pinned_pages = None
+            req.prefill_pos = 0
         self._fail_active(err)
         return queued
 
@@ -1395,6 +1566,11 @@ class ContinuousBatchingEngine:
             meta["num_pages"] = self._num_pages
             meta["attn_impl"] = self.ecfg.attn_impl
             meta["kv_dtype"] = self.ecfg.kv_dtype
+        if self.ecfg.prefill_chunk_tokens:
+            meta["prefill_chunk_tokens"] = self.ecfg.prefill_chunk_tokens
+        if self.draft is not None:
+            meta["spec_draft"] = self.draft.kind
+            meta["spec_k"] = self.ecfg.spec_k
         return meta
 
     def debug_slots(self) -> list[dict]:
@@ -1405,7 +1581,9 @@ class ContinuousBatchingEngine:
             if req is None:
                 out.append({"slot": i, "state": "free"})
                 continue
-            entry = {"slot": i, "state": "decoding",
+            entry = {"slot": i,
+                     "state": ("prefilling" if i in self._chunking
+                               else "decoding"),
                      "request_id": req.request_id,
                      "tenant": req.tenant,
                      "lane": req.lane,
@@ -1417,6 +1595,10 @@ class ContinuousBatchingEngine:
                      "age_s": round(now - req.submitted_at, 3)}
             if req.deadline is not None:
                 entry["deadline_in_s"] = round(req.deadline - now, 3)
+            if i in self._chunking:
+                # chunked prefill in flight: how much of the virtual
+                # prompt's KV is already resident
+                entry["prefill_pos"] = req.prefill_pos
             if self.paged:
                 pages = self._slot_pages[i]
                 entry["pages"] = len(pages) if pages else 0
@@ -1562,7 +1744,12 @@ class ContinuousBatchingEngine:
         if rec is not None:
             rec.queue_depth = self.queue_depth()
         self._reap_cancelled()
+        ch = self.ecfg.prefill_chunk_tokens
+        self._budget_left = ch if ch else None
         admitted = 0
+        # mid-prefill slots advance EVERY pass, drain included: their
+        # pending chunks are in-flight work exactly like active slots
+        chunked = self._continue_chunks()
         if not stopping:
             if self.paged:
                 # disaggregation intake first: adopted requests join
@@ -1570,27 +1757,52 @@ class ContinuousBatchingEngine:
                 # pass's admission can place them (zero re-prefill)
                 self._process_adoptions()
             t_admit = time.perf_counter()
+            pre = {p: (rec.phases.get(p, 0.0) if rec is not None
+                       else 0.0)
+                   for p in ("prefill", "cow_copy", "sample", "stream")}
             admitted = self._admit()
             if rec is not None:
                 # pure scheduler bookkeeping: the admit wall minus the
-                # device/emit phases _admit_* already accounted
+                # device/emit phases _admit_* accounted INSIDE this
+                # window (chunk continuation already billed its own)
                 overhead = (time.perf_counter() - t_admit
-                            - rec.phases.get("prefill", 0.0)
-                            - rec.phases.get("cow_copy", 0.0)
-                            - rec.phases.get("sample", 0.0)
-                            - rec.phases.get("stream", 0.0))
+                            - sum(rec.phases.get(p, 0.0) - pre[p]
+                                  for p in pre))
                 if overhead > 0:
                     rec.phases["admit"] = overhead
-        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if rec is not None:
+            rec.prefilling = len(self._chunking)
+        partial = bool(self._chunking)
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None and i not in self._chunking]
         if not active:
-            if admitted:  # every admission finished inside its prefill
-                self._m_iter_prefill.observe(time.perf_counter() - t_pass)
+            if admitted or chunked:
+                (self._m_iter_chunked if partial or chunked
+                 else self._m_iter_prefill
+                 ).observe(time.perf_counter() - t_pass)
             self._commit_rec(t_pass)
             if not stopping:
                 self._work.clear()
-                if not self.tenants.depth():
+                if not self.tenants.depth() and not self._chunking:
                     self._work.wait(self.ecfg.idle_wait_s)
             return
+        greedy = ([i for i in active
+                   if self._slots[i].temperature == 0.0]
+                  if self.draft is not None else [])
+        if greedy:
+            self._spec_round(active, greedy)
+        else:
+            self._decode_round(active)
+        (((self._m_iter_chunked if partial or chunked
+           else self._m_iter_prefill) if (admitted or chunked)
+          else self._m_iter_decode)
+         ).observe(time.perf_counter() - t_pass)
+        self._commit_rec(t_pass)
+
+    def _decode_round(self, active: list[int]) -> None:
+        """The classic per-token step: ONE decode dispatch for every
+        decode-ready slot."""
+        rec = self._rec
         tokens = np.full((self.ecfg.slots,), self.pad, np.int32)
         mask = np.zeros((self.ecfg.slots,), bool)
         ctx_sum = 0  # analytical-FLOPs accounting (each new token
@@ -1643,9 +1855,155 @@ class ContinuousBatchingEngine:
                           + self._flops_per_ctx * ctx_sum)
         for i in active:
             self._emit(i, logits[i])
-        (self._m_iter_prefill if admitted else self._m_iter_decode
-         ).observe(time.perf_counter() - t_pass)
-        self._commit_rec(t_pass)
+
+    def _spec_round(self, active: list[int], greedy: list[int]) -> None:
+        """One speculative pass (serve/spec_decode.py): the draft
+        source proposes up to ``spec_k`` tokens per greedy slot, and
+        ONE batched target dispatch (``verify_step_pages``) scores
+        every slot's pending token plus its drafts at their true
+        positions through the paged arena.  The host then emits the
+        longest prefix where the target's own greedy choice equals the
+        draft (plus the one bonus token the target computed anyway) —
+        bitwise the sequence non-speculative decode would emit — and
+        rolls rejected-draft KV back by simply not advancing host-side
+        lengths past the accepted context: pages are append-only per
+        slot, so the next real write at each position overwrites the
+        dead rows.  Stochastic (temperature > 0) slots ride the same
+        dispatch drafts-free and keep their one-token-per-pass
+        semantics."""
+        rec = self._rec
+        k = self.ecfg.spec_k
+        # cold-compile window: the first round compiles the verify
+        # program (and a ModelDraft's prefill/decode — a new slot can
+        # also hit a fresh draft-prefill bucket later), none of which
+        # start() warms; without the grace the watchdog reads the
+        # compile as a wedged device and restarts a healthy engine
+        cold = not self._spec_warm or (
+            getattr(self.draft, "compiles_on_slot_ready", False)
+            and any(i not in self._spec_ready for i in greedy))
+        if cold:
+            self.grace_until = max(
+                self.grace_until,
+                time.monotonic() + self.ecfg.compile_grace_s)
+        t0 = time.perf_counter()
+        for i in greedy:
+            if i not in self._spec_ready:
+                req = self._slots[i]
+                self.draft.slot_ready(i, req.prompt_ids + req.tokens)
+                self._spec_ready.add(i)
+        want = {i: self._slots[i].prompt_ids + self._slots[i].tokens
+                for i in greedy}
+        props = self.draft.propose(want, k)
+        t1 = time.perf_counter()
+        dsteps = getattr(self.draft, "last_steps", 0)
+        if not any(props.values()):
+            # nothing drafted this round: the (k+1)-wide verify
+            # dispatch would price each slot's one guaranteed token at
+            # multi-query cost — take the plain decode step (the
+            # configured kernel) instead.  observe() keeps per-slot
+            # draft state rolled to the settled context exactly as a
+            # verified round would.
+            if rec is not None and t1 - t0 > 0:
+                rec.phases["draft"] = rec.phases.get("draft", 0.0) \
+                    + (t1 - t0)
+            if cold:
+                self.grace_until = 0.0  # no verify compile happened
+            self._decode_round(active)
+            for i in greedy:
+                if i in self._spec_ready and self._slots[i] is not None:
+                    req = self._slots[i]
+                    self.draft.observe(i, req.prompt_ids + req.tokens)
+            return
+        width = k + 1
+        tokens = np.full((self.ecfg.slots, width), self.pad, np.int32)
+        mask = np.zeros((self.ecfg.slots, width), np.int32)
+        l0 = self._lengths.copy()
+        ctx_flops = 0.0
+        for i in active:
+            req = self._slots[i]
+            tokens[i, 0] = req.tokens[-1]
+            mask[i, 0] = 1
+            n = 1
+            d = props.get(i)
+            if d:
+                d = d[:k]
+                tokens[i, 1:1 + len(d)] = d
+                mask[i, 1:1 + len(d)] = 1
+                n += len(d)
+            ctx_flops += obs_flops.span_flops(
+                self._flops_base, self._flops_per_ctx, int(l0[i]), n)
+        faults.fire("spec.verify")
+        faults.fire("decode_step")
+        faults.fire("model_fn")
+        t2 = time.perf_counter()
+        logits, self.pool = self._verify_pages(
+            self.cfg, self.params, jnp.asarray(tokens),
+            jnp.asarray(mask), self.pool, self._device_page_table(),
+            jnp.asarray(self._lengths))
+        logits.block_until_ready()
+        if cold:
+            self._spec_warm = True
+            self.grace_until = 0.0  # compiled; wedges detect normally
+        t3 = time.perf_counter()
+        logits = np.asarray(logits)
+        t4 = time.perf_counter()
+        dt = t4 - t2
+        self.iter_s = dt if self.iter_s is None else (
+            0.9 * self.iter_s + 0.1 * dt)
+        self.stats["iterations"] += 1
+        self.stats["spec_rounds"] += 1
+        self.stats["active_slot_steps"] += len(active)
+        self._m_iters.inc()
+        emitted_total = 0
+        drafted_total = accepted_total = 0
+        for i in active:
+            req = self._slots[i]
+            drafted = int(mask[i].sum()) - 1
+            m = 0
+            for j in range(width):
+                self._emit(i, logits[i, j])
+                m += 1
+                if self._slots[i] is None:
+                    break  # EOS / max-tokens: _finish_slot reset state
+                if j + 1 >= width or not mask[i, j + 1]:
+                    break  # no more drafts to confirm
+                if req.tokens[-1] != int(tokens[i, j + 1]):
+                    break  # target disagreed: later drafts are dead
+            emitted_total += m
+            if self._slots[i] is not None:
+                # the rollback IS this assignment: positions beyond
+                # the accepted context hold rejected-draft KV that the
+                # next real write at each position overwrites
+                self._lengths[i] = int(l0[i]) + m
+                if i in self._spec_ready:
+                    self.draft.observe(i, req.prompt_ids + req.tokens)
+            if drafted:
+                drafted_total += drafted
+                accepted_total += m - 1
+        self.stats["spec_drafted"] += drafted_total
+        self.stats["spec_accepted"] += accepted_total
+        if drafted_total:
+            self._m_spec_accepted.inc(accepted_total)
+            self._m_spec_rejected.inc(drafted_total - accepted_total)
+        if self.stats["spec_drafted"]:
+            self._m_spec_accept.set(self.stats["spec_accepted"]
+                                    / self.stats["spec_drafted"])
+        if rec is not None:
+            ph = rec.phases
+            if t1 - t0 > 0:
+                ph["draft"] = ph.get("draft", 0.0) + (t1 - t0)
+            ph["verify"] = ph.get("verify", 0.0) + (t3 - t2)
+            ph["host_sync"] = ph.get("host_sync", 0.0) + (t4 - t3)
+            rec.active = len(active)
+            rec.decode_tokens = emitted_total
+            rec.spec_drafted = drafted_total
+            rec.spec_accepted = accepted_total
+            rec.flops += ctx_flops
+            db, dp = self._draft_flops
+            if dsteps and db and greedy:
+                # draft dispatches run at roughly the round's contexts
+                avg_ctx = sum(int(l0[i]) for i in greedy) / len(greedy)
+                rec.flops += dsteps * len(greedy) * (db + dp * avg_ctx)
 
     def _commit_rec(self, t_pass: float) -> None:
         """Publish the pass's flight record (if it did any work) and
@@ -1693,6 +2051,7 @@ class ContinuousBatchingEngine:
             if req is None:
                 return False
             pages, req.pinned_pages = req.pinned_pages, None
+            req.prefill_pos = 0
             self.tenants.note_pages(req.tenant, -len(pages))
         self.allocator.release(pages)
         return True
@@ -1702,6 +2061,7 @@ class ContinuousBatchingEngine:
         the queue for good (cancel / deadline shed / stop).  Scheduler-
         thread only — the allocator is single-owner, like _slots."""
         pages, req.pinned_pages = req.pinned_pages, None
+        req.prefill_pos = 0
         if pages and self.allocator is not None:
             self.allocator.release(pages)
             with self._qlock:
@@ -1779,6 +2139,181 @@ class ContinuousBatchingEngine:
                                 + self.ecfg.compile_grace_s)
         return cold
 
+    def _spec_free(self, slot: int) -> None:
+        """Drop the draft source's state for a slot leaving the decode
+        batch (finish / preemption) — the lazy ``_spec_ready`` hook
+        rebuilds it if the request ever decodes here again."""
+        if slot in self._spec_ready:
+            self._spec_ready.discard(slot)
+            if self.draft is not None:
+                self.draft.free(slot)
+
+    def _continue_chunks(self) -> int:
+        """Advance every mid-prefill slot by up to the pass's chunk
+        budget, oldest chunk first; returns prompt tokens prefilled.
+        Runs before admission so in-flight prefills never starve
+        behind fresh arrivals."""
+        if not self._chunking:
+            return 0
+        total = 0
+        for slot in list(self._chunking):
+            if self._budget_left is not None and self._budget_left <= 0:
+                break
+            st = self._chunking.get(slot)
+            if st is None or st["req"].cancelled:
+                continue  # _reap_cancelled owns the eviction
+            total += self._advance_chunk(slot, st)
+        return total
+
+    def _advance_chunk(self, slot: int, st: dict) -> int:
+        """Dispatch the next prefill chunk(s) for a mid-prefill slot,
+        within the pass's remaining token budget; completes the slot
+        (first token / decode-ready / handoff) when the final chunk
+        lands.  Returns prompt tokens prefilled."""
+        req = st["req"]
+        vprompt = st["vprompt"]
+        total = 0
+        while True:
+            pos = req.prefill_pos
+            take = len(vprompt) - pos
+            if take <= 0:
+                break
+            if self._budget_left is not None:
+                if self._budget_left <= 0:
+                    return total
+                take = min(take, self._budget_left)
+            chunk = vprompt[pos:pos + take]
+            # chunk shapes bucket tighter than prompts (floor 4, not
+            # 32): at budget 8 a 32-wide bucket would spend 4x the
+            # chunk's compute on padding — the budget bounds the
+            # compiled-shape set anyway (pow2s up to the budget)
+            bucket = 4
+            while bucket < take:
+                bucket *= 2
+            bucket = min(bucket, self.ecfg.max_len)
+            ids = np.full((1, bucket), self.pad, np.int32)
+            mask = np.zeros((1, bucket), np.int32)
+            ids[0, :take] = chunk
+            mask[0, :take] = 1
+            final = pos + take >= len(vprompt)
+            if self.paged:
+                pages = self._slot_pages[slot]
+                tables = np.zeros((1, self.ecfg.pages_per_slot),
+                                  np.int32)
+                tables[0, :len(pages)] = pages
+                shape_key = ("paged", bucket, 1)
+                cold = self._prefill_cold_guard(shape_key)
+                faults.fire("model_fn")
+                t0 = time.perf_counter()
+                logits, self.pool = self._prefill_pages(
+                    self.cfg, self.params, jnp.asarray(ids),
+                    jnp.asarray(mask), self.pool, jnp.asarray(tables),
+                    jnp.asarray([pos], jnp.int32))
+            else:
+                shape_key = ("chunk", bucket, 1)
+                cold = self._prefill_cold_guard(shape_key)
+                faults.fire("model_fn")
+                t0 = time.perf_counter()
+                logits, self.pool = self._chunk_slots(
+                    self.cfg, self.params, jnp.asarray(ids),
+                    jnp.asarray(mask), self.pool,
+                    jnp.asarray([slot], jnp.int32),
+                    jnp.asarray([pos], jnp.int32))
+            # only the FINAL chunk's logits are ever read (they seed
+            # the first sampled token); intermediate chunks skip the
+            # device→host sync so the pass pipelines into its decode
+            logits = np.asarray(logits) if final else None
+            if cold:
+                self._warm_shapes.add(shape_key)
+                self.grace_until = 0.0
+            req.prefill_pos = pos + take
+            if self._budget_left is not None:
+                self._budget_left -= take
+            total += take
+            self.stats["prefill_tokens"] += take
+            self.stats["prefill_chunks"] += 1
+            self._m_prefill_chunks.inc()
+            if st["resumed"]:
+                self.stats["reprefill_tokens"] += take
+            rec = self._rec
+            if rec is not None:
+                rec.phases["prefill"] = rec.phases.get("prefill", 0.0) \
+                    + (time.perf_counter() - t0)
+                rec.prefill_tokens += take
+                rec.flops += obs_flops.span_flops(
+                    self._flops_base, self._flops_per_ctx, pos, take)
+            if req.prefill_pos >= len(vprompt):
+                self._finish_chunking(slot, st, logits)
+                break
+        return total
+
+    def _finish_chunking(self, slot: int, st: dict,
+                         logits: np.ndarray) -> None:
+        """The final chunk landed.  Fresh requests emit their first
+        token from the chunk's last-token logits (then hand off on a
+        prefill-role engine); resumes discard the logits — the last
+        emitted token was already streamed — and just rejoin the
+        decode batch, token-identity intact."""
+        req = st["req"]
+        vprompt = st["vprompt"]
+        del self._chunking[slot]
+        if self.paged:
+            pages = self._slot_pages[slot]
+            self._page_table[slot, :] = 0
+            self._page_table[slot, :len(pages)] = pages
+            self._page_table_dirty = True
+            self._lengths[slot] = len(vprompt)
+            if st.get("res") is not None:
+                # publish full prompt blocks only now that their whole
+                # prefill landed (the cache-publication contract: a
+                # mid-chunk claim must never serve prefix hits)
+                self.allocator.register(st["res"])
+            else:
+                # a mid-chunk preemption dropped the reservation (the
+                # pages travelled pinned on the request instead):
+                # publish the prompt's full blocks now that every
+                # prompt position landed, or a preempted prompt would
+                # silently never serve prefix hits — pages[i] backs
+                # positions [i*ps, (i+1)*ps) in both layouts, and
+                # emitted-token KV starts on the page AFTER the last
+                # full prompt block
+                hashes = paged_kv.chain_hashes(req.prompt_ids,
+                                               self.ecfg.page_size)
+                if hashes:
+                    self.allocator.register_blocks(
+                        hashes, pages[:len(hashes)])
+        # dense mode: the chunk program advanced pool["length"] itself
+        if st["resumed"]:
+            req.resume_len = len(req.tokens)
+            self.stats["resumed"] += 1
+            trace(req.request_id, "prefill", model=self.name, slot=slot,
+                  resumed=True, chunked=True)
+            if self.role == "prefill":
+                self._handoff_slot(slot)
+                return
+            trace(req.request_id, "decode", model=self.name, slot=slot)
+            return
+        self.stats["admitted"] += 1
+        self.stats["prompt_tokens"] += len(vprompt)
+        if req.cached_tokens:
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_saved"] += req.cached_tokens
+            self._m_prefix_hits.inc()
+            self._m_prefix_tokens.inc(req.cached_tokens)
+        self._m_admitted.inc()
+        rec = self._rec
+        if rec is not None:
+            rec.admitted += 1
+            rec.cached_tokens += req.cached_tokens
+            if req.cached_tokens:
+                rec.prefix_hits += 1
+        trace(req.request_id, "prefill", model=self.name, slot=slot,
+              cached_tokens=req.cached_tokens, chunked=True)
+        trace(req.request_id, "decode", model=self.name, slot=slot)
+        self._emit(slot, logits[0])
+        if self.role == "prefill" and self._slots[slot] is not None:
+            self._handoff_slot(slot)
+
     def _admit(self) -> int:
         """Admit queued requests into free slots; returns how many (a
         prefill-bearing pass is what the phase-labeled iteration
@@ -1823,7 +2358,8 @@ class ContinuousBatchingEngine:
                     break
                 victim = self.tenants.pick_victim(
                     [(i, r) for i, r in enumerate(self._slots)
-                     if r is not None])
+                     if r is not None],
+                    tokenless_eligible=self.paged)
                 if victim is None:  # no batch-lane slot to evict
                     self.tenants.unpop(req)
                     break
@@ -1835,10 +2371,17 @@ class ContinuousBatchingEngine:
     def _preempt_slot(self, slot: int) -> None:
         req = self._slots[slot]
         self._slots[slot] = None
+        chunking = self._chunking.pop(slot, None)
+        self._spec_free(slot)
         if self.paged:
             # keep the pages reserved (pinned on the request): the KV
             # for every consumed position survives, so resume is just
-            # re-installing the indirection — prefill-free
+            # re-installing the indirection — prefill-free.  A slot
+            # caught MID-CHUNK keeps its prefill_pos alongside the
+            # pins, so resume continues chunking from there instead of
+            # recomputing delivered chunks.
+            if chunking is None:
+                req.prefill_pos = int(self._lengths[slot])
             req.pinned_pages, self._slot_pages[slot] = \
                 self._slot_pages[slot], None
             self._page_table[slot, :] = 0
@@ -1848,6 +2391,7 @@ class ContinuousBatchingEngine:
             # the slot's KV rows are recycled; resume re-prefills
             # prompt + emitted tokens (deterministic, so re-derived KV
             # continues the sequence bitwise-identically)
+            req.prefill_pos = 0
             self.pool = dict(self.pool)
             self.pool["length"] = self.pool["length"].at[slot].set(0)
         req.claimed = False  # back in the queue, not slot-bound
@@ -1880,6 +2424,35 @@ class ContinuousBatchingEngine:
         # until every group lands in _slots (cleared at the end; a
         # crash in between is _fail_active's to clean up).
         self._admitting = batch + resumes
+        if self.ecfg.prefill_chunk_tokens:
+            # Sarathi co-scheduling: each admission enters chunking
+            # state and prefills only what the pass's token budget
+            # allows (a short prompt completes immediately; a long one
+            # interleaves with decode passes).  Resumes chunk their
+            # re-prefill the same way — the preemption cost this
+            # softens.
+            for req in batch:
+                slot = free.pop(0)
+                self._slots[slot] = req
+                req.prefill_pos = 0
+                with self._qlock:
+                    self.tenants.charge_prefill(req,
+                                                len(req.prompt_ids))
+                self._chunking[slot] = {
+                    "req": req, "vprompt": list(req.prompt_ids),
+                    "resumed": False, "res": None}
+                self._advance_chunk(slot, self._chunking[slot])
+            for req in resumes:
+                slot = free.pop(0)
+                self._slots[slot] = req
+                req.prefill_pos = 0
+                self._chunking[slot] = {
+                    "req": req,
+                    "vprompt": req.prompt_ids + req.tokens[:-1],
+                    "resumed": True, "res": None}
+                self._advance_chunk(slot, self._chunking[slot])
+            self._admitting = []
+            return len(batch) + len(resumes)
         # One prefill dispatch per prompt-length bucket, not per request:
         # a same-bucket burst scatters into its slots with a single
         # program call (compile count stays bounded at
@@ -2058,13 +2631,17 @@ class ContinuousBatchingEngine:
             if req is None:
                 break
             resumed = bool(req.tokens)
-            if resumed and req.pinned_pages:
+            if req.pinned_pages:
+                # a pinned claim still holds every delivered position's
+                # KV — covers decode-ready resumes AND a request
+                # preempted mid-chunked-prefill (tokens may be empty;
+                # prefill_pos says how far its chunks got)
                 req.claimed = True
                 req.admitted_at = time.monotonic()
                 trace(req.request_id, "admitted", model=self.name,
                       queue_s=round(req.admitted_at - req.submitted_at,
                                     6),
-                      tenant=req.tenant, lane=req.lane, resumed=True)
+                      tenant=req.tenant, lane=req.lane, resumed=resumed)
                 pinned.append(req)
                 continue
             # a resume without pages re-derives KV from its virtual
@@ -2129,6 +2706,10 @@ class ContinuousBatchingEngine:
         if rec is not None and any_cow:
             rec.phases["cow_copy"] = rec.phases.get("cow_copy", 0.0) \
                 + (time.perf_counter() - t_cow)
+        if self.ecfg.prefill_chunk_tokens:
+            n = self._admit_paged_chunked(free, batch, pinned)
+            self._admitting = []
+            return n
         by_bucket: dict[int, list[tuple[GenRequest, Any, list, bool]]] = {}
         for entry in batch:
             _, res, vprompt, _ = entry
@@ -2252,6 +2833,65 @@ class ContinuousBatchingEngine:
         self._admitting = []
         return len(batch) + len(pinned)
 
+    def _admit_paged_chunked(self, free: list[int], batch: list,
+                             pinned: list) -> int:
+        """Chunked-prefill placement for paged admissions: every
+        request takes its slot and reservation now, but prefill runs
+        in budget-bounded chunks — the slot's page table and length
+        stay null until the final chunk lands, so the decode program
+        keeps routing its masked garbage write into the null page
+        meanwhile."""
+        rec = self._rec
+        for req, res, vprompt, resumed in batch:
+            slot = free.pop(0)
+            self._slots[slot] = req
+            self._slot_pages[slot] = res.pages
+            self._page_table[slot, :] = 0
+            self._page_table_dirty = True
+            self._lengths[slot] = 0
+            req.prefill_pos = res.cached_tokens
+            with self._qlock:
+                self.tenants.note_pages(req.tenant, len(res.pages))
+                if not resumed:
+                    self.tenants.charge_prefill(
+                        req, len(vprompt) - res.cached_tokens,
+                        start=res.cached_tokens)
+            if rec is not None:
+                rec.pages_reserved += len(res.pages)
+            self._chunking[slot] = {"req": req, "vprompt": vprompt,
+                                    "resumed": resumed, "res": res}
+            self._advance_chunk(slot, self._chunking[slot])
+        for req in pinned:
+            slot = free.pop(0)
+            pages, req.pinned_pages = req.pinned_pages, None
+            self._slots[slot] = req
+            self._slot_pages[slot] = pages
+            vprompt = (req.prompt_ids + req.tokens[:-1]
+                       if req.tokens else list(req.prompt_ids))
+            if req.tokens and req.prefill_pos >= len(vprompt):
+                # fully-delivered claim: the classic prefill-free
+                # resume — reinstall the indirection and decode
+                self._page_table[slot, :] = 0
+                self._page_table[slot, :len(pages)] = pages
+                self._page_table_dirty = True
+                self._lengths[slot] = len(vprompt)
+                req.resume_len = len(req.tokens)
+                self.stats["resumed"] += 1
+                trace(req.request_id, "decode", model=self.name,
+                      slot=slot, resumed=True)
+                continue
+            # preempted mid-chunk: the pinned pages hold positions
+            # 0..prefill_pos-1 — keep chunking from right there (the
+            # chunks already delivered are never recomputed)
+            self._page_table[slot, :] = 0
+            self._page_table_dirty = True
+            self._lengths[slot] = 0
+            self._chunking[slot] = {"req": req, "vprompt": vprompt,
+                                    "resumed": bool(req.tokens),
+                                    "res": None}
+            self._advance_chunk(slot, self._chunking[slot])
+        return len(batch) + len(pinned)
+
     def _bucket(self, n: int) -> int:
         """Power-of-two prompt bucket (same rationale as
         ``CausalLMService._encode_batch``: log-many compiled prefill
@@ -2309,6 +2949,9 @@ class ContinuousBatchingEngine:
                      error: Optional[Exception] = None) -> None:
         req = self._slots[slot]
         self._slots[slot] = None
+        self._chunking.pop(slot, None)
+        self._spec_free(slot)
+        req.prefill_pos = 0
         self.stats["evictions"] += 1
         self._m_evicted.inc()
         released = (len(self._slot_pages[slot])
@@ -2429,11 +3072,17 @@ class ContinuousBatchingModel(Model):
 
     self_batching = True
 
-    def __init__(self, name: str, service, cfg: EngineConfig = EngineConfig()):
+    def __init__(self, name: str, service, cfg: EngineConfig = EngineConfig(),
+                 draft_service=None):
         super().__init__(name)
         self.service = service
         self.cfg = cfg
         self.engine: Optional[ContinuousBatchingEngine] = None
+        #: speculative decoding's draft LM (``cfg.spec_draft`` names a
+        #: model dir): loaded once and kept across engine restarts —
+        #: the supervisor's rebuild path reuses still-loaded weights
+        #: for the draft exactly like the target
+        self.draft_service = draft_service
 
     def load(self) -> None:
         if self.engine is not None and self.engine.draining:
@@ -2446,9 +3095,19 @@ class ContinuousBatchingModel(Model):
             self.service.load()
         if self.engine is None or not self.engine.alive:
             tok = self.service.tokenizer
+            draft = None
+            sd = self.cfg.spec_draft
+            if sd and sd != "ngram":
+                if self.draft_service is None:
+                    self.draft_service = _draft_service_for(sd)
+                if not self.draft_service.ready:
+                    self.draft_service.load()
+                draft = (self.draft_service.cfg,
+                         self.draft_service.params)
             kw = dict(eos_token_id=getattr(tok, "eos_token_id", None),
                       pad_token_id=getattr(tok, "pad_token_id", 0) or 0,
-                      mesh=self.service.mesh, name=self.name)
+                      mesh=self.service.mesh, name=self.name,
+                      draft=draft)
             if self.cfg.role == "prefill":
                 # disaggregated pod: one prefill engine feeding
                 # cfg.decode_slices decode engines through page-
@@ -2516,7 +3175,13 @@ class ContinuousBatchingModel(Model):
                 # (serve/fleet.py), and a probe can tell a sharded
                 # replica from a single-chip one mid-rolling-restart
                 "role": eng.ecfg.role,
-                "mesh_shards": getattr(eng, "mesh_shards", 1)}
+                "mesh_shards": getattr(eng, "mesh_shards", 1),
+                # the latency-offensive knobs, so a probe can tell a
+                # chunking/speculating replica mid-rolling-restart
+                "prefill_chunk_tokens": eng.ecfg.prefill_chunk_tokens,
+                "spec_draft": (eng.draft.kind
+                               if getattr(eng, "draft", None) is not None
+                               else "none")}
 
     # -- request side ------------------------------------------------------
 
@@ -2632,6 +3297,29 @@ class ContinuousBatchingModel(Model):
         return {"completion": self._finish(req, opts)["generated_text"]}
 
 
+def _draft_service_for(model_dir: str):
+    """Build a ``CausalLMService`` over the draft checkpoint dir named
+    by ``EngineConfig.spec_draft`` (lazy import — the weights stack is
+    only paid when a draft model is actually configured).  The draft
+    MUST share the target's tokenizer/vocab: proposals are token ids
+    verified by the target, so a vocab mismatch would only ever reject
+    (correct, but pure waste)."""
+    import os
+
+    from kubernetes_cloud_tpu.serve import lm_service as lms
+    from kubernetes_cloud_tpu.weights.tensorstream import read_index
+
+    weights = lms._resolve_weights(model_dir)
+    index = read_index(weights)
+    cfg = lms._config_from_index(index, weights, None)
+    mdir = (model_dir if os.path.isdir(model_dir)
+            else os.path.dirname(model_dir))
+    return lms.CausalLMService("draft", cfg,
+                               tokenizer=lms._tokenizer_for(mdir),
+                               weights_path=weights,
+                               weights_index=index)
+
+
 def load_engine_config(model_dir: str) -> EngineConfig:
     """Read continuous-batching knobs from ``model_config.json`` (the
     same file the dynamic batcher reads), ``continuous_batching`` key;
@@ -2661,5 +3349,9 @@ def load_engine_config(model_dir: str) -> EngineConfig:
         flight_records=int(cb.get("flight_records", base.flight_records)),
         role=str(cb.get("role", base.role)),
         decode_slices=int(cb.get("decode_slices", base.decode_slices)),
+        prefill_chunk_tokens=int(cb.get("prefill_chunk_tokens",
+                                        base.prefill_chunk_tokens)),
+        spec_draft=cb.get("spec_draft", base.spec_draft),
+        spec_k=int(cb.get("spec_k", base.spec_k)),
         tenancy=parse_tenancy(raw.get("tenancy")),
     )

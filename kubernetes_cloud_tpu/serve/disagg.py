@@ -359,12 +359,28 @@ class DisaggregatedEngine:
 
 def build_disaggregated_engine(cfg, params, engine_cfg: EngineConfig, *,
                                eos_token_id=None, pad_token_id: int = 0,
-                               mesh=None, name: str = "engine"
-                               ) -> DisaggregatedEngine:
+                               mesh=None, name: str = "engine",
+                               draft=None) -> DisaggregatedEngine:
     """One prefill engine + ``engine_cfg.decode_slices`` decode
     engines over shared weights (in-process; on hardware each engine
     maps to its own slice group), coupled by page-granular KV
-    handoff."""
+    handoff.  A speculative-decoding ``draft`` goes to the decode
+    slices only (a prefill-role engine never decodes, so it never
+    speculates)."""
+    from kubernetes_cloud_tpu.serve.spec_decode import DraftSource
+
+    if (engine_cfg.decode_slices > 1 and isinstance(draft, DraftSource)
+            and not draft.shareable):
+        # a stateful DraftSource (ModelDraft: its own slot pool keyed
+        # by engine-local slot index, mutated lock-free on the owning
+        # scheduler thread) handed to N decode engines would race its
+        # pool and collide slot namespaces.  Pass (cfg, params) so
+        # every slice builds a private draft, or run one slice.
+        raise ValueError(
+            f"draft source {draft.kind!r} holds per-slot state and "
+            f"cannot be shared across {engine_cfg.decode_slices} "
+            "decode slices; pass (cfg, params) instead so each slice "
+            "builds its own, or set decode_slices=1")
     pcfg = dataclasses.replace(engine_cfg, role="prefill")
     dcfg = dataclasses.replace(engine_cfg, role="decode")
     prefill = ContinuousBatchingEngine(
@@ -374,6 +390,6 @@ def build_disaggregated_engine(cfg, params, engine_cfg: EngineConfig, *,
         ContinuousBatchingEngine(
             cfg, params, dcfg, eos_token_id=eos_token_id,
             pad_token_id=pad_token_id, mesh=mesh,
-            name=f"{name}-decode{i}")
+            name=f"{name}-decode{i}", draft=draft)
         for i in range(engine_cfg.decode_slices)]
     return DisaggregatedEngine(prefill, decodes, name=name)

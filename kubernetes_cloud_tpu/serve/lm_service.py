@@ -331,6 +331,27 @@ def main(argv: Optional[list] = None) -> int:
                     help="role=prefill: how many decode engines the "
                          "prefill engine feeds (each owns its own "
                          "arena / slice group)")
+    ap.add_argument("--prefill-chunk", type=int, default=-1,
+                    help="continuous batching: Sarathi-style chunked "
+                         "prefill token budget per scheduler pass — "
+                         "long prompts prefill in bounded chunks "
+                         "co-scheduled with decode steps so they "
+                         "cannot stall active streams (0 disables, "
+                         "-1 keeps the model_config.json value; see "
+                         "deploy/README.md 'Latency: chunked prefill "
+                         "& speculative decoding')")
+    ap.add_argument("--spec-draft", default=None,
+                    help="paged continuous batching: speculative-"
+                         "decoding draft source — 'ngram' for "
+                         "prompt-lookup drafting or a draft model dir "
+                         "(e.g. pythia-70m drafting for pythia-410m; "
+                         "must share the target's tokenizer).  Greedy "
+                         "outputs stay bitwise-identical to "
+                         "non-speculative decode")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="draft tokens proposed (and verified in one "
+                         "batched target step) per speculative round "
+                         "(0 keeps the default)")
     ap.add_argument("--flight-records", type=int, default=-1,
                     help="continuous batching: flight-recorder ring "
                          "capacity (per-iteration phase records for "
@@ -429,6 +450,12 @@ def main(argv: Optional[list] = None) -> int:
             overrides["decode_slices"] = args.decode_slices
         if args.flight_records >= 0:
             overrides["flight_records"] = args.flight_records
+        if args.prefill_chunk >= 0:
+            overrides["prefill_chunk_tokens"] = args.prefill_chunk
+        if args.spec_draft:
+            overrides["spec_draft"] = args.spec_draft
+        if args.spec_k > 0:
+            overrides["spec_k"] = args.spec_k
         if args.tenancy:
             import json
 

@@ -1,0 +1,290 @@
+"""Draft sources for speculative decoding (Leviathan et al., ICML '23;
+see PAPERS.md).
+
+Decode is memory-bound at small batch: every emitted token pays a full
+weight sweep for ONE matmul row.  Speculative decoding buys k tokens
+per sweep — a cheap *draft source* proposes k continuation tokens per
+slot, and the engine verifies all of them in ONE batched target step
+through the paged arena (:func:`~kubernetes_cloud_tpu.models.generate.
+verify_step_pages`).  Greedy acceptance — keep the longest prefix
+where the target's own argmax equals the draft — makes the output
+bitwise the non-speculative decode, so correctness never depends on
+the draft: a bad draft only costs speed.  That token-identity oracle
+is what the tests assert across admission orders, prefix sharing,
+preempt/resume, int8 arenas, and the sharded engine.
+
+Three sources:
+
+* :class:`ModelDraft` — a small causal LM (the pythia-70m-drafts-for-
+  410m shape) running k sequential single-token steps over its own
+  dense slot pool.  Rollback is host-side length truncation, catch-up
+  after a fully-accepted round feeds the one not-yet-drafted token.
+* :class:`NgramDraft` — prompt-lookup drafting: propose the k tokens
+  that followed the most recent occurrence of the current trailing
+  n-gram in the sequence itself.  Zero model cost; shines on
+  extractive/repetitive workloads and is the engine's built-in
+  ``spec_draft="ngram"`` mode.
+* :class:`ScriptedDraft` — a deterministic callable for tests: a draft
+  that disagrees at known positions makes the acceptance-ratio
+  arithmetic assertable.
+
+The engine owns scheduling; a draft source only answers "what comes
+next for this slot?".  All methods run on the engine's scheduler
+thread (single-owner, like the page allocator — no locks here).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_cloud_tpu.models.causal_lm import CausalLMConfig
+from kubernetes_cloud_tpu.models.generate import init_cache
+
+
+def _jit_draft_prefill():
+    """The ENGINE's module-level prefill jit (lazy import breaks the
+    cycle): every ModelDraft instance — and every engine restart —
+    shares one compilation cache per (cfg, shape) instead of
+    recompiling private copies; a draft whose config matches its
+    target (tests' self-draft) reuses the target's programs outright."""
+    from kubernetes_cloud_tpu.serve import continuous
+
+    return continuous._jit_prefill()
+
+
+def _jit_draft_decode():
+    from kubernetes_cloud_tpu.serve import continuous
+
+    return continuous._jit_decode()
+
+
+class DraftSource:
+    """Interface the engine drives once per speculative round."""
+
+    #: surfaced in serving metadata / debug so a probe can tell which
+    #: draft mode a replica runs
+    kind = "none"
+    #: draft-model device dispatches in the most recent propose() call
+    #: (the engine prices their analytical FLOPs into the MFU gauge;
+    #: zero-cost sources leave it 0)
+    last_steps = 0
+    #: a source with per-slot state is single-owner: every method runs
+    #: on its engine's scheduler thread with no locks, and slot indices
+    #: are meaningful only within one engine.  Stateless sources
+    #: (ngram, scripted fns) flip this and may be handed to several
+    #: engines (e.g. disaggregated decode slices).
+    shareable = False
+    #: True when slot_ready() JIT-compiles device programs — the engine
+    #: widens its watchdog compile-grace window around such rounds
+    compiles_on_slot_ready = False
+
+    def slot_ready(self, slot: int, seq: Sequence[int]) -> None:
+        """A slot became decode-ready holding context ``seq`` (prompt +
+        emitted tokens) — build whatever per-slot state proposing
+        needs."""
+
+    def propose(self, want: dict[int, Sequence[int]], k: int
+                ) -> dict[int, list[int]]:
+        """Return up to ``k`` draft tokens per requesting slot.
+        ``want`` maps slot → its full context (prompt + emitted);
+        fewer than ``k`` proposals (or none) is always legal — the
+        engine pads the verification window and unproposed columns are
+        simply never accepted."""
+        raise NotImplementedError
+
+    def observe(self, slot: int, seq: Sequence[int]) -> None:
+        """The round settled: ``seq`` is the slot's full accepted
+        context.  Sources with per-slot state roll back here."""
+
+    def free(self, slot: int) -> None:
+        """The slot finished / was preempted — drop its state."""
+
+
+class ModelDraft(DraftSource):
+    """A small draft LM over its own dense slot pool.
+
+    The pool mirrors the target engine's slot geometry (one row per
+    target slot, ``max_len`` rows deep) but at the draft model's much
+    smaller per-token KV cost.  Host-side ``lengths`` are the single
+    source of truth; rollback after a partially-rejected round is a
+    host array write — stale KV beyond the truncated length is never
+    attended and is overwritten by the next real feed at its position
+    (the same append-only argument the paged target arena makes)."""
+
+    kind = "model"
+    compiles_on_slot_ready = True
+
+    def __init__(self, cfg: CausalLMConfig, params, *, slots: int,
+                 max_len: int, pad_token_id: int = 0):
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots
+        self.max_len = max_len
+        self.pad = pad_token_id
+        self.pool: Optional[dict] = None
+        self._lengths = np.zeros((slots,), np.int64)
+        self._prefill = _jit_draft_prefill()
+        self._decode = _jit_draft_decode()
+        self.stats = {"prefills": 0, "steps": 0, "catchup_steps": 0}
+        self.last_steps = 0
+
+    def _ensure_pool(self) -> None:
+        if self.pool is None:
+            self.pool = init_cache(self.cfg, self.slots, self.max_len)
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        bucket = 32
+        while bucket < n:
+            bucket *= 2
+        return bucket
+
+    def slot_ready(self, slot: int, seq: Sequence[int]) -> None:
+        """Prefill ``seq[:-1]`` into the slot's draft row (the final
+        token is fed by the first proposal step, exactly like the
+        target engine's last-token convention)."""
+        self._ensure_pool()
+        ctx = list(seq[:-1])
+        if not ctx:  # a 1-token prompt: nothing resident yet
+            self._lengths[slot] = 0
+            return
+        bucket = min(self._bucket(len(ctx)), self.max_len)
+        ids = np.full((1, bucket), self.pad, np.int32)
+        mask = np.zeros((1, bucket), np.int32)
+        ids[0, :len(ctx)] = ctx
+        mask[0, :len(ctx)] = 1
+        _, self.pool = self._prefill(
+            self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
+            self.pool, jnp.asarray([slot], jnp.int32))
+        self._lengths[slot] = len(ctx)
+        self.stats["prefills"] += 1
+
+    def _step(self, tokens: np.ndarray, active: np.ndarray) -> np.ndarray:
+        """One batched draft decode step; returns argmax tokens [S]."""
+        self.pool = dict(self.pool)
+        self.pool["length"] = jnp.asarray(self._lengths, jnp.int32)
+        logits, self.pool = self._decode(
+            self.cfg, self.params, jnp.asarray(tokens, jnp.int32),
+            self.pool, jnp.asarray(active))
+        self._lengths[active] += 1
+        self.stats["steps"] += 1
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    def propose(self, want: dict[int, Sequence[int]], k: int
+                ) -> dict[int, list[int]]:
+        self._ensure_pool()
+        self.last_steps = 0
+        slots = sorted(want)
+        if not slots or k < 1:
+            return {}
+        # catch-up: after a fully-accepted round the slot's last
+        # accepted draft was never fed (its KV is missing) — feed every
+        # known-but-undrafted token until only seq[-1] remains
+        while True:
+            lag = [s for s in slots
+                   if self._lengths[s] < len(want[s]) - 1]
+            if not lag:
+                break
+            tokens = np.full((self.slots,), self.pad, np.int32)
+            active = np.zeros((self.slots,), bool)
+            for s in lag:
+                tokens[s] = want[s][self._lengths[s]]
+                active[s] = True
+            self._step(tokens, active)
+            self.last_steps += 1
+            self.stats["catchup_steps"] += 1
+        # k proposal steps: feed seq[-1], then each fresh proposal
+        out: dict[int, list[int]] = {s: [] for s in slots}
+        active = np.zeros((self.slots,), bool)
+        tokens = np.full((self.slots,), self.pad, np.int32)
+        for s in slots:
+            tokens[s] = want[s][-1]
+            active[s] = True
+        for _ in range(k):
+            sampled = self._step(tokens, active)
+            self.last_steps += 1
+            tokens = np.full((self.slots,), self.pad, np.int32)
+            for s in slots:
+                out[s].append(int(sampled[s]))
+                tokens[s] = sampled[s]
+        return out
+
+    def observe(self, slot: int, seq: Sequence[int]) -> None:
+        # roll back to the accepted context: positions beyond
+        # len(seq)-1 hold rejected-draft KV (seq[-1] itself is fed by
+        # the next round's proposal step, mirroring the target)
+        self._lengths[slot] = min(int(self._lengths[slot]), len(seq) - 1)
+
+    def free(self, slot: int) -> None:
+        self._lengths[slot] = 0
+
+
+class NgramDraft(DraftSource):
+    """Prompt-lookup drafting: no model, no state — propose the tokens
+    that followed the most recent earlier occurrence of the current
+    trailing n-gram.  Free to compute and surprisingly strong on
+    summarization / extraction / code workloads where continuations
+    repeat earlier spans; on mismatch the verify step rejects and the
+    engine loses nothing but the (empty) draft cost."""
+
+    kind = "ngram"
+    shareable = True  # no per-slot state: propose() is a pure function
+
+    def __init__(self, max_ngram: int = 3, window: int = 1024):
+        if max_ngram < 1:
+            raise ValueError("max_ngram must be >= 1")
+        self.max_ngram = max_ngram
+        self.window = window
+
+    def propose(self, want: dict[int, Sequence[int]], k: int
+                ) -> dict[int, list[int]]:
+        out: dict[int, list[int]] = {}
+        for slot, seq in want.items():
+            seq = list(seq[-self.window:])
+            if len(seq) < 2:
+                continue
+            # the scan runs on the scheduler thread every speculative
+            # round: search int32 cells with bytes.rfind (C speed)
+            # instead of a Python loop of per-position list slices —
+            # an unaligned hit is a byte coincidence spanning cell
+            # boundaries, not a token match, so keep looking left
+            buf = np.asarray(seq, np.int32).tobytes()
+            drafts: list[int] = []
+            for n in range(min(self.max_ngram, len(seq) - 1), 0, -1):
+                pat = buf[-4 * n:]
+                # rightmost earlier occurrence wins (start <= the
+                # final pattern's start - 1; overlap is fine): recent
+                # context is the best predictor of what follows
+                b = buf.rfind(pat, 0, 4 * (len(seq) - 1))
+                while b >= 0 and b % 4:
+                    b = buf.rfind(pat, 0, b + 4 * n - 1)
+                if b >= 0:
+                    i = b // 4
+                    drafts = seq[i + n:i + n + k]
+                    break
+            if drafts:
+                out[slot] = drafts
+        return out
+
+
+class ScriptedDraft(DraftSource):
+    """Deterministic draft for tests: ``fn(slot, seq, k) -> drafts``.
+    A script that disagrees with the target at known positions makes
+    acceptance-ratio accounting exactly assertable."""
+
+    kind = "scripted"
+    shareable = True  # stateless wrapper (a stateful fn is the
+    # caller's own concurrency problem)
+
+    def __init__(self, fn: Callable[[int, Sequence[int], int],
+                                    Sequence[int]]):
+        self.fn = fn
+
+    def propose(self, want: dict[int, Sequence[int]], k: int
+                ) -> dict[int, list[int]]:
+        return {slot: list(self.fn(slot, seq, k))[:k]
+                for slot, seq in want.items()}

@@ -799,7 +799,8 @@ class TenantScheduler:
         st.active_slots += 1
         return req
 
-    def pick_victim(self, slotted) -> Optional[int]:
+    def pick_victim(self, slotted, *,
+                    tokenless_eligible: bool = True) -> Optional[int]:
         """Choose the batch-lane slot to preempt: the request whose
         tenant has consumed the most weighted service (max virtual
         clock — the mirror image of the drain order), newest admission
@@ -811,7 +812,19 @@ class TenantScheduler:
         for slot, req in slotted:
             if req.lane != "batch":
                 continue
-            if (len(req.tokens) - req.resume_len
+            # a slot still mid-chunked-prefill (no tokens emitted yet)
+            # is eligible when the engine says eviction is free
+            # (``tokenless_eligible``: paged mode — pinned pages resume
+            # the remaining chunks exactly where they stopped, so no
+            # work is lost).  In dense mode a preempted slot re-chunks
+            # from position 0, so mid-prefill slots fall under the
+            # progress guard like everyone else — without it a
+            # sustained interactive stream could re-prefill the same
+            # long prompt forever and the request never emits a token.
+            if not req.tokens:
+                if not tokenless_eligible:
+                    continue
+            elif (len(req.tokens) - req.resume_len
                     < self.cfg.min_batch_progress):
                 continue
             key = (self._vt(self.state(req.tenant)),

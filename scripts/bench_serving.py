@@ -1106,6 +1106,310 @@ def run_disagg_comparison(args, svc) -> int:
     return 0
 
 
+def run_chunked_comparison(args, svc) -> int:
+    """--prefill-chunk: the Sarathi chunked-prefill A/B the acceptance
+    bar names (BENCHMARKS.md "Latency offensive").
+
+    Steady decode streams; a gapless long-prompt burster provides
+    continuous prefill pressure.  Three arms on identical geometry:
+
+    1. **no_burst** — steady streams alone: the honest reference for
+       "inter-token p95 stays flat".
+    2. **unchunked_burst** — every burst prefill occupies a whole
+       iteration; the flight recorder's Sarathi stall detector counts
+       the stalls the steady streams eat.
+    3. **chunked_burst** — the same pressure with
+       ``prefill_chunk_tokens`` set: stall count must drop to ~0 and
+       p95 back toward the no-burst floor, with burst TTFT p95
+       unregressed vs the unchunked arm."""
+    import threading
+    import time
+
+    from kubernetes_cloud_tpu.obs import report as obs_report
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingEngine,
+        EngineConfig,
+    )
+
+    cfg = svc.cfg
+    params = svc.params
+    rng = random.Random(args.seed)
+    slots = max(2, args.slots // 2)
+    max_len = args.pool_max_len
+    ps = args.page_size
+    steady_n = max(2, slots // 2)
+    burst_prompt = max_len - 8
+    burst_n = 2
+    duration = args.chunk_duration
+
+    def steady_prompt(i):
+        return [rng.randint(1, 200) for _ in range(6 + i)]
+
+    def burst_prompts():
+        return [[rng.randint(1, 200) for _ in range(burst_prompt)]
+                for _ in range(burst_n)]
+
+    def measure(chunk, burst, label):
+        eng = _started(ContinuousBatchingEngine(
+            cfg, params,
+            EngineConfig(slots=slots, max_len=max_len, paged=True,
+                         page_size=ps, prefill_chunk_tokens=chunk),
+            eos_token_id=None, pad_token_id=0))
+        gaps: list[float] = []
+        ttfts: list[float] = []
+        steady_ttfts: list[float] = []
+        stop = threading.Event()
+        threads = []
+        try:
+            for i in range(steady_n):  # warm every measured shape
+                eng.submit(steady_prompt(i), max_new_tokens=2,
+                           temperature=0.0).wait()
+            warm = [eng.submit(p, max_new_tokens=4, temperature=0.0)
+                    for p in burst_prompts()]
+            for r in warm:
+                r.wait()
+
+            def steady(i):
+                while not stop.is_set():
+                    p = steady_prompt(i)
+                    t_sub = time.monotonic()
+                    req = eng.submit(p, temperature=0.0,
+                                     max_new_tokens=max_len - len(p) - 1)
+                    last = None
+                    try:
+                        for _ in req.iter_tokens(timeout=60.0):
+                            now = time.monotonic()
+                            if last is None and not stop.is_set():
+                                steady_ttfts.append(now - t_sub)
+                            elif last is not None and not stop.is_set():
+                                gaps.append(now - last)
+                            last = now
+                            if stop.is_set():
+                                req.cancel()
+                    except Exception:  # noqa: BLE001 - bench load
+                        return
+
+            for i in range(steady_n):
+                t = threading.Thread(target=steady, args=(i,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+
+            def burster():
+                while not stop.is_set():
+                    brs = [eng.submit(p, max_new_tokens=4,
+                                      temperature=0.0)
+                           for p in burst_prompts()]
+                    for r in brs:
+                        try:
+                            r.wait()
+                            if r.first_token_at is not None:
+                                ttfts.append(r.first_token_at
+                                             - r.submitted_at)
+                        except Exception:  # noqa: BLE001 - bench load
+                            pass
+
+            time.sleep(0.5)
+            if burst:
+                bt = threading.Thread(target=burster, daemon=True)
+                bt.start()
+            time.sleep(duration)
+            stop.set()
+            if burst:
+                bt.join(timeout=30)
+            for t in threads:
+                t.join(timeout=30)
+            stats = dict(eng.stats)
+            analysis = obs_report.analyze({
+                "iterations": eng.flight.tail(),
+                "requests": eng.flight.request_tail(),
+                "meta": eng.debug_meta()})
+        finally:
+            _swallow(eng.stop)
+        gaps.sort()
+        ttfts.sort()
+        steady_ttfts.sort()
+
+        def q(vals, p):
+            return (round(vals[min(int(p * len(vals)), len(vals) - 1)], 6)
+                    if vals else None)
+
+        out = {"label": label, "chunk": chunk,
+               "inter_token_p50_s": q(gaps, 0.50),
+               "inter_token_p95_s": q(gaps, 0.95),
+               "inter_token_p99_s": q(gaps, 0.99),
+               "gap_samples": len(gaps),
+               "steady_ttft_p95_s": q(steady_ttfts, 0.95),
+               "burst_ttft_p95_s": q(ttfts, 0.95),
+               "burst_requests": len(ttfts),
+               "stall_count": analysis["stalls"]["count"],
+               "stall_s_total": round(
+                   analysis["stalls"]["stall_s_total"], 6),
+               "prefill_chunks": stats.get("prefill_chunks", 0)}
+        print(json.dumps(out), file=sys.stderr)
+        return out
+
+    base = measure(0, burst=False, label="no_burst")
+    unchunked = measure(0, burst=True, label="unchunked_burst")
+    chunked = measure(args.prefill_chunk, burst=True,
+                      label="chunked_burst")
+    floor = max(base["inter_token_p95_s"] or 1e-9, 1e-9)
+    record = {
+        "metric": "serving_chunked_prefill_p95",
+        # the acceptance ratio: chunked-under-burst p95 over the
+        # no-burst floor (<= 1.1 passes; the unchunked ratio is the
+        # measured regression chunking removes)
+        "value": round((chunked["inter_token_p95_s"] or 0.0) / floor, 3),
+        "unit": "x_no_burst_p95",
+        "unchunked_ratio": round(
+            (unchunked["inter_token_p95_s"] or 0.0) / floor, 3),
+        "prefill_chunk_tokens": args.prefill_chunk,
+        "burst_prompt_tokens": burst_prompt,
+        "no_burst": base,
+        "unchunked": unchunked,
+        "chunked": chunked,
+    }
+    print(json.dumps(record))
+    return 0
+
+
+def run_spec_comparison(args, svc) -> int:
+    """--spec-decode: speculative-decoding A/B at small batch
+    (BENCHMARKS.md "Latency offensive").
+
+    Closed-loop greedy decode streams at batch ≤ ``--spec-batch``
+    (decode-bound: short prompts, long generations) over identical
+    engine geometry:
+
+    1. **off** — the plain engine.
+    2. **ngram** — prompt-lookup drafting (zero draft-model cost).
+    3. **self** — the target drafts for itself via a ModelDraft: the
+       acceptance upper bound, isolating the verification machinery's
+       tokens-per-dispatch win from draft quality.
+
+    Decode tok/s, accept ratio, and tokens-per-target-dispatch per
+    arm; greedy outputs are oracle-checked identical across arms."""
+    import threading
+    import time
+
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingEngine,
+        EngineConfig,
+    )
+    from kubernetes_cloud_tpu.serve.spec_decode import ModelDraft
+
+    cfg = svc.cfg
+    params = svc.params
+    rng = random.Random(args.seed)
+    batch = max(1, args.spec_batch)
+    max_len = args.pool_max_len
+    gen = max_len // 2
+    duration = args.spec_duration
+    prompts = [[rng.randint(1, 200) for _ in range(6 + i)]
+               for i in range(batch)]
+
+    def build(draft_kind):
+        draft = None
+        ecfg = dict(slots=batch, max_len=max_len, paged=True,
+                    page_size=args.page_size, spec_k=args.spec_k)
+        if draft_kind == "ngram":
+            ecfg["spec_draft"] = "ngram"
+        elif draft_kind == "self":
+            ecfg["spec_draft"] = "model"
+            draft = ModelDraft(cfg, params, slots=batch,
+                               max_len=max_len, pad_token_id=0)
+        return _started(ContinuousBatchingEngine(
+            cfg, params, EngineConfig(**ecfg), eos_token_id=None,
+            pad_token_id=0, draft=draft))
+
+    def measure(draft_kind):
+        eng = build(draft_kind)
+        try:
+            # warmup: compile prefill + decode/verify (+ draft) shapes
+            for p in prompts:
+                eng.submit(p, max_new_tokens=4, temperature=0.0).wait()
+            done = threading.Event()
+            counts = [0] * batch
+            sample: dict = {}
+
+            def worker(w):
+                first = True
+                while not done.is_set():
+                    req = eng.submit(prompts[w], max_new_tokens=gen,
+                                     temperature=0.0)
+                    try:
+                        toks = req.wait()
+                    except Exception:  # noqa: BLE001 - bench load
+                        return
+                    if first and w == 0:
+                        sample["tokens"] = toks  # oracle check
+                        first = False
+                    if not done.is_set():
+                        counts[w] += len(toks)
+
+            eng.reset_peak_active()
+            base_stats = dict(eng.stats)
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=worker, args=(w,),
+                                        daemon=True)
+                       for w in range(batch)]
+            for t in threads:
+                t.start()
+            time.sleep(duration)
+            done.set()
+            for t in threads:
+                t.join(timeout=60)
+            dt = time.monotonic() - t0
+            st = eng.stats
+            rounds = st["iterations"] - base_stats["iterations"]
+            emitted = (st["emitted_tokens"]
+                       - base_stats["emitted_tokens"])
+            drafted = st["spec_drafted"] - base_stats["spec_drafted"]
+            accepted = (st["spec_accepted"]
+                        - base_stats["spec_accepted"])
+            out = {"arm": draft_kind,
+                   "decode_tokens_per_s": round(sum(counts) / dt, 1),
+                   "tokens_per_dispatch": round(
+                       emitted / max(rounds, 1), 3),
+                   "accept_ratio": round(accepted / drafted, 4)
+                   if drafted else None,
+                   "drafted": drafted, "accepted": accepted,
+                   "completions": sum(1 for c in counts if c),
+                   "sample_tokens": sample.get("tokens")}
+            print(json.dumps({k: v for k, v in out.items()
+                              if k != "sample_tokens"}),
+                  file=sys.stderr)
+            return out
+        finally:
+            _swallow(eng.stop)
+
+    arms = {kind: measure(kind) for kind in ("off", "ngram", "self")}
+    # the oracle: every arm's greedy sample is the same token sequence.
+    # A missing sample (worker 0's request failed in some arm) is an
+    # oracle FAILURE, not a vacuous pass — None == None must not count
+    # as "verified identical over zero tokens".
+    want = arms["off"]["sample_tokens"]
+    identical = want is not None and all(
+        a["sample_tokens"] == want for a in arms.values())
+    base_tps = arms["off"]["decode_tokens_per_s"] or 1e-9
+    best = max(("ngram", "self"),
+               key=lambda k: arms[k]["decode_tokens_per_s"])
+    record = {
+        "metric": "serving_spec_decode_speedup",
+        "value": round(arms[best]["decode_tokens_per_s"] / base_tps, 3),
+        "unit": "x_decode_tokens_per_s",
+        "best_arm": best,
+        "batch": batch,
+        "spec_k": args.spec_k,
+        "outputs_identical": identical,
+        "arms": {k: {kk: vv for kk, vv in v.items()
+                     if kk != "sample_tokens"}
+                 for k, v in arms.items()},
+    }
+    print(json.dumps(record))
+    return 0 if identical else 1
+
+
 def _started(eng):
     eng.start()
     return eng
@@ -1591,6 +1895,28 @@ def main(argv=None) -> int:
                          "burst, at equal total slots+arena")
     ap.add_argument("--disagg-duration", type=float, default=10.0,
                     help="disagg mode: measured burst window seconds")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked-prefill A/B: steady decode streams "
+                         "under a gapless long-prompt burst — no-burst "
+                         "floor vs unchunked vs chunked at this token "
+                         "budget; reports inter-token p95 ratios, "
+                         "Sarathi stall counts, and burst TTFT "
+                         "(records serving_chunked_prefill_p95)")
+    ap.add_argument("--chunk-duration", type=float, default=10.0,
+                    help="chunked mode: measured window seconds per arm")
+    ap.add_argument("--spec-decode", action="store_true",
+                    help="speculative-decoding A/B at small batch: "
+                         "off vs ngram prompt-lookup vs self-draft "
+                         "upper bound, greedy outputs oracle-checked "
+                         "identical (records "
+                         "serving_spec_decode_speedup)")
+    ap.add_argument("--spec-batch", type=int, default=2,
+                    help="spec mode: concurrent greedy decode streams "
+                         "(the batch ≤ 4 regime speculation targets)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="spec mode: draft tokens per round")
+    ap.add_argument("--spec-duration", type=float, default=10.0,
+                    help="spec mode: measured window seconds per arm")
     ap.add_argument("--inject", choices=("hang", "crash"), default=None,
                     help="recovery mode: wedge (hang) or crash the "
                          "decode loop and measure supervisor recovery "
@@ -1621,6 +1947,12 @@ def main(argv=None) -> int:
 
     if args.disagg:
         return run_disagg_comparison(args, svc)
+
+    if args.prefill_chunk > 0:
+        return run_chunked_comparison(args, svc)
+
+    if args.spec_decode:
+        return run_spec_comparison(args, svc)
 
     if args.fairness:
         return run_fairness(args, svc)

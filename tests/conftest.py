@@ -23,6 +23,40 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+# The full suite JIT-compiles O(1000) XLA programs in ONE process, and
+# on this backend each CPU executable holds tens of mmap regions for
+# its lifetime (jit caches are deliberately process-global, so they
+# are never released).  Past the kernel's default vm.max_map_count
+# (65 530) an mmap inside XLA's compiler fails and the process dies
+# with a bare SIGSEGV — measured: the suite brushes ~63 k maps and the
+# crash lands in whichever innocent test compiles next, which made it
+# look like a test bug twice before the real cause was found.  Raise
+# the ceiling when permitted (CI runs as root); silently keep the
+# status quo otherwise.  The sysctl is machine-global, so restore the
+# prior value at interpreter exit — a root pytest on a shared box must
+# not leave a permanent kernel-limit change behind.  (A concurrent
+# second session's raise can be clobbered by the first one's restore;
+# rare enough to accept over leaking the limit.)
+try:
+    with open("/proc/sys/vm/max_map_count") as _f:
+        _maps = int(_f.read())
+    if _maps < 1_048_576:
+        with open("/proc/sys/vm/max_map_count", "w") as _f:
+            _f.write("1048576")
+
+        import atexit
+
+        def _restore_map_count(prev=_maps):
+            try:
+                with open("/proc/sys/vm/max_map_count", "w") as f:
+                    f.write(str(prev))
+            except OSError:
+                pass
+
+        atexit.register(_restore_map_count)
+except (OSError, ValueError):  # not root / not Linux: best-effort only
+    pass
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 

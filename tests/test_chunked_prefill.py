@@ -1,0 +1,348 @@
+"""Chunked prefill (Sarathi-Serve co-scheduling): correctness locks.
+
+The engine may split any prefill into ``prefill_chunk_tokens``-bounded
+slices interleaved with decode passes — a partially-prefilled request
+holds its slot (and, paged, its pages) and resumes at its absolute
+position, attending to its own earlier chunks through the gathered
+view.  None of that may perturb outputs: greedy completions must stay
+token-identical to one-shot ``generate`` for ANY chunk size, in both
+KV modes, through prefix-cache hits and through a preemption landing
+MID-CHUNK.
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.models.generate import generate
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingEngine,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.tenancy import TenancyConfig, TenantSpec
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+#: includes a 40-token prompt so chunk sizes 1 and 16 both exercise
+#: real multi-chunk schedules (64 degenerates to one chunk — the
+#: "chunking on but never splitting" regression case)
+PROMPTS = [list(range(1, 9)), list(range(40, 45)),
+           list(range(100, 140)), [7, 8, 9]]
+MAX_NEW = [6, 9, 4, 7]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def reference(params):
+    refs = []
+    for p, n in zip(PROMPTS, MAX_NEW):
+        out = np.asarray(generate(CFG, params, jnp.asarray([p], jnp.int32),
+                                  max_new_tokens=n, temperature=0.0,
+                                  pad_token_id=0))
+        refs.append(out[0, len(p):len(p) + n].tolist())
+    return refs
+
+
+def ref_tokens(params, prompt, n):
+    out = np.asarray(generate(CFG, params, jnp.asarray([prompt], jnp.int32),
+                              max_new_tokens=n, temperature=0.0,
+                              pad_token_id=0))
+    return out[0, len(prompt):len(prompt) + n].tolist()
+
+
+def make_engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    eng = ContinuousBatchingEngine(CFG, params, EngineConfig(**kw),
+                                   eos_token_id=None, pad_token_id=0)
+    eng.start()
+    return eng
+
+
+# ---------------------------------------------------------------------------
+# identity sweep: chunk sizes x KV modes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("chunk", [1, 16, 64])
+def test_chunked_identity_sweep(params, reference, paged, chunk):
+    eng = make_engine(params, paged=paged,
+                      page_size=8 if paged else 16,
+                      prefill_chunk_tokens=chunk)
+    try:
+        order = [2, 0, 3, 1]  # long prompt first: chunks + decode overlap
+        reqs = {i: eng.submit(PROMPTS[i], max_new_tokens=MAX_NEW[i],
+                              temperature=0.0) for i in order}
+        for i in order:
+            assert reqs[i].wait(eng) == reference[i], \
+                f"chunk={chunk} paged={paged} prompt {i} diverged"
+        if chunk < 40:
+            # the 40-token prompt really split
+            assert eng.stats["prefill_chunks"] > len(PROMPTS)
+        assert eng.stats["prefill_tokens"] == sum(len(p) for p in PROMPTS)
+    finally:
+        eng.stop()
+
+
+def test_chunking_coschedules_with_decode(params, reference):
+    """While the long prompt chunks, already-active slots keep
+    decoding: the pass that carries a chunk also carries decode
+    tokens (the whole point of co-scheduling)."""
+    eng = make_engine(params, paged=True, page_size=8,
+                      prefill_chunk_tokens=4)
+    try:
+        first = eng.submit(PROMPTS[0], max_new_tokens=30,
+                           temperature=0.0)
+        next(first.iter_tokens(timeout=60))  # decoding before the long one
+        long = eng.submit(PROMPTS[2], max_new_tokens=MAX_NEW[2],
+                          temperature=0.0)
+        assert long.wait(eng) == reference[2]
+        first.cancel()
+        recs = eng.flight.tail() if eng.flight else []
+        both = [r for r in recs
+                if r.get("prefill_tokens") and r.get("decode_tokens")]
+        assert both, "no pass carried a chunk AND decode tokens"
+        assert all(r["prefill_tokens"] <= 4 for r in recs
+                   if r.get("prefill_tokens"))
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# prefix cache interaction
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_prefill_with_prefix_cache(params):
+    """A chunked admission still reuses cached prefix pages (chunks
+    cover only the uncached tail), and its blocks are published for
+    the NEXT request once its own prefill completes."""
+    shared = list(range(200, 232))  # 4 full pages at page_size 8
+    p1 = shared + [1, 2, 3]
+    p2 = shared + [4, 5, 6, 7]
+    eng = make_engine(params, paged=True, page_size=8,
+                      prefill_chunk_tokens=8)
+    try:
+        r1 = eng.submit(p1, max_new_tokens=6, temperature=0.0)
+        assert r1.wait(eng) == ref_tokens(params, p1, 6)
+        r2 = eng.submit(p2, max_new_tokens=6, temperature=0.0)
+        assert r2.wait(eng) == ref_tokens(params, p2, 6)
+        assert r2.cached_tokens == len(shared)
+        assert eng.stats["prefix_hits"] == 1
+        assert eng.stats["prefix_tokens_saved"] == len(shared)
+        # computed tokens: all of p1, only p2's tail
+        assert eng.stats["prefill_tokens"] == len(p1) + (len(p2)
+                                                         - len(shared))
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# mid-chunk preemption
+# ---------------------------------------------------------------------------
+
+
+def _preempt_tenancy(progress: int) -> TenancyConfig:
+    return TenancyConfig(
+        tenants=(
+            TenantSpec("batchy", lane="batch", api_keys=("k-batchy",)),
+            TenantSpec("inter", lane="interactive",
+                       api_keys=("k-inter",)),
+        ),
+        min_batch_progress=progress,
+    )
+
+
+def test_midchunk_preemption_paged_no_recompute(params):
+    """An interactive arrival evicts a slot still MID-CHUNK: its pages
+    stay pinned with ``prefill_pos``, so resume continues the
+    remaining chunks — delivered chunks are never recomputed — and the
+    output is token-identical.  min_batch_progress is set above any
+    reachable decode progress, so the ONLY eligible victims are
+    mid-prefill slots (locking the progress-guard exemption)."""
+    eng = make_engine(params, paged=True, page_size=8,
+                      prefill_chunk_tokens=2,
+                      tenancy=_preempt_tenancy(1000))
+    long_prompts = [list(range(100, 140)), list(range(150, 190))]
+    i_prompt = [7, 8, 9]
+    try:
+        victims = [eng.submit(p, max_new_tokens=6, temperature=0.0,
+                              api_key="k-batchy") for p in long_prompts]
+        deadline = time.monotonic() + 30
+        while not eng._chunking and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert eng._chunking, "never observed a mid-chunk slot"
+        pre = eng.submit(i_prompt, max_new_tokens=7, temperature=0.0,
+                         api_key="k-inter")
+        assert pre.wait(eng) == ref_tokens(params, i_prompt, 7)
+        for p, v in zip(long_prompts, victims):
+            assert v.wait(eng) == ref_tokens(params, p, 6)
+        assert eng.stats["preemptions"] >= 1
+        assert sum(v.preemptions for v in victims) >= 1
+        # the pinned mid-chunk resume recomputed NOTHING: every prompt
+        # token prefilled exactly once across the whole run
+        total = sum(len(p) for p in long_prompts) + len(i_prompt)
+        assert eng.stats["prefill_tokens"] == total
+        assert eng.stats["reprefill_tokens"] == 0
+    finally:
+        eng.stop()
+
+
+def test_midchunk_dense_stays_under_progress_guard(params):
+    """Dense pool: a mid-chunk victim re-chunks from position 0 on
+    resume, so the paged-mode tokenless exemption does NOT apply — a
+    mid-prefill slot is only preemptable under the same progress guard
+    as a decoding one (otherwise a sustained interactive stream could
+    re-prefill a long prompt forever).  With the guard set above any
+    reachable progress, the interactive arrival must WAIT for a free
+    slot instead of evicting anyone — and every output stays
+    identical."""
+    eng = make_engine(params, paged=False, prefill_chunk_tokens=2,
+                      tenancy=_preempt_tenancy(1000))
+    long_prompts = [list(range(100, 140)), list(range(150, 190))]
+    i_prompt = [7, 8, 9]
+    try:
+        victims = [eng.submit(p, max_new_tokens=6, temperature=0.0,
+                              api_key="k-batchy") for p in long_prompts]
+        deadline = time.monotonic() + 30
+        while not eng._chunking and time.monotonic() < deadline:
+            time.sleep(0.001)
+        pre = eng.submit(i_prompt, max_new_tokens=7, temperature=0.0,
+                         api_key="k-inter")
+        assert pre.wait(eng) == ref_tokens(params, i_prompt, 7)
+        for p, v in zip(long_prompts, victims):
+            assert v.wait(eng) == ref_tokens(params, p, 6)
+        assert eng.stats["preemptions"] == 0
+    finally:
+        eng.stop()
+
+
+def test_midchunk_preemption_still_publishes_prefix(params):
+    """A mid-chunk preemption drops the request's page reservation
+    (pages travel pinned on the request), but completing the prompt
+    after resume must still publish its full blocks to the prefix
+    cache — a later request sharing the prefix gets hits, not a full
+    re-prefill."""
+    eng = make_engine(params, slots=1, paged=True, page_size=8,
+                      prefill_chunk_tokens=2,
+                      tenancy=_preempt_tenancy(1000))
+    long_prompt = list(range(100, 140))  # 5 full blocks of 8
+    i_prompt = [7, 8, 9]
+    try:
+        victim = eng.submit(long_prompt, max_new_tokens=6,
+                            temperature=0.0, api_key="k-batchy")
+        deadline = time.monotonic() + 30
+        while not eng._chunking and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert eng._chunking, "never observed a mid-chunk slot"
+        pre = eng.submit(i_prompt, max_new_tokens=7, temperature=0.0,
+                         api_key="k-inter")
+        assert pre.wait(eng) == ref_tokens(params, i_prompt, 7)
+        assert victim.wait(eng) == ref_tokens(params, long_prompt, 6)
+        assert victim.preemptions >= 1, "victim was never preempted"
+        # the probe shares the victim's whole prompt as its prefix:
+        # publication-after-resume is what makes this hit
+        probe_prompt = long_prompt + [3, 4]
+        probe = eng.submit(probe_prompt, max_new_tokens=4,
+                           temperature=0.0, api_key="k-batchy")
+        assert probe.wait(eng) == ref_tokens(params, probe_prompt, 4)
+        assert eng.stats["prefix_hits"] >= 1
+        assert eng.stats["prefix_tokens_saved"] >= 8
+    finally:
+        eng.stop()
+
+
+def test_dense_preemption_rechunks_after_progress(params):
+    """Dense pool, guard satisfied: once a victim has decoded past
+    ``min_batch_progress`` it is evictable again, and its resume
+    re-chunks prompt + emitted tokens from position 0 — slower than
+    the paged pinned resume, but token-identical."""
+    eng = make_engine(params, paged=False, prefill_chunk_tokens=2,
+                      tenancy=_preempt_tenancy(1))
+    long_prompts = [list(range(100, 140)), list(range(150, 190))]
+    i_prompt = [7, 8, 9]
+    try:
+        victims = [eng.submit(p, max_new_tokens=6, temperature=0.0,
+                              api_key="k-batchy") for p in long_prompts]
+        deadline = time.monotonic() + 30
+        while (not any(v.tokens for v in victims)
+               and time.monotonic() < deadline):
+            time.sleep(0.001)
+        assert any(v.tokens for v in victims), "no victim ever decoded"
+        pre = eng.submit(i_prompt, max_new_tokens=7, temperature=0.0,
+                         api_key="k-inter")
+        assert pre.wait(eng) == ref_tokens(params, i_prompt, 7)
+        for p, v in zip(long_prompts, victims):
+            assert v.wait(eng) == ref_tokens(params, p, 6)
+        assert eng.stats["preemptions"] >= 1
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_disagg_zero_reprefill(params, reference):
+    """Chunked prefill composes with prefill/decode disaggregation:
+    prompts chunk on the prefill engine, the handover stays
+    page-granular, and the decode side adopts a FULLY-delivered claim
+    (``prefill_pos`` travels with the pins) — zero re-prefill."""
+    from kubernetes_cloud_tpu.serve.disagg import (
+        build_disaggregated_engine,
+    )
+
+    eng = build_disaggregated_engine(
+        CFG, params,
+        EngineConfig(slots=2, max_len=64, paged=True, page_size=8,
+                     role="prefill", prefill_chunk_tokens=8),
+        eos_token_id=None, pad_token_id=0, name="lm")
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=n, temperature=0.0)
+                for p, n in zip(PROMPTS, MAX_NEW)]
+        for r, want in zip(reqs, reference):
+            assert r.wait() == want
+        stats = eng.stats
+        assert stats["handoffs"] == len(PROMPTS)
+        assert stats["reprefill_tokens"] == 0
+        assert stats["prefill_chunks"] > 0
+    finally:
+        eng.stop()
+
+
+def test_chunk_config_validation():
+    with pytest.raises(ValueError, match="prefill_chunk_tokens"):
+        EngineConfig(prefill_chunk_tokens=-1)
+
+
+def test_debug_slots_shows_prefilling_state(params):
+    eng = make_engine(params, paged=True, page_size=8,
+                      prefill_chunk_tokens=1)
+    try:
+        req = eng.submit(list(range(100, 140)), max_new_tokens=4,
+                         temperature=0.0)
+        deadline = time.monotonic() + 30
+        seen = None
+        while time.monotonic() < deadline:
+            slots = eng.debug_slots()
+            seen = [s for s in slots if s.get("state") == "prefilling"]
+            if seen:
+                break
+            time.sleep(0.001)
+        assert seen and "prefill_pos" in seen[0]
+        req.wait(eng)
+    finally:
+        eng.stop()

@@ -390,6 +390,12 @@ def test_engine_restart_gets_fresh_ring(service):
     eng.start()
     try:
         eng.submit([1, 2, 3], max_new_tokens=2, temperature=0.0).wait(eng)
+        # wait() wakes on the final token's event; the scheduler
+        # commits that pass's iteration record a few µs later — give
+        # it the tail of its pass before reading the ring
+        deadline = time.monotonic() + 5
+        while len(eng.flight) == 0 and time.monotonic() < deadline:
+            time.sleep(0.001)
         assert len(eng.flight) > 0
     finally:
         eng.stop()

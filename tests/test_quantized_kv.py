@@ -301,7 +301,9 @@ def test_model_health_carries_rollout_metadata(params):
         assert model.serving_metadata() == {"kv_dtype": "int8",
                                             "attn_impl": "gather",
                                             "role": "colocated",
-                                            "mesh_shards": 1}
+                                            "mesh_shards": 1,
+                                            "prefill_chunk_tokens": 0,
+                                            "spec_draft": "none"}
     finally:
         model.stop()
 

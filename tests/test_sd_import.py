@@ -470,15 +470,12 @@ def test_strict_rejects_unknown_keys():
     import_vae(cfg, sd, strict=False)
 
 
-def test_convert_checkpoint_end_to_end(tmp_path):
-    """Fake diffusers snapshot dir → convert → serve via sd_service."""
+def _build_fake_snapshot(src):
+    """Fake diffusers snapshot dir (diffusers' exact file layout);
+    returns the tokenizer vocab so callers can assert id framing."""
     from safetensors.torch import save_file
 
-    from kubernetes_cloud_tpu.serve.sd_service import StableDiffusionService
-    from kubernetes_cloud_tpu.weights.sd_import import convert_checkpoint
-
     torch.manual_seed(4)
-    src = tmp_path / "snapshot"
     # cross-attention width must equal the text encoder's hidden size
     unet_cfg_json = UNET_CONFIG | {"cross_attention_dim": 32}
     for sub, module, cfg_json in (
@@ -527,7 +524,16 @@ def test_convert_checkpoint_end_to_end(tmp_path):
     save_file(CLIPTextModel(hf_cfg).state_dict(),
               str(enc_dir / "model.safetensors"))
     (enc_dir / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
+    return tok_vocab
 
+
+def test_convert_checkpoint_end_to_end(tmp_path):
+    """Fake diffusers snapshot dir → convert → serve via sd_service."""
+    from kubernetes_cloud_tpu.serve.sd_service import StableDiffusionService
+    from kubernetes_cloud_tpu.weights.sd_import import convert_checkpoint
+
+    src = tmp_path / "snapshot"
+    tok_vocab = _build_fake_snapshot(src)
     dest = tmp_path / "serving"
     convert_checkpoint(str(src), str(dest))
     assert os.path.exists(dest / "unet.tensors")
@@ -544,3 +550,25 @@ def test_convert_checkpoint_end_to_end(tmp_path):
     img = svc.generate("a tpu in the snow", height=16, width=16, steps=2,
                        guidance_scale=5.0, seed=1)
     assert img.shape == (16, 16, 3) and img.dtype == np.uint8
+
+
+def test_convert_checkpoint_remote_dest(tmp_path):
+    """A remote (object-store) dest routes module writes, tokenizer
+    assets, AND the ready sentinel through fsspec instead of failing
+    partway with local-FS mkdir/copy errors — the advisor's
+    sd_import.py:424 finding."""
+    import fsspec
+
+    from kubernetes_cloud_tpu.weights.sd_import import convert_checkpoint
+
+    src = tmp_path / "snapshot"
+    _build_fake_snapshot(src)
+    dest = "memory://sd-remote-dest/serving"
+    convert_checkpoint(str(src), dest)
+    fs = fsspec.filesystem("memory")
+    for name in ("unet.tensors", "vae.tensors", "encoder.tensors",
+                 "tokenizer/vocab.json", "tokenizer/merges.txt"):
+        assert fs.exists(f"/sd-remote-dest/serving/{name}"), name
+    ready = [p for p in fs.ls("/sd-remote-dest/serving", detail=False)
+             if "ready" in str(p)]
+    assert ready, "ready sentinel missing on remote dest"

@@ -77,14 +77,14 @@ def greedy_ref(params, prompt, n):
     return out[0, len(prompt):len(prompt) + n].tolist()
 
 
-def make_engine(params, mesh=None, **kw):
+def make_engine(params, mesh=None, draft=None, **kw):
     kw.setdefault("slots", 2)
     kw.setdefault("max_len", 64)
     kw.setdefault("paged", True)
     kw.setdefault("page_size", 8)
     eng = ContinuousBatchingEngine(CFG, params, EngineConfig(**kw),
                                    eos_token_id=None, pad_token_id=0,
-                                   mesh=mesh)
+                                   mesh=mesh, draft=draft)
     eng.start()
     return eng
 

@@ -1,0 +1,474 @@
+"""Speculative decoding: the token-identity oracle + accounting locks.
+
+Greedy acceptance makes speculative output bitwise the non-speculative
+decode — so every test here is an oracle test: whatever the draft
+proposes (good, bad, adversarial), outputs must equal the engine
+without speculation.  A scripted draft that disagrees at known
+positions makes the acceptance-ratio arithmetic exactly assertable;
+the ``spec.verify`` fault site must inherit decode_step's containment
+contract (a crashed verify program fails loudly, never silently
+corrupts).
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.models.generate import generate
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingEngine,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.errors import RetryableError
+from kubernetes_cloud_tpu.serve.spec_decode import (
+    ModelDraft,
+    NgramDraft,
+    ScriptedDraft,
+)
+from kubernetes_cloud_tpu.serve.tenancy import TenancyConfig, TenantSpec
+
+pytestmark = pytest.mark.chaos
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+#: a genuinely smaller draft LM over the same vocab (the
+#: pythia-70m-drafts-for-410m shape, scaled to the test preset)
+DRAFT_CFG = dataclasses.replace(CFG, num_layers=1)
+
+PROMPTS = [list(range(1, 9)), list(range(40, 45)),
+           list(range(100, 120)), [7, 8, 9]]
+MAX_NEW = [6, 9, 4, 7]
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return init_params(DRAFT_CFG, jax.random.key(1))
+
+
+@pytest.fixture(scope="module")
+def reference(params):
+    refs = []
+    for p, n in zip(PROMPTS, MAX_NEW):
+        out = np.asarray(generate(CFG, params, jnp.asarray([p], jnp.int32),
+                                  max_new_tokens=n, temperature=0.0,
+                                  pad_token_id=0))
+        refs.append(out[0, len(p):len(p) + n].tolist())
+    return refs
+
+
+def ref_tokens(params, prompt, n):
+    out = np.asarray(generate(CFG, params, jnp.asarray([prompt], jnp.int32),
+                              max_new_tokens=n, temperature=0.0,
+                              pad_token_id=0))
+    return out[0, len(prompt):len(prompt) + n].tolist()
+
+
+def make_engine(params, draft=None, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("paged", True)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("spec_draft", "ngram")
+    eng = ContinuousBatchingEngine(CFG, params, EngineConfig(**kw),
+                                   eos_token_id=None, pad_token_id=0,
+                                   draft=draft)
+    eng.start()
+    return eng
+
+
+def self_draft(params):
+    """Draft == target: proposals are the target's own argmax, so
+    acceptance is total — the harness that exercises multi-token
+    emission + rollback hardest."""
+    return ModelDraft(CFG, params, slots=2, max_len=64, pad_token_id=0)
+
+
+# ---------------------------------------------------------------------------
+# the oracle: outputs identical to non-speculative decode
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("order", [[0, 1, 2, 3], [3, 2, 1, 0],
+                                   [2, 0, 3, 1]])
+def test_identity_any_admission_order_model_draft(params, draft_params,
+                                                  reference, order):
+    eng = make_engine(params, draft=(DRAFT_CFG, draft_params))
+    try:
+        reqs = {i: eng.submit(PROMPTS[i], max_new_tokens=MAX_NEW[i],
+                              temperature=0.0) for i in order}
+        for i in order:
+            assert reqs[i].wait(eng) == reference[i]
+        assert eng.stats["spec_rounds"] > 0
+    finally:
+        eng.stop()
+
+
+def test_identity_full_acceptance_path(params, reference):
+    """Self-drafting accepts ~every proposal: multi-token emission per
+    verify dispatch, and still bitwise the sequential output."""
+    eng = make_engine(params, draft=self_draft(params))
+    try:
+        reqs = [eng.submit(p, max_new_tokens=n, temperature=0.0)
+                for p, n in zip(PROMPTS, MAX_NEW)]
+        for r, want in zip(reqs, reference):
+            assert r.wait(eng) == want
+        st = eng.stats
+        assert st["spec_accepted"] > 0
+        # fewer verify dispatches than tokens: speculation actually
+        # multiplied tokens-per-dispatch
+        assert st["spec_rounds"] < st["emitted_tokens"] - len(PROMPTS)
+        assert st["spec_accepted"] <= st["spec_drafted"]
+    finally:
+        eng.stop()
+
+
+def test_identity_ngram_draft(params, reference):
+    eng = make_engine(params)  # spec_draft="ngram" default
+    try:
+        reqs = [eng.submit(p, max_new_tokens=n, temperature=0.0)
+                for p, n in zip(PROMPTS, MAX_NEW)]
+        for r, want in zip(reqs, reference):
+            assert r.wait(eng) == want
+        assert isinstance(eng.draft, NgramDraft)
+        assert eng.stats["spec_rounds"] > 0
+    finally:
+        eng.stop()
+
+
+def test_identity_prefix_sharing(params):
+    shared = list(range(200, 232))
+    p1, p2 = shared + [1, 2, 3], shared + [4, 5, 6, 7]
+    eng = make_engine(params, draft=self_draft(params))
+    try:
+        r1 = eng.submit(p1, max_new_tokens=6, temperature=0.0)
+        assert r1.wait(eng) == ref_tokens(params, p1, 6)
+        r2 = eng.submit(p2, max_new_tokens=6, temperature=0.0)
+        assert r2.wait(eng) == ref_tokens(params, p2, 6)
+        assert eng.stats["prefix_hits"] == 1
+        assert eng.stats["spec_accepted"] > 0
+    finally:
+        eng.stop()
+
+
+def test_identity_int8_arena(params):
+    """int8 + speculation vs int8 without: same storage semantics on
+    both sides, so greedy outputs must agree token-for-token."""
+    base = make_engine(params, kv_dtype="int8", spec_draft=None)
+    try:
+        want = [base.submit(p, max_new_tokens=n, temperature=0.0
+                            ).wait(base)
+                for p, n in zip(PROMPTS, MAX_NEW)]
+    finally:
+        base.stop()
+    eng = make_engine(params, kv_dtype="int8",
+                      draft=self_draft(params))
+    try:
+        reqs = [eng.submit(p, max_new_tokens=n, temperature=0.0)
+                for p, n in zip(PROMPTS, MAX_NEW)]
+        for r, w in zip(reqs, want):
+            assert r.wait(eng) == w
+        assert eng.stats["spec_accepted"] > 0
+    finally:
+        eng.stop()
+
+
+def test_identity_preempt_resume(params):
+    """Speculating slots survive QoS preemption/resume: pinned-page
+    resume re-enters the draft lazily and outputs stay identical."""
+    ten = TenancyConfig(
+        tenants=(TenantSpec("batchy", lane="batch",
+                            api_keys=("k-batchy",)),
+                 TenantSpec("inter", lane="interactive",
+                            api_keys=("k-inter",))),
+        min_batch_progress=2)
+    eng = make_engine(params, tenancy=ten, draft=self_draft(params))
+    b_prompts = [list(range(1, 9)), list(range(40, 45))]
+    i_prompt = [7, 8, 9]
+    try:
+        victims = [eng.submit(p, max_new_tokens=40, temperature=0.0,
+                              api_key="k-batchy") for p in b_prompts]
+        for v in victims:
+            next(v.iter_tokens(timeout=60))
+        pre = eng.submit(i_prompt, max_new_tokens=7, temperature=0.0,
+                         api_key="k-inter")
+        assert pre.wait(eng) == ref_tokens(params, i_prompt, 7)
+        for p, v in zip(b_prompts, victims):
+            assert v.wait(eng) == ref_tokens(params, p, 40)
+        assert eng.stats["preemptions"] >= 1
+        assert eng.stats["spec_accepted"] > 0
+    finally:
+        eng.stop()
+
+
+def test_stochastic_slots_keep_identical_sampling(params):
+    """temperature > 0 slots ride the verify dispatch drafts-free: the
+    sampled sequence equals the non-speculative engine's for the same
+    seed (same logits, same host RNG consumption)."""
+    prompt = list(range(1, 9))
+    base = make_engine(params, spec_draft=None)
+    try:
+        want = base.submit(prompt, max_new_tokens=10, temperature=0.8,
+                           seed=7).wait(base)
+    finally:
+        base.stop()
+    eng = make_engine(params, draft=self_draft(params))
+    try:
+        # a greedy neighbour keeps speculation live in the same batch
+        greedy = eng.submit(PROMPTS[2], max_new_tokens=12,
+                            temperature=0.0)
+        got = eng.submit(prompt, max_new_tokens=10, temperature=0.8,
+                         seed=7).wait(eng)
+        assert got == want
+        greedy.wait(eng)
+        assert eng.stats["spec_rounds"] > 0
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance-ratio arithmetic: a scripted draft disagreeing at known
+# positions
+# ---------------------------------------------------------------------------
+
+
+def test_scripted_draft_exact_acceptance_accounting(params):
+    """Draft proposes the TRUE next tokens but corrupts its second
+    proposal: every round accepts exactly one draft and emits exactly
+    two tokens, making rounds/drafted/accepted closed-form."""
+    prompt = PROMPTS[0]
+    n = 9
+    truth = ref_tokens(params, prompt, n)
+
+    def script(slot, seq, k):
+        done = len(seq) - len(prompt)  # tokens emitted so far
+        nxt = truth[done:done + k]
+        nxt = nxt + [0] * (k - len(nxt))
+        out = list(nxt)
+        if len(out) > 1:
+            out[1] = (out[1] + 1) % CFG.vocab_size  # known disagreement
+        return out
+
+    eng = make_engine(params, draft=ScriptedDraft(script), spec_k=4)
+    try:
+        req = eng.submit(prompt, max_new_tokens=n, temperature=0.0)
+        assert req.wait(eng) == truth
+        st = eng.stats
+        # token 1 comes from prefill; each round then emits 2 (one
+        # accepted draft + the disagreeing bonus) -> 4 rounds
+        assert st["spec_rounds"] == 4
+        assert st["spec_drafted"] == 16
+        assert st["spec_accepted"] == 4
+        samples = obs.parse_text(obs.REGISTRY.render())
+        assert obs.sample_value(samples, "kct_engine_spec_tokens_total",
+                                {"model": "engine",
+                                 "result": "accepted"}) >= 4
+        assert 0.0 < obs.sample_value(
+            samples, "kct_engine_spec_accept_ratio",
+            {"model": "engine"}) <= 1.0
+    finally:
+        eng.stop()
+
+
+def test_empty_proposals_fall_back_to_plain_decode(params, reference):
+    """A round where the draft proposes NOTHING takes the plain
+    one-token decode dispatch instead of paying the (k+1)-wide verify
+    program for a guaranteed single token — with a never-proposing
+    draft the engine must behave (and count) exactly like spec-off,
+    while outputs stay identical."""
+    eng = make_engine(params,
+                      draft=ScriptedDraft(lambda slot, seq, k: []),
+                      spec_k=4)
+    try:
+        reqs = [eng.submit(p, max_new_tokens=n, temperature=0.0)
+                for p, n in zip(PROMPTS, MAX_NEW)]
+        for req, want in zip(reqs, reference):
+            assert req.wait(eng) == want
+        st = eng.stats
+        assert st["spec_rounds"] == 0
+        assert st["spec_drafted"] == 0
+        assert st["spec_accepted"] == 0
+    finally:
+        eng.stop()
+
+
+def test_shared_stateful_draft_rejected_across_decode_slices(
+        params, draft_params):
+    """A ModelDraft is single-owner (its slot pool is engine-local,
+    mutated lock-free on the scheduler thread): handing ONE instance
+    to several disaggregated decode slices must be refused up front
+    instead of racing the pool at runtime.  Stateless sources (ngram)
+    stay shareable, and the (cfg, params) form builds a private draft
+    per slice."""
+    from kubernetes_cloud_tpu.serve.disagg import (
+        build_disaggregated_engine,
+    )
+
+    cfg2 = EngineConfig(slots=2, max_len=64, paged=True, page_size=8,
+                        decode_slices=2)
+    shared = ModelDraft(DRAFT_CFG, draft_params, slots=2, max_len=64)
+    with pytest.raises(ValueError, match="cannot be shared"):
+        build_disaggregated_engine(CFG, params, cfg2, draft=shared)
+    # ngram is stateless: sharing is legal
+    pair = build_disaggregated_engine(CFG, params, cfg2,
+                                      draft=NgramDraft())
+    assert all(e.draft is not None for e in pair.decodes)
+    # (cfg, params) builds one private ModelDraft per slice
+    pair2 = build_disaggregated_engine(
+        CFG, params, cfg2, draft=(DRAFT_CFG, draft_params))
+    drafts = [e.draft for e in pair2.decodes]
+    assert all(isinstance(d, ModelDraft) for d in drafts)
+    assert drafts[0] is not drafts[1]
+
+
+def test_adversarial_draft_never_corrupts(params, reference):
+    """A draft proposing garbage every time costs speed only."""
+    eng = make_engine(params,
+                      draft=ScriptedDraft(
+                          lambda slot, seq, k:
+                          [(seq[-1] * 7 + j) % CFG.vocab_size
+                           for j in range(k)]))
+    try:
+        reqs = [eng.submit(p, max_new_tokens=n, temperature=0.0)
+                for p, n in zip(PROMPTS, MAX_NEW)]
+        for r, want in zip(reqs, reference):
+            assert r.wait(eng) == want
+        assert eng.stats["spec_drafted"] > 0
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# draft-source units
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_draft_unit():
+    d = NgramDraft(max_ngram=3)
+    # trailing (8, 9) occurred earlier, followed by 10, 11, 12
+    seq = [1, 8, 9, 10, 11, 12, 5, 8, 9]
+    assert d.propose({0: seq}, 3) == {0: [10, 11, 12]}
+    # no earlier occurrence of any trailing n-gram -> no proposal
+    assert d.propose({1: [1, 2, 3, 4]}, 3) == {}
+
+
+def test_ngram_draft_matches_naive_reference():
+    """The bytes.rfind fast path (int32 cells, alignment-checked) is
+    exactly the naive rightmost-earlier-occurrence scan — fuzzed over
+    token values spanning multiple bytes so cell-boundary byte
+    coincidences are exercised."""
+    import random
+
+    rng = random.Random(7)
+
+    def naive(seq, max_ngram, k):
+        drafts = []
+        for n in range(min(max_ngram, len(seq) - 1), 0, -1):
+            pat = seq[-n:]
+            for i in range(len(seq) - n - 1, -1, -1):
+                if seq[i:i + n] == pat:
+                    drafts = seq[i + n:i + n + k]
+                    break
+            if drafts:
+                break
+        return drafts
+
+    d = NgramDraft(max_ngram=3, window=64)
+    for _ in range(300):
+        # small alphabet forces repeats; values > 255 span bytes
+        vocab = rng.choice([4, 7, 300, 70000])
+        seq = [rng.randrange(vocab)
+               for _ in range(rng.randrange(1, 40))]
+        k = rng.randrange(1, 6)
+        got = d.propose({0: seq}, k).get(0, [])
+        assert got == naive(seq, 3, k), (seq, k, got)
+
+
+def test_model_draft_catchup_after_full_accept(params):
+    """A fully-accepted round leaves the draft one token behind; the
+    next propose() pays exactly the catch-up steps (the bookkeeping
+    the draft's host lengths make observable)."""
+    eng = make_engine(params, draft=self_draft(params))
+    try:
+        req = eng.submit(PROMPTS[2], max_new_tokens=16, temperature=0.0)
+        assert req.wait(eng) == ref_tokens(params, PROMPTS[2], 16)
+        assert eng.draft.stats["catchup_steps"] > 0
+        assert eng.draft.stats["prefills"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_spec_requires_paged():
+    with pytest.raises(ValueError, match="paged"):
+        EngineConfig(spec_draft="ngram", paged=False)
+
+
+def test_model_level_ngram_wiring(params):
+    """ContinuousBatchingModel resolves spec_draft='ngram' without a
+    draft checkpoint, and the rollout metadata names the draft kind so
+    fleet probes can tell a speculating replica mid-restart."""
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingModel,
+    )
+    from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+
+    svc = CausalLMService("lm", CFG, params=params, dtype=jnp.float32)
+    svc.load()
+    m = ContinuousBatchingModel("lm", svc, EngineConfig(
+        slots=2, max_len=64, paged=True, page_size=8,
+        spec_draft="ngram"))
+    m.load()
+    try:
+        assert isinstance(m.engine.draft, NgramDraft)
+        meta = m.serving_metadata()
+        assert meta["spec_draft"] == "ngram"
+        assert meta["prefill_chunk_tokens"] == 0
+    finally:
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# spec.verify chaos containment
+# ---------------------------------------------------------------------------
+
+
+def test_spec_verify_raise_is_a_loud_crash(params):
+    """The decode_step contract: a raising verify program crashes the
+    scheduler loudly — in-flight requests fail retryable (503), the
+    engine reads dead, nothing silently corrupts."""
+    eng = make_engine(params, draft=self_draft(params))
+    try:
+        warm = eng.submit(PROMPTS[0], max_new_tokens=4, temperature=0.0)
+        assert warm.wait(eng) == ref_tokens(params, PROMPTS[0], 4)
+        faults.install(faults.FaultInjector(
+            [FaultSpec("spec.verify", mode="raise")]))
+        doomed = eng.submit(PROMPTS[1], max_new_tokens=8,
+                            temperature=0.0)
+        with pytest.raises(RetryableError):
+            doomed.wait(eng)
+        deadline = time.monotonic() + 10
+        while eng.alive and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not eng.alive
+        assert eng.last_error is not None
+    finally:
+        faults.uninstall()
+        eng.stop()

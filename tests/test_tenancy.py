@@ -260,6 +260,27 @@ def test_pick_victim_progress_guard_and_lane():
     assert s.pick_victim([(0, fresh), (2, inter)]) is None
 
 
+def test_pick_victim_tokenless_gated_on_free_resume():
+    """A mid-chunked-prefill slot (no tokens yet) is exempt from the
+    progress guard only when eviction is free (paged mode:
+    ``tokenless_eligible=True``).  A dense engine re-chunks a victim
+    from position 0, so there the exemption would let a sustained
+    interactive stream starve a long prompt forever — tokenless slots
+    must fall under the guard like everyone else."""
+    cfg = TenancyConfig(tenants=(TenantSpec("g", lane="batch"),),
+                        min_batch_progress=4)
+    s = _sched(cfg)
+    mid_prefill = _req("g", lane="batch")
+    mid_prefill.tokens = []        # still chunking its prompt
+    assert s.pick_victim([(0, mid_prefill)],
+                         tokenless_eligible=True) == 0
+    assert s.pick_victim([(0, mid_prefill)],
+                         tokenless_eligible=False) is None
+    # the default keeps the paged behavior the chunked-prefill
+    # preemption tests lock
+    assert s.pick_victim([(0, mid_prefill)]) == 0
+
+
 def test_purge_and_drain_reach_every_tenant_queue():
     cfg = TenancyConfig(tenants=(TenantSpec("a"), TenantSpec("b")))
     s = _sched(cfg)
