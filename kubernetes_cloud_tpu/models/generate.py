@@ -754,10 +754,17 @@ def kv_quant_probe(cfg: CausalLMConfig, params: Params,
     axis and the probe drives the ``shard_map`` TP programs
     (:mod:`kubernetes_cloud_tpu.models.tp_decode`) instead — the
     sharded acceptance bar for a quantized mesh replica."""
-    run_prefill = (lambda kd, a, i_, m_, t_, s_: prefill_into_pages(
-        cfg, params, i_, m_, a, t_, s_))
-    run_decode = (lambda kd, a, tok, t_, ln: decode_step_pages(
-        cfg, params, tok, a, t_, ln, impl=impl))
+    # jit the single-host paths so the 2 * len(prompts) * max_new_tokens
+    # model calls hit 4 cached executables (prefill/decode x fp32/quant)
+    # instead of paying eager dispatch of the full forward every step.
+    _jit_prefill = jax.jit(lambda p_, a, i_, m_, t_, s_: prefill_into_pages(
+        cfg, p_, i_, m_, a, t_, s_))
+    _jit_decode = jax.jit(lambda p_, a, tok, t_, ln: decode_step_pages(
+        cfg, p_, tok, a, t_, ln, impl=impl))
+    run_prefill = (lambda kd, a, i_, m_, t_, s_: _jit_prefill(
+        params, a, i_, m_, t_, s_))
+    run_decode = (lambda kd, a, tok, t_, ln: _jit_decode(
+        params, a, tok, t_, ln))
     place = lambda a: a  # noqa: E731 - trivial identity default
     if mesh is not None:
         from kubernetes_cloud_tpu.models import tp_decode
@@ -778,13 +785,21 @@ def kv_quant_probe(cfg: CausalLMConfig, params: Params,
     agree = total = 0
     max_err = 0.0
     err_sum = 0.0
+    # ONE geometry for the whole eval set: every prompt right-pads to
+    # the longest and reserves the same page count, so each arena
+    # compiles one prefill and one decode program instead of a fresh
+    # pair per distinct prompt length.  Padded positions are masked
+    # out of attention and their writes route to the null page, so
+    # the reported numbers are unchanged.
+    t_max = max(len(p) for p in prompts)
+    n_pages = -(-(t_max + max_new_tokens) // page_size)
+    tables = jnp.asarray([list(range(1, n_pages + 1))], jnp.int32)
     for prompt in prompts:
         plen = len(prompt)
-        n_pages = -(-(plen + max_new_tokens) // page_size)
-        tables = jnp.asarray([list(range(1, n_pages + 1))], jnp.int32)
         arenas, logits = {}, {}
-        ids = jnp.asarray([list(prompt)], jnp.int32)
-        mask = jnp.ones((1, plen), jnp.int32)
+        pad = t_max - plen
+        ids = jnp.asarray([list(prompt) + [0] * pad], jnp.int32)
+        mask = jnp.asarray([[1] * plen + [0] * pad], jnp.int32)
         start = jnp.zeros((1,), jnp.int32)
         for kd in ("fp32", kv_dtype):
             arena = place(init_page_arena(cfg, n_pages + 1, page_size,

@@ -119,6 +119,19 @@ METRIC_FAMILIES = {
         "completed zero-drop rolling-restart sweeps",
     "kct_fleet_unplaceable_total":
         "requests 503d with no active replica to take them",
+    # elastic autoscaler (serve/autoscaler.py)
+    "kct_autoscaler_desired_replicas":
+        "replicas the control loop wants per role (post-clamp)",
+    "kct_autoscaler_replicas":
+        "replicas per role by lifecycle state (ready|starting|draining)",
+    "kct_autoscaler_panic":
+        "1 while the role's pool is in panic-mode burst scaling",
+    "kct_autoscaler_cold_start_seconds":
+        "measured spawn-begin to replica-probed-healthy cold starts",
+    "kct_autoscaler_activator_queue_depth":
+        "requests held by the activator awaiting a cold start",
+    "kct_autoscaler_scale_events_total":
+        "scale decisions applied per role by direction (up|down)",
     # dynamic batcher (serve/batcher.py)
     "kct_batcher_batches_total":
         "batches dispatched to the device",

@@ -1,0 +1,817 @@
+"""Native elastic autoscaler: role-aware replica pools, an activator
+for scale-from-zero, panic-mode burst scaling, predictive pre-warming.
+
+The paper's serving layer is KServe-on-Knative: every workload scales
+on ``autoscaling.knative.dev/target`` concurrency with ``minScale: 0``
+and an *activator* that holds requests while a pod cold-starts
+(PAPERS.md KServe entry).  Everything below this module serves a
+*fixed* replica set — PR 10's :class:`~kubernetes_cloud_tpu.serve.
+fleet.FleetRouter` routes over whatever replicas it was handed.  This
+module is the missing control plane, expressed natively over the
+repo's own machinery instead of Knative CRDs:
+
+* **Target tracking** (:class:`Autoscaler`).  A Knative-KPA-shaped
+  control loop: per-role observed concurrency (in-flight + queued +
+  activator-held) is averaged over a *stable* window to size the pool
+  at ``ceil(concurrency / target_concurrency)``, while a short *panic*
+  window watches for bursts — when the panic-window demand would need
+  ``panic_threshold``× the ready pool, the loop enters **panic mode**:
+  it scales straight to the panic-window desired count and refuses to
+  scale down until the panic holds clear for ``panic_hold_s``.
+* **Role-aware pools**.  Since PR 14 the fleet is role-split
+  (DistServe): prefill replicas answer TTFT, decode slices answer
+  TPOT.  Each role (``prefill`` / ``decode`` / ``colocated``) gets its
+  own :class:`RolePolicy` (min/max, concurrency target) and its own
+  independent control state — DistServe's placement optimizer
+  expressed as a control loop instead of a one-shot solve.
+* **Scale-to-zero + activator** (:class:`Activator`).  With
+  ``min_replicas == 0`` an idle pool drains to nothing after
+  ``scale_to_zero_grace_s``.  The first arrival then *holds* on the
+  activator (the HTTP thread is the queue — exactly Knative's
+  activator-in-the-data-path), pokes the control loop for immediate
+  scale-up, and replays once a replica probes healthy.  Nothing is
+  dropped and nothing is prefilled twice: the request is dispatched
+  exactly once, after capacity exists.
+* **Measured cold-start prior**.  Every spawn is timed spawn-begin →
+  replica-probed-healthy and folded into an EWMA prior
+  (``cold_start_prior_s`` until the first measurement) — the number
+  predictive pre-warming plans around and the simulator calibrates
+  against.
+* **Predictive pre-warming**.  The recent arrival-rate trend (linear
+  fit over ``trend_window_s``) projects demand one cold-start ahead;
+  a rising trend provisions capacity *before* the queue builds, so a
+  diurnal ramp is absorbed by replicas that were already warming.
+* **Hysteresis & cooldown**.  Scale-up is immediate (queues hurt now);
+  scale-down requires the surplus to persist for
+  ``scale_down_delay_s`` AND a ``cooldown_s`` gap since the last scale
+  event — flapping probes or a noisy minute cannot thrash the pool
+  through spawn/drain cycles.
+
+The loop talks to the world through :class:`ScalingTarget` — signals
+in (:class:`PoolSignals`), spawn/drain verbs out.  Two bindings exist:
+:class:`ElasticFleet` drives a real :class:`~kubernetes_cloud_tpu.
+serve.fleet.FleetRouter` (spawn = build a :class:`LocalReplica` via a
+factory and probe it healthy; drain = the fleet's zero-drop rolling
+machinery: stop routing, transplant the queue into peers, wait out
+in-flight, remove), and :class:`~kubernetes_cloud_tpu.serve.simulate.
+SimFleet` drives the region-scale simulator — the SAME control loop
+code is what the simulator measures, so the flash-crowd numbers in
+BENCHMARKS.md "Elastic fleet" exercise this module, not a model of it.
+
+deploy/README.md "Elastic autoscaling" maps every Knative annotation
+(``autoscaling.knative.dev/target``, ``minScale`` / ``maxScale``,
+panic windows) onto the :class:`AutoscalerConfig` fields below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import threading
+import time
+from typing import Callable, Mapping, Optional, Sequence
+
+from kubernetes_cloud_tpu import obs
+
+log = logging.getLogger(__name__)
+
+#: serving roles a pool can scale (serve/continuous.py EngineConfig.role)
+ROLES = ("colocated", "prefill", "decode")
+
+# Autoscaler metric families (labels: the bounded role vocabulary)
+_M_DESIRED = obs.gauge(
+    "kct_autoscaler_desired_replicas",
+    "Replicas the control loop wants per role (post-clamp).", ("role",))
+_M_ACTUAL = obs.gauge(
+    "kct_autoscaler_replicas",
+    "Replicas per role by lifecycle state (ready | starting | "
+    "draining).", ("role", "state"))
+_M_PANIC = obs.gauge(
+    "kct_autoscaler_panic",
+    "1 while the role's pool is in panic-mode burst scaling.",
+    ("role",))
+_M_COLD_START = obs.histogram(
+    "kct_autoscaler_cold_start_seconds",
+    "Measured spawn-begin to replica-probed-healthy cold starts.",
+    ("role",),
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0,
+             80.0, 160.0))
+_M_ACT_DEPTH = obs.gauge(
+    "kct_autoscaler_activator_queue_depth",
+    "Requests held by the activator awaiting a cold-starting replica.")
+_M_SCALE_EVENTS = obs.counter(
+    "kct_autoscaler_scale_events_total",
+    "Scale decisions applied per role by direction (up | down).",
+    ("role", "direction"))
+
+
+@dataclasses.dataclass(frozen=True)
+class RolePolicy:
+    """Per-role pool bounds + concurrency target.
+
+    ``target_concurrency`` is Knative's
+    ``autoscaling.knative.dev/target``: the in-flight + queued requests
+    one replica should carry; the pool is sized at
+    ``ceil(observed / target)``.  ``min_replicas`` / ``max_replicas``
+    are ``minScale`` / ``maxScale`` (``min_replicas = 0`` enables
+    scale-to-zero through the activator)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_concurrency: float = 4.0
+
+    def __post_init__(self):
+        if self.min_replicas < 0:
+            raise ValueError("min_replicas must be >= 0")
+        if self.max_replicas < max(self.min_replicas, 1):
+            raise ValueError(
+                "max_replicas must be >= max(min_replicas, 1)")
+        if self.target_concurrency <= 0:
+            raise ValueError("target_concurrency must be > 0")
+
+
+def _default_roles() -> Mapping[str, RolePolicy]:
+    return {"colocated": RolePolicy()}
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    """Control-loop knobs.  deploy/README.md "Elastic autoscaling"
+    maps each onto its Knative annotation."""
+
+    #: control-loop cadence (Knative: tick-interval, 2 s default there)
+    tick_s: float = 1.0
+    #: stable concurrency window (autoscaling.knative.dev/window)
+    stable_window_s: float = 30.0
+    #: panic window (panic-window-percentage x stable window)
+    panic_window_s: float = 6.0
+    #: panic entry: panic-window desired >= threshold x ready pool
+    #: (panic-threshold-percentage / 100)
+    panic_threshold: float = 2.0
+    #: stay panicked (no scale-down) this long after the last trigger
+    panic_hold_s: float = 30.0
+    #: scale-down hysteresis: the surplus must persist this long
+    #: (scale-down-delay)
+    scale_down_delay_s: float = 15.0
+    #: minimum gap between applied scale events per role
+    cooldown_s: float = 5.0
+    #: idle time before a min_replicas=0 pool drains to nothing
+    #: (scale-to-zero-grace-period)
+    scale_to_zero_grace_s: float = 30.0
+    #: bound on replicas added by one decision (0 = unbounded;
+    #: max-scale-up-rate)
+    max_scale_up_step: int = 0
+    #: predictive pre-warming from the arrival-rate trend
+    prewarm: bool = True
+    trend_window_s: float = 30.0
+    #: cold-start prior until a spawn is measured; measurements fold
+    #: in at this EWMA weight
+    cold_start_prior_s: float = 10.0
+    cold_start_ewma_alpha: float = 0.4
+    #: bound on one activator hold (a cold start slower than this
+    #: fails the held request retryable — the client's ladder retries)
+    activator_max_hold_s: float = 60.0
+    #: bound on waiting out a draining replica's in-flight work
+    drain_timeout_s: float = 30.0
+    #: per-role policies; roles absent here are not scaled
+    roles: Mapping[str, RolePolicy] = dataclasses.field(
+        default_factory=_default_roles)
+
+    def __post_init__(self):
+        if self.tick_s <= 0:
+            raise ValueError("tick_s must be > 0")
+        if self.stable_window_s <= 0 or self.panic_window_s <= 0:
+            raise ValueError("windows must be > 0")
+        if self.panic_window_s > self.stable_window_s:
+            raise ValueError("panic_window_s must be <= stable_window_s")
+        if self.panic_threshold < 1.0:
+            raise ValueError("panic_threshold must be >= 1.0")
+        if min(self.panic_hold_s, self.scale_down_delay_s,
+               self.cooldown_s, self.scale_to_zero_grace_s) < 0:
+            raise ValueError("delays must be >= 0")
+        if self.max_scale_up_step < 0:
+            raise ValueError("max_scale_up_step must be >= 0 (0 = off)")
+        if self.cold_start_prior_s <= 0:
+            raise ValueError("cold_start_prior_s must be > 0")
+        if not 0 < self.cold_start_ewma_alpha <= 1:
+            raise ValueError("cold_start_ewma_alpha must be in (0, 1]")
+        if self.activator_max_hold_s <= 0 or self.drain_timeout_s <= 0:
+            raise ValueError("hold/drain bounds must be > 0")
+        for role, pol in self.roles.items():
+            if role not in ROLES:
+                raise ValueError(f"unknown role {role!r} "
+                                 f"(expected one of {ROLES})")
+            if not isinstance(pol, RolePolicy):
+                raise ValueError(f"roles[{role!r}] must be a RolePolicy")
+
+    def policy(self, role: str) -> Optional[RolePolicy]:
+        return self.roles.get(role)
+
+
+#: the Knative annotation each AutoscalerConfig / RolePolicy field
+#: replaces (deploy/README.md renders this as the migration table and
+#: tests lock it against the config surface)
+KNATIVE_ANNOTATIONS = {
+    "autoscaling.knative.dev/target": "RolePolicy.target_concurrency",
+    "autoscaling.knative.dev/minScale": "RolePolicy.min_replicas",
+    "autoscaling.knative.dev/maxScale": "RolePolicy.max_replicas",
+    "autoscaling.knative.dev/window": "AutoscalerConfig.stable_window_s",
+    "autoscaling.knative.dev/panic-window-percentage":
+        "AutoscalerConfig.panic_window_s",
+    "autoscaling.knative.dev/panic-threshold-percentage":
+        "AutoscalerConfig.panic_threshold",
+    "autoscaling.knative.dev/scale-down-delay":
+        "AutoscalerConfig.scale_down_delay_s",
+    "autoscaling.knative.dev/scale-to-zero-grace-period":
+        "AutoscalerConfig.scale_to_zero_grace_s",
+    "autoscaling.knative.dev/activation-scale": "Activator (hold+replay)",
+}
+
+
+@dataclasses.dataclass
+class PoolSignals:
+    """One role's observed state, sampled by the control loop each
+    tick.  ``concurrency`` is in-flight + queued on ready replicas;
+    ``activator_depth`` is requests held awaiting capacity;
+    ``arrivals`` is a cumulative arrival count (the rate-trend input)."""
+
+    ready: int = 0
+    starting: int = 0
+    draining: int = 0
+    concurrency: float = 0.0
+    activator_depth: int = 0
+    arrivals: int = 0
+
+
+class ScalingTarget:
+    """What the control loop scales.  ``ElasticFleet`` binds a real
+    router; ``simulate.SimFleet`` binds the region-scale simulator —
+    both run the SAME :class:`Autoscaler`."""
+
+    def roles(self) -> Sequence[str]:
+        raise NotImplementedError
+
+    def signals(self, role: str) -> PoolSignals:
+        raise NotImplementedError
+
+    def scale_up(self, role: str, n: int) -> int:
+        """Begin ``n`` cold starts; returns how many actually began."""
+        raise NotImplementedError
+
+    def scale_down(self, role: str, n: int) -> int:
+        """Begin draining ``n`` replicas; returns how many began."""
+        raise NotImplementedError
+
+
+class RollingDigest:
+    """Bounded rolling sample window with quantiles: ``observe(v)``
+    timestamped samples, ``quantile(q)`` over the trailing
+    ``window_s``.  Feeds live-TTFT hedging (serve/fleet.py) and the
+    control loop's concurrency/arrival-rate windows.  Thread-safe;
+    nothing inside blocks."""
+
+    def __init__(self, window_s: float = 60.0, max_samples: int = 4096):
+        if window_s <= 0 or max_samples < 1:
+            raise ValueError("window_s and max_samples must be > 0")
+        self.window_s = float(window_s)
+        self.max_samples = int(max_samples)
+        self._samples: list[tuple[float, float]] = []
+        self._lock = threading.Lock()
+
+    def observe(self, value: float,
+                now: Optional[float] = None) -> None:
+        t = time.monotonic() if now is None else float(now)
+        with self._lock:
+            self._samples.append((t, float(value)))
+            if len(self._samples) > self.max_samples:
+                del self._samples[:len(self._samples)
+                                  - self.max_samples]
+
+    def _window(self, now: Optional[float]) -> list[tuple[float, float]]:
+        t = time.monotonic() if now is None else float(now)
+        cutoff = t - self.window_s
+        with self._lock:
+            # drop expired samples in place so the list stays small
+            i = 0
+            for i, (ts, _) in enumerate(self._samples):
+                if ts >= cutoff:
+                    break
+            else:
+                i = len(self._samples)
+            if i:
+                del self._samples[:i]
+            return list(self._samples)
+
+    def count(self, now: Optional[float] = None) -> int:
+        return len(self._window(now))
+
+    def quantile(self, q: float, now: Optional[float] = None,
+                 min_samples: int = 1) -> Optional[float]:
+        if not 0 <= q <= 1:
+            raise ValueError("q must be in [0, 1]")
+        vals = sorted(v for _, v in self._window(now))
+        if len(vals) < max(min_samples, 1):
+            return None
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    def mean(self, now: Optional[float] = None) -> Optional[float]:
+        vals = [v for _, v in self._window(now)]
+        return sum(vals) / len(vals) if vals else None
+
+    def trend(self, now: Optional[float] = None
+              ) -> tuple[Optional[float], float]:
+        """Least-squares ``(latest_fit, slope_per_s)`` over the window
+        — the predictive pre-warm input.  ``(None, 0)`` below 2
+        samples."""
+        pts = self._window(now)
+        if len(pts) < 2:
+            return (pts[0][1] if pts else None), 0.0
+        t0 = pts[0][0]
+        xs = [t - t0 for t, _ in pts]
+        ys = [v for _, v in pts]
+        n = len(pts)
+        mx, my = sum(xs) / n, sum(ys) / n
+        var = sum((x - mx) ** 2 for x in xs)
+        if var <= 1e-12:
+            return ys[-1], 0.0
+        slope = sum((x - mx) * (y - my)
+                    for x, y in zip(xs, ys)) / var
+        fit = my + slope * (xs[-1] - mx)
+        return fit, slope
+
+
+class Activator:
+    """Hold-and-replay for scale-from-zero (Knative's activator in the
+    data path).  An HTTP thread that finds no routable replica parks
+    here; the park itself is the demand signal (``on_demand`` pokes
+    the control loop immediately — no waiting out a tick), and
+    ``notify_capacity()`` (a replica probed healthy) wakes every
+    waiter to retry its pick.  The waiting thread IS the queue: the
+    request body never moves, so a replayed request is dispatched —
+    and prefilled — exactly once."""
+
+    def __init__(self, max_hold_s: float = 60.0,
+                 on_demand: Optional[Callable[[], None]] = None):
+        if max_hold_s <= 0:
+            raise ValueError("max_hold_s must be > 0")
+        self.max_hold_s = float(max_hold_s)
+        self.on_demand = on_demand
+        self._cond = threading.Condition()
+        self._depth = 0
+        self._capacity_seq = 0
+        self.stats = {"held": 0, "replayed": 0, "timeouts": 0}
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def hold(self, deadline: Optional[float] = None) -> bool:
+        """Park the calling thread until capacity is announced (True)
+        or ``deadline`` (monotonic; default now + ``max_hold_s``)
+        passes (False).  Callers loop: a wake only means "re-pick", not
+        "your replica"."""
+        if deadline is None:
+            deadline = time.monotonic() + self.max_hold_s
+        poke = self.on_demand
+        with self._cond:
+            self._depth += 1
+            self.stats["held"] += 1
+            _M_ACT_DEPTH.set(self._depth)
+            seq = self._capacity_seq
+        if poke is not None:
+            try:  # the poke is advisory; a raising hook must not 500
+                # the held request
+                poke()
+            except Exception:  # noqa: BLE001 - demand signal best-effort
+                log.exception("activator on_demand hook failed")
+        try:
+            with self._cond:
+                ok = self._cond.wait_for(
+                    lambda: self._capacity_seq != seq,
+                    timeout=max(deadline - time.monotonic(), 0.0))
+                self.stats["replayed" if ok else "timeouts"] += 1
+                return ok
+        finally:
+            with self._cond:
+                self._depth -= 1
+                _M_ACT_DEPTH.set(self._depth)
+
+    def notify_capacity(self) -> None:
+        with self._cond:
+            self._capacity_seq += 1
+            self._cond.notify_all()
+
+
+class _RoleState:
+    """Per-role controller memory (windows, panic, hysteresis)."""
+
+    def __init__(self, cfg: AutoscalerConfig):
+        self.conc = RollingDigest(window_s=cfg.stable_window_s)
+        self.panic_conc = RollingDigest(window_s=cfg.panic_window_s)
+        self.rate = RollingDigest(window_s=cfg.trend_window_s)
+        self.last_arrivals: Optional[int] = None
+        self.last_sample_t: Optional[float] = None
+        self.panic_until = -math.inf
+        self.below_since: Optional[float] = None
+        self.idle_since: Optional[float] = None
+        self.last_scale_at = -math.inf
+        self.desired = 0
+
+
+class Autoscaler:
+    """The control loop.  Deterministic and clock-injectable: tests
+    and the simulator call :meth:`step` with explicit virtual ``now``;
+    live fleets run :meth:`start`'s thread on the wall clock."""
+
+    def __init__(self, target: ScalingTarget,
+                 cfg: AutoscalerConfig = AutoscalerConfig(), *,
+                 clock: Callable[[], float] = time.monotonic):
+        self.target = target
+        self.cfg = cfg
+        self.clock = clock
+        self._states: dict[str, _RoleState] = {}
+        self._cold_start_ewma: dict[str, float] = {}
+        self._kick = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"ticks": 0, "scale_ups": 0, "scale_downs": 0,
+                      "panics": 0, "prewarm_ups": 0, "cold_starts": 0}
+        self._m_desired = {r: _M_DESIRED.labels(role=r) for r in ROLES}
+        self._m_panic = {r: _M_PANIC.labels(role=r) for r in ROLES}
+        self._m_actual = {
+            (r, s): _M_ACTUAL.labels(role=r, state=s)
+            for r in ROLES for s in ("ready", "starting", "draining")}
+
+    # -- cold-start prior ---------------------------------------------------
+
+    def cold_start_s(self, role: str) -> float:
+        """The planning prior: measured EWMA once spawns happened, the
+        configured prior until then."""
+        return self._cold_start_ewma.get(role,
+                                         self.cfg.cold_start_prior_s)
+
+    def note_cold_start(self, role: str, seconds: float) -> None:
+        """Fold one measured spawn→healthy duration into the prior
+        (targets call this; the histogram feeds dashboards)."""
+        seconds = float(seconds)
+        self.stats["cold_starts"] += 1
+        _M_COLD_START.labels(role=role).observe(seconds)
+        prev = self._cold_start_ewma.get(role)
+        a = self.cfg.cold_start_ewma_alpha
+        self._cold_start_ewma[role] = (
+            seconds if prev is None else a * seconds + (1 - a) * prev)
+
+    # -- the control loop ---------------------------------------------------
+
+    def kick(self) -> None:
+        """Demand signal (the activator's ``on_demand``): run a tick
+        now instead of waiting out the interval."""
+        self._kick.set()
+
+    def step(self, now: Optional[float] = None) -> dict:
+        """One control tick over every configured role; returns the
+        per-role decision detail (tests and the simulator assert on
+        it)."""
+        now = self.clock() if now is None else float(now)
+        self.stats["ticks"] += 1
+        out = {}
+        for role in self.target.roles():
+            pol = self.cfg.policy(role)
+            if pol is None:
+                continue
+            out[role] = self._step_role(role, pol, now)
+        return out
+
+    def _step_role(self, role: str, pol: RolePolicy, now: float) -> dict:
+        cfg = self.cfg
+        st = self._states.get(role)
+        if st is None:
+            st = self._states[role] = _RoleState(cfg)
+        sig = self.target.signals(role)
+        conc = float(sig.concurrency) + float(sig.activator_depth)
+        st.conc.observe(conc, now=now)
+        st.panic_conc.observe(conc, now=now)
+
+        # arrival rate sample from the cumulative counter
+        if (st.last_arrivals is not None and st.last_sample_t is not None
+                and now > st.last_sample_t):
+            dt = now - st.last_sample_t
+            st.rate.observe(
+                max(sig.arrivals - st.last_arrivals, 0) / dt, now=now)
+        st.last_arrivals = sig.arrivals
+        st.last_sample_t = now
+
+        stable = st.conc.mean(now=now) or 0.0
+        panic = st.panic_conc.mean(now=now) or 0.0
+        desired_stable = math.ceil(stable / pol.target_concurrency)
+        desired_panic = math.ceil(panic / pol.target_concurrency)
+
+        # panic entry: the short window would need threshold x the
+        # ready pool — burst traffic must not wait out the stable
+        # window's inertia
+        ready = max(sig.ready, 1)
+        if (desired_panic > sig.ready
+                and desired_panic >= cfg.panic_threshold * ready):
+            if now >= st.panic_until:
+                self.stats["panics"] += 1
+            st.panic_until = now + cfg.panic_hold_s
+        in_panic = now < st.panic_until
+        desired = max(desired_stable, desired_panic) if in_panic \
+            else desired_stable
+
+        # predictive pre-warming: project the arrival-rate trend one
+        # cold start ahead; Little's law converts rate to concurrency
+        # through the currently-observed concurrency-per-rps
+        prewarmed = False
+        if cfg.prewarm:
+            rate_now, slope = st.rate.trend(now=now)
+            if rate_now and rate_now > 0 and slope > 0:
+                horizon = self.cold_start_s(role) + cfg.tick_s
+                projected = stable * (rate_now + slope * horizon) \
+                    / rate_now
+                want = math.ceil(projected / pol.target_concurrency)
+                if want > desired:
+                    desired = want
+                    prewarmed = True
+
+        # a held arrival IS demand: never sit at zero with waiters
+        if sig.activator_depth > 0:
+            desired = max(desired, 1)
+
+        # scale-to-zero: with min_replicas == 0 target tracking wants
+        # 0 the moment the pool goes idle — the grace period holds the
+        # LAST replica warm until the idleness has lasted; only then
+        # does the pool actually drain to nothing
+        if conc <= 0 and sig.activator_depth == 0:
+            if st.idle_since is None:
+                st.idle_since = now
+        else:
+            st.idle_since = None
+        desired = min(max(desired, pol.min_replicas), pol.max_replicas)
+        if (pol.min_replicas == 0 and desired == 0
+                and (sig.ready + sig.starting) > 0
+                and (st.idle_since is None or now - st.idle_since
+                     < cfg.scale_to_zero_grace_s)):
+            desired = 1
+
+        current = sig.ready + sig.starting
+        applied = 0
+        if desired > current:
+            n = desired - current
+            if cfg.max_scale_up_step:
+                n = min(n, cfg.max_scale_up_step)
+            applied = self.target.scale_up(role, n)
+            if applied:
+                st.last_scale_at = now
+                st.below_since = None
+                self.stats["scale_ups"] += 1
+                if prewarmed:
+                    self.stats["prewarm_ups"] += 1
+                _M_SCALE_EVENTS.labels(role=role, direction="up") \
+                    .inc(applied)
+        elif desired < current:
+            if in_panic:
+                st.below_since = None  # never scale down in panic
+            else:
+                if st.below_since is None:
+                    st.below_since = now
+                if (now - st.below_since >= cfg.scale_down_delay_s
+                        and now - st.last_scale_at >= cfg.cooldown_s):
+                    applied = -self.target.scale_down(
+                        role, current - desired)
+                    if applied:
+                        st.last_scale_at = now
+                        st.below_since = None
+                        self.stats["scale_downs"] += 1
+                        _M_SCALE_EVENTS.labels(
+                            role=role, direction="down").inc(-applied)
+        else:
+            st.below_since = None
+
+        st.desired = desired
+        self._m_desired[role].set(desired)
+        self._m_panic[role].set(1 if in_panic else 0)
+        self._m_actual[(role, "ready")].set(sig.ready)
+        self._m_actual[(role, "starting")].set(sig.starting)
+        self._m_actual[(role, "draining")].set(sig.draining)
+        return {"desired": desired, "ready": sig.ready,
+                "starting": sig.starting, "draining": sig.draining,
+                "concurrency": round(conc, 3),
+                "stable": round(stable, 3), "panic": round(panic, 3),
+                "in_panic": in_panic, "prewarmed": prewarmed,
+                "applied": applied,
+                "cold_start_s": round(self.cold_start_s(role), 3)}
+
+    # -- live thread --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._kick.wait(timeout=self.cfg.tick_s)
+            self._kick.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 - the loop never dies; a
+                # failed tick is retried next interval
+                log.exception("autoscaler tick failed")
+
+    def snapshot(self) -> dict:
+        out = {"stats": dict(self.stats), "roles": {}}
+        for role, st in self._states.items():
+            out["roles"][role] = {
+                "desired": st.desired,
+                "in_panic": self.clock() < st.panic_until,
+                "cold_start_s": round(self.cold_start_s(role), 3),
+            }
+        return out
+
+
+class ElasticFleet(ScalingTarget):
+    """Binds the control loop to a live :class:`~kubernetes_cloud_tpu.
+    serve.fleet.FleetRouter`: spawn = factory-build a replica on a
+    spawner thread, register it with the router, probe it healthy,
+    feed the measured cold start back; drain = the fleet's zero-drop
+    machinery (stop routing → transplant queued → wait out in-flight →
+    stop → deregister).
+
+    ``factory(role, replica_id)`` returns an **unloaded**
+    :class:`~kubernetes_cloud_tpu.serve.fleet.LocalReplica`; the
+    spawner thread pays ``load()`` (weights + warmup compile) so the
+    measured cold start is honest.  Replicas the router already held
+    at attach time join their role's pool (by probed role) and are
+    drainable like spawned ones."""
+
+    def __init__(self, router, factory, cfg: AutoscalerConfig, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self.router = router
+        self.factory = factory
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._starting: dict[str, int] = {}
+        self._draining: dict[str, int] = {}
+        self._spawn_seq = 0
+        self._arrival_role = ("prefill" if "prefill" in cfg.roles
+                              else "colocated")
+        self.autoscaler = Autoscaler(self, cfg, clock=clock)
+        self.activator = Activator(
+            max_hold_s=cfg.activator_max_hold_s,
+            on_demand=self.autoscaler.kick)
+        router.attach_activator(self.activator)
+        for replica in router.replicas:
+            self._wire_supervisor(replica)
+
+    def _wire_supervisor(self, replica) -> None:
+        """A supervised replica's restarts/circuit-opens change ready
+        capacity mid-tick — point the supervisor's capacity hook at
+        the control loop so it re-evaluates immediately."""
+        server = getattr(replica, "server", None)
+        if server is None:
+            return
+        for model in getattr(server, "models", {}).values():
+            sup = getattr(model, "supervisor", None)
+            if sup is not None and hasattr(sup, "on_capacity_change"):
+                sup.on_capacity_change = self.autoscaler.kick
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.router.start_probing()
+        self.autoscaler.start()
+
+    def stop(self) -> None:
+        self.autoscaler.stop()
+
+    # -- ScalingTarget ------------------------------------------------------
+
+    def roles(self) -> Sequence[str]:
+        return tuple(self.cfg.roles)
+
+    def signals(self, role: str) -> PoolSignals:
+        agg = self.router.role_signals().get(role, {})
+        with self._lock:
+            starting = self._starting.get(role, 0)
+            draining = self._draining.get(role, 0)
+        arrivals = (self.router.stats.get("arrivals", 0)
+                    if role == self._arrival_role else 0)
+        act_depth = (self.activator.depth
+                     if role == self._arrival_role else 0)
+        return PoolSignals(
+            ready=int(agg.get("ready", 0)), starting=starting,
+            draining=draining,
+            concurrency=float(agg.get("concurrency", 0.0)),
+            activator_depth=act_depth, arrivals=arrivals)
+
+    def scale_up(self, role: str, n: int) -> int:
+        begun = 0
+        for _ in range(max(n, 0)):
+            with self._lock:
+                self._spawn_seq += 1
+                rid = f"as-{role}-{self._spawn_seq}"
+                self._starting[role] = self._starting.get(role, 0) + 1
+            threading.Thread(target=self._spawn, args=(role, rid),
+                             daemon=True,
+                             name=f"spawn-{rid}").start()
+            begun += 1
+        return begun
+
+    def _spawn(self, role: str, rid: str) -> None:
+        t0 = time.monotonic()
+        try:
+            replica = self.factory(role, rid)
+            load = getattr(replica, "load", None)
+            if callable(load):
+                load()
+            replica.health.role = role
+            self._wire_supervisor(replica)
+            self.router.add_replica(replica)
+            healthy = self.router._wait_healthy(replica)
+            if healthy:
+                replica.health.force_active()
+                self.autoscaler.note_cold_start(
+                    role, time.monotonic() - t0)
+                self.activator.notify_capacity()
+                log.info("%s: spawned %s in %.2fs", role, rid,
+                         time.monotonic() - t0)
+            else:
+                # a spawn that never probes healthy is removed, not
+                # left haunting the pool as a permanently-ejected ghost
+                replica.health.eject("probe")
+                self.router.remove_replica(rid)
+                log.error("%s: spawn %s never probed healthy", role,
+                          rid)
+        except Exception:  # noqa: BLE001 - a failed spawn must not
+            # kill the spawner; the control loop will try again
+            log.exception("%s: spawn %s failed", role, rid)
+            try:
+                self.router.remove_replica(rid)
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                log.debug("%s: cleanup of failed spawn %s", role, rid)
+        finally:
+            with self._lock:
+                self._starting[role] = max(
+                    self._starting.get(role, 1) - 1, 0)
+
+    def scale_down(self, role: str, n: int) -> int:
+        """Drain the least-loaded ``n`` active replicas of ``role``
+        through the zero-drop path."""
+        from kubernetes_cloud_tpu.serve.fleet import ACTIVE, HALF_OPEN
+
+        victims = sorted(
+            (r for r in self.router.replicas
+             if r.health.role == role
+             and r.health.state in (ACTIVE, HALF_OPEN)
+             and getattr(r, "restartable", False)),
+            key=lambda r: r.load_score())[:max(n, 0)]
+        for r in victims:
+            r.health.begin_drain()
+            with self._lock:
+                self._draining[role] = self._draining.get(role, 0) + 1
+            threading.Thread(target=self._drain, args=(role, r),
+                             daemon=True,
+                             name=f"drain-{r.id}").start()
+        return len(victims)
+
+    def _drain(self, role: str, replica) -> None:
+        try:
+            self.router._transplant_from(replica)
+            deadline = time.monotonic() + self.cfg.drain_timeout_s
+            while replica.inflight > 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            server = getattr(replica, "server", None)
+            if server is not None:
+                for model in server.models.values():
+                    stop = getattr(model, "stop", None)
+                    if callable(stop):
+                        stop()
+            self.router.remove_replica(replica.id)
+            log.info("%s: drained and removed %s", role, replica.id)
+        except Exception:  # noqa: BLE001 - a failed drain leaves the
+            # replica draining (no traffic) rather than dropping work
+            log.exception("%s: drain of %s failed", role, replica.id)
+        finally:
+            with self._lock:
+                self._draining[role] = max(
+                    self._draining.get(role, 1) - 1, 0)
+
+    def snapshot(self) -> dict:
+        return {"autoscaler": self.autoscaler.snapshot(),
+                "activator": {"depth": self.activator.depth,
+                              **self.activator.stats},
+                "fleet": self.router.snapshot()}

@@ -36,11 +36,26 @@ The techniques are the "Tail at Scale" toolkit (Dean & Barroso, CACM
   greedy decoding makes the retried output token-identical by
   construction.
 * **Hedged dispatch**.  A request still *queued-not-admitted* on its
-  replica after ``hedge_after_s`` (the engine's ``request_phase`` — a
+  replica after the hedge delay (the engine's ``request_phase`` — a
   request that started decoding is never duplicated) is mirrored to a
   second replica; the first response wins and the loser is cancelled
   through the existing ``cancel()`` path (in-process directly, remote
-  via ``POST /v1/models/<m>:cancel``).
+  via ``POST /v1/models/<m>:cancel``).  The delay is adaptive (the
+  full Tail-at-Scale recipe): ``hedge_ttft_factor`` × the rolling
+  per-role TTFT quantile observed on winning responses, floored at
+  the fixed ``hedge_after_s`` knob for backward compat — a fleet
+  whose TTFT breathes with load hedges at "slower than peers right
+  now", not at a constant tuned for yesterday's load.
+* **Elastic membership + activator**.  ``add_replica`` /
+  ``remove_replica`` change the pool copy-on-write under a lock while
+  dispatch threads keep routing over their list snapshot, and an
+  attached :class:`~kubernetes_cloud_tpu.serve.autoscaler.Activator`
+  turns "no routable replica" from an instant 503 into Knative's
+  hold-and-replay: the request parks (its park IS the scale-up
+  signal), a spawned replica probes healthy, the request re-picks and
+  dispatches exactly once — scale-from-zero with zero drops and zero
+  duplicate prefills.  :class:`~kubernetes_cloud_tpu.serve.
+  autoscaler.ElasticFleet` drives both through the control loop.
 * **Zero-drop rolling restarts** (:meth:`FleetRouter.rolling_restart`).
   One replica at a time: stop routing to it, transplant its
   never-claimed queue through the router into its peers (the engines'
@@ -87,6 +102,7 @@ import urllib.request
 from typing import Any, Mapping, Optional, Sequence
 
 from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.serve.autoscaler import RollingDigest
 from kubernetes_cloud_tpu.serve.errors import (
     ReplicaUnavailableError,
     RetryableError,
@@ -186,10 +202,20 @@ class FleetConfig:
     retry_budget_ratio: float = 0.2
     retry_budget_burst: float = 10.0
     #: hedge a request still queued-not-admitted after this long; None
-    #: disables hedging
+    #: disables hedging.  With the live-TTFT digest active this is the
+    #: FLOOR under the adaptive delay, not the delay itself
     hedge_after_s: Optional[float] = None
     #: rolling restart: bound on waiting a rebuilt replica healthy
     restart_probe_timeout_s: float = 60.0
+    #: adaptive hedging: delay = max(hedge_after_s, this quantile of
+    #: the rolling per-role TTFT digest x ``hedge_ttft_factor``); None
+    #: falls back to the fixed hedge_after_s alone.  Only consulted
+    #: while hedging is enabled (hedge_after_s set) and the digest has
+    #: ``hedge_ttft_min_samples`` in its ``hedge_ttft_window_s``
+    hedge_ttft_quantile: Optional[float] = 0.95
+    hedge_ttft_factor: float = 2.0
+    hedge_ttft_min_samples: int = 20
+    hedge_ttft_window_s: float = 60.0
 
     def __post_init__(self):
         if self.probe_interval_s <= 0 or self.probe_timeout_s <= 0:
@@ -204,6 +230,14 @@ class FleetConfig:
             raise ValueError("retry knobs must be >= 0")
         if self.hedge_after_s is not None and self.hedge_after_s <= 0:
             raise ValueError("hedge_after_s must be > 0 (None disables)")
+        if (self.hedge_ttft_quantile is not None
+                and not 0 < self.hedge_ttft_quantile <= 1):
+            raise ValueError("hedge_ttft_quantile must be in (0, 1] "
+                             "(None disables the adaptive delay)")
+        if self.hedge_ttft_factor <= 0 or self.hedge_ttft_window_s <= 0:
+            raise ValueError("hedge_ttft factor/window must be > 0")
+        if self.hedge_ttft_min_samples < 1:
+            raise ValueError("hedge_ttft_min_samples must be >= 1")
 
 
 class RetryBudget:
@@ -665,8 +699,11 @@ class FleetRouter(ModelServer):
 
     def __init__(self, replicas: Sequence[Replica],
                  cfg: FleetConfig = FleetConfig(), *,
-                 host: str = "0.0.0.0", port: int = 8080):
-        if not replicas:
+                 host: str = "0.0.0.0", port: int = 8080,
+                 allow_empty: bool = False):
+        if not replicas and not allow_empty:
+            # an elastic fleet (autoscaler-owned membership, possibly
+            # scaled to zero behind the activator) opts in explicitly
             raise ValueError("a fleet needs at least one replica")
         ids = [r.id for r in replicas]
         if len(set(ids)) != len(ids):
@@ -678,14 +715,24 @@ class FleetRouter(ModelServer):
                                         cfg.retry_budget_burst)
         #: the fleet-wide WFQ ledger (serve/tenancy.FleetClock)
         self.clock = FleetClock()
+        #: scale-from-zero hold-and-replay (attach_activator)
+        self.activator = None
+        #: rolling per-role TTFT digests feeding the adaptive hedge
+        #: delay (observed from winning response bodies)
+        self._ttft_digests: dict[str, RollingDigest] = {}
         self._probe_stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
         #: serializes rolling restarts (two sweeps would double-drain)
         self._restart_lock = threading.Lock()
+        #: serializes membership writes; readers ride list snapshots
+        #: (replacement, never mutation — the copy-on-write idiom)
+        self._replica_lock = threading.Lock()
         self.stats = {"dispatches": 0, "retries": 0, "retried_ok": 0,
                       "retry_budget_exhausted": 0, "hedges": 0,
                       "hedge_wins": 0, "rerouted": 0, "unplaceable": 0,
-                      "transplanted": 0, "rolling_restarts": 0}
+                      "transplanted": 0, "rolling_restarts": 0,
+                      "arrivals": 0, "activator_held": 0,
+                      "activator_replayed": 0}
         #: stats increments come from concurrent HTTP dispatch
         #: threads; dict += is a read-modify-write that loses updates
         #: without this (the bench reports these numbers)
@@ -698,6 +745,58 @@ class FleetRouter(ModelServer):
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
             self.stats[key] += n
+
+    # -- elastic membership ------------------------------------------------
+
+    def add_replica(self, replica: Replica) -> None:
+        """Register a (spawned) replica.  Membership changes replace
+        ``self.replicas`` wholesale under the lock; in-flight dispatch
+        threads keep iterating their own list snapshot, so routing
+        never races a resize."""
+        with self._replica_lock:
+            if any(r.id == replica.id for r in self.replicas):
+                raise ValueError(f"duplicate replica id: {replica.id}")
+            self.replicas = [*self.replicas, replica]
+        attach = getattr(replica, "attach_clock", None)
+        if attach is not None:
+            attach(self.clock)
+        self._refresh_state_gauge()
+
+    def remove_replica(self, replica_id: str) -> Optional["Replica"]:
+        """Deregister a (drained) replica; returns it, or None if the
+        id is not a member.  The caller owns stopping its workers."""
+        with self._replica_lock:
+            found = next((r for r in self.replicas
+                          if r.id == replica_id), None)
+            if found is not None:
+                self.replicas = [r for r in self.replicas
+                                 if r is not found]
+        if found is not None:
+            self._refresh_state_gauge()
+        return found
+
+    def attach_activator(self, activator) -> None:
+        """Arm scale-from-zero hold-and-replay (serve/autoscaler.
+        :class:`Activator`): a request that finds NO routable replica
+        parks on the activator (the park itself pokes the control
+        loop) instead of failing unplaceable, and re-picks when a
+        spawn probes healthy — dispatched exactly once, after
+        capacity exists."""
+        self.activator = activator
+
+    def role_signals(self) -> dict[str, dict]:
+        """Per-role pool signals for the autoscaler: ready (routable)
+        replica count and observed concurrency (router-tracked
+        in-flight + last-probed admission queue depth)."""
+        out: dict[str, dict] = {}
+        for r in self.replicas:
+            agg = out.setdefault(r.health.role,
+                                 {"ready": 0, "concurrency": 0.0})
+            if r.health.state in (ACTIVE, HALF_OPEN):
+                agg["ready"] += 1
+                agg["concurrency"] += (r.inflight
+                                       + r.health.queue_depth)
+        return out
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -859,6 +958,8 @@ class FleetRouter(ModelServer):
         body = json.dumps(payload).encode()
         rid = payload.get("request_id")
         self.retry_budget.deposit()
+        self._bump("arrivals")
+        hold_deadline: Optional[float] = None
         retries = dispatches = 0
         hedged = hedge_win = rerouted = False
         tried: list[Replica] = []
@@ -894,6 +995,23 @@ class FleetRouter(ModelServer):
             replica, trial, skipped = self._pick(tried)
             rerouted = rerouted or skipped
             if replica is None:
+                act = self.activator
+                if act is not None and last_failure is None:
+                    # scale-from-zero: no routable replica and nothing
+                    # failed yet — park on the activator (whose park
+                    # pokes the control loop) and re-pick on capacity.
+                    # Total held time is bounded by the activator's
+                    # max_hold_s however many wake/re-park rounds the
+                    # race takes; past the deadline the request falls
+                    # through to the retryable-unplaceable contract.
+                    if hold_deadline is None:
+                        hold_deadline = (time.monotonic()
+                                         + act.max_hold_s)
+                        self._bump("activator_held")
+                    if (time.monotonic() < hold_deadline
+                            and act.hold(deadline=hold_deadline)):
+                        self._bump("activator_replayed")
+                        continue
                 self._bump("unplaceable")
                 _M_UNPLACEABLE.inc()
                 if last_failure is not None:
@@ -974,8 +1092,9 @@ class FleetRouter(ModelServer):
         hedge_replica: Optional[Replica] = None
         hedge_trial = False
         deadline = time.monotonic() + self.cfg.dispatch_timeout_s
-        hedge_at = (time.monotonic() + self.cfg.hedge_after_s
-                    if self.cfg.hedge_after_s is not None else None)
+        hedge_delay = self._hedge_delay(replica.health.role)
+        hedge_at = (time.monotonic() + hedge_delay
+                    if hedge_delay is not None else None)
         first_failure: Optional[tuple[int, dict]] = None
         while pending:
             now = time.monotonic()
@@ -1004,6 +1123,7 @@ class FleetRouter(ModelServer):
                 trial=is_trial)
             self._note_dispatch_metrics(rep, status, event)
             if ok:
+                self._observe_ttft(rep, obj)
                 # winner: cancel the losing leg through cancel(); a
                 # loser holding a half-open trial claim gets it back —
                 # its result will never be consumed, and a leaked
@@ -1052,6 +1172,43 @@ class FleetRouter(ModelServer):
         status, obj, failed_id = first_failure or (
             0, {"error": "dispatch produced no result"}, replica.id)
         return status, obj, hedged, False, failed_id
+
+    def _hedge_delay(self, role: str) -> Optional[float]:
+        """The Tail-at-Scale adaptive hedge trigger: ``hedge_ttft_
+        factor`` × the rolling per-role TTFT quantile, floored at the
+        fixed ``hedge_after_s`` knob.  ``hedge_after_s is None`` keeps
+        hedging disabled (backward compat — the digest never *enables*
+        hedging, it only tunes the delay); a cold or thin digest falls
+        back to the floor."""
+        base = self.cfg.hedge_after_s
+        if base is None or self.cfg.hedge_ttft_quantile is None:
+            return base
+        digest = self._ttft_digests.get(role)
+        if digest is None:
+            return base
+        q = digest.quantile(self.cfg.hedge_ttft_quantile,
+                            min_samples=self.cfg.hedge_ttft_min_samples)
+        if q is None:
+            return base
+        return max(base, q * self.cfg.hedge_ttft_factor)
+
+    def _observe_ttft(self, replica: Replica, obj: Mapping[str, Any]
+                      ) -> None:
+        """Feed the winning response's per-prediction ``ttft_s`` into
+        the replica's role digest (what ``_hedge_delay`` consults)."""
+        preds = obj.get("predictions") if isinstance(obj, dict) else None
+        if not isinstance(preds, list):
+            return
+        role = replica.health.role
+        digest = self._ttft_digests.get(role)
+        if digest is None:
+            digest = self._ttft_digests.setdefault(
+                role,
+                RollingDigest(window_s=self.cfg.hedge_ttft_window_s))
+        for p in preds:
+            ttft = p.get("ttft_s") if isinstance(p, dict) else None
+            if ttft is not None:
+                digest.observe(float(ttft))
 
     def _maybe_hedge(self, primary: Replica, path: str, body: bytes,
                      rid: Optional[str], tried: Sequence[Replica],

@@ -1,0 +1,719 @@
+"""Region-scale traffic simulator for the elastic autoscaler.
+
+The live fleet tests can exercise scale-from-zero and a flash crowd at
+the scale of a laptop: a handful of replicas, seconds of traffic.  The
+paper's regime is the other end — a region of Knative services riding
+multi-hour diurnal load with occasional flash crowds, where the
+question is not "does the control loop work" but "what does it COST":
+cost-normalized goodput, SLO-violation minutes, how long a scale
+reaction takes.  This module answers at that scale by simulation:
+
+* **Workload** (:class:`WorkloadConfig`): an open-loop inhomogeneous
+  Poisson arrival process — diurnal sinusoid × :class:`FlashCrowd`
+  multipliers — thinning-sampled (:func:`~kubernetes_cloud_tpu.serve.
+  trace.thinning_arrivals`), with every request drawn from a Zipf
+  population of millions of users via O(1) inversion
+  (:func:`~kubernetes_cloud_tpu.serve.trace.zipf_user`).  Everything
+  derives from one seed; the same config reproduces the same run
+  bit-for-bit.
+* **Fleet model** (:class:`SimFleet` / :class:`_Pool`): per-role pools
+  of :class:`SimReplica` s — slot-limited servers with configured
+  prefill/decode token rates and measured-jitter cold starts — behind
+  a pool FIFO (the router queue: freshly-ready replicas absorb the
+  backlog, which is what the live router's transplant/least-loaded
+  machinery does).  An empty pool holds arrivals activator-style and
+  replays them when the first replica turns ready; a hold outliving
+  ``max_hold_s`` is a **dropped** request (the acceptance criterion
+  says the autoscaled arm must never produce one).  Optionally
+  disaggregated: prefill pool → decode pool as a two-stage tandem
+  queue, each sized by its own :class:`~kubernetes_cloud_tpu.serve.
+  autoscaler.RolePolicy`.
+* **The real controller**: :class:`SimFleet` implements
+  :class:`~kubernetes_cloud_tpu.serve.autoscaler.ScalingTarget`, so
+  the simulator steps the ACTUAL :class:`~kubernetes_cloud_tpu.serve.
+  autoscaler.Autoscaler` — panic windows, pre-warming, hysteresis,
+  measured cold-start feedback and all — under a virtual clock.  The
+  BENCHMARKS.md numbers exercise the shipping control loop, not a
+  model of it.
+* **Report** (:func:`run_scenario` / :func:`compare_fleets`):
+  per-request TTFT/TPOT against the SLO, **cost-normalized goodput**
+  (SLO-meeting output tokens per replica-second paid),
+  **SLO-violation minutes** (wall minutes whose completions miss the
+  attainment bar), per-flash-crowd **reaction** (first scale-up after
+  onset) and **recovery** (backlog back under the pool's target)
+  times.  ``compare_fleets`` runs the same workload through the
+  autoscaled fleet, a fixed minimal fleet, and a fixed peak-sized
+  fleet — the A/B/C lane ``bench_serving --autoscale`` publishes.
+
+The simulator is pure Python + ``random`` — no jax, no threads, no
+wall clock — so the tier-1 smoke scenario finishes in well under a
+second and the multi-hour region runs are just bigger loops
+(``@pytest.mark.slow``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import math
+import random
+from collections import deque
+from typing import Callable, Mapping, Optional, Sequence
+
+from kubernetes_cloud_tpu.serve.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    PoolSignals,
+    RolePolicy,
+    ScalingTarget,
+)
+from kubernetes_cloud_tpu.serve.trace import thinning_arrivals, zipf_user
+
+
+class VirtualClock:
+    """The simulation's time source (monotonic, manually advanced).
+    Injected as the :class:`Autoscaler`'s ``clock`` so the control
+    loop's windows, cooldowns, and cold-start math run entirely in
+    simulated time — a 4-hour region day replays in seconds."""
+
+    def __init__(self, t0: float = 0.0):
+        self._now = float(t0)
+
+    def now(self) -> float:
+        return self._now
+
+    def advance_to(self, t: float) -> None:
+        if t < self._now:
+            raise ValueError("virtual clock cannot go backwards")
+        self._now = t
+
+
+@dataclasses.dataclass(frozen=True)
+class FlashCrowd:
+    """One flash-crowd event: the arrival rate multiplies by
+    ``multiplier``, ramping linearly over ``ramp_s`` at each edge."""
+
+    at_s: float
+    duration_s: float
+    multiplier: float = 6.0
+    ramp_s: float = 10.0
+
+    def __post_init__(self):
+        if self.at_s < 0 or self.duration_s <= 0:
+            raise ValueError("flash crowd timing must be >= 0 / > 0")
+        if self.multiplier < 1.0:
+            raise ValueError("multiplier must be >= 1")
+        if self.ramp_s < 0 or 2 * self.ramp_s > self.duration_s:
+            raise ValueError("ramps must fit inside the crowd")
+
+    def multiplier_at(self, t: float) -> float:
+        dt = t - self.at_s
+        if dt < 0 or dt > self.duration_s:
+            return 1.0
+        if self.ramp_s > 0:
+            edge = min(dt, self.duration_s - dt, self.ramp_s) \
+                / self.ramp_s
+        else:
+            edge = 1.0
+        return 1.0 + (self.multiplier - 1.0) * edge
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadConfig:
+    """The open-loop region workload: diurnal sinusoid × flash
+    crowds, Zipf users, mixed request shapes."""
+
+    duration_s: float = 600.0
+    base_rps: float = 4.0
+    diurnal_period_s: float = 600.0
+    diurnal_amplitude: float = 0.6
+    flash_crowds: tuple[FlashCrowd, ...] = ()
+    n_users: int = 1_000_000
+    zipf_s: float = 1.3
+    #: uniform prompt / output token ranges (inclusive)
+    prompt_tokens: tuple[int, int] = (16, 96)
+    output_tokens: tuple[int, int] = (8, 48)
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.duration_s <= 0 or self.base_rps <= 0:
+            raise ValueError("duration_s and base_rps must be > 0")
+        if not 0 <= self.diurnal_amplitude < 1:
+            raise ValueError("diurnal_amplitude must be in [0, 1)")
+        if self.diurnal_period_s <= 0:
+            raise ValueError("diurnal_period_s must be > 0")
+        for lo, hi in (self.prompt_tokens, self.output_tokens):
+            if lo < 1 or hi < lo:
+                raise ValueError("token ranges must be 1 <= lo <= hi")
+        for fc in self.flash_crowds:
+            if fc.at_s + fc.duration_s > self.duration_s:
+                raise ValueError("flash crowd exceeds the workload")
+
+    def rate(self, t: float) -> float:
+        lam = self.base_rps * (1.0 + self.diurnal_amplitude * math.sin(
+            2 * math.pi * t / self.diurnal_period_s))
+        for fc in self.flash_crowds:
+            lam *= fc.multiplier_at(t)
+        return max(lam, 0.0)
+
+    def rate_max(self) -> float:
+        peak = self.base_rps * (1.0 + self.diurnal_amplitude)
+        for fc in self.flash_crowds:
+            peak *= fc.multiplier
+        return peak
+
+    def sample(self, rng: random.Random) -> list["SimRequest"]:
+        times = thinning_arrivals(rng, self.duration_s, self.rate,
+                                  self.rate_max())
+        plo, phi = self.prompt_tokens
+        olo, ohi = self.output_tokens
+        return [SimRequest(
+            rid=i, t_arrive=t,
+            user=zipf_user(rng, self.n_users, self.zipf_s),
+            prompt_tokens=rng.randint(plo, phi),
+            max_new_tokens=rng.randint(olo, ohi),
+        ) for i, t in enumerate(times)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaModel:
+    """What one simulated replica can do (calibrate from the fused
+    decode bench: tokens/s per slot, not per chip)."""
+
+    slots: int = 4
+    prefill_tps: float = 2000.0
+    decode_tps: float = 40.0
+    cold_start_s: float = 8.0
+    #: uniform ±fraction jitter on each cold start (what the measured
+    #: EWMA prior has to track)
+    cold_start_jitter: float = 0.25
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError("slots must be >= 1")
+        if self.prefill_tps <= 0 or self.decode_tps <= 0:
+            raise ValueError("token rates must be > 0")
+        if self.cold_start_s <= 0:
+            raise ValueError("cold_start_s must be > 0")
+        if not 0 <= self.cold_start_jitter < 1:
+            raise ValueError("cold_start_jitter must be in [0, 1)")
+
+
+@dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """Per-request SLOs and the per-minute attainment bar."""
+
+    ttft_s: float = 2.5
+    tpot_s: float = 0.1
+    minute_attainment: float = 0.99
+
+    def __post_init__(self):
+        if self.ttft_s <= 0 or self.tpot_s <= 0:
+            raise ValueError("SLOs must be > 0")
+        if not 0 < self.minute_attainment <= 1:
+            raise ValueError("minute_attainment must be in (0, 1]")
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Simulator mechanics (distinct from the workload and the
+    controller under test)."""
+
+    tick_s: float = 0.1
+    #: activator bound: a request held this long with its pool still
+    #: empty is dropped (the figure the acceptance criterion pins to
+    #: zero for the autoscaled arm)
+    max_hold_s: float = 30.0
+    #: run past the last arrival to let in-flight work finish
+    drain_grace_s: float = 120.0
+    #: prefill pool → decode pool tandem instead of colocated
+    disaggregated: bool = False
+    replica: ReplicaModel = ReplicaModel()
+    slo: SloConfig = SloConfig()
+
+    def __post_init__(self):
+        if self.tick_s <= 0:
+            raise ValueError("tick_s must be > 0")
+        if self.max_hold_s <= 0 or self.drain_grace_s < 0:
+            raise ValueError("max_hold_s/drain_grace_s must be valid")
+
+
+class SimRequest:
+    """One request's lifecycle timestamps (filled in as it flows)."""
+
+    __slots__ = ("rid", "t_arrive", "user", "prompt_tokens",
+                 "max_new_tokens", "t_first", "t_done", "dropped")
+
+    def __init__(self, rid: int, t_arrive: float, user: int,
+                 prompt_tokens: int, max_new_tokens: int):
+        self.rid = rid
+        self.t_arrive = t_arrive
+        self.user = user
+        self.prompt_tokens = prompt_tokens
+        self.max_new_tokens = max_new_tokens
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.dropped = False
+
+
+#: SimReplica lifecycle
+_STARTING, _READY, _DRAINING, _GONE = "starting", "ready", "draining", \
+    "gone"
+
+
+class SimReplica:
+    __slots__ = ("rid", "state", "ready_at", "active", "t_spawn")
+
+    def __init__(self, rid: str, spawn_t: float, ready_at: float):
+        self.rid = rid
+        self.state = _STARTING
+        self.t_spawn = spawn_t
+        self.ready_at = ready_at
+        self.active = 0  # in-service requests (slot occupancy)
+
+
+class _Pool:
+    """One role's replica pool: FIFO router queue in front of
+    slot-limited replicas.  ``service(req)`` returns (ttft_offset_s,
+    total_service_s) for this pool's stage."""
+
+    def __init__(self, role: str, model: ReplicaModel,
+                 service: Callable[[SimRequest], tuple[float, float]]):
+        self.role = role
+        self.model = model
+        self.service = service
+        self.replicas: list[SimReplica] = []
+        self.queue: deque[tuple[SimRequest, float]] = deque()
+        self.arrivals = 0
+        self.next_stage: Optional["_Pool"] = None
+        self.scale_log: list[tuple[float, int]] = []  # (t, +n/-n)
+        self._seq = 0
+
+    # -- membership --------------------------------------------------------
+
+    def ready(self) -> list[SimReplica]:
+        return [r for r in self.replicas if r.state == _READY]
+
+    def counts(self) -> tuple[int, int, int]:
+        s = sum(1 for r in self.replicas if r.state == _STARTING)
+        rd = sum(1 for r in self.replicas if r.state == _READY)
+        d = sum(1 for r in self.replicas if r.state == _DRAINING)
+        return rd, s, d
+
+    def alive(self) -> int:
+        return sum(1 for r in self.replicas if r.state != _GONE)
+
+    def spawn(self, now: float, rng: random.Random) -> SimReplica:
+        self._seq += 1
+        j = self.model.cold_start_jitter
+        cold = self.model.cold_start_s * (
+            1.0 + rng.uniform(-j, j) if j else 1.0)
+        rep = SimReplica(f"{self.role}-{self._seq}", now, now + cold)
+        self.replicas.append(rep)
+        self.scale_log.append((now, 1))
+        return rep
+
+    def drain(self, now: float, n: int) -> int:
+        victims = sorted(self.ready(), key=lambda r: r.active)[:n]
+        for r in victims:
+            r.state = _DRAINING
+            if r.active == 0:
+                r.state = _GONE
+            self.scale_log.append((now, -1))
+        return len(victims)
+
+    def mark_ready(self, now: float,
+                   on_cold_start: Optional[Callable[[str, float], None]]
+                   ) -> int:
+        """STARTING replicas whose cold start elapsed turn READY; the
+        measured duration feeds the controller's prior."""
+        turned = 0
+        for r in self.replicas:
+            if r.state == _STARTING and r.ready_at <= now:
+                r.state = _READY
+                turned += 1
+                if on_cold_start is not None:
+                    on_cold_start(self.role, r.ready_at - r.t_spawn)
+        return turned
+
+    # -- data path ---------------------------------------------------------
+
+    def submit(self, req: SimRequest, t: float) -> None:
+        self.arrivals += 1
+        self.queue.append((req, t))
+
+    def in_system(self) -> int:
+        return sum(r.active for r in self.replicas
+                   if r.state in (_READY, _DRAINING)) + len(self.queue)
+
+    def dispatch(self, now: float, done_heap: list, seq: list,
+                 max_hold_s: float, dropped: list) -> None:
+        """Pull queued work into free slots (least-loaded first); age
+        out holds that outlived ``max_hold_s`` with the pool still
+        empty — the activator's bound."""
+        if not self.queue:
+            return
+        ready = self.ready()
+        if not ready:
+            while self.queue and now - self.queue[0][1] >= max_hold_s:
+                req, _t = self.queue.popleft()
+                req.dropped = True
+                dropped.append(req)
+            return
+        while self.queue:
+            rep = min(ready, key=lambda r: r.active)
+            if rep.active >= self.model.slots:
+                return
+            req, _enq = self.queue.popleft()
+            rep.active += 1
+            ttft_off, svc = self.service(req)
+            if req.t_first is None and ttft_off is not None:
+                req.t_first = now + ttft_off
+            seq[0] += 1
+            heapq.heappush(done_heap,
+                           (now + svc, seq[0], self, rep, req))
+
+    def complete(self, rep: SimReplica, req: SimRequest, t: float
+                 ) -> None:
+        rep.active -= 1
+        if rep.state == _DRAINING and rep.active == 0:
+            rep.state = _GONE
+        if self.next_stage is not None:
+            self.next_stage.submit(req, t)
+        else:
+            req.t_done = t
+
+
+class SimFleet(ScalingTarget):
+    """The simulated fleet: one pool per role, implementing
+    :class:`ScalingTarget` so the REAL autoscaler sizes it."""
+
+    def __init__(self, cfg: SimConfig, rng: random.Random):
+        self.cfg = cfg
+        self.rng = rng
+        self.on_cold_start: Optional[Callable[[str, float], None]] = None
+        m = cfg.replica
+        if cfg.disaggregated:
+            prefill = _Pool(
+                "prefill", m,
+                lambda r: (r.prompt_tokens / m.prefill_tps,
+                           r.prompt_tokens / m.prefill_tps))
+            decode = _Pool(
+                "decode", m,
+                lambda r: (None, r.max_new_tokens / m.decode_tps))
+            prefill.next_stage = decode
+            self.pools = {"prefill": prefill, "decode": decode}
+            self.admit_pool = prefill
+        else:
+            pool = _Pool(
+                "colocated", m,
+                lambda r: (r.prompt_tokens / m.prefill_tps,
+                           r.prompt_tokens / m.prefill_tps
+                           + r.max_new_tokens / m.decode_tps))
+            self.pools = {"colocated": pool}
+            self.admit_pool = pool
+        self._done_heap: list = []
+        self._seq = [0]
+        self.dropped: list[SimRequest] = []
+
+    def provision(self, counts: Mapping[str, int]) -> None:
+        """Pre-warm ``counts[role]`` replicas, ready at t=0 (initial
+        pools for every arm; the fixed arms never change them)."""
+        for role, n in counts.items():
+            pool = self.pools[role]
+            for _ in range(n):
+                rep = pool.spawn(0.0, self.rng)
+                rep.ready_at = 0.0
+                rep.state = _READY
+            del pool.scale_log[:]  # provisioning is not a scale event
+
+    # -- ScalingTarget ------------------------------------------------------
+
+    def roles(self) -> Sequence[str]:
+        return tuple(self.pools)
+
+    def signals(self, role: str) -> PoolSignals:
+        pool = self.pools[role]
+        ready, starting, draining = pool.counts()
+        qlen = len(pool.queue)
+        active = sum(r.active for r in pool.replicas
+                     if r.state in (_READY, _DRAINING))
+        held = qlen if ready == 0 else 0
+        return PoolSignals(
+            ready=ready, starting=starting, draining=draining,
+            concurrency=active + (qlen - held),
+            activator_depth=held, arrivals=pool.arrivals)
+
+    def scale_up(self, role: str, n: int) -> int:
+        now = self._now
+        for _ in range(max(n, 0)):
+            self.pools[role].spawn(now, self.rng)
+        return max(n, 0)
+
+    def scale_down(self, role: str, n: int) -> int:
+        return self.pools[role].drain(self._now, max(n, 0))
+
+    # -- tick mechanics ------------------------------------------------------
+
+    _now = 0.0
+
+    def advance(self, now: float) -> None:
+        """One tick: readiness transitions, completions up to ``now``
+        (stage hops included), then queue→slot dispatch."""
+        self._now = now
+        for pool in self.pools.values():
+            pool.mark_ready(now, self.on_cold_start)
+        while self._done_heap and self._done_heap[0][0] <= now:
+            t, _s, pool, rep, req = heapq.heappop(self._done_heap)
+            pool.complete(rep, req, t)
+        for pool in self.pools.values():
+            pool.dispatch(now, self._done_heap, self._seq,
+                          self.cfg.max_hold_s, self.dropped)
+
+    def in_system(self) -> int:
+        return sum(p.in_system() for p in self.pools.values())
+
+    def alive(self) -> int:
+        return sum(p.alive() for p in self.pools.values())
+
+
+def _percentile(xs: list[float], p: float) -> Optional[float]:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(p * len(xs)))], 4)
+
+
+def run_scenario(workload: WorkloadConfig, sim: SimConfig, *,
+                 mode: str = "autoscaled",
+                 autoscaler_cfg: Optional[AutoscalerConfig] = None,
+                 fixed_replicas: Optional[Mapping[str, int]] = None,
+                 ) -> dict:
+    """Replay ``workload`` against one fleet arm and report.
+
+    ``mode="autoscaled"`` steps the real :class:`Autoscaler` (pools
+    start at each role's ``min_replicas`` — possibly zero, arriving
+    through the activator hold); ``mode="fixed"`` pins
+    ``fixed_replicas`` for the whole run.  Deterministic for a given
+    (workload, sim, controller) tuple."""
+    if mode not in ("autoscaled", "fixed"):
+        raise ValueError("mode must be autoscaled | fixed")
+    rng = random.Random(workload.seed)
+    requests = workload.sample(rng)
+    clock = VirtualClock()
+    fleet = SimFleet(sim, rng)
+    scaler: Optional[Autoscaler] = None
+    if mode == "autoscaled":
+        cfg = autoscaler_cfg or AutoscalerConfig()
+        scaler = Autoscaler(fleet, cfg, clock=clock.now)
+        fleet.on_cold_start = scaler.note_cold_start
+        fleet.provision({role: pol.min_replicas
+                         for role, pol in cfg.roles.items()
+                         if role in fleet.pools})
+        ctrl_tick = cfg.tick_s
+    else:
+        if not fixed_replicas:
+            raise ValueError("fixed mode needs fixed_replicas")
+        fleet.provision(fixed_replicas)
+        ctrl_tick = None
+
+    horizon = workload.duration_s + sim.drain_grace_s
+    tick = sim.tick_s
+    i = 0
+    t = 0.0
+    next_ctrl = 0.0
+    replica_seconds = 0.0
+    while t < horizon:
+        t = min(t + tick, horizon)
+        clock.advance_to(t)
+        fleet.advance(t)
+        while i < len(requests) and requests[i].t_arrive <= t:
+            fleet.admit_pool.submit(requests[i], requests[i].t_arrive)
+            i += 1
+        if scaler is not None and t >= next_ctrl:
+            scaler.step(now=t)
+            next_ctrl = t + ctrl_tick
+        replica_seconds += fleet.alive() * tick
+        if i >= len(requests) and fleet.in_system() == 0 \
+                and not fleet._done_heap:
+            break
+
+    return _report(workload, sim, fleet, requests, scaler,
+                   replica_seconds, mode)
+
+
+def _report(workload: WorkloadConfig, sim: SimConfig, fleet: SimFleet,
+            requests: list[SimRequest], scaler: Optional[Autoscaler],
+            replica_seconds: float, mode: str) -> dict:
+    slo = sim.slo
+    done = [r for r in requests if r.t_done is not None]
+    dropped = [r for r in requests if r.dropped]
+    unfinished = len(requests) - len(done) - len(dropped)
+    ttfts, tpots = [], []
+    good_tokens = 0
+    total_tokens = 0
+    minute_total: dict[int, int] = {}
+    minute_bad: dict[int, int] = {}
+    for r in done:
+        ttft = (r.t_first if r.t_first is not None else r.t_done) \
+            - r.t_arrive
+        tpot = (r.t_done - (r.t_first if r.t_first is not None
+                            else r.t_arrive)) / max(r.max_new_tokens, 1)
+        ttfts.append(ttft)
+        tpots.append(tpot)
+        ok = ttft <= slo.ttft_s and tpot <= slo.tpot_s
+        total_tokens += r.max_new_tokens
+        if ok:
+            good_tokens += r.max_new_tokens
+        minute = int(r.t_done // 60)
+        minute_total[minute] = minute_total.get(minute, 0) + 1
+        if not ok:
+            minute_bad[minute] = minute_bad.get(minute, 0) + 1
+    for r in dropped:  # a dropped request poisons its arrival minute
+        minute = int(r.t_arrive // 60)
+        minute_total[minute] = minute_total.get(minute, 0) + 1
+        minute_bad[minute] = minute_bad.get(minute, 0) + 1
+    violation_minutes = sum(
+        1 for m, n in minute_total.items()
+        if 1.0 - minute_bad.get(m, 0) / n < slo.minute_attainment)
+
+    crowds = []
+    for fc in workload.flash_crowds:
+        reaction = recovery = None
+        for pool in fleet.pools.values():
+            for ts, delta in pool.scale_log:
+                if delta > 0 and ts >= fc.at_s:
+                    reaction = ts - fc.at_s if reaction is None \
+                        else min(reaction, ts - fc.at_s)
+                    break
+        bad_after = [r.t_done for r in done
+                     if r.t_done is not None and r.t_done >= fc.at_s
+                     and ((r.t_first or r.t_done) - r.t_arrive
+                          > slo.ttft_s)]
+        if bad_after:
+            recovery = max(bad_after) - fc.at_s
+        elif done:
+            recovery = 0.0
+        crowds.append({
+            "at_s": fc.at_s, "multiplier": fc.multiplier,
+            "reaction_s": None if reaction is None
+            else round(reaction, 3),
+            "recovery_s": None if recovery is None
+            else round(recovery, 3),
+        })
+
+    out = {
+        "mode": mode,
+        "requests": len(requests),
+        "completed": len(done),
+        "dropped": len(dropped),
+        "unfinished": unfinished,
+        "users": len({r.user for r in requests}),
+        "slo_attainment": round(good_tokens / total_tokens, 4)
+        if total_tokens else None,
+        "total_tokens": total_tokens,
+        "good_tokens": good_tokens,
+        "replica_seconds": round(replica_seconds, 1),
+        "cost_normalized_goodput": round(
+            good_tokens / replica_seconds, 4) if replica_seconds
+        else 0.0,
+        "slo_violation_minutes": violation_minutes,
+        "minutes_observed": len(minute_total),
+        "ttft_p50_s": _percentile(ttfts, 0.50),
+        "ttft_p95_s": _percentile(ttfts, 0.95),
+        "tpot_p95_s": _percentile(tpots, 0.95),
+        "scale_ups": sum(1 for p in fleet.pools.values()
+                         for _, d in p.scale_log if d > 0),
+        "scale_downs": sum(1 for p in fleet.pools.values()
+                           for _, d in p.scale_log if d < 0),
+        "flash_crowds": crowds,
+        "pools": {role: {"final_alive": pool.alive(),
+                         "arrivals": pool.arrivals}
+                  for role, pool in fleet.pools.items()},
+    }
+    if scaler is not None:
+        out["autoscaler"] = scaler.snapshot()
+    return out
+
+
+def flash_crowd_workload(*, duration_s: float = 1800.0,
+                         base_rps: float = 3.0,
+                         flash_at_s: float = 600.0,
+                         flash_duration_s: float = 240.0,
+                         flash_multiplier: float = 8.0,
+                         seed: int = 0) -> WorkloadConfig:
+    """The canonical acceptance workload: a diurnal half-hour with one
+    hard flash crowd in the middle (bench + tests share it)."""
+    return WorkloadConfig(
+        duration_s=duration_s, base_rps=base_rps,
+        diurnal_period_s=duration_s, diurnal_amplitude=0.5,
+        flash_crowds=(FlashCrowd(at_s=flash_at_s,
+                                 duration_s=flash_duration_s,
+                                 multiplier=flash_multiplier,
+                                 ramp_s=20.0),),
+        seed=seed)
+
+
+def default_autoscaler_cfg(*, max_replicas: int = 16,
+                           min_replicas: int = 1,
+                           target_concurrency: float = 3.0,
+                           role: str = "colocated"
+                           ) -> AutoscalerConfig:
+    """A reasonable single-role controller for simulator runs."""
+    return AutoscalerConfig(
+        tick_s=1.0, stable_window_s=30.0, panic_window_s=6.0,
+        panic_threshold=1.5, scale_down_delay_s=30.0, cooldown_s=5.0,
+        scale_to_zero_grace_s=60.0,
+        roles={role: RolePolicy(min_replicas=min_replicas,
+                                max_replicas=max_replicas,
+                                target_concurrency=target_concurrency)})
+
+
+def peak_replicas(workload: WorkloadConfig, sim: SimConfig,
+                  target_concurrency: float = 3.0) -> int:
+    """Little's-law peak sizing: replicas a fixed fleet needs to hold
+    the SLO at the workload's PEAK rate (what the over-provisioned
+    comparison arm pays for all day)."""
+    m = sim.replica
+    mean_prompt = sum(workload.prompt_tokens) / 2
+    mean_out = sum(workload.output_tokens) / 2
+    service_s = mean_prompt / m.prefill_tps + mean_out / m.decode_tps
+    concurrency = workload.rate_max() * service_s
+    return max(1, math.ceil(concurrency / target_concurrency))
+
+
+def compare_fleets(workload: WorkloadConfig, sim: SimConfig, *,
+                   autoscaler_cfg: Optional[AutoscalerConfig] = None,
+                   min_fleet: int = 1,
+                   peak_fleet: Optional[int] = None) -> dict:
+    """The three-arm A/B/C the acceptance criterion names: the SAME
+    workload through (a) the autoscaled fleet, (b) a fixed minimal
+    fleet (cheap, drowns in the flash crowd), (c) a fixed peak-sized
+    fleet (meets SLO, pays peak all day).  The autoscaled arm must
+    beat BOTH on cost-normalized goodput, with zero drops."""
+    cfg = autoscaler_cfg or default_autoscaler_cfg()
+    role = next(iter(cfg.roles))
+    if role not in ("colocated",) and not sim.disaggregated:
+        raise ValueError("role-split controller needs disaggregated sim")
+    if peak_fleet is None:
+        pol = cfg.roles[role]
+        peak_fleet = min(
+            peak_replicas(workload, sim, pol.target_concurrency),
+            pol.max_replicas)
+    auto = run_scenario(workload, sim, mode="autoscaled",
+                        autoscaler_cfg=cfg)
+    fixed_min = run_scenario(workload, sim, mode="fixed",
+                             fixed_replicas={role: min_fleet})
+    fixed_peak = run_scenario(workload, sim, mode="fixed",
+                              fixed_replicas={role: peak_fleet})
+    g = "cost_normalized_goodput"
+    return {
+        "autoscaled": auto,
+        "fixed_min": fixed_min,
+        "fixed_peak": fixed_peak,
+        "min_fleet": min_fleet,
+        "peak_fleet": peak_fleet,
+        "autoscaled_beats_min": auto[g] > fixed_min[g],
+        "autoscaled_beats_peak": auto[g] > fixed_peak[g],
+        "autoscaled_zero_drops": auto["dropped"] == 0,
+    }

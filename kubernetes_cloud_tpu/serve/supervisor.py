@@ -44,7 +44,7 @@ import dataclasses
 import logging
 import threading
 import time
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 from kubernetes_cloud_tpu import obs
 from kubernetes_cloud_tpu.serve.errors import EngineRestartedError
@@ -248,6 +248,12 @@ class ServingSupervisor:
         self._lock = threading.Lock()  # serializes restart vs health
         self.stats = {"restarts": 0, "hangs": 0, "crashes": 0,
                       "circuit_opens": 0, "requeued": 0}
+        #: optional capacity-change hook (serve/autoscaler.py wires
+        #: ``Autoscaler.kick`` here): a restart beginning/finishing or
+        #: a circuit opening changes this pod's ready capacity, and an
+        #: elastic control loop should re-evaluate NOW rather than at
+        #: its next tick
+        self.on_capacity_change: Optional[Callable[[], None]] = None
 
     # -- registration ------------------------------------------------------
 
@@ -351,6 +357,7 @@ class ServingSupervisor:
                           len(w.restarts), self.cfg.restart_window_s,
                           reason)
                 t.shut_down(err)  # fails work only; never touches device
+                self._notify_capacity_change()
                 return
             w.restarts.append(now)
             self.stats["restarts"] += 1
@@ -359,8 +366,19 @@ class ServingSupervisor:
             w.restarting = True
         log.warning("%s: %s; restarting worker (restart %d/%d in window)",
                     t.name, reason, len(w.restarts), self.cfg.max_restarts)
+        self._notify_capacity_change()  # pod unready for the rebuild
         threading.Thread(target=self._do_restart, args=(w, err),
                          daemon=True, name=f"restart-{t.name}").start()
+
+    def _notify_capacity_change(self) -> None:
+        hook = self.on_capacity_change
+        if hook is None:
+            return
+        try:
+            hook()
+        except Exception:  # noqa: BLE001 - an elastic control loop's
+            # poke must never take the watchdog down with it
+            log.exception("on_capacity_change hook failed")
 
     def _do_restart(self, w: _Watched, err: Exception) -> None:
         try:
@@ -374,6 +392,7 @@ class ServingSupervisor:
         finally:
             with self._lock:
                 w.restarting = False
+            self._notify_capacity_change()  # pod routable again
 
     # -- readiness ---------------------------------------------------------
 

@@ -136,6 +136,51 @@ def zipf_weights(n: int, s: float) -> list[float]:
     return [x / total for x in w]
 
 
+def zipf_user(rng: random.Random, n_users: int, s: float = 1.3) -> int:
+    """Sample ONE user rank in ``[0, n_users)`` from a Zipf(``s``)
+    population by inverse-CDF of the Pareto tail envelope
+    (``P(rank >= k) ~ k^-(s-1)``) — O(1) per draw with no weight
+    table, which is what lets the region-scale simulator
+    (serve/simulate.py) model millions of users where
+    :func:`zipf_weights` would materialize millions of floats per
+    sample."""
+    if n_users < 1:
+        raise ValueError("n_users must be >= 1")
+    if s <= 1.0:
+        raise ValueError("zipf inversion needs s > 1")
+    u = 1.0 - rng.random()  # (0, 1]: rank**-(s-1) inverted
+    rank = int(u ** (-1.0 / (s - 1.0)))
+    return min(max(rank - 1, 0), n_users - 1)
+
+
+def thinning_arrivals(rng: random.Random, duration_s: float, rate_fn,
+                      lam_max: float) -> list[float]:
+    """Inhomogeneous-Poisson arrival times on ``[0, duration_s)`` by
+    Lewis-Shedler thinning: candidates at the envelope rate
+    ``lam_max``, each accepted with ``rate_fn(t) / lam_max``.  The
+    generic sampler under the ``diurnal`` trace kind and the
+    simulator's diurnal-plus-flash-crowd rate curves; ``rate_fn`` may
+    dip to (or below) zero but must never exceed ``lam_max``."""
+    if lam_max <= 0 or duration_s <= 0:
+        raise ValueError("lam_max and duration_s must be > 0")
+    out: list[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(lam_max)
+        if t >= duration_s:
+            return out
+        lam = float(rate_fn(t))
+        if lam > lam_max * (1 + 1e-9):
+            raise ValueError(
+                f"rate_fn({t:.3f}) = {lam:.3f} exceeds the thinning "
+                f"envelope lam_max = {lam_max:.3f}")
+        # one draw per candidate unconditionally: the accept decision
+        # AND the rng sequence match the historical diurnal sampler,
+        # so seeded traces stay byte-identical
+        if rng.random() * lam_max < lam:
+            out.append(t)
+
+
 def _arrival_times(rng: random.Random, kind: str, duration_s: float,
                    rate_rps: float, *, burst_factor: float,
                    period_s: float, amplitude: float) -> list[float]:
@@ -164,16 +209,11 @@ def _arrival_times(rng: random.Random, kind: str, duration_s: float,
     if kind == "diurnal":
         # sinusoidal rate via thinning: candidates at the peak rate,
         # accepted with lambda(t)/lambda_max
-        lam_max = rate_rps * (1.0 + amplitude)
-        out, t = [], 0.0
-        while True:
-            t += rng.expovariate(lam_max)
-            if t >= duration_s:
-                return out
-            lam = rate_rps * (1.0 + amplitude
-                              * math.sin(2 * math.pi * t / period_s))
-            if rng.random() < lam / lam_max:
-                out.append(t)
+        return thinning_arrivals(
+            rng, duration_s,
+            lambda t: rate_rps * (1.0 + amplitude * math.sin(
+                2 * math.pi * t / period_s)),
+            rate_rps * (1.0 + amplitude))
     raise ValueError(
         f"unknown arrival kind {kind!r} "
         f"(expected poisson | bursty | diurnal)")

@@ -1775,6 +1775,75 @@ def run_fleet(args, svc) -> int:
     return 0
 
 
+def run_autoscale(args) -> int:
+    """--autoscale: the elastic-fleet A/B the acceptance bar names
+    (BENCHMARKS.md "Elastic fleet").  Runs the REAL Autoscaler over
+    the region-scale simulator's flash-crowd trace three ways —
+    autoscaled, fixed at the minimal fleet, fixed at the Little's-law
+    peak fleet — and reports cost-normalized goodput (SLO-meeting
+    output tokens per replica-second), SLO-violation minutes, drops,
+    and flash-crowd reaction/recovery time.  Entirely jax-free (the
+    simulator is virtual-clock Python), so this lane runs anywhere.
+    """
+    from kubernetes_cloud_tpu.serve.simulate import (
+        SimConfig,
+        compare_fleets,
+        default_autoscaler_cfg,
+        flash_crowd_workload,
+    )
+
+    wl = flash_crowd_workload(
+        duration_s=args.as_duration, base_rps=args.as_base_rps,
+        flash_at_s=args.as_duration / 3.0,
+        flash_duration_s=args.as_duration / 5.0,
+        flash_multiplier=args.as_flash_mult, seed=args.seed)
+    sim = SimConfig(tick_s=args.as_tick)
+    cfg = default_autoscaler_cfg(max_replicas=args.as_max_replicas)
+    out = compare_fleets(wl, sim, autoscaler_cfg=cfg, min_fleet=1)
+    auto, fmin, fpeak = (out["autoscaled"], out["fixed_min"],
+                         out["fixed_peak"])
+
+    def arm(r):
+        return {
+            "cost_normalized_goodput": r["cost_normalized_goodput"],
+            "slo_attainment": r["slo_attainment"],
+            "slo_violation_minutes": r["slo_violation_minutes"],
+            "replica_seconds": r["replica_seconds"],
+            "requests": r["requests"], "completed": r["completed"],
+            "dropped": r["dropped"], "unfinished": r["unfinished"],
+            "ttft_p95_s": r["ttft_p95_s"],
+            "scale_ups": r["scale_ups"],
+            "scale_downs": r["scale_downs"],
+        }
+
+    record = {
+        "metric": "serving_autoscale_goodput_per_replica_s",
+        "value": auto["cost_normalized_goodput"],
+        "unit": "slo_tokens_per_replica_s",
+        "duration_s": wl.duration_s,
+        "base_rps": wl.base_rps,
+        "flash_multiplier": args.as_flash_mult,
+        "peak_fleet": out["peak_fleet"],
+        "beats_min": out["autoscaled_beats_min"],
+        "beats_peak": out["autoscaled_beats_peak"],
+        "zero_drops": out["autoscaled_zero_drops"],
+        "flash_crowds": auto["flash_crowds"],
+        "autoscaled": arm(auto),
+        "fixed_min": arm(fmin),
+        "fixed_peak": arm(fpeak),
+    }
+    if fmin["cost_normalized_goodput"]:
+        record["vs_min"] = round(
+            auto["cost_normalized_goodput"]
+            / fmin["cost_normalized_goodput"], 3)
+    if fpeak["cost_normalized_goodput"]:
+        record["vs_peak"] = round(
+            auto["cost_normalized_goodput"]
+            / fpeak["cost_normalized_goodput"], 3)
+    print(json.dumps(record))
+    return 0
+
+
 def main(argv=None) -> int:
     from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
     from kubernetes_cloud_tpu.serve.batcher import BatcherConfig, BatchingModel
@@ -1917,6 +1986,23 @@ def main(argv=None) -> int:
                     help="spec mode: draft tokens per round")
     ap.add_argument("--spec-duration", type=float, default=10.0,
                     help="spec mode: measured window seconds per arm")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="elastic-fleet A/B on the region-scale "
+                         "simulator's flash-crowd trace: the real "
+                         "Autoscaler vs fixed-min vs fixed-peak "
+                         "fleets, reporting cost-normalized goodput "
+                         "(records serving_autoscale_goodput_per_"
+                         "replica_s); jax-free")
+    ap.add_argument("--as-duration", type=float, default=900.0,
+                    help="autoscale mode: simulated trace seconds")
+    ap.add_argument("--as-base-rps", type=float, default=3.0,
+                    help="autoscale mode: off-peak arrival rate")
+    ap.add_argument("--as-flash-mult", type=float, default=8.0,
+                    help="autoscale mode: flash-crowd rate multiplier")
+    ap.add_argument("--as-max-replicas", type=int, default=16,
+                    help="autoscale mode: autoscaler max_replicas")
+    ap.add_argument("--as-tick", type=float, default=0.25,
+                    help="autoscale mode: simulator tick seconds")
     ap.add_argument("--inject", choices=("hang", "crash"), default=None,
                     help="recovery mode: wedge (hang) or crash the "
                          "decode loop and measure supervisor recovery "
@@ -1925,6 +2011,10 @@ def main(argv=None) -> int:
                     help="recovery mode: supervisor heartbeat-staleness "
                          "threshold")
     args = ap.parse_args(argv)
+
+    if args.autoscale:
+        # virtual-clock simulation: no service, no jax, no payloads
+        return run_autoscale(args)
 
     if args.inject:
         return run_recovery(args)

@@ -1,0 +1,460 @@
+"""Elastic-autoscaler units: rolling digests, the activator's
+hold/replay contract, KPA target-tracking math (panic entry, hysteresis
+and cooldown, scale-to-zero, predictive pre-warming, cold-start EWMA),
+the adaptive live-TTFT hedge delay, and the Knative-annotation mapping
+the deploy docs promise.  Everything here is jax-free and
+deterministic: the control loop runs on an explicit virtual ``now``,
+never the wall clock."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_cloud_tpu.serve.autoscaler import (
+    KNATIVE_ANNOTATIONS,
+    Activator,
+    Autoscaler,
+    AutoscalerConfig,
+    PoolSignals,
+    RolePolicy,
+    RollingDigest,
+    ScalingTarget,
+)
+from kubernetes_cloud_tpu.serve.fleet import FleetConfig, FleetRouter
+
+
+# ---------------------------------------------------------------------------
+# RollingDigest
+# ---------------------------------------------------------------------------
+
+
+def test_digest_quantile_windows_and_min_samples():
+    d = RollingDigest(window_s=10.0)
+    for i in range(10):
+        d.observe(float(i), now=float(i))
+    # window [0, 9]: everything in range
+    assert d.quantile(0.0, now=9.0) == 0.0
+    assert d.quantile(1.0, now=9.0) == 9.0
+    assert d.quantile(0.5, now=9.0) == 5.0
+    # advance: samples older than 10 s fall out
+    assert d.quantile(0.0, now=15.0) == 5.0
+    # below min_samples the digest abstains (hedging falls back to
+    # the fixed floor, never a junk quantile)
+    assert d.quantile(0.5, now=9.0, min_samples=100) is None
+    assert RollingDigest(window_s=5.0).quantile(0.5) is None
+
+
+def test_digest_trend_fits_slope():
+    d = RollingDigest(window_s=60.0)
+    for i in range(20):
+        d.observe(2.0 * i + 1.0, now=float(i))
+    fit, slope = d.trend(now=19.0)
+    assert slope == pytest.approx(2.0, abs=1e-6)
+    assert fit == pytest.approx(39.0, abs=1e-6)
+    flat = RollingDigest(window_s=60.0)
+    flat.observe(5.0, now=0.0)
+    assert flat.trend(now=0.0) == (5.0, 0.0)
+
+
+def test_digest_bounds_sample_count():
+    d = RollingDigest(window_s=1e9, max_samples=100)
+    for i in range(1000):
+        d.observe(float(i), now=float(i))
+    assert d.count(now=999.0) == 100
+    assert d.quantile(0.0, now=999.0) == 900.0
+
+
+def test_digest_validates():
+    with pytest.raises(ValueError):
+        RollingDigest(window_s=0)
+    with pytest.raises(ValueError):
+        RollingDigest(window_s=1.0).quantile(1.5)
+
+
+# ---------------------------------------------------------------------------
+# Activator
+# ---------------------------------------------------------------------------
+
+
+def test_activator_hold_replays_on_capacity():
+    pokes = []
+    act = Activator(max_hold_s=30.0, on_demand=lambda: pokes.append(1))
+    got = []
+
+    def waiter():
+        got.append(act.hold())
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while act.depth == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert act.depth == 1
+    assert pokes == [1]  # the park itself signalled demand
+    act.notify_capacity()
+    t.join(timeout=5.0)
+    assert got == [True]
+    assert act.depth == 0
+    assert act.stats["held"] == 1 and act.stats["replayed"] == 1
+
+
+def test_activator_hold_times_out():
+    act = Activator(max_hold_s=30.0)
+    t0 = time.monotonic()
+    assert act.hold(deadline=t0 + 0.05) is False
+    assert act.stats["timeouts"] == 1
+    assert act.depth == 0
+
+
+def test_activator_raising_demand_hook_is_contained():
+    def boom():
+        raise RuntimeError("hook down")
+
+    act = Activator(max_hold_s=30.0, on_demand=boom)
+    assert act.hold(deadline=time.monotonic() + 0.05) is False
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler control loop (stub target, virtual clock)
+# ---------------------------------------------------------------------------
+
+
+class StubTarget(ScalingTarget):
+    """Instant-capacity target: scale_up turns ready next signals
+    read; every call is recorded for assertions."""
+
+    def __init__(self, role="colocated", ready=1):
+        self.role = role
+        self.sig = PoolSignals(ready=ready)
+        self.ups: list[int] = []
+        self.downs: list[int] = []
+
+    def roles(self):
+        return (self.role,)
+
+    def signals(self, role):
+        assert role == self.role
+        return self.sig
+
+    def scale_up(self, role, n):
+        self.ups.append(n)
+        self.sig.ready += n
+        return n
+
+    def scale_down(self, role, n):
+        self.downs.append(n)
+        self.sig.ready -= n
+        return n
+
+
+def _cfg(**kw):
+    role = kw.pop("role", "colocated")
+    policy = kw.pop("policy", None) or RolePolicy(
+        min_replicas=kw.pop("min_replicas", 1),
+        max_replicas=kw.pop("max_replicas", 10),
+        target_concurrency=kw.pop("target_concurrency", 2.0))
+    base = dict(tick_s=1.0, stable_window_s=10.0, panic_window_s=3.0,
+                panic_threshold=2.0, panic_hold_s=10.0,
+                scale_down_delay_s=5.0, cooldown_s=2.0,
+                scale_to_zero_grace_s=5.0, prewarm=False,
+                roles={role: policy})
+    base.update(kw)
+    return AutoscalerConfig(**base)
+
+
+def test_target_tracking_sizes_ceil_of_concurrency_over_target():
+    tgt = StubTarget(ready=1)
+    scaler = Autoscaler(tgt, _cfg(), clock=lambda: 0.0)
+    tgt.sig.concurrency = 9.0
+    out = scaler.step(now=0.0)
+    assert out["colocated"]["desired"] == 5  # ceil(9 / 2)
+    assert tgt.ups == [4]
+    # steady state: no further scaling
+    out = scaler.step(now=1.0)
+    assert out["colocated"]["applied"] == 0
+
+
+def test_max_replicas_clamps_and_max_step_bounds():
+    tgt = StubTarget(ready=1)
+    scaler = Autoscaler(
+        tgt, _cfg(max_replicas=3, max_scale_up_step=1),
+        clock=lambda: 0.0)
+    tgt.sig.concurrency = 100.0
+    scaler.step(now=0.0)
+    assert tgt.ups == [1]  # one spawn per decision, clamped at 3
+    scaler.step(now=1.0)
+    assert tgt.ups == [1, 1]
+    scaler.step(now=2.0)
+    assert tgt.sig.ready == 3
+    scaler.step(now=3.0)
+    assert tgt.ups == [1, 1]  # at max_replicas: no further ups
+
+
+def test_panic_mode_scales_on_burst_and_blocks_scale_down():
+    tgt = StubTarget(ready=2)
+    scaler = Autoscaler(tgt, _cfg(), clock=lambda: 0.0)
+    # calm history holds the stable window at steady state (desired
+    # == ready, so neither direction moves)
+    tgt.sig.concurrency = 4.0
+    for t in range(8):
+        scaler.step(now=float(t))
+    assert tgt.ups == [] and tgt.downs == []
+    # burst: short panic window sees it immediately even though the
+    # stable average is still diluted by the calm history
+    tgt.sig.concurrency = 40.0
+    out = scaler.step(now=8.0)
+    assert out["colocated"]["in_panic"] is True
+    assert tgt.sig.ready > 2
+    assert scaler.stats["panics"] == 1
+    # burst passes; panic holds — no scale-down inside panic_hold_s
+    tgt.sig.concurrency = 0.0
+    for t in range(9, 14):
+        out = scaler.step(now=float(t))
+        assert out["colocated"]["in_panic"] is True
+    assert tgt.downs == []
+
+
+def test_scale_down_needs_delay_and_cooldown():
+    tgt = StubTarget(ready=6)
+    cfg = _cfg(scale_down_delay_s=5.0, cooldown_s=2.0)
+    scaler = Autoscaler(tgt, cfg, clock=lambda: 0.0)
+    tgt.sig.concurrency = 2.0  # desired = 1, surplus of 5
+    scaler.step(now=0.0)
+    assert tgt.downs == []  # surplus must persist first
+    scaler.step(now=3.0)
+    assert tgt.downs == []
+    scaler.step(now=5.0)  # delay satisfied, cooldown clear
+    assert tgt.downs == [5]
+    assert tgt.sig.ready == 1
+
+
+def test_flapping_surplus_resets_hysteresis():
+    tgt = StubTarget(ready=4)
+    scaler = Autoscaler(tgt, _cfg(stable_window_s=2.0,
+                                  panic_window_s=1.0,
+                                  scale_down_delay_s=5.0,
+                                  cooldown_s=0.0),
+                        clock=lambda: 0.0)
+    tgt.sig.concurrency = 2.0  # desired 1: surplus of 3 opens
+    scaler.step(now=0.0)
+    scaler.step(now=2.0)
+    # load returns before the delay elapses: the below-clock resets
+    tgt.sig.concurrency = 14.0  # short-window mean 8 -> desired 4
+    scaler.step(now=4.0)
+    # surplus reopens: the 5 s clock must restart from here, so no
+    # scale-down until a CONTINUOUS surplus stretch elapses
+    tgt.sig.concurrency = 2.0
+    scaler.step(now=6.0)
+    scaler.step(now=8.0)
+    scaler.step(now=10.0)
+    scaler.step(now=12.0)
+    assert tgt.downs == []  # never 5 continuous surplus seconds yet
+    scaler.step(now=13.0)  # 13 - 8 = 5: the continuous stretch lands
+    assert tgt.downs == [3]
+
+
+def test_scale_to_zero_after_grace_and_activator_forces_one():
+    tgt = StubTarget(ready=1)
+    scaler = Autoscaler(tgt, _cfg(min_replicas=0,
+                                  scale_to_zero_grace_s=5.0,
+                                  scale_down_delay_s=0.0,
+                                  cooldown_s=0.0),
+                        clock=lambda: 0.0)
+    tgt.sig.concurrency = 0.0
+    for t in range(5):
+        scaler.step(now=float(t))
+    assert tgt.downs == []  # idle but inside the grace period
+    out = scaler.step(now=5.0)
+    assert out["colocated"]["desired"] == 0
+    assert tgt.sig.ready == 0
+    # a held arrival IS demand: the activator depth forces >= 1
+    tgt.sig.activator_depth = 1
+    out = scaler.step(now=6.0)
+    assert out["colocated"]["desired"] >= 1
+    assert tgt.sig.ready == 1
+
+
+def test_prewarm_scales_ahead_of_rising_arrival_rate():
+    tgt = StubTarget(ready=1)
+    cfg = _cfg(prewarm=True, trend_window_s=10.0,
+               cold_start_prior_s=10.0, target_concurrency=2.0)
+    scaler = Autoscaler(tgt, cfg, clock=lambda: 0.0)
+    tgt.sig.concurrency = 2.0  # desired stays 1 on its own
+    arrivals = 0
+    for t in range(8):
+        # arrival RATE doubles every couple of ticks: the linear fit
+        # projects well past current demand one cold-start out
+        arrivals += 4 * (t + 1)
+        tgt.sig.arrivals = arrivals
+        scaler.step(now=float(t))
+    assert scaler.stats["prewarm_ups"] >= 1
+    assert tgt.sig.ready > 1
+
+
+def test_cold_start_prior_ewma_tracks_measurements():
+    tgt = StubTarget()
+    scaler = Autoscaler(tgt, _cfg(cold_start_prior_s=10.0),
+                        clock=lambda: 0.0)
+    assert scaler.cold_start_s("colocated") == 10.0  # the prior
+    scaler.note_cold_start("colocated", 4.0)
+    assert scaler.cold_start_s("colocated") == 4.0  # first = seed
+    scaler.note_cold_start("colocated", 8.0)
+    # alpha = 0.4: 0.4*8 + 0.6*4
+    assert scaler.cold_start_s("colocated") == pytest.approx(5.6)
+
+
+def test_kick_wakes_the_loop_thread():
+    tgt = StubTarget(ready=0)
+    scaler = Autoscaler(tgt, _cfg(tick_s=30.0, min_replicas=0),
+                        clock=time.monotonic)
+    tgt.sig.activator_depth = 1
+    scaler.start()
+    try:
+        scaler.kick()
+        deadline = time.monotonic() + 5.0
+        while not tgt.ups and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert tgt.ups  # the kick ran a tick well before tick_s
+    finally:
+        scaler.stop()
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        RolePolicy(min_replicas=-1)
+    with pytest.raises(ValueError):
+        RolePolicy(min_replicas=5, max_replicas=2)
+    with pytest.raises(ValueError):
+        RolePolicy(target_concurrency=0)
+    with pytest.raises(ValueError):
+        AutoscalerConfig(panic_window_s=60.0, stable_window_s=30.0)
+    with pytest.raises(ValueError):
+        AutoscalerConfig(panic_threshold=0.5)
+    with pytest.raises(ValueError):
+        AutoscalerConfig(roles={"nonsense": RolePolicy()})
+    with pytest.raises(ValueError):
+        AutoscalerConfig(roles={"prefill": "not-a-policy"})
+
+
+def test_knative_annotation_map_names_real_fields():
+    # the deploy/README migration table is generated from this map —
+    # every target must be a real config field (or the activator)
+    cfg_fields = {f.name for f in
+                  AutoscalerConfig.__dataclass_fields__.values()}
+    pol_fields = {f.name for f in
+                  RolePolicy.__dataclass_fields__.values()}
+    for annotation, target in KNATIVE_ANNOTATIONS.items():
+        assert annotation.startswith("autoscaling.knative.dev/")
+        if target.startswith("AutoscalerConfig."):
+            assert target.split(".", 1)[1] in cfg_fields, target
+        elif target.startswith("RolePolicy."):
+            assert target.split(".", 1)[1] in pol_fields, target
+        else:
+            assert "Activator" in target
+
+
+# ---------------------------------------------------------------------------
+# adaptive hedge delay (fleet.py satellite)
+# ---------------------------------------------------------------------------
+
+
+def _empty_router(**cfg_kw):
+    fcfg = FleetConfig(**cfg_kw)
+    return FleetRouter([], fcfg, host="127.0.0.1", port=0,
+                       allow_empty=True)
+
+
+def test_empty_fleet_requires_opt_in():
+    with pytest.raises(ValueError):
+        FleetRouter([], FleetConfig(), host="127.0.0.1", port=0)
+
+
+def test_hedge_delay_floors_at_fixed_knob():
+    router = _empty_router(hedge_after_s=0.5, hedge_ttft_quantile=0.9,
+                           hedge_ttft_factor=2.0,
+                           hedge_ttft_min_samples=4)
+    # cold digest: the fixed knob alone
+    assert router._hedge_delay("colocated") == 0.5
+    digest = RollingDigest(window_s=60.0)
+    router._ttft_digests["colocated"] = digest
+    # thin digest (below min_samples): still the floor
+    digest.observe(10.0)
+    assert router._hedge_delay("colocated") == 0.5
+    # warm digest, fast TTFTs: quantile*factor below the floor — the
+    # floor wins (backward compat: never hedge EARLIER than the knob)
+    for _ in range(10):
+        digest.observe(0.01)
+    assert router._hedge_delay("colocated") == 0.5
+    # slow TTFTs: the adaptive delay takes over
+    for _ in range(20):
+        digest.observe(1.0)
+    assert router._hedge_delay("colocated") == pytest.approx(2.0)
+
+
+def test_hedge_disabled_stays_disabled_regardless_of_digest():
+    router = _empty_router(hedge_after_s=None)
+    digest = RollingDigest(window_s=60.0)
+    for _ in range(50):
+        digest.observe(3.0)
+    router._ttft_digests["colocated"] = digest
+    assert router._hedge_delay("colocated") is None
+
+
+def test_hedge_quantile_none_falls_back_to_fixed():
+    router = _empty_router(hedge_after_s=0.25,
+                           hedge_ttft_quantile=None)
+    digest = RollingDigest(window_s=60.0)
+    for _ in range(50):
+        digest.observe(3.0)
+    router._ttft_digests["colocated"] = digest
+    assert router._hedge_delay("colocated") == 0.25
+
+
+def test_observe_ttft_is_per_role():
+    router = _empty_router(hedge_after_s=0.1,
+                           hedge_ttft_min_samples=1,
+                           hedge_ttft_factor=1.0,
+                           hedge_ttft_quantile=1.0)
+
+    class _R:
+        pass
+
+    rep = _R()
+    rep.health = _R()
+    rep.health.role = "prefill"
+    router._observe_ttft(rep, {"predictions": [{"ttft_s": 4.0},
+                                               {"ttft_s": 2.0}]})
+    assert router._hedge_delay("prefill") == pytest.approx(4.0)
+    # other roles' digests are untouched — colocated stays at floor
+    assert router._hedge_delay("colocated") == pytest.approx(0.1)
+    # bodies without predictions are ignored, not an error
+    router._observe_ttft(rep, {"error": "nope"})
+
+
+def test_fleet_config_validates_hedge_ttft_knobs():
+    with pytest.raises(ValueError):
+        FleetConfig(hedge_ttft_quantile=1.5)
+    with pytest.raises(ValueError):
+        FleetConfig(hedge_ttft_factor=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(hedge_ttft_min_samples=0)
+
+
+def test_supervisor_capacity_hook_pokes_and_is_contained():
+    """serve/supervisor.py's capacity hook: unset is a no-op, a wired
+    hook fires, and a raising hook never takes the watchdog down."""
+    from kubernetes_cloud_tpu.serve.supervisor import ServingSupervisor
+
+    sup = ServingSupervisor()
+    sup._notify_capacity_change()  # no hook wired: no-op
+    calls = []
+    sup.on_capacity_change = lambda: calls.append(1)
+    sup._notify_capacity_change()
+    assert calls == [1]
+
+    def boom():
+        raise RuntimeError("kick failed")
+
+    sup.on_capacity_change = boom
+    sup._notify_capacity_change()  # contained, not raised

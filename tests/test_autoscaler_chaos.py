@@ -1,0 +1,325 @@
+"""Elastic-fleet chaos: the autoscaler's live acceptance scenarios
+over real engines.
+
+* scale-from-zero through the activator: a request that arrives at an
+  EMPTY fleet parks on the activator, the poked control loop spawns a
+  replica, and the held request replays onto it — one 200, token-
+  identical to one-shot greedy ``generate``, nothing dropped and
+  nothing re-prefilled behind the client's back;
+* a flash crowd forces a scale-up while a fault kills a replica
+  mid-burst — the retry ladder absorbs the crash, the control loop
+  replaces the capacity, and ZERO client requests fail;
+* prefill and decode pools are independent: scaling one role never
+  touches the other role's replicas.
+
+Same determinism stance as ``test_fleet_chaos``: engines are warmed
+before faults arm, and the assertions are about counters and health
+states, not wall-clock racing.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.serve.autoscaler import (
+    AutoscalerConfig,
+    ElasticFleet,
+    RolePolicy,
+)
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingModel,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.fleet import (
+    ACTIVE,
+    FleetConfig,
+    FleetRouter,
+    LocalReplica,
+)
+from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+pytestmark = [pytest.mark.chaos, pytest.mark.fleet]
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def service(params):
+    svc = CausalLMService("lm", CFG, params=params, dtype=jnp.float32)
+    svc.load()
+    return svc
+
+
+def make_factory(service, fcfg, engine_kw=None):
+    """An ElasticFleet factory: each spawn gets its OWN engine over
+    the shared weights, UNLOADED — the spawner thread pays ``load()``
+    so the measured cold start is honest."""
+    kw = {"slots": 2, "max_len": 96}
+    kw.update(engine_kw or {})
+
+    def factory(role, rid):
+        model = ContinuousBatchingModel("lm", service,
+                                        EngineConfig(**kw))
+        server = ModelServer([model], host="127.0.0.1", port=0)
+        return LocalReplica(rid, server, fcfg)
+
+    return factory
+
+
+def make_seeded_replica(service, rid, fcfg, engine_kw=None):
+    """A pre-warmed replica for fleets that do NOT start from zero."""
+    kw = {"slots": 2, "max_len": 96}
+    kw.update(engine_kw or {})
+    model = ContinuousBatchingModel("lm", service, EngineConfig(**kw))
+    model.load()
+    replica = LocalReplica(rid, ModelServer([model], host="127.0.0.1",
+                                            port=0), fcfg)
+    model.engine.submit([1, 2, 3], max_new_tokens=2,
+                        temperature=0.0).wait()
+    return replica
+
+
+def teardown(fleet, router):
+    fleet.stop()
+    router.shutdown()
+
+
+def _predict(port, prompt, max_new, timeout=60, rid=None):
+    payload = {"instances": [prompt],
+               "parameters": {"max_new_tokens": max_new,
+                              "temperature": 0.0}}
+    if rid:
+        payload["request_id"] = rid
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/lm:predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def greedy_reference(service, prompt, n):
+    opts = {"MAX_NEW_TOKENS": n, "TEMPERATURE": 0.0, "TOP_K": 0,
+            "TOP_P": 1.0, "SEED": 0, "ECHO_PROMPT": False}
+    return service.generate_texts([prompt], opts)[0]
+
+
+def _wait_until(cond, timeout=30.0, interval=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_scale_from_zero_activator_holds_and_replays(service):
+    """ISSUE acceptance: a request arriving at an EMPTY fleet is held
+    by the activator (never 503d), the poke wakes the control loop,
+    a replica cold-starts, and the held request replays onto it —
+    the client sees one 200, token-identical to greedy generate."""
+    fcfg = FleetConfig(dispatch_timeout_s=60.0, probe_interval_s=0.1)
+    router = FleetRouter([], fcfg, host="127.0.0.1", port=0,
+                         allow_empty=True)
+    cfg = AutoscalerConfig(
+        tick_s=0.05, stable_window_s=0.5, panic_window_s=0.2,
+        scale_down_delay_s=60.0, cooldown_s=0.05, prewarm=False,
+        scale_to_zero_grace_s=60.0, cold_start_prior_s=10.0,
+        roles={"colocated": RolePolicy(min_replicas=0, max_replicas=2,
+                                       target_concurrency=2.0)})
+    fleet = ElasticFleet(router, make_factory(service, fcfg), cfg)
+    router.start()
+    fleet.start()
+    try:
+        want = greedy_reference(service, "wake the fleet", 5)
+        status, obj = _predict(router.port, "wake the fleet", 5,
+                               timeout=90)
+        assert status == 200
+        assert obj["predictions"][0]["generated_text"] == want
+        # the hold-and-replay path really ran: held once, replayed
+        # once, and NOTHING was 503d or silently re-prefilled
+        assert fleet.activator.stats["held"] >= 1
+        assert fleet.activator.stats["replayed"] >= 1
+        assert fleet.activator.stats["timeouts"] == 0
+        assert router.stats["unplaceable"] == 0
+        assert router.stats["activator_held"] >= 1
+        assert router.stats["activator_replayed"] >= 1
+        # exactly the capacity asked for, probed healthy and ACTIVE
+        assert len(router.replicas) == 1
+        assert router.replicas[0].health.state == ACTIVE
+        # the measured cold start replaced the configured prior
+        measured = fleet.autoscaler.cold_start_s("colocated")
+        assert measured != pytest.approx(cfg.cold_start_prior_s)
+        assert 0.0 < measured < 60.0
+    finally:
+        teardown(fleet, router)
+
+
+def test_flash_crowd_scale_up_with_replica_killed_mid_burst(service):
+    """ISSUE acceptance: a flash crowd drives concurrency over target
+    → the control loop spawns capacity; a fault kills an engine in
+    the middle of the scale-up — retries absorb the crash, the loop
+    replaces the lost replica, and ZERO client requests fail."""
+    fcfg = FleetConfig(dispatch_timeout_s=60.0, probe_interval_s=0.1,
+                       retry_budget_burst=64.0, retry_budget_ratio=1.0)
+    seed = make_seeded_replica(service, "r0", fcfg)
+    router = FleetRouter([seed], fcfg, host="127.0.0.1", port=0)
+    cfg = AutoscalerConfig(
+        tick_s=0.05, stable_window_s=0.4, panic_window_s=0.2,
+        panic_threshold=1.5, scale_down_delay_s=60.0, cooldown_s=0.05,
+        prewarm=False,
+        roles={"colocated": RolePolicy(min_replicas=1, max_replicas=3,
+                                       target_concurrency=1.0)})
+    fleet = ElasticFleet(router, make_factory(service, fcfg), cfg)
+    router.start()
+    fleet.start()
+    prompt = "flash crowd burst"
+    want = greedy_reference(service, prompt, 5)
+    results, failures = [], []
+    stop = threading.Event()
+
+    def client(wid):
+        i = 0
+        while not stop.is_set():
+            try:
+                status, obj = _predict(router.port, prompt, 5,
+                                       timeout=60, rid=f"w{wid}-{i}")
+                results.append((status, obj))
+            except Exception as e:  # noqa: BLE001 - recorded, asserted
+                failures.append(repr(e))
+            i += 1
+
+    workers = [threading.Thread(target=client, args=(w,))
+               for w in range(5)]
+    for t in workers:
+        t.start()
+    try:
+        # let the burst register and the scale-up begin...
+        _wait_until(lambda: fleet.autoscaler.stats["scale_ups"] >= 1,
+                    what="the flash crowd to trigger a scale-up")
+        # ...then kill the next decoding engine mid-scale-up
+        faults.install(faults.FaultInjector(
+            [FaultSpec("decode_step", at=1, times=1)]))
+        _wait_until(lambda: any(
+            not r.server.models["lm"].engine.alive
+            for r in router.replicas), what="the fault to kill an engine")
+        faults.uninstall()  # spawned replacements must come up clean
+        # the loop must refill the pool: >= 2 ACTIVE live engines
+        _wait_until(lambda: sum(
+            1 for r in router.replicas
+            if r.health.state == ACTIVE
+            and r.server.models["lm"].engine.alive) >= 2,
+            timeout=60,
+            what="the control loop to replace the killed replica")
+        time.sleep(0.5)  # keep serving on the rebuilt pool
+    finally:
+        stop.set()
+        for t in workers:
+            t.join(timeout=60)
+    try:
+        assert failures == []  # ZERO transport/unhandled failures
+        assert results, "load loop never completed a request"
+        assert [s for s, _ in results if s != 200] == []
+        assert all(o["predictions"][0]["generated_text"] == want
+                   for _, o in results)
+        assert fleet.autoscaler.stats["scale_ups"] >= 1
+        assert router.stats["unplaceable"] == 0
+    finally:
+        teardown(fleet, router)
+
+
+def test_supervised_replica_wired_to_control_loop(service):
+    """A supervised model's restarts change ready capacity mid-tick:
+    ElasticFleet points the supervisor's capacity hook at the control
+    loop, so a restart/circuit-open wakes it immediately."""
+    from kubernetes_cloud_tpu.serve.supervisor import ServingSupervisor
+
+    fcfg = FleetConfig(probe_interval_s=30.0)
+    rep = make_seeded_replica(service, "s0", fcfg)
+    sup = ServingSupervisor()
+    sup.watch(rep.server.models["lm"])
+    router = FleetRouter([rep], fcfg, host="127.0.0.1", port=0)
+    cfg = AutoscalerConfig(
+        roles={"colocated": RolePolicy(min_replicas=1, max_replicas=2,
+                                       target_concurrency=4.0)})
+    fleet = ElasticFleet(router, make_factory(service, fcfg), cfg)
+    try:
+        assert sup.on_capacity_change == fleet.autoscaler.kick
+        fleet.autoscaler._kick.clear()
+        sup._notify_capacity_change()
+        assert fleet.autoscaler._kick.is_set()
+    finally:
+        teardown(fleet, router)
+
+
+def test_prefill_and_decode_pools_scale_independently(service):
+    """Role isolation: scaling the prefill pool spawns/drains ONLY
+    prefill replicas — the decode pool's membership never moves."""
+    fcfg = FleetConfig(dispatch_timeout_s=60.0, probe_interval_s=30.0)
+    pre = make_seeded_replica(service, "pre0", fcfg)
+    dec = make_seeded_replica(service, "dec0", fcfg)
+    pre.health.role = "prefill"
+    dec.health.role = "decode"
+    router = FleetRouter([pre, dec], fcfg, host="127.0.0.1", port=0)
+    cfg = AutoscalerConfig(
+        tick_s=0.05, stable_window_s=0.5, panic_window_s=0.2,
+        scale_down_delay_s=60.0, cooldown_s=0.05, prewarm=False,
+        roles={"prefill": RolePolicy(min_replicas=1, max_replicas=4,
+                                     target_concurrency=2.0),
+               "decode": RolePolicy(min_replicas=1, max_replicas=4,
+                                    target_concurrency=2.0)})
+    fleet = ElasticFleet(router, make_factory(service, fcfg), cfg)
+    try:
+        assert fleet.signals("prefill").ready == 1
+        assert fleet.signals("decode").ready == 1
+
+        # scale prefill up: the spawn is role-tagged and joins the
+        # prefill pool; decode membership is untouched
+        assert fleet.scale_up("prefill", 1) == 1
+        _wait_until(lambda: fleet.signals("prefill").ready == 2,
+                    what="prefill spawn to probe healthy")
+        assert fleet.signals("decode").ready == 1
+        spawned = [r for r in router.replicas
+                   if r.id not in ("pre0", "dec0")]
+        assert len(spawned) == 1
+        assert spawned[0].health.role == "prefill"
+
+        # scale prefill back down: the drain victim is a prefill
+        # replica; the decode replica never drains
+        assert fleet.scale_down("prefill", 1) == 1
+        _wait_until(lambda: len(router.replicas) == 2,
+                    what="prefill drain to complete")
+        assert fleet.signals("prefill").ready == 1
+        assert fleet.signals("decode").ready == 1
+        assert dec.health.state == ACTIVE
+
+        # asking decode for a drain never victimizes prefill
+        assert fleet.scale_down("decode", 1) == 1
+        _wait_until(lambda: len(router.replicas) == 1,
+                    what="decode drain to complete")
+        assert router.replicas[0].health.role == "prefill"
+    finally:
+        teardown(fleet, router)

@@ -477,3 +477,72 @@ def test_load_test_metrics_check_fails_loudly():
         assert tolerant["ok"] is True
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# metric-family catalog completeness
+# ---------------------------------------------------------------------------
+
+
+def test_catalog_covers_every_registered_kct_family():
+    # every kct_* family the process registers must have a catalog
+    # entry (obs/catalog.py) — an instrumented-but-uncataloged family
+    # is exactly the telemetry drift KCT-REG exists to kill.  Import
+    # the serving layers that register at import time first; jax-free
+    # by construction.
+    import kubernetes_cloud_tpu.serve.autoscaler  # noqa: F401
+    import kubernetes_cloud_tpu.serve.fleet  # noqa: F401
+    from kubernetes_cloud_tpu.obs.catalog import METRIC_FAMILIES
+
+    registered = {name for name in obs.REGISTRY._metrics
+                  if name.startswith("kct_")}
+    assert registered, "no kct_* families registered?"
+    missing = registered - set(METRIC_FAMILIES)
+    assert not missing, f"registered but not in catalog: {sorted(missing)}"
+
+
+def test_autoscaler_families_cataloged_and_emitting():
+    from kubernetes_cloud_tpu.obs.catalog import METRIC_FAMILIES
+    from kubernetes_cloud_tpu.serve.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        PoolSignals,
+        RolePolicy,
+        ScalingTarget,
+    )
+
+    wanted = [
+        "kct_autoscaler_desired_replicas",
+        "kct_autoscaler_replicas",
+        "kct_autoscaler_panic",
+        "kct_autoscaler_cold_start_seconds",
+        "kct_autoscaler_activator_queue_depth",
+        "kct_autoscaler_scale_events_total",
+    ]
+    for name in wanted:
+        assert name in METRIC_FAMILIES, name
+        assert obs.REGISTRY.get(name) is not None, name
+
+    class _Target(ScalingTarget):
+        def roles(self):
+            return ("colocated",)
+
+        def signals(self, role):
+            return PoolSignals(ready=1, concurrency=9.0, arrivals=5)
+
+        def scale_up(self, role, n):
+            return n
+
+        def scale_down(self, role, n):
+            return n
+
+    cfg = AutoscalerConfig(
+        roles={"colocated": RolePolicy(max_replicas=8,
+                                       target_concurrency=2.0)})
+    scaler = Autoscaler(_Target(), cfg, clock=lambda: 0.0)
+    scaler.step(now=0.0)
+    scaler.note_cold_start("colocated", 3.0)
+    desired = obs.REGISTRY.get("kct_autoscaler_desired_replicas")
+    assert desired.labels(role="colocated").value >= 1
+    hist = obs.REGISTRY.get("kct_autoscaler_cold_start_seconds")
+    assert hist.labels(role="colocated").count == 1
