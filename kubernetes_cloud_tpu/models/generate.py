@@ -732,6 +732,159 @@ def decode_step_pages(cfg: CausalLMConfig, params: Params,
     return _unembed(cfg, params, x)[:, 0], new_arena
 
 
+def ragged_step_pages(cfg: CausalLMConfig, params: Params,
+                      tokens: jax.Array, seg_slot: jax.Array,
+                      positions: jax.Array, mask: jax.Array, arena: dict,
+                      page_table: jax.Array, out_rows: jax.Array,
+                      copy_src: jax.Array, copy_dst: jax.Array,
+                      impl: str = "gather") -> tuple[jax.Array, dict]:
+    """ONE ragged hybrid step: a flat ``[N]`` batch of real tokens from
+    every segment kind a scheduler pass produces (Orca selective
+    batching, OSDI '22; Sarathi's single hybrid batch).
+
+    ``tokens`` [N] is the flat fed-token batch — prefill-chunk tokens,
+    decode tokens, and spec-verify windows concatenated, padded to a
+    bucketed N; ``seg_slot`` [N] names each token's owning slot (= its
+    row in ``page_table``), ``positions`` [N] its absolute position,
+    ``mask`` [N] the real-token flags (pad rows route to the null
+    page).  Embeddings, the MLP stack, and the LM head run dense over
+    the flat batch — token-level ops are row-independent, so a token
+    computes bit-for-bit what it computes in the padded per-kind
+    programs; attention routes per-segment through the paged
+    indirection (``ops.paged_attention.paged_segment_attention``).
+    Within one pass every token's K/V scatters BEFORE attention in each
+    layer (the :func:`verify_step_pages` discipline), and the per-token
+    causal frontier ``kpos <= position`` gives chunk tokens the
+    within-chunk triangle and decode/verify tokens their full context —
+    so segment kinds cannot see across each other except through pages
+    they legitimately share (prefix sharing).
+
+    ``out_rows`` [M] selects the flat rows whose logits the host will
+    read (chunk-final, decode, and verify rows); the LM head runs on
+    those M rows only.  ``copy_src``/``copy_dst`` [C] are this pass's
+    copy-on-write page pairs, applied before any write so a shared
+    source page can never be read after its private copy diverges —
+    COW stops being its own dispatch.  Returns (logits [M, V], arena).
+    """
+    n = tokens.shape[0]
+    ps = arena["k"].shape[2]
+    max_len = page_table.shape[1] * ps
+    quant = "k_scale" in arena
+    interpret = jax.default_backend() != "tpu"
+
+    if copy_src.shape[0]:
+        arena = copy_pages(arena, copy_src, copy_dst)
+
+    valid = (mask != 0) & (positions < max_len)
+    positions = jnp.minimum(positions, max_len - 1)[:, None]  # [N, 1]
+    mask2 = valid.astype(jnp.int32)[:, None]
+    pt_tok = page_table[seg_slot]                             # [N, P]
+    ctx_lens = positions[:, 0] + 1
+
+    rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
+            if cfg.pos_emb == "rope" else None)
+    kpos_all = jnp.broadcast_to(jnp.arange(max_len), (n, max_len))
+    bias = (_alibi_bias(cfg, kpos_all.astype(jnp.float32))
+            if cfg.pos_emb == "alibi" else None)
+    slopes = (alibi_slopes(cfg.num_heads) if cfg.pos_emb == "alibi"
+              else None)
+    key_mask = (kpos_all[:, None, None, :]
+                <= positions[:, None, :, None]).astype(jnp.int32)
+
+    phys, rows = _page_scatter_indices(pt_tok, positions,
+                                       valid[:, None], ps)
+    phys_f = phys.reshape(n)
+    rows_f = rows.reshape(n)
+    valid_f = valid
+
+    x = _embed(cfg, params, tokens[:, None], positions)
+
+    def body(carry, layer):
+        x = carry
+        if quant:
+            p, ck, cv, sk, sv = layer
+        else:
+            p, ck, cv = layer
+            sk = sv = None
+        q, k_new, v_new, attn_in = _project_qkv(
+            cfg, p, x, rope=rope, q_positions=positions)
+        k_flat = k_new.reshape(n, cfg.kv_heads, cfg.head_dim)
+        v_flat = v_new.reshape(n, cfg.kv_heads, cfg.head_dim)
+        if quant:
+            ck, sk = _quant_prefill_write(ck, sk, pt_tok, phys_f,
+                                          rows_f, k_flat, valid_f)
+            cv, sv = _quant_prefill_write(cv, sv, pt_tok, phys_f,
+                                          rows_f, v_flat, valid_f)
+        else:
+            ck = ck.at[phys_f, rows_f].set(k_flat.astype(ck.dtype))
+            cv = cv.at[phys_f, rows_f].set(v_flat.astype(cv.dtype))
+        if impl == "fused":
+            from kubernetes_cloud_tpu.ops.fused_decode import (
+                fused_paged_segment,
+            )
+
+            attn_out = fused_paged_segment(
+                q[:, 0],
+                ck if quant else ck.astype(cfg.dtype),
+                cv if quant else cv.astype(cfg.dtype),
+                page_table, seg_slot, ctx_lens,
+                p["attn"]["wo"].astype(cfg.dtype),
+                k_scale=sk, v_scale=sv, slopes=slopes, impl="pallas",
+                interpret=interpret)
+            if cfg.use_bias:
+                attn_out = attn_out + p["attn"]["bo"].astype(cfg.dtype)
+            x, _aux = _finish_block(cfg, p, x, None, attn_in,
+                                    token_mask=mask2, moe_no_drop=True,
+                                    attn_out=attn_out[:, None, :])
+            return x, ((ck, cv, sk, sv) if quant else (ck, cv))
+        if impl == "pallas":
+            from kubernetes_cloud_tpu.ops.paged_attention import (
+                paged_segment_attention,
+            )
+
+            attn_vec = paged_segment_attention(
+                q[:, 0],
+                ck if quant else ck.astype(cfg.dtype),
+                cv if quant else cv.astype(cfg.dtype),
+                page_table, seg_slot, ctx_lens, k_scale=sk, v_scale=sv,
+                slopes=slopes, impl="pallas", interpret=interpret,
+            )[:, None]
+        elif quant:
+            from kubernetes_cloud_tpu.ops.paged_attention import (
+                gather_pages,
+            )
+
+            dense_k = gather_pages(ck, pt_tok, sk)
+            dense_v = gather_pages(cv, pt_tok, sv)
+            attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                                 dense_v.astype(cfg.dtype), causal=False,
+                                 bias=bias, mask=key_mask, impl="xla")
+        else:
+            dense_k = ck[pt_tok].reshape(n, max_len, cfg.kv_heads,
+                                         cfg.head_dim)
+            dense_v = cv[pt_tok].reshape(n, max_len, cfg.kv_heads,
+                                         cfg.head_dim)
+            attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                                 dense_v.astype(cfg.dtype), causal=False,
+                                 bias=bias, mask=key_mask, impl="xla")
+        x, _aux = _finish_block(cfg, p, x, attn_vec, attn_in,
+                                token_mask=mask2, moe_no_drop=True)
+        return x, ((ck, cv, sk, sv) if quant else (ck, cv))
+
+    if quant:
+        xs = (params["blocks"], arena["k"], arena["v"],
+              arena["k_scale"], arena["v_scale"])
+        x, (ks, vs, ssk, ssv) = jax.lax.scan(body, x, xs)
+        new_arena = {"k": ks, "v": vs, "k_scale": ssk, "v_scale": ssv}
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["blocks"], arena["k"], arena["v"]))
+        new_arena = {"k": ks, "v": vs}
+    # LM head over the M read rows only: the flat batch's other rows'
+    # logits are never consumed, and M bounds the host transfer.
+    return _unembed(cfg, params, x[out_rows])[:, 0], new_arena
+
+
 def kv_quant_probe(cfg: CausalLMConfig, params: Params,
                    prompts: Sequence[Sequence[int]], *,
                    max_new_tokens: int = 16, page_size: int = 16,

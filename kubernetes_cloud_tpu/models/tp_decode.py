@@ -56,6 +56,7 @@ from kubernetes_cloud_tpu.models.generate import (
     _page_scatter_indices,
     _quant_decode_write,
     _quant_prefill_write,
+    copy_pages,
 )
 from kubernetes_cloud_tpu.ops.attention import attention
 from kubernetes_cloud_tpu.ops.layers import (
@@ -575,10 +576,138 @@ def _verify_shard_fn(cfg: CausalLMConfig, m: int, params: Params,
     return _tp_unembed(cfg, params, x, idx, m), new_arena
 
 
+def _ragged_shard_fn(cfg: CausalLMConfig, m: int, impl: str,
+                     interpret: bool, params: Params, tokens: jax.Array,
+                     seg_slot: jax.Array, positions: jax.Array,
+                     mask: jax.Array, arena: dict, page_table: jax.Array,
+                     out_rows: jax.Array, copy_src: jax.Array,
+                     copy_dst: jax.Array) -> tuple[jax.Array, dict]:
+    """Per-shard body of ONE ragged hybrid iteration (mirrors
+    ``generate.ragged_step_pages``): the flat ``[N]`` token batch —
+    prefill chunks, decode steps, spec-verify windows — runs dense
+    through the head-sliced block math, attention routes per-segment
+    through the page indirection, and the pass's COW page pairs copy
+    head-locally up front (pages and their scale rows shard on the
+    kv-head axis, so a per-shard copy IS the whole copy)."""
+    idx = jax.lax.axis_index(AXIS_MODEL)
+    h_loc = cfg.num_heads // m
+    n = tokens.shape[0]
+    ps = arena["k"].shape[2]
+    max_len = page_table.shape[1] * ps
+    quant = "k_scale" in arena
+
+    if copy_src.shape[0]:
+        arena = copy_pages(arena, copy_src, copy_dst)
+
+    valid = (mask != 0) & (positions < max_len)
+    positions = jnp.minimum(positions, max_len - 1)[:, None]  # [N, 1]
+    mask2 = valid.astype(jnp.int32)[:, None]
+    pt_tok = page_table[seg_slot]                             # [N, P]
+    ctx_lens = positions[:, 0] + 1
+
+    rope = (rope_cache(max_len, cfg.rotary_dim, cfg.rope_theta)
+            if cfg.pos_emb == "rope" else None)
+    kpos_all = jnp.broadcast_to(jnp.arange(max_len), (n, max_len))
+    slopes_loc = bias = None
+    if cfg.pos_emb == "alibi":
+        slopes_loc = jax.lax.dynamic_slice_in_dim(
+            alibi_slopes(cfg.num_heads), idx * h_loc, h_loc)
+        bias = (slopes_loc[None, :, None, None]
+                * kpos_all.astype(jnp.float32)[:, None, None, :])
+    key_mask = (kpos_all[:, None, None, :]
+                <= positions[:, None, :, None]).astype(jnp.int32)
+
+    phys, rows = _page_scatter_indices(pt_tok, positions,
+                                       valid[:, None], ps)
+    phys_f = phys.reshape(n)
+    rows_f = rows.reshape(n)
+    valid_f = valid
+    hkv_loc = cfg.kv_heads // m
+
+    x = _tp_embed(cfg, params, tokens[:, None], positions, idx, m)
+
+    def body(carry, layer):
+        x = carry
+        if quant:
+            p, ck, cv, sk, sv = layer
+        else:
+            p, ck, cv = layer
+            sk = sv = None
+        q, k_new, v_new = _tp_qkv(cfg, p, x, rope=rope,
+                                  q_positions=positions)
+        k_flat = k_new.reshape(n, hkv_loc, cfg.head_dim)
+        v_flat = v_new.reshape(n, hkv_loc, cfg.head_dim)
+        if quant:
+            ck, sk = _quant_prefill_write(ck, sk, pt_tok, phys_f,
+                                          rows_f, k_flat, valid_f)
+            cv, sv = _quant_prefill_write(cv, sv, pt_tok, phys_f,
+                                          rows_f, v_flat, valid_f)
+        else:
+            ck = ck.at[phys_f, rows_f].set(k_flat.astype(ck.dtype))
+            cv = cv.at[phys_f, rows_f].set(v_flat.astype(cv.dtype))
+        if impl == "fused":
+            from kubernetes_cloud_tpu.ops.fused_decode import (
+                fused_paged_segment,
+            )
+
+            part = fused_paged_segment(
+                q[:, 0],
+                ck if quant else ck.astype(cfg.dtype),
+                cv if quant else cv.astype(cfg.dtype),
+                page_table, seg_slot, ctx_lens,
+                p["attn"]["wo"].astype(cfg.dtype),
+                k_scale=sk, v_scale=sv, slopes=slopes_loc,
+                impl="pallas", interpret=interpret)
+            attn_out = jax.lax.psum(part, AXIS_MODEL)
+            if cfg.use_bias:
+                attn_out = attn_out + p["attn"]["bo"].astype(cfg.dtype)
+            attn_out = attn_out[:, None, :]
+        else:
+            if impl == "pallas":
+                from kubernetes_cloud_tpu.ops.paged_attention import (
+                    paged_segment_attention,
+                )
+
+                attn_vec = paged_segment_attention(
+                    q[:, 0],
+                    ck if quant else ck.astype(cfg.dtype),
+                    cv if quant else cv.astype(cfg.dtype),
+                    page_table, seg_slot, ctx_lens, k_scale=sk,
+                    v_scale=sv, slopes=slopes_loc, impl="pallas",
+                    interpret=interpret)[:, None]
+            else:
+                from kubernetes_cloud_tpu.ops.paged_attention import (
+                    gather_pages,
+                )
+
+                dense_k = gather_pages(ck, pt_tok, sk)
+                dense_v = gather_pages(cv, pt_tok, sv)
+                attn_vec = attention(q, dense_k.astype(cfg.dtype),
+                                     dense_v.astype(cfg.dtype),
+                                     causal=False, bias=bias,
+                                     mask=key_mask, impl="xla")
+            attn_out = _tp_wo(cfg, p, attn_vec)
+        x = _tp_finish(cfg, p, x, attn_out, mask2, True)
+        return x, ((ck, cv, sk, sv) if quant else (ck, cv))
+
+    if quant:
+        xs = (params["blocks"], arena["k"], arena["v"],
+              arena["k_scale"], arena["v_scale"])
+        x, (ks, vs, ssk, ssv) = jax.lax.scan(body, x, xs)
+        new_arena = {"k": ks, "v": vs, "k_scale": ssk, "v_scale": ssv}
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["blocks"], arena["k"], arena["v"]))
+        new_arena = {"k": ks, "v": vs}
+    logits = _tp_unembed(cfg, params, x[out_rows], idx, m)[:, 0]
+    return logits, new_arena
+
+
 #: (cfg, mesh, kv_dtype, attn_impl) → (prefill_jit, decode_jit,
 #: verify_jit); one compilation cache shared by every engine
 #: incarnation (a supervisor restart builds a new engine but reuses
-#: the programs)
+#: the programs).  Ragged engines key with a trailing "ragged" marker
+#: and cache the single hybrid program instead of the trio.
 _PROGRAMS: dict = {}
 
 
@@ -634,3 +763,46 @@ def build_tp_programs(cfg: CausalLMConfig, mesh, params_split: Params, *,
                 jax.jit(verify, donate_argnums=(3,)))
     _PROGRAMS[key] = programs
     return programs
+
+
+def build_tp_ragged_program(cfg: CausalLMConfig, mesh,
+                            params_split: Params, *,
+                            kv_dtype: str = "fp32",
+                            attn_impl: str = "gather"):
+    """ONE jitted shard_map program for the ragged hybrid iteration —
+    the whole sharded surface of a ragged engine (``EngineConfig.
+    ragged``): prefill chunks, decode steps, spec-verify windows, and
+    COW copies are all segment shapes inside this single program, so a
+    TP engine pays one shard_map launch per scheduler pass instead of
+    up to four.
+
+    Signature (static config bound):
+
+    * ``ragged(params, tokens, seg_slot, positions, mask, arena,
+      table, out_rows, copy_src, copy_dst)`` → ``(logits [M, V],
+      arena)``
+
+    The arena argument is donated, like the trio's."""
+    key = (cfg, mesh, kv_dtype, attn_impl, "ragged")
+    if key in _PROGRAMS:
+        return _PROGRAMS[key]
+    reason = tp_unsupported_reason(cfg, mesh)
+    if reason is not None:
+        raise ValueError(f"TP ragged program unsupported: {reason}")
+    m = tp_shards(mesh)
+    interpret = jax.default_backend() != "tpu"
+    quant = kv_dtype == "int8"
+    pspecs = tp_param_specs(params_split)
+    arena_spec = kv_arena_specs(quant)
+    rep = P()
+
+    ragged = shard_map(
+        functools.partial(_ragged_shard_fn, cfg, m, attn_impl, interpret),
+        mesh=mesh,
+        in_specs=(pspecs, rep, rep, rep, rep, arena_spec, rep, rep, rep,
+                  rep),
+        out_specs=(rep, arena_spec),
+        check_rep=False)
+    program = jax.jit(ragged, donate_argnums=(5,))
+    _PROGRAMS[key] = program
+    return program

@@ -83,6 +83,10 @@ METRIC_FAMILIES = {
         "speculative draft tokens by verification result",
     "kct_engine_prefill_chunks_total":
         "chunked-prefill slices dispatched (Sarathi co-scheduling)",
+    "kct_engine_dispatches_total":
+        "device programs launched by the scheduler, by kind",
+    "kct_engine_padded_tokens_total":
+        "token rows computed that carried no real work (padding)",
     # multi-tenant traffic plane (serve/tenancy.py)
     "kct_tenant_admitted_total":
         "requests admitted into slots per tenant and QoS lane",

@@ -58,8 +58,13 @@ from typing import Any, Optional
 #: per-token step (serve/spec_decode.py): draft-model proposal steps
 #: vs the ONE batched target verification dispatch that replaces the
 #: decode dispatch on speculative rounds
+#: "ragged" is the flat-batch hybrid iteration (ragged dispatch): ONE
+#: device program per scheduler pass covering every prefill chunk,
+#: admission tail, decode step, spec verification, and COW copy as
+#: segments — it replaces cow_copy/prefill/decode/verify device time
+#: on engines with EngineConfig.ragged
 PHASES = ("admit", "cow_copy", "prefill", "decode", "fused_decode",
-          "draft", "verify", "sample", "stream", "host_sync",
+          "ragged", "draft", "verify", "sample", "stream", "host_sync",
           "kv_transfer")
 
 

@@ -214,3 +214,33 @@ def fused_paged_decode(
                             interpret)
     return _ref_impl(q, k_pages, v_pages, page_table, ctx_lens, wo,
                      slopes, float(scale), k_scale, v_scale)
+
+
+def fused_paged_segment(
+    q: jax.Array,            # [N, H, D] one query per flat token
+    k_pages: jax.Array,      # [NP, ps, Hkv, D] arena (one layer)
+    v_pages: jax.Array,
+    page_table: jax.Array,   # [S, P] physical page per slot block
+    seg_slot: jax.Array,     # [N] owning slot per flat token
+    ctx_lens: jax.Array,     # [N] keys visible to each token (incl. self)
+    wo: jax.Array,           # [H, Dh, hidden] output projection
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    slopes: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    impl: str = "ref",
+    interpret: bool = False,
+) -> jax.Array:
+    """Segment-aware fused decode for a flat ragged token batch: the
+    per-token expansion of the slot page table
+    (:func:`kubernetes_cloud_tpu.ops.paged_attention.
+    paged_segment_attention`) feeding the fused gather + attention +
+    projection kernel.  The kernel grid is per-row in N, so multi-token
+    segments (prefill chunks, spec-verify windows) ride the decode
+    kernel unchanged — within-segment causality is entirely in
+    ``ctx_lens``.  Returns ``[N, hidden]`` (``W_o`` applied)."""
+    return fused_paged_decode(
+        q, k_pages, v_pages, page_table[seg_slot], ctx_lens, wo,
+        k_scale=k_scale, v_scale=v_scale, slopes=slopes, scale=scale,
+        impl=impl, interpret=interpret)

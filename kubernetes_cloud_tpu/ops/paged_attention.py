@@ -220,3 +220,40 @@ def paged_decode_attention(
                             k_scale=k_scale, v_scale=v_scale)
     return _gather_impl(q, k_pages, v_pages, page_table, ctx_lens, slopes,
                         float(scale), k_scale=k_scale, v_scale=v_scale)
+
+
+def paged_segment_attention(
+    q: jax.Array,            # [N, H, D] one query per flat token
+    k_pages: jax.Array,      # [NP, ps, Hkv, D] arena (one layer)
+    v_pages: jax.Array,
+    page_table: jax.Array,   # [S, P] physical page per slot block
+    seg_slot: jax.Array,     # [N] owning slot per flat token
+    ctx_lens: jax.Array,     # [N] keys visible to each token (incl. self)
+    *,
+    k_scale: Optional[jax.Array] = None,  # [NP, Hkv] int8 dequant
+    v_scale: Optional[jax.Array] = None,
+    slopes: Optional[jax.Array] = None,   # [H] ALiBi slopes
+    scale: Optional[float] = None,
+    impl: str = "gather",
+    interpret: bool = False,
+) -> jax.Array:
+    """Segment-aware paged attention for a flat ragged token batch.
+
+    The ragged engine iteration (Orca selective batching) runs one query
+    row per *real* token: segment membership is ``seg_slot`` — each
+    token routes through its owning slot's row of the SAME per-slot
+    page indirection decode uses, expanded per-token
+    (``page_table[seg_slot]``).  Per-token ``ctx_lens`` carries the
+    causal frontier (``position + 1``), so a prefill chunk's tokens see
+    the resident prefix plus the within-chunk triangle, a decode token
+    sees everything before it, and a spec-verify token sees the drafts
+    ahead of it in the batch masked off — all three are just segment
+    shapes over one kernel.  Both backends are per-row in N, so this
+    delegates to :func:`paged_decode_attention` on the expanded table
+    and inherits its numerics exactly (the gather path stays
+    bit-identical to the padded programs it replaces).  Returns
+    ``[N, H, D]``."""
+    return paged_decode_attention(
+        q, k_pages, v_pages, page_table[seg_slot], ctx_lens,
+        k_scale=k_scale, v_scale=v_scale, slopes=slopes, scale=scale,
+        impl=impl, interpret=interpret)

@@ -76,6 +76,7 @@ from kubernetes_cloud_tpu.models.generate import (
     prefill_chunk_into_slots,
     prefill_into_pages,
     prefill_into_slots,
+    ragged_step_pages,
     verify_step_pages,
 )
 from kubernetes_cloud_tpu.serve.errors import (
@@ -237,6 +238,23 @@ _M_PREFILL_CHUNKS = obs.counter(
     "Chunked-prefill slices dispatched (Sarathi co-scheduling): a "
     "long prompt admits as several bounded chunks interleaved with "
     "decode steps instead of one stall-length prefill.", ("model",))
+_M_DISPATCHES = obs.counter(
+    "kct_engine_dispatches_total",
+    "Device programs the scheduler launched, by kind.  The padded "
+    "multi-program iteration issues up to one each of prefill | "
+    "chunk_prefill | decode | verify | cow_copy per pass; the ragged "
+    "engine issues exactly one kind=\"ragged\" flat-batch program — "
+    "rate(kind=\"ragged\") vs the sum of the padded kinds is the "
+    "dispatch-count delta the ragged A/B lane reports.",
+    ("model", "kind"))
+_M_PADDED_TOKENS = obs.counter(
+    "kct_engine_padded_tokens_total",
+    "Token rows computed but carrying no real work: bucket padding in "
+    "prefill/chunk dispatches, frozen slots in decode steps, masked "
+    "draft lanes in verification, and ladder padding in the ragged "
+    "flat batch.  The waste the ragged dispatch deletes — compare "
+    "against kct_engine_tokens_total for the padding overhead ratio.",
+    ("model",))
 
 
 class RequestCancelled(RuntimeError):
@@ -334,6 +352,17 @@ class EngineConfig:
     #: draft tokens proposed (and verified in ONE batched target
     #: step) per speculative round
     spec_k: int = 4
+    #: ragged token-level dispatch (Orca selective batching / Sarathi
+    #: single hybrid batch): every scheduler pass runs ONE flat
+    #: ``[total_tokens]`` program — prefill chunks, decode steps,
+    #: spec-decode verification and COW copies are just segment shapes
+    #: inside it, with attention routed per-segment through the paged
+    #: indirection.  Token counts bucket to a small power-of-two
+    #: ladder so the executable cache stays bounded (deploy/README.md
+    #: "Ragged dispatch").  Paged engines only; the padded
+    #: multi-program iteration remains as the ``ragged=False``
+    #: fallback for one release.
+    ragged: bool = True
 
     def __post_init__(self):
         if self.slots < 1:
@@ -578,14 +607,13 @@ class GenRequest:
         return list(self.tokens)
 
 
-def _sample_host(logits: np.ndarray, rng: np.random.Generator, *,
-                 temperature: float, top_k: int, top_p: float) -> int:
-    """Host-side mirror of :func:`models.generate.sample_token` for one
-    slot's [V] logits row.  Greedy (temperature 0) is exactly argmax, so
-    greedy decode is token-identical to the device sampler; stochastic
-    sampling matches its distribution (numpy RNG, not jax's)."""
-    if temperature == 0.0:
-        return int(logits.argmax())
+def _filtered_probs(logits: np.ndarray, *, temperature: float,
+                    top_k: int, top_p: float) -> np.ndarray:
+    """The stochastic sampling distribution for one [V] logits row:
+    temperature → top-k → top-p filtering, then softmax — the exact op
+    order ``_sample_host`` has always used (refactored out so
+    speculative rejection sampling can score draft tokens against the
+    same distribution the non-speculative path samples from)."""
     logits = logits.astype(np.float64) / temperature
     if 0 < top_k < logits.shape[-1]:
         kth = np.sort(logits)[-top_k]
@@ -597,7 +625,20 @@ def _sample_host(logits: np.ndarray, rng: np.random.Generator, *,
         cutoff = sorted_logits[min(int((cum < top_p).sum()),
                                    len(sorted_logits) - 1)]
         logits = np.where(logits < cutoff, -np.inf, logits)
-    return int(rng.choice(logits.shape[-1], p=_softmax(logits)))
+    return _softmax(logits)
+
+
+def _sample_host(logits: np.ndarray, rng: np.random.Generator, *,
+                 temperature: float, top_k: int, top_p: float) -> int:
+    """Host-side mirror of :func:`models.generate.sample_token` for one
+    slot's [V] logits row.  Greedy (temperature 0) is exactly argmax, so
+    greedy decode is token-identical to the device sampler; stochastic
+    sampling matches its distribution (numpy RNG, not jax's)."""
+    if temperature == 0.0:
+        return int(logits.argmax())
+    probs = _filtered_probs(logits, temperature=temperature,
+                            top_k=top_k, top_p=top_p)
+    return int(rng.choice(probs.shape[-1], p=probs))
 
 
 def _softmax(x: np.ndarray) -> np.ndarray:
@@ -662,6 +703,87 @@ def _jit_verify_pages():
     return _JITTED["verify_pages"]
 
 
+def _jit_ragged_pages():
+    if "ragged_pages" not in _JITTED:
+        _JITTED["ragged_pages"] = jax.jit(
+            ragged_step_pages, static_argnums=0,
+            static_argnames=("impl",), donate_argnums=6)
+    return _JITTED["ragged_pages"]
+
+
+def _pow2_bucket(n: int, floor: int) -> int:
+    """Smallest power-of-two ≥ max(n, floor) — the ragged geometry
+    ladder (log-many compiled shapes per dimension)."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class _RaggedPass:
+    """One scheduler pass's flat hybrid batch, accumulated host-side.
+
+    The scheduler's builders (chunk continuation, admission, decode,
+    spec verify) append *segments* — runs of real tokens for one slot
+    at absolute context positions — plus copy-on-write page pairs and
+    deferred continuations; ``_flush_ragged`` then pads to the
+    geometry ladder, runs ONE device program, and replays the
+    continuations (emit / finish-chunking / handoff) against the
+    gathered logits in build order."""
+
+    __slots__ = ("tokens", "seg_slot", "positions", "out_rows",
+                 "copy_src", "copy_dst", "override_rows",
+                 "continuations", "kinds", "step_slots", "_base_slots")
+
+    def __init__(self, slots: int):
+        self.tokens: list[int] = []
+        self.seg_slot: list[int] = []
+        self.positions: list[int] = []
+        #: flat-batch row indices whose logits the host reads
+        self.out_rows: list[int] = []
+        self.copy_src: list[int] = []
+        self.copy_dst: list[int] = []
+        #: page lists dispatched as table rows ``slots + i`` — a
+        #: mid-chunk slot's global table row is deliberately null, and
+        #: a slot preempted+refilled within one pass needs two
+        #: different rows, so chunk segments always route through a
+        #: private virtual row instead of the slot's own
+        self.override_rows: list[list] = []
+        self.continuations: list = []
+        self.kinds: set[str] = set()
+        #: decode/verify slots stepped this pass (active_slot_steps)
+        self.step_slots = 0
+        self._base_slots = slots
+
+    def override(self, pages: list) -> int:
+        """Reserve a private table row; returns its virtual slot id."""
+        self.override_rows.append(list(pages))
+        return self._base_slots + len(self.override_rows) - 1
+
+    def add_segment(self, vslot: int, token_ids, start: int, *,
+                    kind: str, out: str) -> list[int]:
+        """Append one segment; ``out`` is which rows the host will
+        read ("all" | "last" | "none").  Returns indices into the
+        flush's gathered logits for those rows."""
+        base = len(self.tokens)
+        n = len(token_ids)
+        self.tokens.extend(int(t) for t in token_ids)
+        self.seg_slot.extend([int(vslot)] * n)
+        self.positions.extend(range(int(start), int(start) + n))
+        self.kinds.add(kind)
+        if out == "all":
+            rows = range(base, base + n)
+        elif out == "last" and n:
+            rows = [base + n - 1]
+        else:
+            rows = []
+        idxs = []
+        for r in rows:
+            idxs.append(len(self.out_rows))
+            self.out_rows.append(r)
+        return idxs
+
+
 class ContinuousBatchingEngine:
     """Owns the slot pool and the scheduler thread.
 
@@ -717,6 +839,14 @@ class ContinuousBatchingEngine:
         self._copy_pages = _jit_copy_pages()
         self._chunk_slots = _jit_chunk_slots()
         self._verify_pages = _jit_verify_pages()
+        self._ragged_pages = _jit_ragged_pages()
+        #: ragged token-level dispatch: the whole pass is ONE flat-
+        #: batch program; paged engines only (the segment routing IS
+        #: the paged indirection)
+        self._ragged = engine_cfg.paged and engine_cfg.ragged
+        #: the pass under construction (scheduler thread only); None
+        #: between passes and always None on the padded path
+        self._pass: Optional[_RaggedPass] = None
         #: chunked prefill (Sarathi co-scheduling): slots mid-prefill,
         #: slot -> {"req", "vprompt", "resumed", "res"}; the request's
         #: ``prefill_pos`` tracks delivered positions.  Chunking slots
@@ -744,21 +874,37 @@ class ContinuousBatchingEngine:
             reason = tp_decode.tp_unsupported_reason(cfg, mesh)
             if reason is None:
                 self.params = tp_decode.place_tp_params(cfg, params, mesh)
-                _tp_pf, _tp_dec, _tp_vf = tp_decode.build_tp_programs(
-                    cfg, mesh, self.params,
-                    kv_dtype=engine_cfg.kv_dtype,
-                    attn_impl=engine_cfg.attn_impl)
-                # same call signature as the single-chip jits (cfg is
-                # baked into the shard_map closure; impl likewise)
-                self._prefill_pages = (
-                    lambda _c, p, ids, msk, pool, tbl, st:
-                    _tp_pf(p, ids, msk, pool, tbl, st))
-                self._decode_pages = (
-                    lambda _c, p, tok, pool, tbl, ln, impl=None:
-                    _tp_dec(p, tok, pool, tbl, ln))
-                self._verify_pages = (
-                    lambda _c, p, tok, msk, pool, tbl, ln:
-                    _tp_vf(p, tok, msk, pool, tbl, ln))
+                if self._ragged:
+                    # ragged engines build ONE shard_map program — the
+                    # flat hybrid batch is the only iteration shape, so
+                    # the legacy prefill/decode/verify trio never
+                    # compiles
+                    _tp_rg = tp_decode.build_tp_ragged_program(
+                        cfg, mesh, self.params,
+                        kv_dtype=engine_cfg.kv_dtype,
+                        attn_impl=engine_cfg.attn_impl)
+                    self._ragged_pages = (
+                        lambda _c, p, tok, ss, pos, msk, pool, tbl,
+                        orows, csrc, cdst, impl=None:
+                        _tp_rg(p, tok, ss, pos, msk, pool, tbl,
+                               orows, csrc, cdst))
+                else:
+                    _tp_pf, _tp_dec, _tp_vf = tp_decode.build_tp_programs(
+                        cfg, mesh, self.params,
+                        kv_dtype=engine_cfg.kv_dtype,
+                        attn_impl=engine_cfg.attn_impl)
+                    # same call signature as the single-chip jits (cfg
+                    # is baked into the shard_map closure; impl
+                    # likewise)
+                    self._prefill_pages = (
+                        lambda _c, p, ids, msk, pool, tbl, st:
+                        _tp_pf(p, ids, msk, pool, tbl, st))
+                    self._decode_pages = (
+                        lambda _c, p, tok, pool, tbl, ln, impl=None:
+                        _tp_dec(p, tok, pool, tbl, ln))
+                    self._verify_pages = (
+                        lambda _c, p, tok, msk, pool, tbl, ln:
+                        _tp_vf(p, tok, msk, pool, tbl, ln))
                 self._tp_active = True
             else:
                 log.warning(
@@ -883,7 +1029,12 @@ class ContinuousBatchingEngine:
                       # ledger (drafted vs accepted is the accept
                       # ratio; rounds = verification dispatches)
                       "prefill_chunks": 0, "spec_rounds": 0,
-                      "spec_drafted": 0, "spec_accepted": 0}
+                      "spec_drafted": 0, "spec_accepted": 0,
+                      # ragged-dispatch A/B accounting: device programs
+                      # launched (every kind) and token rows computed
+                      # as padding — the bench's dispatch-count and
+                      # padding-waste deltas read straight from here
+                      "dispatches": 0, "padded_tokens": 0}
         #: always-on flight recorder: bounded ring of per-iteration
         #: phase timings + batch composition (GET /debug/timeline);
         #: flight_records=0 disables it for overhead A/Bs.  A restart
@@ -953,6 +1104,11 @@ class ContinuousBatchingEngine:
         self._m_spec_rejected = _M_SPEC_TOKENS.labels(
             model=self.name, result="rejected")
         self._m_prefill_chunks = _M_PREFILL_CHUNKS.labels(**m)
+        self._m_dispatch = {
+            kind: _M_DISPATCHES.labels(model=self.name, kind=kind)
+            for kind in ("prefill", "chunk_prefill", "decode", "verify",
+                         "cow_copy", "ragged")}
+        self._m_padded = _M_PADDED_TOKENS.labels(**m)
         if self.draft is not None:
             self._m_spec_accept.set(0.0)
         self._m_kv_transfer_s = _M_KV_TRANSFER_S.labels(**m)
@@ -1004,7 +1160,20 @@ class ContinuousBatchingEngine:
         # makes this instant on warm boots.  Prefill compiles stay
         # per-bucket on demand, protected by the compile_grace_s window
         # (_admit raises grace_until around each first-time shape).
-        if self.paged:
+        if self._ragged:
+            # the steady-state ragged decode shape: the smallest
+            # ladder rung (8 tokens, 8 out rows, no COW).  All-masked
+            # rows write into the null page, so this is a semantic
+            # no-op exactly like the frozen decode warm-up below.
+            z8 = jnp.zeros((8,), jnp.int32)
+            tbl = jnp.zeros((2 * self.ecfg.slots,
+                             self.ecfg.pages_per_slot), jnp.int32)
+            c0 = jnp.zeros((0,), jnp.int32)
+            _, self.pool = self._ragged_pages(
+                self.cfg, self.params, z8, z8, z8, z8, self.pool,
+                tbl, z8, c0, c0, impl=self.ecfg.attn_impl)
+            self._warm_shapes.add(("ragged", 8, 8, 0))
+        elif self.paged:
             _, self.pool = self._decode_pages(
                 self.cfg, self.params,
                 jnp.zeros((self.ecfg.slots,), jnp.int32), self.pool,
@@ -1566,6 +1735,7 @@ class ContinuousBatchingEngine:
             meta["num_pages"] = self._num_pages
             meta["attn_impl"] = self.ecfg.attn_impl
             meta["kv_dtype"] = self.ecfg.kv_dtype
+            meta["ragged"] = self._ragged
         if self.ecfg.prefill_chunk_tokens:
             meta["prefill_chunk_tokens"] = self.ecfg.prefill_chunk_tokens
         if self.draft is not None:
@@ -1746,6 +1916,11 @@ class ContinuousBatchingEngine:
         self._reap_cancelled()
         ch = self.ecfg.prefill_chunk_tokens
         self._budget_left = ch if ch else None
+        # ragged mode: every builder below appends segments to this
+        # pass instead of dispatching its own padded program; ONE
+        # flush at the end of the pass runs the whole hybrid batch
+        self._pass = (_RaggedPass(self.ecfg.slots)
+                      if self._ragged else None)
         admitted = 0
         # mid-prefill slots advance EVERY pass, drain included: their
         # pending chunks are in-flight work exactly like active slots
@@ -1773,9 +1948,19 @@ class ContinuousBatchingEngine:
         if rec is not None:
             rec.prefilling = len(self._chunking)
         partial = bool(self._chunking)
+        # a slot admitted THIS pass under ragged dispatch has no
+        # emitted token yet (its first sample waits on the flush), so
+        # it cannot feed a decode segment — it joins next pass, same
+        # (context, feed) sequence one pass later.  Padded admission
+        # emits eagerly, so the guard never bites there.
         active = [i for i, s in enumerate(self._slots)
-                  if s is not None and i not in self._chunking]
+                  if s is not None and i not in self._chunking
+                  and (s.tokens or self._pass is None)]
         if not active:
+            # prefill/chunk-only pass: the built segments (if any)
+            # still need their one dispatch before the continuations
+            # can emit first tokens / finish chunking
+            self._flush_ragged()
             if admitted or chunked:
                 (self._m_iter_chunked if partial or chunked
                  else self._m_iter_prefill
@@ -1786,22 +1971,127 @@ class ContinuousBatchingEngine:
                 if not self.tenants.depth() and not self._chunking:
                     self._work.wait(self.ecfg.idle_wait_s)
             return
-        greedy = ([i for i in active
-                   if self._slots[i].temperature == 0.0]
-                  if self.draft is not None else [])
-        if greedy:
-            self._spec_round(active, greedy)
+        if self.draft is not None:
+            # every slot speculates: greedy slots verify by exact
+            # match, stochastic slots by rejection sampling against
+            # the verification distribution (distribution-exact)
+            self._spec_round(active)
         else:
             self._decode_round(active)
+        self._flush_ragged()
         (((self._m_iter_chunked if partial or chunked
            else self._m_iter_prefill) if (admitted or chunked)
           else self._m_iter_decode)
          ).observe(time.perf_counter() - t_pass)
         self._commit_rec(t_pass)
 
+    def _count_dispatch(self, kind: str, padded: int) -> None:
+        """Dispatch/padding accounting: one device program launched,
+        ``padded`` of whose token rows carried no real work (bucket
+        padding, frozen slots, masked draft lanes, ladder rounding).
+        The ragged A/B bench lane reads both deltas from here."""
+        self._m_dispatch[kind].inc()
+        self.stats["dispatches"] += 1
+        if padded > 0:
+            self._m_padded.inc(padded)
+            self.stats["padded_tokens"] += padded
+
+    def _flush_ragged(self) -> None:
+        """THE engine iteration under ragged dispatch: run the pass's
+        flat hybrid batch — every chunk-prefill, admission-prefill,
+        decode, and spec-verify segment the builders appended, plus
+        the COW page copies — as ONE device program, then replay the
+        deferred host continuations in build order (exactly the padded
+        engine's emission order).
+
+        The flat length rides a pow-2 geometry ladder (floor 8) so the
+        executable cache stays bounded: a pass with 37 real tokens and
+        5 read rows runs the (64, 8) bucket, not a fresh compile per
+        shape.  Padding rows are masked (``valid=False`` routes their
+        KV writes to the null page) and read row 0 harmlessly.  The
+        page table ships as ``[2*slots, P]``: rows < slots mirror
+        ``_page_table``, rows >= slots are the pass's private override
+        rows (mid-chunk prefill writes into reservation pages the
+        slot's global row deliberately doesn't hold yet)."""
+        ps, self._pass = self._pass, None
+        if ps is None or not ps.tokens:
+            return
+        rec = self._rec
+        n_real = len(ps.tokens)
+        m_real = len(ps.out_rows)
+        c_real = len(ps.copy_src)
+        n_b = _pow2_bucket(n_real, 8)
+        m_b = _pow2_bucket(max(m_real, 1), 8)
+        # COW pairs round to 8; zero stays zero (the common no-COW
+        # pass must not drag a copy prologue into its executable)
+        c_b = (-(-c_real // 8) * 8) if c_real else 0
+        tokens = np.full((n_b,), self.pad, np.int32)
+        tokens[:n_real] = ps.tokens
+        seg = np.zeros((n_b,), np.int32)
+        seg[:n_real] = ps.seg_slot
+        pos = np.zeros((n_b,), np.int32)
+        pos[:n_real] = ps.positions
+        mask = np.zeros((n_b,), np.int32)
+        mask[:n_real] = 1
+        out_rows = np.zeros((m_b,), np.int32)
+        out_rows[:m_real] = ps.out_rows
+        # padded copy pairs are (0, 0): a null-page self-copy
+        csrc = np.zeros((c_b,), np.int32)
+        cdst = np.zeros((c_b,), np.int32)
+        csrc[:c_real] = ps.copy_src
+        cdst[:c_real] = ps.copy_dst
+        slots = self.ecfg.slots
+        table = np.zeros((2 * slots, self.ecfg.pages_per_slot),
+                         np.int32)
+        table[:slots] = self._page_table
+        for i, pages in enumerate(ps.override_rows):
+            table[slots + i, :len(pages)] = pages
+        shape_key = ("ragged", n_b, m_b, c_b)
+        cold = self._prefill_cold_guard(shape_key)
+        if "verify" in ps.kinds:
+            faults.fire("spec.verify")
+        if "decode" in ps.kinds or "verify" in ps.kinds:
+            faults.fire("decode_step")
+        faults.fire("model_fn")
+        t0 = time.perf_counter()
+        logits, self.pool = self._ragged_pages(
+            self.cfg, self.params, jnp.asarray(tokens),
+            jnp.asarray(seg), jnp.asarray(pos), jnp.asarray(mask),
+            self.pool, jnp.asarray(table), jnp.asarray(out_rows),
+            jnp.asarray(csrc), jnp.asarray(cdst),
+            impl=self.ecfg.attn_impl)
+        logits.block_until_ready()
+        if cold:
+            self._warm_shapes.add(shape_key)
+        t1 = time.perf_counter()
+        logits = np.asarray(logits)
+        t2 = time.perf_counter()
+        self._count_dispatch("ragged", n_b - n_real)
+        if c_real:
+            self.stats["cow_copies"] += c_real
+            self._m_cow.inc(c_real)
+        if "decode" in ps.kinds or "verify" in ps.kinds:
+            dt = t2 - t0
+            self.iter_s = dt if self.iter_s is None else (
+                0.9 * self.iter_s + 0.1 * dt)
+            self.stats["iterations"] += 1
+            self.stats["active_slot_steps"] += ps.step_slots
+            self._m_iters.inc()
+            if "verify" in ps.kinds:
+                self.stats["spec_rounds"] += 1
+        if rec is not None:
+            rec.phases["ragged"] = rec.phases.get("ragged", 0.0) \
+                + (t1 - t0)
+            rec.phases["host_sync"] = rec.phases.get("host_sync", 0.0) \
+                + (t2 - t1)
+        for fin in ps.continuations:
+            fin(logits)
+
     def _decode_round(self, active: list[int]) -> None:
         """The classic per-token step: ONE decode dispatch for every
-        decode-ready slot."""
+        decode-ready slot.  Ragged mode builds one-token segments into
+        the pass instead (zero padding: the flat batch holds exactly
+        ``len(active)`` rows before the ladder rounds up)."""
         rec = self._rec
         tokens = np.full((self.ecfg.slots,), self.pad, np.int32)
         mask = np.zeros((self.ecfg.slots,), bool)
@@ -1813,6 +2103,28 @@ class ContinuousBatchingEngine:
             mask[i] = True
             ctx_sum += min(len(req.prompt_ids) + len(req.tokens) + 1,
                            self.ecfg.max_len)
+        if self._pass is not None:
+            rows = {}
+            for i in active:
+                idx = self._pass.add_segment(
+                    i, [int(tokens[i])], int(self._lengths[i]),
+                    kind="decode", out="all")
+                rows[i] = idx[0]
+                self._lengths[i] += 1
+            self._pass.step_slots += len(active)
+            if rec is not None:
+                rec.active = len(active)
+                rec.decode_tokens = len(active)
+                rec.flops += (len(active) * self._flops_base
+                              + self._flops_per_ctx * ctx_sum)
+
+            def _fin(logits, order=list(active), rows=rows):
+                for i in order:
+                    if self._slots[i] is not None:
+                        self._emit(i, logits[rows[i]])
+
+            self._pass.continuations.append(_fin)
+            return
         faults.fire("decode_step")
         faults.fire("model_fn")
         t0 = time.perf_counter()
@@ -1830,6 +2142,7 @@ class ContinuousBatchingEngine:
             logits, self.pool = self._decode(self.cfg, self.params,
                                              jnp.asarray(tokens), self.pool,
                                              jnp.asarray(mask))
+        self._count_dispatch("decode", self.ecfg.slots - len(active))
         # decode = dispatch + device compute; host_sync = the
         # device→host logits copy (the split the flight recorder
         # reports; the explicit block costs nothing — asarray would
@@ -1856,21 +2169,24 @@ class ContinuousBatchingEngine:
         for i in active:
             self._emit(i, logits[i])
 
-    def _spec_round(self, active: list[int], greedy: list[int]) -> None:
+    def _spec_round(self, active: list[int]) -> None:
         """One speculative pass (serve/spec_decode.py): the draft
-        source proposes up to ``spec_k`` tokens per greedy slot, and
-        ONE batched target dispatch (``verify_step_pages``) scores
-        every slot's pending token plus its drafts at their true
-        positions through the paged arena.  The host then emits the
-        longest prefix where the target's own greedy choice equals the
-        draft (plus the one bonus token the target computed anyway) —
-        bitwise the sequence non-speculative decode would emit — and
-        rolls rejected-draft KV back by simply not advancing host-side
+        source proposes up to ``spec_k`` tokens per active slot, and
+        ONE batched target dispatch scores every slot's pending token
+        plus its drafts at their true positions through the paged
+        arena.  Greedy (temperature 0) slots emit the longest prefix
+        where the target's own argmax equals the draft (plus the one
+        bonus token the target computed anyway) — bitwise the sequence
+        non-speculative decode would emit.  Stochastic slots emit via
+        rejection sampling against the verification rows' filtered
+        distributions (``_emit_rejection``) — distribution-exact, so
+        temperature > 0 requests finally speculate too.  Either way
+        rejected-draft KV rolls back by simply not advancing host-side
         lengths past the accepted context: pages are append-only per
         slot, so the next real write at each position overwrites the
-        dead rows.  Stochastic (temperature > 0) slots ride the same
-        dispatch drafts-free and keep their one-token-per-pass
-        semantics."""
+        dead rows.  Ragged mode builds the verification as per-slot
+        segments of the pass's flat batch instead of a padded
+        ``[slots, k+1]`` dispatch."""
         rec = self._rec
         k = self.ecfg.spec_k
         # cold-compile window: the first round compiles the verify
@@ -1880,19 +2196,19 @@ class ContinuousBatchingEngine:
         # compile as a wedged device and restarts a healthy engine
         cold = not self._spec_warm or (
             getattr(self.draft, "compiles_on_slot_ready", False)
-            and any(i not in self._spec_ready for i in greedy))
+            and any(i not in self._spec_ready for i in active))
         if cold:
             self.grace_until = max(
                 self.grace_until,
                 time.monotonic() + self.ecfg.compile_grace_s)
         t0 = time.perf_counter()
-        for i in greedy:
+        for i in active:
             if i not in self._spec_ready:
                 req = self._slots[i]
                 self.draft.slot_ready(i, req.prompt_ids + req.tokens)
                 self._spec_ready.add(i)
         want = {i: self._slots[i].prompt_ids + self._slots[i].tokens
-                for i in greedy}
+                for i in active}
         props = self.draft.propose(want, k)
         t1 = time.perf_counter()
         dsteps = getattr(self.draft, "last_steps", 0)
@@ -1909,29 +2225,75 @@ class ContinuousBatchingEngine:
             if cold:
                 self.grace_until = 0.0  # no verify compile happened
             self._decode_round(active)
-            for i in greedy:
+            if self._pass is not None:
+                # the context roll must see the token the deferred
+                # decode continuation emits — observe after the flush
+                def _observe(_logits, order=list(active)):
+                    for i in order:
+                        if (i in self._spec_ready
+                                and self._slots[i] is not None):
+                            req = self._slots[i]
+                            self.draft.observe(
+                                i, req.prompt_ids + req.tokens)
+
+                self._pass.continuations.append(_observe)
+                return
+            for i in active:
                 if i in self._spec_ready and self._slots[i] is not None:
                     req = self._slots[i]
                     self.draft.observe(i, req.prompt_ids + req.tokens)
             return
+        l0 = self._lengths.copy()
+        drafts = {i: list((props.get(i) or [])[:k]) for i in active}
+        ctx_flops = 0.0
+        for i in active:
+            ctx_flops += obs_flops.span_flops(
+                self._flops_base, self._flops_per_ctx, int(l0[i]),
+                1 + len(drafts[i]))
+        if self._pass is not None:
+            rows = {}
+            for i in active:
+                req = self._slots[i]
+                rows[i] = self._pass.add_segment(
+                    i, [req.tokens[-1]] + drafts[i], int(l0[i]),
+                    kind="verify", out="all")
+            self._pass.step_slots += len(active)
+            if rec is not None:
+                if t1 - t0 > 0:
+                    rec.phases["draft"] = rec.phases.get("draft", 0.0) \
+                        + (t1 - t0)
+                rec.active = len(active)
+                rec.flops += ctx_flops
+                db, dp = self._draft_flops
+                if dsteps and db:
+                    avg_ctx = (sum(int(l0[i]) for i in active)
+                               / len(active))
+                    rec.flops += dsteps * len(active) * (db
+                                                         + dp * avg_ctx)
+
+            def _fin(logits, order=list(active), rows=rows,
+                     drafts=drafts, l0=l0):
+                self._spec_emit(order, l0, drafts,
+                                lambda i, j: logits[rows[i][j]])
+
+            self._pass.continuations.append(_fin)
+            if cold:
+                # the flat-batch program's compile is the flush's
+                # ladder guard's to cover; the draft's own compiles
+                # (propose above) already returned
+                self._spec_warm = True
+            return
         width = k + 1
         tokens = np.full((self.ecfg.slots, width), self.pad, np.int32)
         mask = np.zeros((self.ecfg.slots, width), np.int32)
-        l0 = self._lengths.copy()
-        ctx_flops = 0.0
         for i in active:
             req = self._slots[i]
             tokens[i, 0] = req.tokens[-1]
             mask[i, 0] = 1
-            n = 1
-            d = props.get(i)
+            d = drafts[i]
             if d:
-                d = d[:k]
                 tokens[i, 1:1 + len(d)] = d
                 mask[i, 1:1 + len(d)] = 1
-                n += len(d)
-            ctx_flops += obs_flops.span_flops(
-                self._flops_base, self._flops_per_ctx, int(l0[i]), n)
         faults.fire("spec.verify")
         faults.fire("decode_step")
         faults.fire("model_fn")
@@ -1941,6 +2303,8 @@ class ContinuousBatchingEngine:
             jnp.asarray(mask), self.pool, self._device_page_table(),
             jnp.asarray(self._lengths))
         logits.block_until_ready()
+        self._count_dispatch(
+            "verify", self.ecfg.slots * width - int(mask.sum()))
         if cold:
             self._spec_warm = True
             self.grace_until = 0.0  # compiled; wedges detect normally
@@ -1954,21 +2318,50 @@ class ContinuousBatchingEngine:
         self.stats["spec_rounds"] += 1
         self.stats["active_slot_steps"] += len(active)
         self._m_iters.inc()
+        self._spec_emit(active, l0, drafts, lambda i, j: logits[i, j])
+        if rec is not None:
+            ph = rec.phases
+            if t1 - t0 > 0:
+                ph["draft"] = ph.get("draft", 0.0) + (t1 - t0)
+            ph["verify"] = ph.get("verify", 0.0) + (t3 - t2)
+            ph["host_sync"] = ph.get("host_sync", 0.0) + (t4 - t3)
+            rec.active = len(active)
+            rec.flops += ctx_flops
+            db, dp = self._draft_flops
+            if dsteps and db and active:
+                # draft dispatches run at roughly the round's contexts
+                avg_ctx = sum(int(l0[i]) for i in active) / len(active)
+                rec.flops += dsteps * len(active) * (db + dp * avg_ctx)
+
+    def _spec_emit(self, order: list[int], l0: np.ndarray,
+                   drafts: dict, get_row) -> None:
+        """Shared verification emit (padded and ragged feed it their
+        own ``get_row``): walk each slot's verification rows, emit the
+        accepted prefix plus one extra token — greedy by exact match,
+        stochastic by rejection sampling — then roll host-side lengths
+        to the accepted context."""
+        rec = self._rec
         emitted_total = 0
         drafted_total = accepted_total = 0
-        for i in active:
+        for i in order:
             req = self._slots[i]
-            drafted = int(mask[i].sum()) - 1
-            m = 0
-            for j in range(width):
-                self._emit(i, logits[i, j])
-                m += 1
-                if self._slots[i] is None:
-                    break  # EOS / max-tokens: _finish_slot reset state
-                if j + 1 >= width or not mask[i, j + 1]:
-                    break  # no more drafts to confirm
-                if req.tokens[-1] != int(tokens[i, j + 1]):
-                    break  # target disagreed: later drafts are dead
+            if req is None:
+                continue
+            d = drafts.get(i) or []
+            drafted = len(d)
+            if req.temperature == 0.0:
+                m = 0
+                for j in range(drafted + 1):
+                    self._emit(i, get_row(i, j))
+                    m += 1
+                    if self._slots[i] is None:
+                        break  # EOS / max-tokens: _finish_slot reset
+                    if j >= drafted:
+                        break  # no more drafts to confirm
+                    if req.tokens[-1] != int(d[j]):
+                        break  # target disagreed: later drafts are dead
+            else:
+                m = self._emit_rejection(i, d, get_row)
             emitted_total += m
             if self._slots[i] is not None:
                 # the rollback IS this assignment: positions beyond
@@ -1989,21 +2382,51 @@ class ContinuousBatchingEngine:
             self._m_spec_accept.set(self.stats["spec_accepted"]
                                     / self.stats["spec_drafted"])
         if rec is not None:
-            ph = rec.phases
-            if t1 - t0 > 0:
-                ph["draft"] = ph.get("draft", 0.0) + (t1 - t0)
-            ph["verify"] = ph.get("verify", 0.0) + (t3 - t2)
-            ph["host_sync"] = ph.get("host_sync", 0.0) + (t4 - t3)
-            rec.active = len(active)
             rec.decode_tokens = emitted_total
             rec.spec_drafted = drafted_total
             rec.spec_accepted = accepted_total
-            rec.flops += ctx_flops
-            db, dp = self._draft_flops
-            if dsteps and db and greedy:
-                # draft dispatches run at roughly the round's contexts
-                avg_ctx = sum(int(l0[i]) for i in greedy) / len(greedy)
-                rec.flops += dsteps * len(greedy) * (db + dp * avg_ctx)
+
+    def _emit_rejection(self, i: int, d: list[int], get_row) -> int:
+        """Stochastic speculative emit for one slot: delta-proposal
+        rejection sampling (Leviathan et al., PAPERS.md).  The draft
+        proposes point masses, so the generic accept probability
+        min(1, p/q) reduces to p(draft) under the verification row's
+        filtered distribution; a rejection samples the residual — p
+        with the draft token zeroed, renormalized — and the emitted
+        marginal is exactly p, the distribution the non-speculative
+        path samples from.  Returns tokens emitted."""
+        req = self._slots[i]
+        m = 0
+        for j in range(len(d) + 1):
+            row = get_row(i, j)
+            if j < len(d):
+                p = _filtered_probs(row, temperature=req.temperature,
+                                    top_k=req.top_k, top_p=req.top_p)
+                t = int(d[j])
+                if float(req.rng.random()) < float(p[t]):
+                    self._emit(i, row, token=t)
+                    m += 1
+                    if self._slots[i] is None:
+                        break
+                    continue
+                residual = p.copy()
+                residual[t] = 0.0
+                s = float(residual.sum())
+                # s == 0 means p was (numerically) a point mass on the
+                # draft token itself — acceptance was then certain, so
+                # this is pure paranoia against float underflow
+                tok = (int(req.rng.choice(residual.shape[-1],
+                                          p=residual / s))
+                       if s > 0 else t)
+                self._emit(i, row, token=tok)
+                m += 1
+                break
+            # every draft accepted: the bonus token samples the last
+            # row's distribution through the ordinary path
+            self._emit(i, row)
+            m += 1
+            break
+        return m
 
     def _commit_rec(self, t_pass: float) -> None:
         """Publish the pass's flight record (if it did any work) and
@@ -2183,6 +2606,52 @@ class ContinuousBatchingEngine:
                     return total
                 take = min(take, self._budget_left)
             chunk = vprompt[pos:pos + take]
+            if self._pass is not None:
+                final = pos + take >= len(vprompt)
+                # a mid-chunk slot's GLOBAL table row is deliberately
+                # null (the publication contract: no prefix hits until
+                # the whole prompt landed), so the chunk writes route
+                # through a private override row of the flush table —
+                # which also keeps a preempt-then-readmit slot's two
+                # lives on two different rows within one pass
+                vrow = self._pass.override(self._slot_pages[slot])
+                idx = self._pass.add_segment(
+                    vrow, chunk, pos, kind="chunk",
+                    out=("last" if final and not st["resumed"]
+                         else "none"))
+                req.prefill_pos = pos + take
+                if self._budget_left is not None:
+                    self._budget_left -= take
+                total += take
+                self.stats["prefill_tokens"] += take
+                self.stats["prefill_chunks"] += 1
+                self._m_prefill_chunks.inc()
+                if st["resumed"]:
+                    self.stats["reprefill_tokens"] += take
+                rec = self._rec
+                if rec is not None:
+                    rec.prefill_tokens += take
+                    rec.flops += obs_flops.span_flops(
+                        self._flops_base, self._flops_per_ctx, pos,
+                        take)
+                if final:
+                    row = idx[0] if idx else None
+
+                    def _fin(logits, slot=slot, st=st, row=row):
+                        # guard: a mid-pass preemption already popped
+                        # this chunking state (the executed chunk
+                        # landed in the request's pinned pages with
+                        # prefill_pos advanced — resume continues
+                        # past it, nothing to finish here)
+                        if self._chunking.get(slot) is st:
+                            self._finish_chunking(
+                                slot, st,
+                                None if row is None
+                                else logits[row][None])
+
+                    self._pass.continuations.append(_fin)
+                    break
+                continue
             # chunk shapes bucket tighter than prompts (floor 4, not
             # 32): at budget 8 a 32-wide bucket would spend 4x the
             # chunk's compute on padding — the budget bounds the
@@ -2226,6 +2695,7 @@ class ContinuousBatchingEngine:
             if cold:
                 self._warm_shapes.add(shape_key)
                 self.grace_until = 0.0
+            self._count_dispatch("chunk_prefill", bucket - take)
             req.prefill_pos = pos + take
             if self._budget_left is not None:
                 self._budget_left -= take
@@ -2476,6 +2946,8 @@ class ContinuousBatchingEngine:
                 self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
                 self.pool, jnp.asarray(slots, jnp.int32))
             logits = np.asarray(logits)
+            self._count_dispatch(
+                "prefill", int(len(group) * bucket - mask.sum()))
             rec = self._rec
             if rec is not None:
                 rec.phases["prefill"] = rec.phases.get("prefill", 0.0) \
@@ -2532,6 +3004,7 @@ class ContinuousBatchingEngine:
             self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
             self.pool, jnp.asarray([slot], jnp.int32))
         logits.block_until_ready()  # discard: see docstring
+        self._count_dispatch("prefill", int(bucket - mask.sum()))
         rec = self._rec
         if rec is not None:
             rec.phases["prefill"] = rec.phases.get("prefill", 0.0) \
@@ -2698,18 +3171,128 @@ class ContinuousBatchingEngine:
             if res.cow is not None:
                 src, dst = res.cow
                 any_cow = True
+                if self._pass is not None:
+                    # the flush program's copy prologue runs before its
+                    # layer scan — i.e. before every write of the pass,
+                    # the same ordering this loop's eager dispatches
+                    # give the padded engine (flush counts the stats)
+                    self._pass.copy_src.append(src)
+                    self._pass.copy_dst.append(dst)
+                    continue
                 self.stats["cow_copies"] += 1
                 self._m_cow.inc()
                 self.pool = self._copy_pages(
                     self.pool, jnp.asarray([src], jnp.int32),
                     jnp.asarray([dst], jnp.int32))
-        if rec is not None and any_cow:
+                self._count_dispatch("cow_copy", 0)
+        if rec is not None and any_cow and self._pass is None:
             rec.phases["cow_copy"] = rec.phases.get("cow_copy", 0.0) \
                 + (time.perf_counter() - t_cow)
         if self.ecfg.prefill_chunk_tokens:
             n = self._admit_paged_chunked(free, batch, pinned)
             self._admitting = []
             return n
+        if self._pass is not None:
+            # ragged admission: every uncached tail is a segment of
+            # the pass's flat batch at its true positions — no
+            # tail-length bucketing (the flush ladder bounds shapes),
+            # no per-bucket dispatch.  Slot state installs NOW (the
+            # segment's global table row must resolve at flush);
+            # first-token emission and prefill-role handoff defer to
+            # continuations, after the program ran.
+            for req, res, vprompt, resumed in batch:
+                slot = free.pop(0)
+                self._slots[slot] = req
+                self._slot_pages[slot] = res.pages
+                self._page_table[slot, :] = 0
+                self._page_table[slot, :len(res.pages)] = res.pages
+                self._page_table_dirty = True
+                self._lengths[slot] = len(vprompt)
+                self.allocator.register(res)
+                plen = len(vprompt)
+                computed = plen - res.cached_tokens
+                idx = self._pass.add_segment(
+                    slot, vprompt[res.cached_tokens:],
+                    res.cached_tokens, kind="prefill",
+                    out=("none" if resumed else "last"))
+                self.stats["prefill_tokens"] += computed
+                with self._qlock:
+                    self.tenants.note_pages(req.tenant, len(res.pages))
+                    if not resumed:
+                        self.tenants.charge_prefill(
+                            req, computed, start=res.cached_tokens)
+                if rec is not None:
+                    rec.admitted += 1
+                    rec.prefill_tokens += computed
+                    rec.pages_reserved += len(res.pages)
+                    rec.flops += obs_flops.span_flops(
+                        self._flops_base, self._flops_per_ctx,
+                        res.cached_tokens, computed)
+                if resumed:
+                    req.resume_len = len(req.tokens)
+                    self.stats["resumed"] += 1
+                    self.stats["reprefill_tokens"] += computed
+                    trace(req.request_id, "prefill", model=self.name,
+                          slot=slot, resumed=True)
+                    if self.role == "prefill":
+                        # the re-derived KV must land in the arena
+                        # before the extract reads it
+                        def _fin(logits, slot=slot, req=req):
+                            if self._slots[slot] is req:
+                                self._handoff_slot(slot)
+
+                        self._pass.continuations.append(_fin)
+                        continue
+                    trace(req.request_id, "decode", model=self.name,
+                          slot=slot)
+                    continue
+                self.stats["admitted"] += 1
+                self.stats["prompt_tokens"] += plen
+                if res.cached_tokens:
+                    self.stats["prefix_hits"] += 1
+                    self.stats["prefix_tokens_saved"] += \
+                        res.cached_tokens
+                    self._m_prefix_hits.inc()
+                    self._m_prefix_tokens.inc(res.cached_tokens)
+                self._m_admitted.inc()
+                if rec is not None:
+                    rec.cached_tokens += res.cached_tokens
+                    if res.cached_tokens:
+                        rec.prefix_hits += 1
+                trace(req.request_id, "prefill", model=self.name,
+                      slot=slot, cached_tokens=res.cached_tokens)
+                trace(req.request_id, "decode", model=self.name,
+                      slot=slot)
+
+                def _fin(logits, slot=slot, req=req, row=idx[0]):
+                    # guard: an interactive burst next pass can't have
+                    # preempted us yet (continuations run inside this
+                    # pass), but a cancel reap can — emit only if the
+                    # slot still holds this request
+                    if self._slots[slot] is not req:
+                        return
+                    self._emit(slot, logits[row])
+                    if (self.role == "prefill"
+                            and self._slots[slot] is not None):
+                        self._handoff_slot(slot)
+
+                self._pass.continuations.append(_fin)
+            for req in pinned:
+                slot = free.pop(0)
+                pages, req.pinned_pages = req.pinned_pages, None
+                self._slots[slot] = req
+                self._slot_pages[slot] = pages
+                self._page_table[slot, :] = 0
+                self._page_table[slot, :len(pages)] = pages
+                self._page_table_dirty = True
+                self._lengths[slot] = (len(req.prompt_ids)
+                                       + len(req.tokens) - 1)
+                req.resume_len = len(req.tokens)
+                self.stats["resumed"] += 1
+                trace(req.request_id, "decode", model=self.name,
+                      slot=slot, resumed=True)
+            self._admitting = []
+            return len(batch) + len(pinned)
         by_bucket: dict[int, list[tuple[GenRequest, Any, list, bool]]] = {}
         for entry in batch:
             _, res, vprompt, _ = entry
@@ -2736,6 +3319,8 @@ class ContinuousBatchingEngine:
                 self.cfg, self.params, jnp.asarray(ids), jnp.asarray(mask),
                 self.pool, jnp.asarray(tables), jnp.asarray(start))
             logits = np.asarray(logits)
+            self._count_dispatch(
+                "prefill", int(len(group) * bucket - mask.sum()))
             if rec is not None:
                 rec.phases["prefill"] = rec.phases.get("prefill", 0.0) \
                     + (time.perf_counter() - t0)
@@ -2901,14 +3486,20 @@ class ContinuousBatchingEngine:
             bucket *= 2
         return min(bucket, self.ecfg.max_len)
 
-    def _emit(self, slot: int, logits_row: np.ndarray) -> None:
+    def _emit(self, slot: int, logits_row: np.ndarray,
+              token: Optional[int] = None) -> None:
         """Sample the slot's next token, stream it out, and evict the
         slot if the request just finished — ordering identical to
-        :func:`models.generate.generate`'s sample→emit→check-eos loop."""
+        :func:`models.generate.generate`'s sample→emit→check-eos loop.
+        ``token`` bypasses sampling for a caller that already drew it
+        (stochastic speculative accept/reject — ``_emit_rejection``
+        consumed the slot RNG itself)."""
         req = self._slots[slot]
         t0 = time.perf_counter()
-        tok = _sample_host(logits_row, req.rng, temperature=req.temperature,
-                           top_k=req.top_k, top_p=req.top_p)
+        tok = (int(token) if token is not None
+               else _sample_host(logits_row, req.rng,
+                                 temperature=req.temperature,
+                                 top_k=req.top_k, top_p=req.top_p))
         t1 = time.perf_counter()
         if req.first_token_at is None:
             req.first_token_at = time.monotonic()
@@ -3181,7 +3772,11 @@ class ContinuousBatchingModel(Model):
                 "prefill_chunk_tokens": eng.ecfg.prefill_chunk_tokens,
                 "spec_draft": (eng.draft.kind
                                if getattr(eng, "draft", None) is not None
-                               else "none")}
+                               else "none"),
+                # flat-batch vs padded multi-program iteration — a
+                # probe can tell which replica shape it is hitting
+                # mid-rollout of the ragged flag flip
+                "ragged": bool(getattr(eng, "_ragged", False))}
 
     # -- request side ------------------------------------------------------
 
@@ -3353,5 +3948,6 @@ def load_engine_config(model_dir: str) -> EngineConfig:
                                         base.prefill_chunk_tokens)),
         spec_draft=cb.get("spec_draft", base.spec_draft),
         spec_k=int(cb.get("spec_k", base.spec_k)),
+        ragged=bool(cb.get("ragged", base.ragged)),
         tenancy=parse_tenancy(raw.get("tenancy")),
     )

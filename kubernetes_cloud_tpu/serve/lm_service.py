@@ -352,6 +352,15 @@ def main(argv: Optional[list] = None) -> int:
                     help="draft tokens proposed (and verified in one "
                          "batched target step) per speculative round "
                          "(0 keeps the default)")
+    ap.add_argument("--ragged", choices=("on", "off"), default=None,
+                    help="paged continuous batching: ragged token-"
+                         "level dispatch — the scheduler pass runs ONE "
+                         "flat-batch program covering prefill chunks, "
+                         "admission tails, decode steps, spec "
+                         "verification, and COW copies as segments "
+                         "(default on; 'off' keeps the padded multi-"
+                         "program iteration for one release — see "
+                         "deploy/README.md 'Ragged dispatch')")
     ap.add_argument("--flight-records", type=int, default=-1,
                     help="continuous batching: flight-recorder ring "
                          "capacity (per-iteration phase records for "
@@ -456,6 +465,8 @@ def main(argv: Optional[list] = None) -> int:
             overrides["spec_draft"] = args.spec_draft
         if args.spec_k > 0:
             overrides["spec_k"] = args.spec_k
+        if args.ragged is not None:
+            overrides["ragged"] = args.ragged == "on"
         if args.tenancy:
             import json
 

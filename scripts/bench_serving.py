@@ -1273,6 +1273,171 @@ def run_chunked_comparison(args, svc) -> int:
     return 0
 
 
+def run_ragged_comparison(args, svc) -> int:
+    """--ragged: the flat-hybrid-batch A/B (BENCHMARKS.md "Ragged
+    dispatch").  Steady decode streams under a gapless long-prompt
+    burst, three arms on identical paged + chunked-prefill geometry:
+
+    1. **no_burst** — the ragged engine, steady streams alone: the
+       floor the acceptance bar is measured against.
+    2. **padded_burst** — ``EngineConfig(ragged=False)``: the padded
+       multi-program iteration (chunk prefill, decode, admission each
+       a separate device dispatch per pass).
+    3. **ragged_burst** — the same pressure through ONE flat ragged
+       dispatch per scheduler pass.
+
+    Acceptance: ragged inter-token p95 ≤ 1.1× the no-burst floor.
+    The record carries the two deltas the tentpole claims: device
+    dispatches per emitted token (the ``dispatches`` counter) and
+    compiled-shape count (``_warm_shapes`` — the geometry ladder's
+    recompile bound) for both arms."""
+    import threading
+    import time
+
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingEngine,
+        EngineConfig,
+    )
+
+    cfg = svc.cfg
+    params = svc.params
+    rng = random.Random(args.seed)
+    slots = max(2, args.slots // 2)
+    max_len = args.pool_max_len
+    ps = args.page_size
+    steady_n = max(2, slots // 2)
+    burst_prompt = max_len - 8
+    burst_n = 2
+    chunk = args.prefill_chunk or 48
+    duration = args.ragged_duration
+
+    def steady_prompt(i):
+        return [rng.randint(1, 200) for _ in range(6 + i)]
+
+    def burst_prompts():
+        return [[rng.randint(1, 200) for _ in range(burst_prompt)]
+                for _ in range(burst_n)]
+
+    def measure(ragged, burst, label):
+        eng = _started(ContinuousBatchingEngine(
+            cfg, params,
+            EngineConfig(slots=slots, max_len=max_len, paged=True,
+                         page_size=ps, prefill_chunk_tokens=chunk,
+                         ragged=ragged),
+            eos_token_id=None, pad_token_id=0))
+        gaps: list[float] = []
+        stop = threading.Event()
+        threads = []
+        try:
+            for i in range(steady_n):  # warm every measured shape
+                eng.submit(steady_prompt(i), max_new_tokens=2,
+                           temperature=0.0).wait()
+            warm = [eng.submit(p, max_new_tokens=4, temperature=0.0)
+                    for p in burst_prompts()]
+            for r in warm:
+                r.wait()
+            warm_stats = dict(eng.stats)
+
+            def steady(i):
+                while not stop.is_set():
+                    p = steady_prompt(i)
+                    req = eng.submit(p, temperature=0.0,
+                                     max_new_tokens=max_len - len(p) - 1)
+                    last = None
+                    try:
+                        for _ in req.iter_tokens(timeout=60.0):
+                            now = time.monotonic()
+                            if last is not None and not stop.is_set():
+                                gaps.append(now - last)
+                            last = now
+                            if stop.is_set():
+                                req.cancel()
+                    except Exception:  # noqa: BLE001 - bench load
+                        return
+
+            for i in range(steady_n):
+                t = threading.Thread(target=steady, args=(i,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+
+            def burster():
+                while not stop.is_set():
+                    brs = [eng.submit(p, max_new_tokens=4,
+                                      temperature=0.0)
+                           for p in burst_prompts()]
+                    for r in brs:
+                        try:
+                            r.wait()
+                        except Exception:  # noqa: BLE001 - bench load
+                            pass
+
+            time.sleep(0.5)
+            if burst:
+                bt = threading.Thread(target=burster, daemon=True)
+                bt.start()
+            time.sleep(duration)
+            stop.set()
+            if burst:
+                bt.join(timeout=30)
+            for t in threads:
+                t.join(timeout=30)
+            stats = dict(eng.stats)
+            shapes = len(eng._warm_shapes)
+        finally:
+            _swallow(eng.stop)
+        gaps.sort()
+
+        def q(p):
+            return (round(gaps[min(int(p * len(gaps)),
+                                   len(gaps) - 1)], 6)
+                    if gaps else None)
+
+        emitted = stats["emitted_tokens"] - warm_stats["emitted_tokens"]
+        dispatches = stats["dispatches"] - warm_stats["dispatches"]
+        out = {"label": label, "ragged": ragged,
+               "inter_token_p50_s": q(0.50),
+               "inter_token_p95_s": q(0.95),
+               "inter_token_p99_s": q(0.99), "gap_samples": len(gaps),
+               "dispatches": dispatches,
+               "dispatches_per_token": round(
+                   dispatches / max(emitted, 1), 4),
+               "padded_tokens": (stats["padded_tokens"]
+                                 - warm_stats["padded_tokens"]),
+               "emitted_tokens": emitted,
+               "compiled_shapes": shapes,
+               "prefill_chunks": stats.get("prefill_chunks", 0)}
+        print(json.dumps(out), file=sys.stderr)
+        return out
+
+    base = measure(True, burst=False, label="no_burst")
+    padded = measure(False, burst=True, label="padded_burst")
+    ragged = measure(True, burst=True, label="ragged_burst")
+    floor = max(base["inter_token_p95_s"] or 1e-9, 1e-9)
+    record = {
+        "metric": "serving_ragged_dispatch_p95",
+        # the acceptance ratio: ragged-under-burst p95 over the
+        # no-burst floor (<= 1.1 passes; the padded ratio is the
+        # regression the flat batch removes)
+        "value": round((ragged["inter_token_p95_s"] or 0.0) / floor, 3),
+        "unit": "x_no_burst_p95",
+        "padded_ratio": round(
+            (padded["inter_token_p95_s"] or 0.0) / floor, 3),
+        "prefill_chunk_tokens": chunk,
+        "burst_prompt_tokens": burst_prompt,
+        "dispatch_reduction": round(
+            1.0 - ragged["dispatches_per_token"]
+            / max(padded["dispatches_per_token"], 1e-9), 4),
+        "compiled_shapes": {"padded": padded["compiled_shapes"],
+                            "ragged": ragged["compiled_shapes"]},
+        "no_burst": base,
+        "padded": padded,
+        "ragged": ragged,
+    }
+    print(json.dumps(record))
+    return 0
+
+
 def run_spec_comparison(args, svc) -> int:
     """--spec-decode: speculative-decoding A/B at small batch
     (BENCHMARKS.md "Latency offensive").
@@ -1973,6 +2138,16 @@ def main(argv=None) -> int:
                          "(records serving_chunked_prefill_p95)")
     ap.add_argument("--chunk-duration", type=float, default=10.0,
                     help="chunked mode: measured window seconds per arm")
+    ap.add_argument("--ragged", action="store_true",
+                    help="ragged-dispatch A/B: steady decode streams "
+                         "under a gapless long-prompt burst — no-burst "
+                         "floor vs the padded multi-program iteration "
+                         "vs one flat ragged dispatch per pass; "
+                         "reports inter-token p95 ratios plus "
+                         "dispatch-count and compiled-shape deltas "
+                         "(records serving_ragged_dispatch_p95)")
+    ap.add_argument("--ragged-duration", type=float, default=10.0,
+                    help="ragged mode: measured window seconds per arm")
     ap.add_argument("--spec-decode", action="store_true",
                     help="speculative-decoding A/B at small batch: "
                          "off vs ngram prompt-lookup vs self-draft "
@@ -2037,6 +2212,9 @@ def main(argv=None) -> int:
 
     if args.disagg:
         return run_disagg_comparison(args, svc)
+
+    if args.ragged:
+        return run_ragged_comparison(args, svc)
 
     if args.prefill_chunk > 0:
         return run_chunked_comparison(args, svc)

@@ -156,6 +156,80 @@ def _paged_case(name, *, s=8, h=8, hkv=2, d=64, npages=64, ps=16,
     return all_ok
 
 
+def _segment_case(name, *, h=8, hkv=2, d=64, npages=64, ps=16,
+                  p_per=8, use_alibi=False, seed=0, kv_dtype="fp32"):
+    """Ragged segment-attention parity: the flat hybrid batch's entry
+    (``paged_segment_attention``) vs the jnp gather fallback vs a
+    dense reference, on a batch mixing a mid-prompt prefill chunk,
+    decode steps, and a spec-verify window — the three segment shapes
+    the ragged engine iteration co-schedules in one program.  Each flat
+    token routes through its owning slot's page-table row with its own
+    causal frontier; parity here is what makes the single dispatch
+    bit-faithful to the padded programs it replaced."""
+    from kubernetes_cloud_tpu.ops.paged_attention import (
+        gather_pages,
+        paged_segment_attention,
+    )
+
+    rng = np.random.default_rng(seed)
+    kp = jnp.asarray(rng.standard_normal((npages, ps, hkv, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((npages, ps, hkv, d)), jnp.float32)
+    slots = 4
+    pt = jnp.asarray(rng.integers(1, npages, (slots, p_per)), jnp.int32)
+    # the hybrid batch: slot 0 carries a 6-token prefill chunk resuming
+    # at position 24 (within-chunk causal triangle), slots 1 and 3 are
+    # single decode steps at different depths, slot 2 verifies a
+    # 4-token speculative window from position 40
+    seg, ctx = [], []
+    seg += [0] * 6
+    ctx += [25 + j for j in range(6)]
+    seg += [1]
+    ctx += [57]
+    seg += [2] * 4
+    ctx += [41 + j for j in range(4)]
+    seg += [3]
+    ctx += [9]
+    n = len(seg)
+    q = jnp.asarray(rng.standard_normal((n, h, d)), jnp.float32)
+    seg = jnp.asarray(seg, jnp.int32)
+    ctx = jnp.asarray(ctx, jnp.int32)
+    slopes = alibi_slopes(h) if use_alibi else None
+
+    # dense reference: expand each token's slot indirection, flatten
+    # the pages, and run the XLA MHA with that token's frontier mask
+    mask = (jnp.arange(p_per * ps)[None, :] < ctx[:, None]).astype(
+        jnp.int32)
+    dk = gather_pages(kp, pt[seg]).transpose(0, 2, 1, 3)
+    dv = gather_pages(vp, pt[seg]).transpose(0, 2, 1, 3)
+    ref = _ref(q[:, :, None, :], dk, dv, slopes=slopes, mask=mask,
+               causal=False)[:, :, 0, :]
+    scales = {}
+    if kv_dtype == "int8":
+        kp, ks = _quantize_arena(kp)
+        vp, vs = _quantize_arena(vp)
+        scales = {"k_scale": ks, "v_scale": vs}
+    gather = paged_segment_attention(q, kp, vp, pt, seg, ctx,
+                                     slopes=slopes, impl="gather",
+                                     **scales)
+    kernel = paged_segment_attention(
+        q, kp, vp, pt, seg, ctx, slopes=slopes, impl="pallas",
+        interpret=jax.devices()[0].platform != "tpu", **scales)
+
+    errs = {"gather vs dense": float(jnp.abs(gather - ref).max()),
+            "kernel vs dense": float(jnp.abs(kernel - ref).max()),
+            "kernel vs gather": float(jnp.abs(kernel - gather).max())}
+    if kv_dtype == "int8":
+        all_ok = errs["kernel vs gather"] < FWD_TOL
+        errs["quant noise (vs fp32 dense)"] = errs.pop("gather vs dense")
+        errs.pop("kernel vs dense")
+    else:
+        all_ok = all(e < FWD_TOL for e in errs.values())
+    print(f"[{'OK ' if all_ok else 'FAIL'}] {name}")
+    for k, e in errs.items():
+        print(f"  {k} max err: {e:.2e}")
+    return all_ok
+
+
 def _fused_case(name, *, s=8, h=8, hkv=2, d=64, npages=64, ps=16,
                 p_per=8, hidden=256, use_alibi=False, seed=0,
                 kv_dtype="fp32"):
@@ -297,6 +371,18 @@ def main() -> int:
                           seed=12)
         ok &= _paged_case("paged int8 mha alibi ps16", hkv=8,
                           use_alibi=True, kv_dtype="int8", seed=13)
+        # ragged segment attention (EngineConfig.ragged): mixed
+        # prefill/decode/verify segments through one flat dispatch
+        ok &= _segment_case("segment mixed gqa 8/2 ps16 "
+                            "(ragged default)", seed=20)
+        ok &= _segment_case("segment mixed mha alibi ps16", hkv=8,
+                            use_alibi=True, seed=21)
+        ok &= _segment_case("segment mixed gqa 8/4 d128 ps32", hkv=4,
+                            d=128, ps=32, p_per=4, npages=32, seed=22)
+        ok &= _segment_case("segment int8 gqa 8/2 ps16",
+                            kv_dtype="int8", seed=23)
+        ok &= _segment_case("segment int8 gqa 8/2 alibi ps16",
+                            use_alibi=True, kv_dtype="int8", seed=24)
         # fused decode (attn_impl="fused"): gather+attention+projection
         ok &= _fused_case("fused gqa 8/2 ps16 (serving default)", seed=14)
         ok &= _fused_case("fused mha alibi ps16", hkv=8, use_alibi=True,

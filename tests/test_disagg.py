@@ -238,7 +238,10 @@ def test_decode_slice_death_reprefills_on_survivor(params):
     try:
         # arm the kill AFTER a couple of decode iterations so some
         # requests are mid-decode and some still queued behind them
-        orig = victim._decode_pages
+        # kill the program the engine actually drives: the ragged
+        # engine's flat-batch dispatch, else the padded decode step
+        attr = "_ragged_pages" if victim._ragged else "_decode_pages"
+        orig = getattr(victim, attr)
         state = {"n": 0}
 
         def boom(*a, **kw):
@@ -247,7 +250,7 @@ def test_decode_slice_death_reprefills_on_survivor(params):
                 raise RuntimeError("injected decode-slice death")
             return orig(*a, **kw)
 
-        victim._decode_pages = boom
+        setattr(victim, attr, boom)
         reqs = {i: pair.submit(PROMPTS[i], max_new_tokens=40,
                                temperature=0.0) for i in range(4)}
         outcomes = {}

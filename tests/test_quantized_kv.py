@@ -303,7 +303,8 @@ def test_model_health_carries_rollout_metadata(params):
                                             "role": "colocated",
                                             "mesh_shards": 1,
                                             "prefill_chunk_tokens": 0,
-                                            "spec_draft": "none"}
+                                            "spec_draft": "none",
+                                            "ragged": True}
     finally:
         model.stop()
 

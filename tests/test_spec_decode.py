@@ -219,29 +219,32 @@ def test_identity_preempt_resume(params):
         eng.stop()
 
 
-def test_stochastic_slots_keep_identical_sampling(params):
-    """temperature > 0 slots ride the verify dispatch drafts-free: the
-    sampled sequence equals the non-speculative engine's for the same
-    seed (same logits, same host RNG consumption)."""
+def test_stochastic_slots_speculate_reproducibly(params):
+    """temperature > 0 slots now speculate too, via rejection sampling
+    against the verification rows' filtered distributions.  The RNG
+    consumption pattern differs from the non-speculative path, so the
+    lock is NOT bitwise equality with a drafts-free engine —
+    distribution-exactness is locked statistically in
+    tests/test_ragged_dispatch.py.  What must hold here: speculation
+    actually engages for the stochastic slot, and a fixed seed is
+    still fully reproducible run-to-run."""
     prompt = list(range(1, 9))
-    base = make_engine(params, spec_draft=None)
-    try:
-        want = base.submit(prompt, max_new_tokens=10, temperature=0.8,
-                           seed=7).wait(base)
-    finally:
-        base.stop()
-    eng = make_engine(params, draft=self_draft(params))
-    try:
-        # a greedy neighbour keeps speculation live in the same batch
-        greedy = eng.submit(PROMPTS[2], max_new_tokens=12,
-                            temperature=0.0)
-        got = eng.submit(prompt, max_new_tokens=10, temperature=0.8,
-                         seed=7).wait(eng)
-        assert got == want
-        greedy.wait(eng)
-        assert eng.stats["spec_rounds"] > 0
-    finally:
-        eng.stop()
+    outs = []
+    for _ in range(2):
+        eng = make_engine(params, draft=self_draft(params))
+        try:
+            # a greedy neighbour exercises the mixed greedy/stochastic
+            # emit split inside one verification round
+            greedy = eng.submit(PROMPTS[2], max_new_tokens=12,
+                                temperature=0.0)
+            outs.append(eng.submit(prompt, max_new_tokens=10,
+                                   temperature=0.8, seed=7).wait(eng))
+            greedy.wait(eng)
+            assert eng.stats["spec_rounds"] > 0
+            assert eng.stats["spec_drafted"] > 0
+        finally:
+            eng.stop()
+    assert outs[0] == outs[1]
 
 
 # ---------------------------------------------------------------------------
