@@ -129,6 +129,21 @@ SITES = {
     "train.checkpoint": "inside the trainer's checkpoint save (raise "
                         "= failed save surfaces loudly; hang = wedged "
                         "storage during the save window)",
+    "weights.read": "per chunk inside the streaming weight reader "
+                    "(raise = transient I/O failure the bounded "
+                    "chunk-resume ladder absorbs — exhausting it is a "
+                    "typed WeightReadError; slow = stalled storage; "
+                    "drop = the chunk arrives zero-filled, i.e. "
+                    "corrupt, which per-chunk crc32 verification must "
+                    "turn into WeightIntegrityError instead of loaded "
+                    "garbage)",
+    "weights.swap": "inside a live hot-swap, after the new version is "
+                    "prepared but before the atomic engine cutover "
+                    "(raise = failed swap that must roll back with the "
+                    "old weights still serving and zero dropped "
+                    "requests; hang = wedged swap contained to the "
+                    "admin thread — the data plane and /readyz never "
+                    "route through this site)",
     "spec.verify": "before the speculative-decoding batched "
                    "verification dispatch, on the scheduler thread "
                    "(raise = crashed verify program -> engine crash, "

@@ -136,6 +136,22 @@ METRIC_FAMILIES = {
         "requests held by the activator awaiting a cold start",
     "kct_autoscaler_scale_events_total":
         "scale decisions applied per role by direction (up|down)",
+    # streaming weight pipeline (weights/tensorstream.py,
+    # serve/model_cache.py, serve/continuous.py hot-swap)
+    "kct_weights_load_seconds":
+        "artifact load wall time by mode (stream | mmap | fullread)",
+    "kct_weights_loaded_bytes_total":
+        "tensor bytes deserialized from weight artifacts",
+    "kct_weights_chunk_retries_total":
+        "chunk read retries by kind (transient | reread)",
+    "kct_weights_integrity_failures_total":
+        "failed weight loads by kind (corrupt | truncated | read)",
+    "kct_weights_cache_models":
+        "models in the lifecycle cache per state",
+    "kct_weights_swaps_total":
+        "live weight hot-swap attempts by outcome (ok | rolled_back)",
+    "kct_weights_swap_seconds":
+        "wall time of a committed hot-swap, load through transplant",
     # dynamic batcher (serve/batcher.py)
     "kct_batcher_batches_total":
         "batches dispatched to the device",

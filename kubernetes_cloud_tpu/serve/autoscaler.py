@@ -462,6 +462,61 @@ class Autoscaler:
         self._cold_start_ewma[role] = (
             seconds if prev is None else a * seconds + (1 - a) * prev)
 
+    def seed_cold_start(self, role: str, seconds: float) -> None:
+        """Pre-populate the prior from an out-of-band measurement
+        (the ``bench_serving --cold-start`` record) WITHOUT counting a
+        spawn: a fresh autoscaler starts planning with the measured
+        startup→first-token time instead of the configured guess.
+        Live ``note_cold_start`` measurements fold over it normally."""
+        seconds = float(seconds)
+        if seconds <= 0:
+            raise ValueError("cold-start seed must be > 0 seconds")
+        self._cold_start_ewma.setdefault(role, seconds)
+
+    def seed_from_benchmark(self, record: Any) -> int:
+        """Seed priors from a ``bench_serving.py --cold-start`` JSON
+        record (a dict, a JSON string, or a path to a file of one
+        record per line — the bench's output convention).  Reads
+        ``{"cold_start_s": {role: seconds}}``; returns how many roles
+        were seeded.  Unknown shapes seed nothing (0) rather than
+        raise — the bench file is advisory input, not config."""
+        import json
+        import os
+
+        records: list = []
+        if isinstance(record, dict):
+            records = [record]
+        elif isinstance(record, str):
+            if os.path.exists(record):
+                with open(record) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            records.append(json.loads(line))
+                        except ValueError:
+                            continue
+            else:
+                try:
+                    records.append(json.loads(record))
+                except ValueError:
+                    return 0
+        seeded = 0
+        for rec in records:
+            if not isinstance(rec, dict):
+                continue
+            per_role = rec.get("cold_start_s")
+            if not isinstance(per_role, dict):
+                continue
+            for role, seconds in per_role.items():
+                try:
+                    self.seed_cold_start(str(role), float(seconds))
+                    seeded += 1
+                except (TypeError, ValueError):
+                    continue
+        return seeded
+
     # -- the control loop ---------------------------------------------------
 
     def kick(self) -> None:

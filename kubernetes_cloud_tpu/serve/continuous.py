@@ -87,6 +87,8 @@ from kubernetes_cloud_tpu.serve.errors import (
     QueueFullError,
     RetryableError,
     StreamTimeoutError,
+    SwapInProgressError,
+    SwapVerificationError,
 )
 from kubernetes_cloud_tpu.serve import paged_kv
 from kubernetes_cloud_tpu.serve.paged_kv import PageAllocator
@@ -167,6 +169,15 @@ _M_TOKENS = obs.counter(
 _M_TTFT = obs.histogram(
     "kct_engine_ttft_seconds",
     "Time from submit to the request's first emitted token.", ("model",))
+_M_SWAPS = obs.counter(
+    "kct_weights_swaps_total",
+    "Live weight hot-swap attempts by outcome (ok | rolled_back).",
+    ("model", "outcome"))
+_M_SWAP_S = obs.histogram(
+    "kct_weights_swap_seconds",
+    "Wall time of a successful hot-swap: streamed load + smoke "
+    "verification + engine build + cutover + queue transplant.",
+    ("model",))
 _M_ACTIVE = obs.gauge(
     "kct_engine_active_slots", "Slots currently decoding.", ("model",))
 _M_SLOTS = obs.gauge(
@@ -796,9 +807,15 @@ class ContinuousBatchingEngine:
     def __init__(self, cfg: CausalLMConfig, params: Any,
                  engine_cfg: EngineConfig = EngineConfig(), *,
                  eos_token_id: Optional[int] = None, pad_token_id: int = 0,
-                 mesh=None, name: str = "engine", draft: Any = None):
+                 mesh=None, name: str = "engine", draft: Any = None,
+                 weights_version: Optional[str] = None):
         self.cfg = cfg
         self.params = params
+        #: content-hash identity of the params this engine decodes with
+        #: (a hot-swap builds a NEW engine for the new version, so the
+        #: version is engine-scoped by construction — a request served
+        #: mid-rollout reports the weights that actually produced it)
+        self.weights_version = weights_version
         self.ecfg = engine_cfg
         self.eos = eos_token_id
         self.pad = pad_token_id
@@ -1730,6 +1747,8 @@ class ContinuousBatchingEngine:
                 "iter_s_ewma": self.iter_s,
                 "kv_bytes_per_token": self.kv_bytes_per_token,
                 "flight_records": self.ecfg.flight_records}
+        if self.weights_version is not None:
+            meta["weights_version"] = self.weights_version
         if self.paged:
             meta["page_size"] = self.ecfg.page_size
             meta["num_pages"] = self._num_pages
@@ -3674,6 +3693,47 @@ class ContinuousBatchingModel(Model):
         #: the supervisor's rebuild path reuses still-loaded weights
         #: for the draft exactly like the target
         self.draft_service = draft_service
+        #: guards the engine/params pointer cutover — held by load()
+        #: and the supervisor's restart path so a hot-swap can never
+        #: interleave with an engine rebuild (only pointer mutation
+        #: happens under it; weight I/O stays outside)
+        self._swap_lock = threading.RLock()
+        #: non-blocking serializer for swap_weights: a second swap
+        #: while one is in flight is SwapInProgressError (503), not a
+        #: queue of multi-second weight loads
+        self._swapping = threading.Lock()
+
+    def _build_engine(self, params,
+                      weights_version: Optional[str] = None):
+        """Construct (but don't start) an engine over ``params`` —
+        shared by cold ``load()`` and ``swap_weights``'s prepare-aside
+        path, so both rollout shapes run the exact same build."""
+        tok = self.service.tokenizer
+        draft = None
+        sd = self.cfg.spec_draft
+        if sd and sd != "ngram":
+            if self.draft_service is None:
+                self.draft_service = _draft_service_for(sd)
+            if not self.draft_service.ready:
+                self.draft_service.load()
+            draft = (self.draft_service.cfg,
+                     self.draft_service.params)
+        kw = dict(eos_token_id=getattr(tok, "eos_token_id", None),
+                  pad_token_id=getattr(tok, "pad_token_id", 0) or 0,
+                  mesh=self.service.mesh, name=self.name,
+                  draft=draft, weights_version=weights_version)
+        if self.cfg.role == "prefill":
+            # disaggregated pod: one prefill engine feeding
+            # cfg.decode_slices decode engines through page-
+            # granular KV handoff (serve/disagg.py)
+            from kubernetes_cloud_tpu.serve.disagg import (
+                build_disaggregated_engine,
+            )
+
+            return build_disaggregated_engine(
+                self.service.cfg, params, self.cfg, **kw)
+        return ContinuousBatchingEngine(
+            self.service.cfg, params, self.cfg, **kw)
 
     def load(self) -> None:
         if self.engine is not None and self.engine.draining:
@@ -3684,36 +3744,13 @@ class ContinuousBatchingModel(Model):
                 "previous engine still draining; call stop() again")
         if not self.service.ready:
             self.service.load()
+        self.weights_version = getattr(self.service, "weights_version",
+                                       None)
         if self.engine is None or not self.engine.alive:
-            tok = self.service.tokenizer
-            draft = None
-            sd = self.cfg.spec_draft
-            if sd and sd != "ngram":
-                if self.draft_service is None:
-                    self.draft_service = _draft_service_for(sd)
-                if not self.draft_service.ready:
-                    self.draft_service.load()
-                draft = (self.draft_service.cfg,
-                         self.draft_service.params)
-            kw = dict(eos_token_id=getattr(tok, "eos_token_id", None),
-                      pad_token_id=getattr(tok, "pad_token_id", 0) or 0,
-                      mesh=self.service.mesh, name=self.name,
-                      draft=draft)
-            if self.cfg.role == "prefill":
-                # disaggregated pod: one prefill engine feeding
-                # cfg.decode_slices decode engines through page-
-                # granular KV handoff (serve/disagg.py)
-                from kubernetes_cloud_tpu.serve.disagg import (
-                    build_disaggregated_engine,
-                )
-
-                self.engine = build_disaggregated_engine(
-                    self.service.cfg, self.service.params, self.cfg,
-                    **kw)
-            else:
-                self.engine = ContinuousBatchingEngine(
-                    self.service.cfg, self.service.params, self.cfg,
-                    **kw)
+            engine = self._build_engine(self.service.params,
+                                        self.weights_version)
+            with self._swap_lock:
+                self.engine = engine
             self.engine.start()
         self.ready = True
 
@@ -3721,6 +3758,121 @@ class ContinuousBatchingModel(Model):
         if self.engine is not None:
             self.engine.stop()
         self.ready = False
+
+    # -- live weight hot-swap ----------------------------------------------
+
+    def _smoke_check(self, params, smoke_tokens: int) -> None:
+        """kv_quant_probe-style gate: the candidate weights must drive
+        a real end-to-end generation (in-vocab tokens out) BEFORE they
+        may take traffic — checksum integrity says the bytes are the
+        ones written; this says they behave like a model."""
+        if smoke_tokens <= 0:
+            return
+        svc = self.service
+        ids, mask = svc._encode_batch(["weights hot-swap probe"])
+        out = svc._generate(
+            svc.cfg, params, ids, mask,
+            max_new_tokens=int(smoke_tokens), temperature=1.0,
+            top_k=1, top_p=1.0, eos_token_id=None,
+            pad_token_id=getattr(svc.tokenizer, "pad_token_id", 0) or 0,
+            rng=jax.random.key(0))
+        arr = np.asarray(jax.block_until_ready(out))
+        fresh = arr[:, ids.shape[1]:]
+        if fresh.shape[-1] < 1 or not bool(
+                np.all((fresh >= 0) & (fresh < svc.cfg.vocab_size))):
+            raise SwapVerificationError(
+                "smoke generation over candidate weights produced "
+                "invalid tokens — refusing to swap")
+
+    def swap_weights(self, weights_path: str, *,
+                     smoke_tokens: int = 4) -> dict:
+        """Roll new weights into the RUNNING model: prepare the new
+        version entirely off to the side (chunk-verified streamed
+        load, smoke generation, fresh engine build — no lock held, the
+        old engine keeps serving throughout), then an atomic pointer
+        cutover and a queued-work transplant through the same
+        extract/requeue path a supervisor restart uses.  Any failure
+        before the cutover rolls back by discarding the prepared side:
+        the old version is never released until the new one has passed
+        verification, and no accepted request is dropped either way —
+        queued work moves to the new engine, in-flight slots finish on
+        the weights that prefilled them."""
+        from kubernetes_cloud_tpu.weights.tensorstream import (
+            read_index,
+            resolve_artifact,
+        )
+
+        if self.engine is None or not self.ready:
+            raise RetryableError(
+                "model not serving; load() it before swapping weights")
+        if not self._swapping.acquire(blocking=False):
+            raise SwapInProgressError(
+                f"a weight swap is already in flight on {self.name}")
+        t0 = time.perf_counter()
+        try:
+            try:
+                # -- prepare off to the side (old engine untouched) ---
+                path = resolve_artifact(weights_path)
+                index = read_index(path)
+                new_params, new_version = self.service.load_params(
+                    path, index)
+                self._smoke_check(new_params, smoke_tokens)
+                new_engine = self._build_engine(new_params, new_version)
+                new_engine.start()
+                try:
+                    # chaos hook: the window after the new version is
+                    # fully prepared, before it takes any traffic
+                    faults.fire("weights.swap")
+                    with self._swap_lock:
+                        old_engine, self.engine = self.engine, new_engine
+                        svc = self.service
+                        svc.params = new_params
+                        svc.weights_path = path
+                        svc.weights_index = index
+                        svc.weights_version = new_version
+                        self.weights_version = new_version
+                except Exception:  # noqa: BLE001 - rollback then re-raise
+                    # rollback: discard the prepared side whole — the
+                    # old engine never stopped serving
+                    new_engine.stop()
+                    raise
+            except Exception:  # noqa: BLE001 - metric then re-raise
+                _M_SWAPS.labels(model=self.name,
+                                outcome="rolled_back").inc()
+                raise
+            # -- committed: transplant queued work, drain the old -----
+            transplanted = 0
+            empty_rounds = 0
+            while empty_rounds < 3:
+                # settle loop: a _submit_all racing the cutover may
+                # still land requests on the old engine; keep pulling
+                # until it stays empty
+                moved = old_engine.extract_queued()
+                if moved:
+                    empty_rounds = 0
+                    for r in moved:
+                        new_engine.requeue(r)
+                    transplanted += len(moved)
+                else:
+                    empty_rounds += 1
+                    time.sleep(0.005)
+            try:
+                # blocks until active slots finish on the old weights
+                old_engine.stop()
+            except Exception:  # noqa: BLE001 - swap already committed
+                log.exception("%s: draining the old engine after a "
+                              "committed swap failed", self.name)
+            dt = time.perf_counter() - t0
+            _M_SWAPS.labels(model=self.name, outcome="ok").inc()
+            _M_SWAP_S.labels(model=self.name).observe(dt)
+            log.info("%s: hot-swapped to weights %s in %.2fs "
+                     "(%d queued request(s) transplanted)", self.name,
+                     new_version, dt, transplanted)
+            return {"weights_version": new_version,
+                    "transplanted": transplanted,
+                    "swap_seconds": round(dt, 3)}
+        finally:
+            self._swapping.release()
 
     def request_phase(self, request_id: Optional[str]) -> Optional[str]:
         """Fleet-router hedging gate: where the request is on this
@@ -3758,7 +3910,14 @@ class ContinuousBatchingModel(Model):
         eng = self.engine
         if eng is None:
             return {}
-        return {"kv_dtype": (eng.ecfg.kv_dtype if eng.paged else "fp32"),
+        meta = {}
+        if getattr(eng, "weights_version", None) is not None:
+            # content-hash identity of the weights THIS engine serves
+            # (engine-scoped: mid-swap the old engine keeps reporting
+            # the version that prefilled its slots)
+            meta["weights_version"] = eng.weights_version
+        return {**meta,
+                "kv_dtype": (eng.ecfg.kv_dtype if eng.paged else "fp32"),
                 "attn_impl": (eng.ecfg.attn_impl if eng.paged
                               else "dense"),
                 # the fleet router learns roles from probe bodies:
@@ -3848,6 +4007,13 @@ class ContinuousBatchingModel(Model):
                # measured logit-error budget, not bitwise fp identity
                "kv_dtype": (self.cfg.kv_dtype if self.cfg.paged
                             else "fp32")}
+        # which weights produced these tokens: the request's OWN
+        # engine (requeue() re-points it at transplant), so a request
+        # finishing on the draining pre-swap engine reports the old
+        # version while post-cutover traffic reports the new one
+        wv = getattr(req.engine or self.engine, "weights_version", None)
+        if wv is not None:
+            out["weights_version"] = wv
         if req.first_token_at is not None:
             # client-visible TTFT (load_test reports its distribution
             # and checks it against the server-side histogram),

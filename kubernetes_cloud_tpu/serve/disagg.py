@@ -360,7 +360,8 @@ class DisaggregatedEngine:
 def build_disaggregated_engine(cfg, params, engine_cfg: EngineConfig, *,
                                eos_token_id=None, pad_token_id: int = 0,
                                mesh=None, name: str = "engine",
-                               draft=None) -> DisaggregatedEngine:
+                               draft=None,
+                               weights_version=None) -> DisaggregatedEngine:
     """One prefill engine + ``engine_cfg.decode_slices`` decode
     engines over shared weights (in-process; on hardware each engine
     maps to its own slice group), coupled by page-granular KV
@@ -385,11 +386,16 @@ def build_disaggregated_engine(cfg, params, engine_cfg: EngineConfig, *,
     dcfg = dataclasses.replace(engine_cfg, role="decode")
     prefill = ContinuousBatchingEngine(
         cfg, params, pcfg, eos_token_id=eos_token_id,
-        pad_token_id=pad_token_id, mesh=mesh, name=f"{name}-prefill")
+        pad_token_id=pad_token_id, mesh=mesh, name=f"{name}-prefill",
+        weights_version=weights_version)
     decodes = [
         ContinuousBatchingEngine(
             cfg, params, dcfg, eos_token_id=eos_token_id,
             pad_token_id=pad_token_id, mesh=mesh,
-            name=f"{name}-decode{i}", draft=draft)
+            name=f"{name}-decode{i}", draft=draft,
+            weights_version=weights_version)
         for i in range(engine_cfg.decode_slices)]
-    return DisaggregatedEngine(prefill, decodes, name=name)
+    pod = DisaggregatedEngine(prefill, decodes, name=name)
+    # the facade answers serving_metadata/probes for the whole pod
+    pod.weights_version = weights_version
+    return pod

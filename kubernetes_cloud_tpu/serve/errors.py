@@ -58,6 +58,37 @@ class TenantQuotaError(RetryableError):
         self.retry_after_s = retry_after_s
 
 
+class ModelCacheFullError(RetryableError):
+    """The lifecycle model cache is at capacity and every resident
+    model is busy — nothing is idle enough to page out.  Same
+    retryable-503 contract as queue backpressure: the admission was
+    fine, the zoo transiently was not; retries land once a request
+    completes and an LRU victim frees up."""
+
+
+class SwapInProgressError(RetryableError):
+    """A live weight hot-swap is already running on this model; swaps
+    serialize (the old version is never released until the new one
+    passes verification, so two at once cannot both hold that
+    guarantee).  Retry after the running swap lands or rolls back."""
+
+
+class SwapVerificationError(RuntimeError):
+    """A hot-swap candidate passed checksum integrity but failed the
+    smoke generation gate (out-of-vocab tokens, empty output) — the
+    bytes are the ones written, they just don't behave like a model.
+    NOT retryable: the same artifact will fail the same way.  Mapped to
+    409 by the ``:swap`` route with ``rolled_back: true``; the old
+    version keeps serving."""
+
+
+class NoModelsLoadedError(RuntimeError):
+    """``load_all`` over the lifecycle cache left EVERY model in the
+    terminal ``failed`` state — the pod has nothing to serve and should
+    crash-loop loudly (a zoo with one bad adapter serves degraded
+    instead and never raises this)."""
+
+
 class ReplicaUnavailableError(RetryableError):
     """The fleet router could not place the request on any replica:
     every replica is ejected/draining/dead, or the chosen replica

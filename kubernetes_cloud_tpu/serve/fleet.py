@@ -294,6 +294,10 @@ class ReplicaHealth:
         #: through their prefill partner's KV handoff, so the router
         #: never dispatches admission traffic to them
         self.role = "colocated"
+        #: {model: weights_version} learned from probe bodies — during
+        #: a rolling hot-swap this is how the router tells an already-
+        #: swapped replica from one still serving the old artifact
+        self.weights_versions: dict[str, str] = {}
         self.stats = {"probes": 0, "probe_fails": 0, "ejections": 0,
                       "recoveries": 0, "dispatch_ok": 0,
                       "dispatch_err": 0, "dispatch_timeout": 0}
@@ -313,7 +317,9 @@ class ReplicaHealth:
 
     def note_probe(self, healthy: bool, queue_depth: int = 0,
                    heartbeat_age_s: Optional[float] = None,
-                   role: Optional[str] = None) -> Optional[str]:
+                   role: Optional[str] = None, *,
+                   weights_versions: Optional[dict[str, str]] = None
+                   ) -> Optional[str]:
         """Record one active-probe verdict; returns an ejection cause
         or the string ``"half_open"`` on an EJECTED→HALF_OPEN
         transition (callers emit metrics/logs outside the lock)."""
@@ -326,6 +332,8 @@ class ReplicaHealth:
                 self.last_probe_ok = True
                 if role is not None:
                     self.role = role
+                if weights_versions:
+                    self.weights_versions = dict(weights_versions)
                 if self.state == EJECTED:
                     # recovery probe succeeded: one trial request will
                     # decide reinstatement
@@ -425,6 +433,7 @@ class ReplicaHealth:
         with self._lock:
             return {"state": self.state,
                     "role": self.role,
+                    "weights_versions": dict(self.weights_versions),
                     "ejected_cause": self.ejected_cause,
                     "queue_depth": self.queue_depth,
                     "heartbeat_age_s": self.heartbeat_age_s,
@@ -658,34 +667,42 @@ class RemoteReplica(Replica):
 
 
 def _probe_healthy(status: int, body: Mapping[str, Any], stale_s: float
-                   ) -> tuple[bool, int, Optional[float], Optional[str]]:
+                   ) -> tuple[bool, int, Optional[float], Optional[str],
+                              dict[str, str]]:
     """Evaluate a /readyz answer: (healthy, queue_depth,
-    worst_heartbeat_age, role).  HTTP 200 alone is not enough — a hung
-    unsupervised engine still answers ready, but its per-model
-    ``heartbeat_age_s`` gives it away.  ``role`` is the serving role
-    the replica's models declare (serving_metadata): a "decode"-role
-    replica serves only through its prefill partner's KV handoff, so
-    the router learns to keep admission traffic off it."""
+    worst_heartbeat_age, role, weights_versions).  HTTP 200 alone is
+    not enough — a hung unsupervised engine still answers ready, but
+    its per-model ``heartbeat_age_s`` gives it away.  ``role`` is the
+    serving role the replica's models declare (serving_metadata): a
+    "decode"-role replica serves only through its prefill partner's KV
+    handoff, so the router learns to keep admission traffic off it.
+    ``weights_versions`` maps model name → content-hash weight
+    identity, so mid-hot-swap the router can tell which replicas have
+    rolled onto the new artifact and which still serve the old one."""
     if status != 200:
-        return False, 0, None, None
+        return False, 0, None, None, {}
     depth, worst_age, role = 0, None, None
-    for detail in (body.get("models") or {}).values():
+    versions: dict[str, str] = {}
+    for name, detail in (body.get("models") or {}).items():
         if not isinstance(detail, dict):
             continue
         if not detail.get("ok", True):
-            return False, 0, None, None
+            return False, 0, None, None, {}
         depth += int(detail.get("queue_depth") or 0)
         got = detail.get("role")
         if got is not None:
             # one admission-taking model makes the replica routable
             role = got if role in (None, "decode") else role
+        wv = detail.get("weights_version")
+        if wv is not None:
+            versions[str(name)] = str(wv)
         age = detail.get("heartbeat_age_s")
         if age is not None:
             age = float(age)
             worst_age = age if worst_age is None else max(worst_age, age)
     if worst_age is not None and worst_age > stale_s:
-        return False, depth, worst_age, role
-    return True, depth, worst_age, role
+        return False, depth, worst_age, role, versions
+    return True, depth, worst_age, role, versions
 
 
 class FleetRouter(ModelServer):
@@ -867,14 +884,16 @@ class FleetRouter(ModelServer):
             try:
                 faults.fire("fleet.probe")
                 status, body = r.probe(self.cfg.probe_timeout_s)
-                healthy, depth, age, role = _probe_healthy(
+                healthy, depth, age, role, versions = _probe_healthy(
                     status, body, self.cfg.heartbeat_stale_s)
             except Exception as e:  # noqa: BLE001 - a failed probe is
                 # data, not an error: transport refusal, injected
                 # fault, malformed body — all read "unhealthy"
-                healthy, depth, age, role = False, 0, None, None
+                healthy, depth, age, role, versions = (False, 0, None,
+                                                       None, {})
                 log.debug("%s: probe failed: %s", r.id, e)
-            event = r.health.note_probe(healthy, depth, age, role)
+            event = r.health.note_probe(healthy, depth, age, role,
+                                        weights_versions=versions)
             if healthy:
                 r._m_queue.set(depth)
                 attach = getattr(r, "attach_clock", None)
@@ -1427,7 +1446,7 @@ class FleetRouter(ModelServer):
         while time.monotonic() < deadline:
             try:
                 status, body = r.probe(self.cfg.probe_timeout_s)
-                healthy, depth, _age, _role = _probe_healthy(
+                healthy, depth, _age, _role, _wv = _probe_healthy(
                     status, body, self.cfg.heartbeat_stale_s)
             except Exception:  # noqa: BLE001 - keep probing to deadline
                 healthy, depth = False, 0

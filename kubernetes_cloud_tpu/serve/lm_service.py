@@ -34,7 +34,11 @@ from kubernetes_cloud_tpu.serve.model import (
     parse_instances,
     request_deadline,
 )
-from kubernetes_cloud_tpu.weights.tensorstream import load_pytree
+from kubernetes_cloud_tpu.weights.tensorstream import (
+    load_pytree,
+    read_index,
+    weights_version,
+)
 
 log = logging.getLogger(__name__)
 
@@ -102,21 +106,36 @@ class CausalLMService(Model):
             static_argnames=("max_new_tokens", "temperature", "top_k",
                              "top_p", "eos_token_id", "pad_token_id"))
 
+    def _shardings(self, params_like: Any = None):
+        if self.mesh is None:
+            return None
+        if params_like is None:
+            from kubernetes_cloud_tpu.models.causal_lm import init_params
+            params_like = jax.eval_shape(
+                lambda: init_params(self.cfg, jax.random.key(0)))
+        return logical_to_physical(param_specs(params_like), self.mesh)
+
+    def load_params(self, weights_path: Optional[str] = None,
+                    index: Optional[dict] = None) -> tuple[Any, str]:
+        """Chunk-verified streamed load of an artifact into (sharded)
+        device params — the cold-start path, and how a live hot-swap
+        prepares its new version off to the side.  Returns
+        ``(params, weights_version)``; corruption/truncation raise the
+        typed ``tensorstream`` errors instead of returning params."""
+        path = weights_path or self.weights_path
+        if path is None:
+            raise ValueError("need params or weights_path")
+        if index is None:
+            index = read_index(path)
+        params = load_pytree(path, self._shardings(), dtype=self.dtype,
+                             index=index)
+        return params, weights_version(index)
+
     def load(self) -> None:
         t0 = time.perf_counter()
         if self.params is None:
-            if self.weights_path is None:
-                raise ValueError("need params or weights_path")
-            shardings = None
-            if self.mesh is not None:
-                from kubernetes_cloud_tpu.models.causal_lm import init_params
-                shapes = jax.eval_shape(
-                    lambda: init_params(self.cfg, jax.random.key(0)))
-                shardings = logical_to_physical(param_specs(shapes),
-                                                self.mesh)
-            self.params = load_pytree(self.weights_path, shardings,
-                                      dtype=self.dtype,
-                                      index=self.weights_index)
+            self.params, self.weights_version = self.load_params(
+                self.weights_path, self.weights_index)
         elif self.mesh is not None:
             shardings = logical_to_physical(param_specs(self.params),
                                             self.mesh)
@@ -184,8 +203,11 @@ class CausalLMService(Model):
             if opts.get("ECHO_PROMPT"):
                 toks = [t for t in row[:plen].tolist()
                         if t != pad and t != eos] + completion
-            outputs.append({"generated_text": self.tokenizer.decode(toks),
-                            "tokens_out": len(completion)})
+            entry = {"generated_text": self.tokenizer.decode(toks),
+                     "tokens_out": len(completion)}
+            if self.weights_version is not None:
+                entry["weights_version"] = self.weights_version
+            outputs.append(entry)
         return outputs
 
     def generate_texts(self, prompts: Sequence[str],

@@ -50,6 +50,13 @@ def instance_text(inst: Any) -> str:
 
 
 class Model:
+    #: content-hash identity of the loaded weights (``tensorstream.
+    #: weights_version`` of the artifact) — None until a versioned
+    #: artifact loads.  Surfaced in /readyz bodies, /debug/timeline
+    #: meta, and per-prediction responses so fleet probes can tell
+    #: replicas apart mid-rollout.
+    weights_version: Optional[str] = None
+
     def __init__(self, name: str):
         self.name = name
         self.ready = False
@@ -74,8 +81,11 @@ class Model:
         return self._local_health()
 
     def _local_health(self) -> dict:
-        return {"ok": self.ready,
-                "reason": "ok" if self.ready else "not loaded"}
+        out = {"ok": self.ready,
+               "reason": "ok" if self.ready else "not loaded"}
+        if self.weights_version is not None:
+            out["weights_version"] = self.weights_version
+        return out
 
     # -- option handling ---------------------------------------------------
 

@@ -1,0 +1,263 @@
+"""Lifecycle-managed model registry — the multiplexing half of the
+streaming-weights plane (ROADMAP item 2: "that refactor is the point").
+
+``ModelServer`` historically held ``{name: Model}``, built once and
+never mutated: one static model per process, a failed ``load()``
+leaving the registry half-populated.  :class:`ModelCache` replaces it
+**as a dict subclass** — every ``items()`` / ``get()`` / ``sorted()``
+call site in the server, fleet router, and debug plane keeps working —
+and adds the lifecycle the multi-model story needs:
+
+* **states**: ``loading → active → draining → retired``, plus terminal
+  ``failed`` (a load that raised: the model STAYS registered so
+  ``/readyz`` reports the failure per-model instead of pretending the
+  name never existed);
+* **LRU paging** for a small model zoo / LoRA-style adapters:
+  ``capacity`` bounds resident loaded models; admitting one more evicts
+  the least-recently-used idle model through its drain path first
+  (``model.stop()`` — the engine's slot drain, so eviction never drops
+  in-flight work);
+* **tenancy**: an adapter admitted for a tenant counts against that
+  tenant's ``tenant_model_quota`` — one tenant cannot page the whole
+  zoo in and evict everyone else's models.
+
+The cache is the server-side anchor for live weight hot-swaps too:
+``swap(name, path)`` delegates to the model's ``swap_weights`` (the
+engine-level drain/transplant rollout in ``serve/continuous.py``) and
+keeps the registry's lifecycle/metrics honest around it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Iterable, Optional
+
+from kubernetes_cloud_tpu import obs
+from kubernetes_cloud_tpu.serve.errors import (
+    ModelCacheFullError,
+    TenantQuotaError,
+)
+from kubernetes_cloud_tpu.serve.model import Model
+
+log = logging.getLogger(__name__)
+
+#: lifecycle vocabulary (also the ``kct_weights_cache_models`` label set)
+STATES = ("loading", "active", "draining", "retired", "failed")
+
+_M_CACHE = obs.gauge(
+    "kct_weights_cache_models",
+    "Models in the lifecycle cache per state (loading | active | "
+    "draining | retired | failed).", ("state",))
+
+
+@dataclasses.dataclass
+class ModelEntry:
+    """Lifecycle metadata riding alongside the registry's Model."""
+
+    model: Model
+    state: str = "loading"
+    tenant: Optional[str] = None
+    error: Optional[str] = None
+    loaded_at: float = 0.0
+    last_used: float = 0.0
+    inflight: int = 0
+
+    def snapshot(self) -> dict:
+        out = {"state": self.state}
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        if self.error is not None:
+            out["error"] = self.error
+        version = getattr(self.model, "weights_version", None)
+        if version is not None:
+            out["weights_version"] = version
+        return out
+
+
+class ModelCache(dict):
+    """``{name: Model}`` with lifecycle states, LRU paging, and tenant
+    quotas.  The dict holds every non-retired model (including
+    ``failed`` ones, so readiness stays honest); ``entries`` carries
+    the metadata, including retired history."""
+
+    def __init__(self, models: Iterable[Model] = (), *,
+                 capacity: int = 0, tenant_model_quota: int = 0):
+        super().__init__()
+        #: max resident (loading|active) models; 0 = unbounded
+        self.capacity = capacity
+        #: max non-retired models one tenant may hold; 0 = unbounded
+        self.tenant_model_quota = tenant_model_quota
+        self.entries: dict[str, ModelEntry] = {}
+        self._lock = threading.RLock()
+        for m in models:
+            self.admit(m)
+
+    # -- admission / paging ------------------------------------------------
+
+    def admit(self, model: Model, *, tenant: Optional[str] = None) -> Model:
+        """Register a model in ``loading`` state.  Enforces the tenant
+        quota, then makes room: over ``capacity`` the least-recently-
+        used idle model is evicted (drain path) first.  Raises
+        :class:`TenantQuotaError` / :class:`ModelCacheFullError` —
+        both retryable-503s, the request was fine."""
+        with self._lock:
+            if model.name in self and self.entries[
+                    model.name].state != "retired":
+                raise ValueError(f"model {model.name!r} already "
+                                 f"registered")
+            if tenant is not None and self.tenant_model_quota:
+                held = sum(1 for e in self.entries.values()
+                           if e.tenant == tenant
+                           and e.state not in ("retired",))
+                if held >= self.tenant_model_quota:
+                    raise TenantQuotaError(
+                        f"tenant {tenant!r} already holds {held} "
+                        f"model(s) (quota {self.tenant_model_quota})")
+            self._make_room()
+            entry = ModelEntry(model=model, tenant=tenant,
+                               state="loading" if not model.ready
+                               else "active")
+            if model.ready:
+                entry.loaded_at = entry.last_used = time.monotonic()
+            self.entries[model.name] = entry
+            self[model.name] = model
+            self._export()
+            return model
+
+    def _resident(self) -> int:
+        return sum(1 for e in self.entries.values()
+                   if e.state in ("loading", "active"))
+
+    def _make_room(self) -> None:
+        """Evict LRU idle models until under capacity (lock held)."""
+        if not self.capacity:
+            return
+        while self._resident() >= self.capacity:
+            victims = sorted(
+                (e for e in self.entries.values()
+                 if e.state == "active" and e.inflight == 0),
+                key=lambda e: e.last_used)
+            if not victims:
+                raise ModelCacheFullError(
+                    f"model cache at capacity ({self.capacity}) and "
+                    f"every resident model is busy — retry after a "
+                    f"request completes")
+            self.evict(victims[0].model.name)
+
+    def evict(self, name: str, *, drain_timeout_s: float = 10.0) -> None:
+        """Page a model out: ``active → draining`` (the model's own
+        ``stop()`` drains engine slots — in-flight work completes) →
+        ``retired``, removed from the registry.  Terminal ``failed``
+        entries retire without a drain."""
+        with self._lock:
+            entry = self.entries.get(name)
+            if entry is None or entry.state == "retired":
+                return
+            prior, entry.state = entry.state, "draining"
+            self._export()
+        try:
+            if prior == "active":
+                deadline = time.monotonic() + drain_timeout_s
+                while entry.inflight > 0 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                stop = getattr(entry.model, "stop", None)
+                if callable(stop):
+                    stop()
+        except Exception:  # noqa: BLE001 - eviction is best-effort drain
+            log.exception("draining %s during eviction failed", name)
+        finally:
+            with self._lock:
+                entry.state = "retired"
+                entry.model.ready = False
+                self.pop(name, None)
+                self._export()
+        log.info("model %s retired from cache (%s)", name, prior)
+
+    # -- loading -----------------------------------------------------------
+
+    def load(self, name: str) -> None:
+        """Run the model's ``load()``: ``loading → active``; an
+        exception lands the entry in terminal ``failed`` (the model
+        stays registered and unready — ``/readyz`` reports it) and
+        re-raises for callers loading a single model."""
+        entry = self.entries[name]
+        try:
+            entry.model.load()
+        except Exception as e:  # noqa: BLE001 - recorded as the entry's
+            # terminal failed state (and re-raised below)
+            with self._lock:
+                entry.state = "failed"
+                entry.error = f"{type(e).__name__}: {e}"
+                entry.model.ready = False
+                self._export()
+            raise
+        with self._lock:
+            entry.state = "active"
+            entry.error = None
+            entry.loaded_at = entry.last_used = time.monotonic()
+            self._export()
+
+    def load_all(self) -> dict[str, str]:
+        """Load every unready model, continuing past failures.  Returns
+        ``{name: error}`` for the models that landed in ``failed``."""
+        failed: dict[str, str] = {}
+        for name in list(self):
+            entry = self.entries[name]
+            if entry.model.ready or entry.state == "failed":
+                continue
+            try:
+                self.load(name)
+            except Exception as e:  # noqa: BLE001 - recorded per model
+                log.exception("loading model %s failed", name)
+                failed[name] = f"{type(e).__name__}: {e}"
+        return failed
+
+    # -- dispatch bookkeeping ----------------------------------------------
+
+    def using(self, name: str) -> "_Using":
+        """Context manager the server wraps dispatch in: counts the
+        model's in-flight work (eviction waits on it) and touches the
+        LRU clock."""
+        return _Using(self, name)
+
+    def touch(self, name: str) -> None:
+        entry = self.entries.get(name)
+        if entry is not None:
+            entry.last_used = time.monotonic()
+
+    # -- introspection -----------------------------------------------------
+
+    def entry(self, name: str) -> Optional[ModelEntry]:
+        return self.entries.get(name)
+
+    def states(self) -> dict[str, str]:
+        return {name: e.state for name, e in self.entries.items()}
+
+    def _export(self) -> None:
+        counts = dict.fromkeys(STATES, 0)
+        for e in self.entries.values():
+            counts[e.state] += 1
+        for state, n in counts.items():
+            _M_CACHE.labels(state=state).set(n)
+
+
+class _Using:
+    def __init__(self, cache: ModelCache, name: str):
+        self._cache, self._name = cache, name
+        self._entry = cache.entries.get(name)
+
+    def __enter__(self) -> "_Using":
+        if self._entry is not None:
+            with self._cache._lock:
+                self._entry.inflight += 1
+                self._entry.last_used = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._entry is not None:
+            with self._cache._lock:
+                self._entry.inflight -= 1
+                self._entry.last_used = time.monotonic()

@@ -62,9 +62,11 @@ from kubernetes_cloud_tpu import faults, obs
 from kubernetes_cloud_tpu.obs import tracing
 from kubernetes_cloud_tpu.serve.errors import (
     DeadlineExceededError,
+    NoModelsLoadedError,
     RetryableError,
 )
 from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.model_cache import ModelCache
 
 log = logging.getLogger(__name__)
 
@@ -106,6 +108,8 @@ def route_label(path: str) -> str:
         return "predict"
     if path.endswith(":cancel"):
         return "cancel"
+    if path.endswith(":swap"):
+        return "swap"
     if path.startswith("/v1/models"):
         return "models"
     return "other"
@@ -120,11 +124,23 @@ class TextResponse:
     content_type: str = obs.CONTENT_TYPE
 
 
+class _LockMap(dict):
+    """Per-model dispatch locks, created lazily so models admitted into
+    the cache after construction get one too."""
+
+    def __missing__(self, name: str) -> threading.Lock:
+        lock = self[name] = threading.Lock()
+        return lock
+
+
 class ModelServer:
-    def __init__(self, models: Iterable[Model], *, host: str = "0.0.0.0",
-                 port: int = 8080):
-        self.models = {m.name: m for m in models}
-        self.locks = {name: threading.Lock() for name in self.models}
+    def __init__(self, models: "Iterable[Model] | ModelCache", *,
+                 host: str = "0.0.0.0", port: int = 8080):
+        #: lifecycle-managed registry ({name: Model} plus states/LRU/
+        #: tenancy); accepts a pre-built cache for capacity/quota config
+        self.models = models if isinstance(models, ModelCache) \
+            else ModelCache(models)
+        self.locks = _LockMap()
         self.host, self.port = host, port
         self._httpd: ThreadingHTTPServer | None = None
         self._draining = False
@@ -135,9 +151,17 @@ class ModelServer:
         self.profiler = obs.ProfileWindow()
 
     def load_all(self) -> None:
-        for model in self.models.values():
-            if not model.ready:
-                model.load()
+        """Load every registered model, continuing past failures: a
+        failed load lands that model in the cache's terminal ``failed``
+        state (reported per-model by ``/readyz``) instead of leaving
+        the registry half-populated.  Raises only when NOTHING loaded —
+        a single-model pod still crash-loops loudly; a zoo with one
+        bad adapter serves degraded."""
+        failed = self.models.load_all()
+        if failed and not any(m.ready for m in self.models.values()):
+            raise NoModelsLoadedError(
+                "no model loaded successfully: "
+                + "; ".join(f"{n}: {e}" for n, e in failed.items()))
 
     # -- request handling --------------------------------------------------
 
@@ -188,7 +212,11 @@ class ModelServer:
                 model = self.models.get(name)
                 if model is None:
                     return 404, {"error": f"model {name} not found"}
-                return 200, {"name": name, "ready": model.ready}
+                out = {"name": name, "ready": model.ready}
+                entry = self.models.entry(name)
+                if entry is not None:
+                    out.update(entry.snapshot())
+                return 200, out
             return 404, {"error": "not found"}
 
         if method == "POST":
@@ -233,6 +261,10 @@ class ModelServer:
                         "/v1/models/"):
                     name = path[len("/v1/models/"):-len(":cancel")]
                     return self._cancel(name, payload)
+                if path.endswith(":swap") and path.startswith(
+                        "/v1/models/"):
+                    name = path[len("/v1/models/"):-len(":swap")]
+                    return self._swap(name, payload)
                 if path == "/completion":
                     return self._completion(payload)
                 return 404, {"error": "not found"}
@@ -356,6 +388,13 @@ class ModelServer:
         detail, ok = {}, True
         for name, model in self.models.items():
             h = model.health()
+            entry = self.models.entry(name)
+            if entry is not None:
+                # lifecycle state + weights_version ride every probe
+                # body so fleet routers can tell replicas apart
+                # mid-rollout and report WHY a model is unready
+                for key, value in entry.snapshot().items():
+                    h.setdefault(key, value)
             detail[name] = h
             ok = ok and bool(h.get("ok"))
         return (200 if ok else 503), {
@@ -396,8 +435,14 @@ class ModelServer:
         if model is None:
             return 404, {"error": f"model {name} not found"}
         if not model.ready:
+            entry = self.models.entry(name)
+            if entry is not None and entry.state == "failed":
+                return 503, {"error": f"model {name} failed to load: "
+                                      f"{entry.error}",
+                             "error_kind": "ModelLoadFailed"}
             return 503, {"error": f"model {name} is not ready"}
-        return self._dispatch(model, model.predict, payload, "predict")
+        with self.models.using(name):
+            return self._dispatch(model, model.predict, payload, "predict")
 
     def _cancel(self, name: str, payload: dict) -> tuple[int, dict]:
         """``POST /v1/models/<name>:cancel {"request_id": ...}`` —
@@ -417,6 +462,42 @@ class ModelServer:
         # rid always exists; a minted one matches nothing → false
         rid = payload.get("request_id")
         return 200, {"cancelled": bool(fn(str(rid)))}
+
+    def _swap(self, name: str, payload: dict) -> tuple[int, dict]:
+        """``POST /v1/models/<name>:swap {"weights": path}`` — live
+        weight hot-swap through the model's drain/transplant rollout
+        (``swap_weights``).  The admin plane of a rollout: the old
+        version keeps serving until the new one verifies; a failed or
+        corrupt swap answers 409 with ``rolled_back: true`` and the
+        still-serving version."""
+        from kubernetes_cloud_tpu.weights.tensorstream import (
+            WeightStreamError,
+        )
+
+        model = self.models.get(name)
+        if model is None:
+            return 404, {"error": f"model {name} not found"}
+        fn = getattr(model, "swap_weights", None)
+        if fn is None:
+            return 404, {"error": f"model {name} does not support "
+                                  "weight hot-swap"}
+        weights = payload.get("weights")
+        if not weights:
+            return 400, {"error": 'payload needs {"weights": <path>}'}
+        try:
+            result = fn(str(weights))
+        except RetryableError as e:  # swap already running
+            return 503, {"error": str(e),
+                         "error_kind": type(e).__name__}
+        except (WeightStreamError, RuntimeError, ValueError) as e:
+            log.exception("hot-swap of %s failed; old weights serving",
+                          name)
+            return 409, {
+                "swapped": False, "rolled_back": True,
+                "error": str(e), "error_kind": type(e).__name__,
+                "weights_version": getattr(model, "weights_version",
+                                           None)}
+        return 200, {"swapped": True, **result}
 
     def _completion(self, payload: dict) -> tuple[int, dict]:
         capable = [(n, m) for n, m in self.models.items()

@@ -40,6 +40,7 @@ reason to kill a pod holding hundreds of GiB of streamed weights.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import logging
 import threading
@@ -164,11 +165,18 @@ class _EngineTarget:
                 and time.monotonic() < getattr(eng, "grace_until", 0.0))
 
     def restart(self, err: Exception) -> int:
-        old, self.model.engine = self.model.engine, None
-        queued = old.abandon(err) if old is not None else []
-        self.model.load()  # weights stay; fresh engine + slot pool
-        for req in queued:
-            self.model.engine.requeue(req)
+        # serialize against a live weight hot-swap's pointer cutover
+        # (continuous.py swap_weights): whichever side wins the lock,
+        # the process converges to exactly ONE live engine — a restart
+        # landing mid-swap rebuilds over whatever version the swap
+        # left as current, never a torn half of each
+        lock = getattr(self.model, "_swap_lock", None)
+        with (lock if lock is not None else contextlib.nullcontext()):
+            old, self.model.engine = self.model.engine, None
+            queued = old.abandon(err) if old is not None else []
+            self.model.load()  # weights stay; fresh engine + slot pool
+            for req in queued:
+                self.model.engine.requeue(req)
         return len(queued)
 
     def shut_down(self, err: Exception) -> None:

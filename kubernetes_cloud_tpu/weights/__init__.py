@@ -1,6 +1,13 @@
 from kubernetes_cloud_tpu.weights.tensorstream import (  # noqa: F401
+    WeightIntegrityError,
+    WeightReadError,
+    WeightStreamError,
+    WeightTruncatedError,
     read_index,
     load_pytree,
+    load_pytree_fullread,
+    verify_file,
+    weights_version,
     write_pytree,
 )
 from kubernetes_cloud_tpu.weights.checkpoint import (  # noqa: F401

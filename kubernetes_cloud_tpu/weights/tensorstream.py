@@ -1,5 +1,5 @@
 """Streaming tensor serialization: serialize pytrees, deserialize straight
-into sharded device memory.
+into sharded device memory — chunked, checksummed, and resumable.
 
 TPU-native re-design of the reference's Tensorizer usage
 (``online-inference/tensorizer-isvc/tensorizer_hf_isvc/load_model.py:45-75``,
@@ -20,32 +20,124 @@ offset content
 0      magic ``KCTS0001``
 8      u64 header length in bytes
 16     header JSON: ``{"tensors": {name: {dtype, shape, offset,
-       nbytes}}, "meta": {...}}``
+       nbytes, crc32: [..]}}, "meta": {...}, "chunk_bytes": N,
+       "content_hash": sha256}``
 ...    per-tensor raw data, each blob 512-byte aligned
 ====== ======================================================
 
 Dotted names encode pytree structure (``blocks.attn.wqkv``).
+
+Integrity & resume (the serving cold-start / hot-swap contract):
+
+* every tensor carries a ``crc32`` list — one checksum per
+  ``chunk_bytes``-sized slice of its blob, computed at write time;
+* the streaming reader verifies each chunk as it lands and **resumes at
+  chunk granularity**: a transient ``OSError`` (flaky PVC, dropped GCS
+  connection) re-opens the source and retries that chunk with bounded
+  exponential backoff; only exhausted retries surface, as a typed
+  :class:`WeightReadError`;
+* a checksum mismatch is re-read once (a network-transient garble heals,
+  genuine corruption doesn't) and then raises
+  :class:`WeightIntegrityError` **naming the tensor and chunk** — a
+  corrupt file can never hand tensors to a model;
+* a file shorter than its header promises — truncated upload, or an
+  mmap whose backing file shrank mid-read — raises
+  :class:`WeightTruncatedError` instead of returning garbage (or
+  SIGBUS-ing on the fault path);
+* ``content_hash`` digests every tensor's checksums: its prefix is the
+  ``weights_version`` the serving plane stamps on ``/readyz``,
+  ``/debug/timeline`` and every prediction, so a fleet mid-rollout can
+  tell replicas apart by content, not by filename.
+
+Chaos: every chunk read routes through fault site ``weights.read``
+(``raise`` = transient I/O error absorbed by the retry ladder, ``slow``
+= stalled storage, ``drop`` = the chunk arrives zero-filled — i.e.
+corrupt — which the verifier must catch).
 """
 
 from __future__ import annotations
 
+import hashlib
+import io
 import json
 import mmap
 import os
+import time
+import zlib
 from typing import Any, Mapping, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_cloud_tpu import faults, obs
+
 MAGIC = b"KCTS0001"
 ALIGN = 512
+
+#: checksum granularity — also the resume granularity: a failed read
+#: costs at most this many bytes of rework.
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+#: transient-read retry budget per chunk (exponential backoff between).
+READ_RETRIES = 3
+READ_BACKOFF_S = 0.05
 
 #: URI schemes routed through fsspec range reads instead of mmap —
 #: serving cold-starts stream weights straight from object storage into
 #: device memory (the reference streams Tensorizer files from S3/HTTP,
 #: ``stream_io.CURLStreamFile``; here the bucket is GCS).
 REMOTE_SCHEMES = ("gs://", "s3://", "http://", "https://", "memory://")
+
+_M_LOAD_S = obs.histogram(
+    "kct_weights_load_seconds",
+    "Wall time of one full weight deserialization, by loader mode "
+    "(stream | mmap | fullread).", ("mode",),
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 120.0))
+_M_BYTES = obs.counter(
+    "kct_weights_loaded_bytes_total",
+    "Weight bytes deserialized onto devices, by loader mode.", ("mode",))
+_M_RETRIES = obs.counter(
+    "kct_weights_chunk_retries_total",
+    "Chunk-granular read retries: transient I/O resumes and "
+    "checksum-mismatch re-reads.", ("kind",))
+_M_INTEGRITY = obs.counter(
+    "kct_weights_integrity_failures_total",
+    "Typed weight-load failures surfaced instead of loading garbage "
+    "(corrupt | truncated | read).", ("kind",))
+
+
+class WeightStreamError(RuntimeError):
+    """Base of the typed weight-pipeline failures (never loads garbage)."""
+
+
+class WeightIntegrityError(WeightStreamError):
+    """A chunk failed checksum verification — names tensor and chunk."""
+
+    def __init__(self, message: str, *, tensor: Optional[str] = None,
+                 chunk: Optional[int] = None, path: Optional[str] = None):
+        super().__init__(message)
+        self.tensor, self.chunk, self.path = tensor, chunk, path
+
+
+class WeightTruncatedError(WeightStreamError):
+    """The file is shorter than its header promises (bad upload, or the
+    backing file shrank under an open mmap)."""
+
+    def __init__(self, message: str, *, tensor: Optional[str] = None,
+                 path: Optional[str] = None):
+        super().__init__(message)
+        self.tensor, self.path = tensor, path
+
+
+class WeightReadError(WeightStreamError):
+    """Transient read failures exhausted the bounded retry budget."""
+
+    def __init__(self, message: str, *, tensor: Optional[str] = None,
+                 chunk: Optional[int] = None, path: Optional[str] = None):
+        super().__init__(message)
+        self.tensor, self.chunk, self.path = tensor, chunk, path
 
 
 def is_remote(path: str) -> bool:
@@ -117,28 +209,64 @@ def _unflatten(flat: dict[str, Any]) -> Any:
     return restore_lists(root)
 
 
-def write_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
+def _chunk_crcs(raw: bytes, chunk_bytes: int) -> list[int]:
+    return [zlib.crc32(raw[off:off + chunk_bytes])
+            for off in range(0, max(len(raw), 1), chunk_bytes)]
+
+
+def _content_hash(index: Mapping[str, Mapping]) -> str:
+    """Digest of every tensor's identity + chunk checksums: equal hash
+    ⇔ equal weights, independent of filename or header cosmetics."""
+    basis = {name: [info["dtype"], list(info["shape"]),
+                    list(info.get("crc32") or ())]
+             for name, info in sorted(index.items())}
+    return hashlib.sha256(
+        json.dumps(basis, sort_keys=True).encode()).hexdigest()
+
+
+def weights_version(index: Optional[Mapping]) -> str:
+    """Short content-hash identity of a header (``read_index`` result).
+    Legacy files without checksums are ``"unversioned"``."""
+    if not index:
+        return "unversioned"
+    full = index.get("content_hash")
+    return full[:12] if full else "unversioned"
+
+
+def write_pytree(path: str, tree: Any, meta: Optional[dict] = None, *,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> None:
     """Serialize a pytree of arrays.  Sharded jax.Arrays are gathered
     process-locally per shard (callers on multi-host meshes should write
-    from one process or use :class:`Checkpointer` instead)."""
+    from one process or use :class:`Checkpointer` instead).  Every blob
+    carries per-``chunk_bytes`` crc32s and the header a ``content_hash``
+    so readers can verify and version what they load."""
     flat = _flatten(tree)
     index: dict[str, dict] = {}
     offset = 0
 
     arrays: dict[str, np.ndarray] = {}
+    raws: dict[str, bytes] = {}
     for name, arr in flat.items():
         np_arr = np.asarray(arr)
         arrays[name] = np_arr
+        raw = np_arr.tobytes()
+        raws[name] = raw
         nbytes = np_arr.nbytes
         index[name] = {
             "dtype": jnp.dtype(np_arr.dtype).name,
             "shape": list(np_arr.shape),
             "offset": offset,  # relative to data start
             "nbytes": nbytes,
+            "crc32": _chunk_crcs(raw, chunk_bytes),
         }
         offset += (nbytes + ALIGN - 1) // ALIGN * ALIGN
 
-    header = json.dumps({"tensors": index, "meta": meta or {}}).encode()
+    header = json.dumps({
+        "tensors": index,
+        "meta": meta or {},
+        "chunk_bytes": chunk_bytes,
+        "content_hash": _content_hash(index),
+    }).encode()
     data_start = 16 + len(header)
     data_start = (data_start + ALIGN - 1) // ALIGN * ALIGN
 
@@ -149,13 +277,13 @@ def write_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
         f.write(len(header).to_bytes(8, "little"))
         f.write(header)
         pos = 16 + len(header)
-        for name, np_arr in arrays.items():
+        for name in arrays:
             target = data_start + index[name]["offset"]
             if target > pos:
                 f.write(b"\0" * (target - pos))
                 pos = target
-            f.write(np_arr.tobytes())
-            pos += np_arr.nbytes
+            f.write(raws[name])
+            pos += len(raws[name])
         end = data_start + offset
         if end > pos:
             f.write(b"\0" * (end - pos))
@@ -209,16 +337,22 @@ def _target_dtype(src_dtype, dtype):
     return jnp.dtype(dtype) if cast else src_dtype
 
 
-def _place_leaf(arr: np.ndarray, sharding, target_dtype):
+def _place_leaf(arr: np.ndarray, sharding, target_dtype, *,
+                owned: bool = False):
     """Shared cast + (sharded) device placement for both source paths.
 
     The source ``arr`` may view borrowed memory (an mmap about to close,
     a bytes buffer): ``materialize`` guarantees an owned copy, which jax
-    zero-copies on CPU backends."""
+    zero-copies on CPU backends.  ``owned=True`` marks a staging buffer
+    the loader allocated for exactly this tensor and will never touch
+    again — it is donated to jax as-is, skipping the defensive copy
+    (the streamed path's zero-copy handoff)."""
 
     def materialize(view: np.ndarray) -> np.ndarray:
         if target_dtype != view.dtype:
             return view.astype(target_dtype)  # astype already copies
+        if owned:
+            return view
         return np.array(view, copy=True)
 
     if sharding is None:
@@ -233,26 +367,130 @@ def _place_leaf(arr: np.ndarray, sharding, target_dtype):
         arr.shape, sharding, shards)
 
 
-def _leaf_from_mmap(mm, data_start: int, info: dict, sharding, dtype):
-    shape = tuple(info["shape"])
-    src_dtype = jnp.dtype(info["dtype"])
-    arr = np.ndarray(shape, src_dtype,
-                     buffer=mm, offset=data_start + info["offset"])
-    return _place_leaf(arr, sharding, _target_dtype(src_dtype, dtype))
+class _ChunkSource:
+    """Positioned chunk reads over a local file or remote stream, with
+    the resume ladder: transient ``OSError``s re-open the source and
+    retry the SAME chunk (bounded, exponential backoff); short reads are
+    truncation; the ``weights.read`` fault site fires per chunk."""
+
+    def __init__(self, path: str, *, retries: int = READ_RETRIES,
+                 backoff_s: float = READ_BACKOFF_S):
+        self.path = path
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.file = _open_stream(path)
+
+    def close(self) -> None:
+        try:
+            self.file.close()
+        except OSError:
+            pass
+
+    def _reopen(self) -> None:
+        self.close()
+        self.file = _open_stream(self.path)
+
+    def _local_size(self) -> Optional[int]:
+        fileno = getattr(self.file, "fileno", None)
+        if fileno is None or is_remote(self.path):
+            return None
+        try:
+            return os.fstat(fileno()).st_size
+        except (OSError, ValueError):
+            return None
+
+    def read_chunk(self, off: int, size: int, *, tensor: str,
+                   chunk: int) -> bytes:
+        attempt = 0
+        while True:
+            try:
+                mode = faults.fire("weights.read")
+                total = self._local_size()
+                if total is not None and off + size > total:
+                    _M_INTEGRITY.labels(kind="truncated").inc()
+                    raise WeightTruncatedError(
+                        f"{self.path}: tensor {tensor!r} chunk {chunk} "
+                        f"needs bytes [{off}, {off + size}) but the file "
+                        f"is {total} bytes — truncated or shrank "
+                        f"mid-read", tensor=tensor, path=self.path)
+                self.file.seek(off)
+                data = self.file.read(size)
+                if len(data) < size:
+                    _M_INTEGRITY.labels(kind="truncated").inc()
+                    raise WeightTruncatedError(
+                        f"{self.path}: short read on tensor {tensor!r} "
+                        f"chunk {chunk} ({len(data)}/{size} bytes)",
+                        tensor=tensor, path=self.path)
+                if mode == "drop":
+                    # injected corruption: the chunk "arrives" garbled
+                    data = b"\0" * size
+                return data
+            except faults.FaultError as e:
+                # raise-mode injection = a transient I/O failure; route
+                # it through the same resume ladder as a real OSError
+                err: Exception = OSError(str(e))
+                err.__cause__ = e
+            except OSError as e:
+                err = e
+            attempt += 1
+            if attempt > self.retries:
+                _M_INTEGRITY.labels(kind="read").inc()
+                raise WeightReadError(
+                    f"{self.path}: tensor {tensor!r} chunk {chunk} still "
+                    f"failing after {self.retries} retries: {err}",
+                    tensor=tensor, chunk=chunk, path=self.path) from err
+            _M_RETRIES.labels(kind="transient").inc()
+            time.sleep(self.backoff_s * (2 ** (attempt - 1)))
+            try:
+                self._reopen()
+            except OSError:
+                pass  # next attempt reports through the ladder
+
+    def read_tensor(self, data_start: int, name: str, info: Mapping, *,
+                    chunk_bytes: int, verify: bool) -> np.ndarray:
+        """Chunked sequential read of one blob into an owned staging
+        buffer, verifying each chunk's crc32 as it lands."""
+        nbytes = int(info["nbytes"])
+        shape = tuple(info["shape"])
+        src_dtype = jnp.dtype(info["dtype"])
+        if nbytes == 0:
+            return np.zeros(shape, src_dtype)
+        crcs = info.get("crc32")
+        buf = np.empty(nbytes, dtype=np.uint8)
+        base = data_start + int(info["offset"])
+        n_chunks = (nbytes + chunk_bytes - 1) // chunk_bytes
+        for ci in range(n_chunks):
+            lo = ci * chunk_bytes
+            size = min(chunk_bytes, nbytes - lo)
+            data = self.read_chunk(base + lo, size, tensor=name, chunk=ci)
+            if verify and crcs is not None:
+                want = crcs[ci] if ci < len(crcs) else None
+                if want is None or len(crcs) != n_chunks:
+                    raise WeightIntegrityError(
+                        f"{self.path}: tensor {name!r} declares "
+                        f"{len(crcs)} chunk checksums for {n_chunks} "
+                        f"chunks — header/blob mismatch",
+                        tensor=name, chunk=ci, path=self.path)
+                if zlib.crc32(data) != want:
+                    # one re-read: a transiently garbled chunk heals,
+                    # genuine corruption fails identically twice
+                    _M_RETRIES.labels(kind="reread").inc()
+                    data = self.read_chunk(base + lo, size,
+                                           tensor=name, chunk=ci)
+                    if zlib.crc32(data) != want:
+                        _M_INTEGRITY.labels(kind="corrupt").inc()
+                        raise WeightIntegrityError(
+                            f"{self.path}: tensor {name!r} chunk "
+                            f"{ci}/{n_chunks} failed crc32 verification",
+                            tensor=name, chunk=ci, path=self.path)
+            buf[lo:lo + size] = np.frombuffer(data, dtype=np.uint8)
+        return buf.view(src_dtype).reshape(shape)
 
 
-def _leaf_from_stream(f, data_start: int, info: dict, sharding, dtype):
-    """Remote path: stream exactly this tensor's byte range (seek+read —
-    a ranged GET under fsspec/GCS) and place it, per-shard when sharded.
-    One tensor is resident on host at a time, so a sharded model larger
-    than host RAM still loads; per-shard sub-ranges within a tensor are
-    a future refinement."""
-    shape = tuple(info["shape"])
-    src_dtype = jnp.dtype(info["dtype"])
-    f.seek(data_start + info["offset"])
-    raw = f.read(info["nbytes"])
-    arr = np.frombuffer(raw, src_dtype).reshape(shape)
-    return _place_leaf(arr, sharding, _target_dtype(src_dtype, dtype))
+def _verifiable(header: Mapping) -> bool:
+    tensors = header.get("tensors") or {}
+    return bool(tensors) and all(
+        info.get("crc32") is not None for info in tensors.values())
 
 
 def load_pytree(
@@ -261,6 +499,9 @@ def load_pytree(
     *,
     dtype: Any = None,
     index: Optional[dict] = None,
+    verify: Optional[bool] = None,
+    streaming: bool = True,
+    retries: int = READ_RETRIES,
 ) -> Any:
     """Load a serialized pytree.
 
@@ -273,38 +514,184 @@ def load_pytree(
     path, no local copy of the artifact.  ``index``: a pre-read
     :func:`read_index` result, so callers that already fetched the header
     (for config metadata) don't pay a second remote round-trip.
+
+    ``verify``: ``None`` (default) verifies when the header carries chunk
+    checksums; ``True`` demands them (legacy files raise
+    :class:`WeightIntegrityError`); ``False`` skips verification.
+    ``streaming=False`` selects the legacy mmap path for local files
+    (page-cache zero-copy, no chunk resume — trainer-side restores of
+    just-written checkpoints); the truncation guard still applies.
     """
+    if not streaming and not is_remote(path):
+        return _load_pytree_mmap(path, shardings, dtype=dtype, index=index)
+
+    t0 = time.perf_counter()
     flat_shardings = _flatten(shardings) if shardings is not None else {}
+    src = _ChunkSource(path, retries=retries)
+    try:
+        if index is not None:
+            header = index
+        else:
+            header = _read_index_from(src.file, path)
+        do_verify = _verifiable(header) if verify is None else verify
+        if verify and not _verifiable(header):
+            raise WeightIntegrityError(
+                f"{path}: verification requested but the header carries "
+                f"no chunk checksums (legacy format)", path=path)
+        data_start = header["data_start"]
+        chunk_bytes = int(header.get("chunk_bytes") or DEFAULT_CHUNK_BYTES)
+        flat = {}
+        total = 0
+        for name, info in header["tensors"].items():
+            arr = src.read_tensor(data_start, name, info,
+                                  chunk_bytes=chunk_bytes,
+                                  verify=do_verify)
+            total += arr.nbytes
+            flat[name] = _place_leaf(
+                arr, flat_shardings.get(name),
+                _target_dtype(arr.dtype, dtype), owned=True)
+        # one tensor resident on host at a time; block before returning
+        jax.block_until_ready(list(flat.values()))
+    finally:
+        src.close()
+    _M_LOAD_S.labels(mode="stream").observe(time.perf_counter() - t0)
+    _M_BYTES.labels(mode="stream").inc(total)
+    return _unflatten(flat)
 
-    if is_remote(path):
-        # One remote open serves header and tensor reads (connection and
-        # auth setup on GCS is not free on the cold-start path).
-        with _open_stream(path) as f:
-            if index is not None:
-                header = index
-                f.seek(0)
-            else:
-                header = _read_index_from(f, path)
-            data_start = header["data_start"]
-            flat = {}
-            for name, info in header["tensors"].items():
-                flat[name] = _leaf_from_stream(
-                    f, data_start, info, flat_shardings.get(name), dtype)
-            jax.block_until_ready(list(flat.values()))
-        return _unflatten(flat)
 
+def _leaf_from_mmap(mm, data_start: int, info: dict, sharding, dtype):
+    shape = tuple(info["shape"])
+    src_dtype = jnp.dtype(info["dtype"])
+    arr = np.ndarray(shape, src_dtype,
+                     buffer=mm, offset=data_start + info["offset"])
+    return _place_leaf(arr, sharding, _target_dtype(src_dtype, dtype))
+
+
+def _load_pytree_mmap(path: str, shardings: Any = None, *,
+                      dtype: Any = None,
+                      index: Optional[dict] = None) -> Any:
+    """Legacy local path: map the whole file, view tensors in place.
+    Guards every tensor's extent against the file's LIVE size so a file
+    that shrank under the mapping raises :class:`WeightTruncatedError`
+    instead of SIGBUS-ing on the page fault."""
+    t0 = time.perf_counter()
+    flat_shardings = _flatten(shardings) if shardings is not None else {}
     header = index if index is not None else read_index(path)
     data_start = header["data_start"]
 
+    total = 0
     with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
         mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         try:
             flat = {}
             for name, info in header["tensors"].items():
+                end = data_start + int(info["offset"]) + int(info["nbytes"])
+                live = os.fstat(f.fileno()).st_size
+                if end > min(size, live):
+                    _M_INTEGRITY.labels(kind="truncated").inc()
+                    raise WeightTruncatedError(
+                        f"{path}: tensor {name!r} extends to byte {end} "
+                        f"but the file is {min(size, live)} bytes — "
+                        f"truncated or shrank under the mapping",
+                        tensor=name, path=path)
+                total += int(info["nbytes"])
                 flat[name] = _leaf_from_mmap(
                     mm, data_start, info, flat_shardings.get(name), dtype)
             # block before the mmap goes away
             jax.block_until_ready(list(flat.values()))
         finally:
             mm.close()
+    _M_LOAD_S.labels(mode="mmap").observe(time.perf_counter() - t0)
+    _M_BYTES.labels(mode="mmap").inc(total)
     return _unflatten(flat)
+
+
+def load_pytree_fullread(path: str, shardings: Any = None, *,
+                         dtype: Any = None,
+                         index: Optional[dict] = None) -> Any:
+    """Baseline loader for the cold-start A/B: fetch the ENTIRE artifact
+    into host memory first (the ``torch.load``-style shape Tensorizer
+    replaces), then unpack per tensor.  No verification, full-file host
+    residency — exists so ``bench_serving --cold-start`` measures the
+    streamed loader against an honest full-file arm."""
+    t0 = time.perf_counter()
+    flat_shardings = _flatten(shardings) if shardings is not None else {}
+    with _open_stream(path) as f:
+        blob = f.read()
+    header = index if index is not None else _read_index_from(
+        io.BytesIO(blob), path)
+    data_start = header["data_start"]
+    flat = {}
+    total = 0
+    for name, info in header["tensors"].items():
+        shape = tuple(info["shape"])
+        src_dtype = jnp.dtype(info["dtype"])
+        off = data_start + int(info["offset"])
+        end = off + int(info["nbytes"])
+        if end > len(blob):
+            _M_INTEGRITY.labels(kind="truncated").inc()
+            raise WeightTruncatedError(
+                f"{path}: tensor {name!r} extends past end of file",
+                tensor=name, path=path)
+        arr = np.frombuffer(blob, src_dtype,
+                            count=int(np.prod(shape, dtype=np.int64)),
+                            offset=off).reshape(shape)
+        total += arr.nbytes
+        flat[name] = _place_leaf(arr, flat_shardings.get(name),
+                                 _target_dtype(src_dtype, dtype))
+    jax.block_until_ready(list(flat.values()))
+    _M_LOAD_S.labels(mode="fullread").observe(time.perf_counter() - t0)
+    _M_BYTES.labels(mode="fullread").inc(total)
+    return _unflatten(flat)
+
+
+def verify_file(path: str, *, index: Optional[dict] = None) -> dict:
+    """Offline integrity check of a ``.tensors`` artifact against its
+    chunk checksums — the post-serialize gate and the admission check a
+    hot-swap runs before touching a serving engine.
+
+    Returns a report dict: ``status`` is ``clean`` (every chunk
+    verifies), ``corrupt`` (checksum mismatch — ``errors`` names
+    tensor/chunk), ``truncated`` (file shorter than the header
+    promises), or ``unverifiable`` (legacy header without checksums;
+    sizes still checked).  Never raises on a bad file — unreadable or
+    bad-magic files report ``corrupt``."""
+    report: dict[str, Any] = {"path": path, "status": "clean",
+                              "tensors": 0, "bytes": 0, "errors": [],
+                              "weights_version": "unversioned"}
+    try:
+        header = index if index is not None else read_index(path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        report["status"] = "corrupt"
+        report["errors"].append(f"unreadable header: {e}")
+        return report
+    report["weights_version"] = weights_version(header)
+    data_start = header["data_start"]
+    chunk_bytes = int(header.get("chunk_bytes") or DEFAULT_CHUNK_BYTES)
+    verifiable = _verifiable(header)
+    corrupt = truncated = False
+    src = _ChunkSource(path, retries=0)
+    try:
+        for name, info in header["tensors"].items():
+            report["tensors"] += 1
+            report["bytes"] += int(info["nbytes"])
+            try:
+                src.read_tensor(data_start, name, info,
+                                chunk_bytes=chunk_bytes,
+                                verify=verifiable)
+            except WeightTruncatedError as e:
+                truncated = True
+                report["errors"].append(str(e))
+            except (WeightIntegrityError, WeightReadError) as e:
+                corrupt = True
+                report["errors"].append(str(e))
+    finally:
+        src.close()
+    if corrupt:
+        report["status"] = "corrupt"
+    elif truncated:
+        report["status"] = "truncated"
+    elif not verifiable:
+        report["status"] = "unverifiable"
+    return report

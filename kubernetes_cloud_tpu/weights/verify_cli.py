@@ -1,0 +1,67 @@
+"""``kct-tensors-verify`` — offline integrity check of ``.tensors``
+artifacts against their per-chunk crc32 checksums.
+
+The workflow's post-serialize gate and the pre-flight a rollout runs
+before pointing a hot-swap at a new artifact.  Exit codes are distinct
+per failure class so shell pipelines can branch without parsing:
+
+====  ==========================================================
+code  meaning
+====  ==========================================================
+0     clean — every chunk of every file verified
+3     corrupt — checksum mismatch or unreadable header (worst wins)
+4     truncated — file shorter than its header promises
+5     unverifiable — legacy header without checksums (sizes OK)
+====  ==========================================================
+
+(1 is Python's crash exit, 2 argparse's usage exit — neither is a
+verification verdict, so verdict codes start at 3.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+#: verdict -> exit code (worst across multiple files wins)
+EXIT_CODES = {"clean": 0, "corrupt": 3, "truncated": 4, "unverifiable": 5}
+_SEVERITY = ("clean", "unverifiable", "truncated", "corrupt")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kct-tensors-verify",
+        description="Verify .tensors artifacts against their chunk "
+                    "checksums (exit 0 clean / 3 corrupt / 4 truncated "
+                    "/ 5 unverifiable).")
+    ap.add_argument("paths", nargs="+",
+                    help=".tensors files, directories holding "
+                         "model.tensors, or remote URIs (gs://, s3://)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-file output; exit code only")
+    args = ap.parse_args(argv)
+
+    # deferred so --help stays instant (tensorstream imports jax)
+    from kubernetes_cloud_tpu.weights import tensorstream as ts
+
+    reports = [ts.verify_file(ts.resolve_artifact(p)) for p in args.paths]
+    worst = max((r["status"] for r in reports), key=_SEVERITY.index)
+    if not args.quiet:
+        if args.format == "json":
+            print(json.dumps(reports if len(reports) > 1 else reports[0]))
+        else:
+            for r in reports:
+                line = (f"{r['path']}: {r['status']} "
+                        f"({r['tensors']} tensors, {r['bytes']} bytes, "
+                        f"version {r['weights_version']})")
+                print(line)
+                for err in r["errors"]:
+                    print(f"  {err}", file=sys.stderr)
+    return EXIT_CODES[worst]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,9 @@
 """Canned pipelines — the reference's flagship composition as a built-in.
 
-``finetune-and-serve`` is the five-primitive pipeline (corpus →
-dataset-downloader → tokenizer → finetuner → serve smoke-test) sized to
-complete on the CPU-simulated mesh in one command::
+``finetune-and-serve`` is the flagship pipeline (corpus →
+dataset-downloader → tokenizer → finetuner → tensors-verify →
+serve smoke-test) sized to complete on the CPU-simulated mesh in one
+command::
 
     python -m kubernetes_cloud_tpu.workflow run finetune-and-serve
 
@@ -106,6 +107,18 @@ def build_finetune_and_serve() -> WorkflowSpec:
             artifacts=[f"{wd}/results-{run}"],
         ),
         Step(
+            # post-serialize integrity gate: chunk-checksum the fresh
+            # artifact BEFORE a pod pays a cold start on it — a corrupt
+            # or truncated save fails the workflow here (exit 3/4,
+            # weights/verify_cli.py) instead of a serving rollout
+            name="tensors-verify",
+            command=[py, "-m", "kubernetes_cloud_tpu.weights.verify_cli",
+                     f"{wd}/results-{run}/final"],
+            deps=["finetuner"],
+            timeout=600.0,
+            env=dict(_CPU_ENV),
+        ),
+        Step(
             name="serve-smoke",
             command=[py, "-m", "kubernetes_cloud_tpu.serve.lm_service",
                      "--model", f"{wd}/results-{run}/final",
@@ -113,7 +126,7 @@ def build_finetune_and_serve() -> WorkflowSpec:
                      "--smoke", "{{workflow.parameters.prompt}}",
                      "--smoke-tokens",
                      "{{workflow.parameters.max_new_tokens}}"],
-            deps=["finetuner"],
+            deps=["tensors-verify"],
             retry=RetryStrategy(limit=1, backoff=2.0),
             timeout=900.0,
             env=dict(_CPU_ENV),

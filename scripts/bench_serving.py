@@ -2009,6 +2009,89 @@ def run_autoscale(args) -> int:
     return 0
 
 
+def run_cold_start(args) -> int:
+    """--cold-start: streamed vs whole-file-read weight loading,
+    measured as startup→first-token (BENCHMARKS.md "Streaming cold
+    start").  Serializes the preset once, pre-warms XLA (a production
+    pod restarts into a persistent compile cache — the loader, not
+    compilation, is what a cold start pays), then times interleaved
+    pairs of full cold starts: chunk-verified streaming ``load_pytree``
+    vs the ``load_pytree_fullread`` read-everything-then-deserialize
+    baseline, each followed by one generation.  The JSON record's
+    ``cold_start_s`` map is the shape
+    ``Autoscaler.seed_from_benchmark`` reads, so a fresh autoscaler
+    plans with this measurement instead of its configured prior."""
+    import statistics
+    import tempfile
+    import time
+
+    from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
+    from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+    from kubernetes_cloud_tpu.weights import tensorstream as ts
+
+    cfg = dataclasses.replace(PRESETS[args.preset], dtype=jnp.float32)
+    params = init_params(cfg, jax.random.key(args.seed))
+    nbytes = sum(int(x.nbytes) for x in jax.tree.leaves(params))
+
+    def first_token(svc):
+        opts = svc.configure_request(
+            {"parameters": {"max_new_tokens": args.cold_tokens,
+                            "temperature": 0.0}})
+        out = svc.generate_outputs(["cold start probe"], opts)
+        assert out and out[0]["tokens_out"] >= 0
+
+    def one_start(path, mode):
+        t0 = time.perf_counter()
+        if mode == "stream":
+            loaded = ts.load_pytree(path)
+        else:
+            loaded = ts.load_pytree_fullread(path)
+        svc = CausalLMService("lm", cfg, params=loaded,
+                              dtype=jnp.float32)
+        svc.load()
+        first_token(svc)
+        return time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "model.tensors")
+        ts.write_pytree(path, params,
+                        {"model_name": args.preset,
+                         "model_config": dataclasses.asdict(
+                             dataclasses.replace(
+                                 cfg, dtype=str(cfg.dtype),
+                                 param_dtype=str(cfg.param_dtype)))})
+        # warm XLA once so both arms measure loading, not compilation
+        one_start(path, "fullread")
+        stream_s, fullread_s = [], []
+        for _ in range(max(1, args.cold_repeats)):
+            # interleave the arms so drift (page cache, thermal, CI
+            # noise) lands on both sides evenly
+            stream_s.append(one_start(path, "stream"))
+            fullread_s.append(one_start(path, "fullread"))
+
+    stream_mean = statistics.mean(stream_s)
+    fullread_mean = statistics.mean(fullread_s)
+    record = {
+        "metric": "serving_cold_start_streamed_s",
+        "value": round(stream_mean, 4),
+        "unit": "seconds",
+        "preset": args.preset,
+        "artifact_mib": round(nbytes / 2**20, 3),
+        "repeats": len(stream_s),
+        "stream_s": [round(s, 4) for s in stream_s],
+        "fullread_s": [round(s, 4) for s in fullread_s],
+        "stream_mean_s": round(stream_mean, 4),
+        "fullread_mean_s": round(fullread_mean, 4),
+        "speedup": round(fullread_mean / max(stream_mean, 1e-9), 3),
+        "streamed_beats_fullread": stream_mean < fullread_mean,
+        # the autoscaler-seedable prior: startup→first-token per role
+        # (one colocated service here; disagg pods would report both)
+        "cold_start_s": {"colocated": round(stream_mean, 4)},
+    }
+    print(json.dumps(record))
+    return 0
+
+
 def main(argv=None) -> int:
     from kubernetes_cloud_tpu.models.causal_lm import PRESETS, init_params
     from kubernetes_cloud_tpu.serve.batcher import BatcherConfig, BatchingModel
@@ -2178,6 +2261,17 @@ def main(argv=None) -> int:
                     help="autoscale mode: autoscaler max_replicas")
     ap.add_argument("--as-tick", type=float, default=0.25,
                     help="autoscale mode: simulator tick seconds")
+    ap.add_argument("--cold-start", action="store_true",
+                    help="streamed vs whole-file weight loading, "
+                         "measured startup→first-token with warmed "
+                         "XLA (records serving_cold_start_streamed_s; "
+                         "the JSON cold_start_s map seeds "
+                         "Autoscaler.seed_from_benchmark)")
+    ap.add_argument("--cold-repeats", type=int, default=3,
+                    help="cold-start mode: interleaved measured pairs")
+    ap.add_argument("--cold-tokens", type=int, default=8,
+                    help="cold-start mode: tokens in the first-token "
+                         "generation")
     ap.add_argument("--inject", choices=("hang", "crash"), default=None,
                     help="recovery mode: wedge (hang) or crash the "
                          "decode loop and measure supervisor recovery "
@@ -2193,6 +2287,9 @@ def main(argv=None) -> int:
 
     if args.inject:
         return run_recovery(args)
+
+    if args.cold_start:
+        return run_cold_start(args)
 
     rng = random.Random(args.seed)
     pool = _payload_pool(rng, args.requests,

@@ -1,0 +1,26 @@
+#!/usr/bin/env python3
+"""CI/dev wrapper around the ``kct-tensors-verify`` engine.
+
+Exactly the same entry point as the console script and
+``python -m kubernetes_cloud_tpu.weights.verify_cli`` — one verifier,
+one exit-code contract (0 clean, 3 corrupt, 4 truncated,
+5 unverifiable), so the workflow's post-serialize gate and humans can
+never disagree about what was checked.
+
+Usage::
+
+    python scripts/tensors_verify.py results/run/final
+    python scripts/tensors_verify.py a.tensors b.tensors --format json
+"""
+
+import pathlib
+import sys
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from kubernetes_cloud_tpu.weights.verify_cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

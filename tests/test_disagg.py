@@ -295,7 +295,7 @@ def test_probe_healthy_learns_role():
     body = {"models": {"lm": {"ok": True, "queue_depth": 2,
                               "heartbeat_age_s": 0.01,
                               "role": "decode"}}}
-    healthy, depth, _age, role = _probe_healthy(200, body, 5.0)
+    healthy, depth, _age, role, _wv = _probe_healthy(200, body, 5.0)
     assert healthy and depth == 2 and role == "decode"
     # any admission-taking model makes the replica routable
     body["models"]["lm2"] = {"ok": True, "role": "prefill"}

@@ -176,11 +176,11 @@ def test_probe_healthy_reads_heartbeat_and_depth():
                              "heartbeat_age_s": 0.1},
                        "b": {"ok": True, "queue_depth": 3,
                              "heartbeat_age_s": 0.2}}}
-    ok, depth, age, _role = _probe_healthy(200, body, stale_s=10.0)
+    ok, depth, age, _role, _wv = _probe_healthy(200, body, stale_s=10.0)
     assert ok and depth == 5 and age == pytest.approx(0.2)
     # HTTP 200 with a stale heartbeat is a HUNG pod, not a healthy one
     body["models"]["b"]["heartbeat_age_s"] = 99.0
-    ok, _, age, _role = _probe_healthy(200, body, stale_s=10.0)
+    ok, _, age, _role, _wv = _probe_healthy(200, body, stale_s=10.0)
     assert not ok and age == pytest.approx(99.0)
     assert _probe_healthy(503, {}, 10.0)[0] is False
 

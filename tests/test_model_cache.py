@@ -1,0 +1,229 @@
+"""Lifecycle-managed model registry (serve/model_cache.py).
+
+The cache replaces ``ModelServer``'s static ``{name: Model}`` dict: a
+model moves ``loading → active → draining → retired`` (terminal
+``failed`` for a load that raised), ``capacity`` pages the
+least-recently-used idle model out through its drain path, and tenant
+quotas stop one tenant from evicting everyone else's adapters.  The
+server-visible consequences ride along: ``load_all`` continues past a
+bad model instead of leaving the registry half-populated, ``/readyz``
+reports the failure per-model, and ``/v1/models/<name>`` merges the
+lifecycle snapshot into the readiness body.
+"""
+
+import json
+import threading
+
+import pytest
+
+from kubernetes_cloud_tpu.serve.errors import (
+    ModelCacheFullError,
+    TenantQuotaError,
+)
+from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.model_cache import ModelCache
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+pytestmark = pytest.mark.swap
+
+
+class Toy(Model):
+    """Instrumented predictor: scriptable load failure, drain witness."""
+
+    def __init__(self, name, *, fail=False, version=None):
+        super().__init__(name)
+        self._fail = fail
+        self.weights_version = version
+        self.stopped = False
+
+    def load(self):
+        if self._fail:
+            raise RuntimeError(f"weights for {self.name} unreadable")
+        self.ready = True
+
+    def predict(self, payload):
+        return {"model": self.name, "echo": payload.get("x")}
+
+    def stop(self):
+        self.stopped = True
+        self.ready = False
+
+
+# -- lifecycle states --------------------------------------------------------
+
+
+def test_states_walk_the_lifecycle():
+    cache = ModelCache([Toy("m")])
+    assert cache.states() == {"m": "loading"}
+    cache.load("m")
+    assert cache.states() == {"m": "active"}
+    assert cache["m"].ready
+    cache.evict("m")
+    # retired: metadata survives, the registry dict no longer serves it
+    assert cache.states() == {"m": "retired"}
+    assert "m" not in cache
+    assert cache.entry("m").model.ready is False
+
+
+def test_failed_load_is_terminal_and_stays_registered():
+    cache = ModelCache([Toy("bad", fail=True)])
+    with pytest.raises(RuntimeError, match="unreadable"):
+        cache.load("bad")
+    entry = cache.entry("bad")
+    assert entry.state == "failed"
+    assert "unreadable" in entry.error
+    # the name still resolves — readiness can report WHY, and load_all
+    # does not retry a terminal failure
+    assert "bad" in cache
+    assert cache.load_all() == {}
+    assert entry.state == "failed"
+
+
+def test_evict_drains_through_stop_and_allows_readmission():
+    m = Toy("m")
+    cache = ModelCache([m])
+    cache.load("m")
+    cache.evict("m")
+    assert m.stopped and not m.ready
+    # a retired name can be admitted again (rollout round-trip)
+    cache.admit(Toy("m"))
+    assert cache.states()["m"] == "loading"
+
+
+def test_double_admit_rejected():
+    cache = ModelCache([Toy("m")])
+    with pytest.raises(ValueError, match="already"):
+        cache.admit(Toy("m"))
+
+
+# -- LRU paging --------------------------------------------------------------
+
+
+def _loaded(name):
+    m = Toy(name)
+    m.load()
+    return m
+
+
+def test_capacity_evicts_least_recently_used():
+    cache = ModelCache(capacity=2)
+    a, b = _loaded("a"), _loaded("b")
+    cache.admit(a)
+    cache.admit(b)
+    cache.touch("a")  # b is now the LRU model
+    cache.admit(_loaded("c"))
+    assert "b" not in cache and b.stopped
+    assert set(cache) == {"a", "c"}
+    assert cache.states()["b"] == "retired"
+
+
+def test_busy_models_are_never_paged_out():
+    cache = ModelCache(capacity=1)
+    cache.admit(_loaded("a"))
+    with cache.using("a"):  # in-flight request pins it
+        with pytest.raises(ModelCacheFullError, match="busy"):
+            cache.admit(_loaded("b"))
+        assert "a" in cache
+    # once idle the same admit succeeds and pages "a" out
+    cache.admit(_loaded("b"))
+    assert set(cache) == {"b"}
+
+
+def test_using_counts_inflight_and_touches_lru():
+    cache = ModelCache([_loaded("m")])
+    entry = cache.entry("m")
+    before = entry.last_used
+    with cache.using("m"):
+        assert entry.inflight == 1
+        with cache.using("m"):
+            assert entry.inflight == 2
+    assert entry.inflight == 0
+    assert entry.last_used >= before
+
+
+# -- tenancy -----------------------------------------------------------------
+
+
+def test_tenant_quota_bounds_one_tenants_zoo():
+    cache = ModelCache(tenant_model_quota=1)
+    cache.admit(_loaded("a1"), tenant="acme")
+    with pytest.raises(TenantQuotaError, match="acme"):
+        cache.admit(_loaded("a2"), tenant="acme")
+    # another tenant (and the operator's untenanted models) are not
+    # collateral damage
+    cache.admit(_loaded("b1"), tenant="other")
+    cache.admit(_loaded("ops"))
+    # retiring frees the quota slot
+    cache.evict("a1")
+    cache.admit(_loaded("a2"), tenant="acme")
+
+
+# -- the server riding the cache ---------------------------------------------
+
+
+def _get(server, path):
+    status, obj = server.handle("GET", path, b"")
+    return status, obj
+
+
+def _post(server, path, payload):
+    return server.handle("POST", path, json.dumps(payload).encode())
+
+
+def test_load_all_serves_degraded_past_a_bad_model():
+    srv = ModelServer([Toy("good"), Toy("bad", fail=True)],
+                      host="127.0.0.1", port=0)
+    srv.load_all()  # must NOT raise: one model made it
+    status, body = _get(srv, "/readyz")
+    assert status == 503 and body["status"] == "unready"
+    assert body["models"]["good"]["ok"]
+    bad = body["models"]["bad"]
+    assert not bad["ok"]
+    assert bad["state"] == "failed" and "unreadable" in bad["error"]
+    # the good model serves; the failed one answers a typed 503
+    status, body = _post(srv, "/v1/models/good:predict", {"x": 1})
+    assert status == 200 and body["echo"] == 1
+    status, body = _post(srv, "/v1/models/bad:predict", {"x": 1})
+    assert status == 503 and body["error_kind"] == "ModelLoadFailed"
+
+
+def test_load_all_raises_when_nothing_loaded():
+    srv = ModelServer([Toy("bad", fail=True)], host="127.0.0.1", port=0)
+    with pytest.raises(RuntimeError, match="no model loaded"):
+        srv.load_all()
+
+
+def test_model_detail_merges_lifecycle_snapshot():
+    srv = ModelServer([Toy("m", version="abcdef123456")],
+                      host="127.0.0.1", port=0)
+    srv.load_all()
+    status, body = _get(srv, "/v1/models/m")
+    assert status == 200
+    assert body == {"name": "m", "ready": True, "state": "active",
+                    "weights_version": "abcdef123456"}
+
+
+def test_server_accepts_prebuilt_cache_with_quota():
+    cache = ModelCache([Toy("m")], capacity=4, tenant_model_quota=2)
+    srv = ModelServer(cache, host="127.0.0.1", port=0)
+    assert srv.models is cache
+    srv.load_all()
+    status, body = _get(srv, "/readyz")
+    assert status == 200 and body["models"]["m"]["state"] == "active"
+
+
+def test_concurrent_using_is_thread_safe():
+    cache = ModelCache([_loaded("m")])
+    n, rounds = 8, 200
+
+    def worker():
+        for _ in range(rounds):
+            with cache.using("m"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert cache.entry("m").inflight == 0

@@ -45,7 +45,9 @@ def _req(server, path, payload=None):
 def test_liveness_and_model_list(server):
     assert _req(server, "/")["status"] == "alive"
     assert _req(server, "/v1/models") == {"models": ["lm"]}
-    assert _req(server, "/v1/models/lm") == {"name": "lm", "ready": True}
+    detail = _req(server, "/v1/models/lm")
+    assert detail["name"] == "lm" and detail["ready"] is True
+    assert detail["state"] == "active"
 
 
 def test_predict_v1(server):

@@ -1,0 +1,364 @@
+"""Live weight hot-swap chaos: drain/transplant rollouts under faults.
+
+The proof for ``ContinuousBatchingModel.swap_weights``: a running model
+rolls onto new weights with zero dropped requests (queued work
+transplants, in-flight slots finish on the weights that prefilled
+them); a corrupt candidate or an injected ``weights.swap`` fault rolls
+back whole — the old version never stops serving and the prepared side
+is discarded; a second swap while one is in flight answers a typed 503;
+and a supervisor restart landing mid-swap converges to exactly ONE live
+engine (the ``_swap_lock`` cutover serialization).  The identity trail
+rides along: ``weights_version`` changes across the swap in /readyz,
+per-prediction responses, fleet probe learning, and the native
+front-end, so a rollout is observable end to end.
+"""
+
+import dataclasses
+import json
+import shutil
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingModel,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.errors import (
+    EngineRestartedError,
+    SwapInProgressError,
+)
+from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+from kubernetes_cloud_tpu.serve.server import ModelServer
+from kubernetes_cloud_tpu.serve.supervisor import (
+    ServingSupervisor,
+    SupervisorConfig,
+    _EngineTarget,
+)
+from kubernetes_cloud_tpu.weights.tensorstream import (
+    read_index,
+    weights_version,
+    write_pytree,
+)
+
+pytestmark = [pytest.mark.swap, pytest.mark.chaos]
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.uninstall()
+    yield
+    inj = faults.active()
+    if inj is not None:
+        inj.release()
+    faults.uninstall()
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """Two distinct versioned artifacts of the same architecture —
+    the old rollout and the candidate."""
+    d = tmp_path_factory.mktemp("weights")
+    v1, v2 = str(d / "v1.tensors"), str(d / "v2.tensors")
+    write_pytree(v1, init_params(CFG, jax.random.key(0)))
+    write_pytree(v2, init_params(CFG, jax.random.key(1)))
+    ver1 = weights_version(read_index(v1))
+    ver2 = weights_version(read_index(v2))
+    assert ver1 != ver2
+    return {"v1": v1, "v2": v2, "ver1": ver1, "ver2": ver2}
+
+
+@pytest.fixture
+def model(artifacts):
+    """A serving model streamed from the v1 artifact (so its
+    weights_version is the content hash, not None)."""
+    svc = CausalLMService("lm", CFG, weights_path=artifacts["v1"],
+                          dtype=jnp.float32)
+    m = ContinuousBatchingModel("lm", svc,
+                                EngineConfig(slots=2, max_len=96))
+    m.load()
+    # compile the programs the scenario will hit before arming faults
+    m.engine.submit([1, 2, 3], max_new_tokens=2, temperature=0.0).wait()
+    yield m
+    m.stop()
+
+
+def _predict(port, prompt, max_new, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/lm:predict",
+        data=json.dumps({
+            "instances": [prompt],
+            "parameters": {"max_new_tokens": max_new, "temperature": 0.0},
+        }).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _swap(port, weights):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/lm:swap",
+        data=json.dumps({"weights": weights}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _readyz_model(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/readyz", timeout=10) as r:
+        return json.loads(r.read())["models"]["lm"]
+
+
+def test_hot_swap_under_traffic_drops_nothing(model, artifacts):
+    """ISSUE acceptance: swap weights on a model taking continuous
+    traffic — every client request succeeds (queued work transplants
+    to the new engine, in-flight slots drain on the old), and the
+    weights_version trail flips everywhere at once."""
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    stop = threading.Event()
+    results, failures = [], []
+
+    def client():
+        while not stop.is_set():
+            try:
+                status, body = _predict(server.port, "rolling rollout", 4)
+                results.append((status, body["predictions"][0]))
+            except Exception as e:  # noqa: BLE001 - the assertion target
+                failures.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(3)]
+    try:
+        assert _readyz_model(server.port)["weights_version"] \
+            == artifacts["ver1"]
+        for t in threads:
+            t.start()
+        status, body = _swap(server.port, artifacts["v2"])
+        assert status == 200, body
+        assert body["swapped"] is True
+        assert body["weights_version"] == artifacts["ver2"]
+        # a post-swap prediction carries the new identity
+        _, after = _predict(server.port, "rolling rollout", 4)
+        assert after["predictions"][0]["weights_version"] \
+            == artifacts["ver2"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        server.stop()
+    assert not failures, failures
+    assert results and all(s == 200 for s, _ in results)
+    # every prediction names the weights that produced it — one of the
+    # two versions, never an unlabeled tear
+    seen = {p["weights_version"] for _, p in results}
+    assert seen <= {artifacts["ver1"], artifacts["ver2"]}
+    assert model.weights_version == artifacts["ver2"]
+    assert model.engine.weights_version == artifacts["ver2"]
+
+
+def test_corrupt_candidate_rolls_back_409(model, artifacts, tmp_path):
+    """A candidate artifact with a flipped byte never takes traffic:
+    the chunk crc32 catches it during prepare, the route answers 409
+    with ``rolled_back: true``, and the old version keeps serving."""
+    bad = str(tmp_path / "bad.tensors")
+    shutil.copyfile(artifacts["v2"], bad)
+    idx = read_index(bad)
+    victim = idx["data_start"] + 64
+    with open(bad, "r+b") as f:
+        f.seek(victim)
+        byte = f.read(1)
+        f.seek(victim)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        old_engine = model.engine
+        status, body = _swap(server.port, bad)
+        assert status == 409
+        assert body["rolled_back"] is True
+        assert body["error_kind"] == "WeightIntegrityError"
+        assert body["weights_version"] == artifacts["ver1"]
+        # the old engine object itself is still the serving one
+        assert model.engine is old_engine and model.engine.alive
+        status, out = _predict(server.port, "still the old weights", 4)
+        assert status == 200
+        assert out["predictions"][0]["weights_version"] \
+            == artifacts["ver1"]
+    finally:
+        server.stop()
+
+
+def test_swap_fault_after_prepare_rolls_back_whole(model, artifacts):
+    """``weights.swap`` fires in the worst window — the new engine is
+    fully prepared and started, one instant before cutover.  Rollback
+    discards the prepared side whole; the lock is released so a retry
+    succeeds."""
+    old_engine = model.engine
+    faults.install(faults.FaultInjector([FaultSpec("weights.swap")]))
+    with pytest.raises(faults.FaultError):
+        model.swap_weights(artifacts["v2"])
+    assert model.engine is old_engine and model.engine.alive
+    assert model.weights_version == artifacts["ver1"]
+    assert model.service.weights_path == artifacts["v1"]
+    # service params were not torn mid-rollback: the old engine still
+    # generates
+    assert len(model.engine.submit([5, 6], max_new_tokens=3,
+                                   temperature=0.0).wait()) == 3
+    faults.uninstall()
+    out = model.swap_weights(artifacts["v2"])
+    assert out["weights_version"] == artifacts["ver2"]
+    assert not old_engine.alive  # drained after the committed swap
+
+
+def test_concurrent_swap_rejected_typed_503(model, artifacts):
+    """Swaps serialize: while one is in flight a second answers the
+    retryable ``SwapInProgressError`` (503 over HTTP) instead of
+    queueing a multi-second weight load behind the first."""
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        assert model._swapping.acquire(blocking=False)
+        try:
+            with pytest.raises(SwapInProgressError):
+                model.swap_weights(artifacts["v2"])
+            status, body = _swap(server.port, artifacts["v2"])
+            assert status == 503
+            assert body["error_kind"] == "SwapInProgressError"
+        finally:
+            model._swapping.release()
+        status, body = _swap(server.port, artifacts["v2"])
+        assert status == 200 and body["swapped"] is True
+    finally:
+        server.stop()
+
+
+def test_supervisor_restart_mid_swap_converges_to_one_engine(
+        model, artifacts):
+    """The interleave the ``_swap_lock`` exists for: a watchdog restart
+    lands while a swap sleeps between prepare and cutover.  Whichever
+    side wins the lock, the process converges to exactly one live
+    engine serving the new version — never a torn half of each."""
+    sup = ServingSupervisor(SupervisorConfig(poll_interval_s=60.0))
+    sup.watch(model)  # installs model.supervisor (no watchdog thread:
+    # the restart is driven synchronously below, determinism over dice)
+    e0 = model.engine
+    inj = faults.install(faults.FaultInjector(
+        [FaultSpec("weights.swap", mode="slow", delay_s=1.0)]))
+    swap_result: dict = {}
+
+    def swapper():
+        try:
+            swap_result["out"] = model.swap_weights(artifacts["v2"])
+        except Exception as e:  # noqa: BLE001 - inspected below
+            swap_result["err"] = e
+
+    t = threading.Thread(target=swapper)
+    t.start()
+    try:
+        # the swap thread is parked inside the slow fault, new engine
+        # prepared, cutover not yet taken
+        deadline = 10.0
+        while not inj.fired and deadline > 0:
+            threading.Event().wait(0.01)
+            deadline -= 0.01
+        assert inj.fired, "swap never reached the weights.swap site"
+        # the production restart path (what the watchdog thread runs)
+        _EngineTarget(model).restart(
+            EngineRestartedError("lm: injected mid-swap restart"))
+    finally:
+        t.join(timeout=60)
+    assert "err" not in swap_result, swap_result
+    assert swap_result["out"]["weights_version"] == artifacts["ver2"]
+    # converged: the current engine is alive on v2; the pre-swap engine
+    # and the restart-built interim engine are both stopped
+    assert model.engine.alive
+    assert model.engine.weights_version == artifacts["ver2"]
+    assert not e0.alive
+    assert len(model.engine.submit([7, 8], max_new_tokens=3,
+                                   temperature=0.0).wait()) == 3
+
+
+def test_weights_version_parity_on_native_front_end():
+    """The C++ front-end routes through the same ``handle()``, so the
+    rollout identity a fleet probe reads is byte-identical across
+    front-ends."""
+    from kubernetes_cloud_tpu.serve import native_server
+    from kubernetes_cloud_tpu.serve.model import Model
+    from kubernetes_cloud_tpu.serve.native_server import NativeModelServer
+
+    if not native_server.available():
+        pytest.skip("native front-end toolchain unavailable")
+
+    class Versioned(Model):
+        weights_version = "cafebabe0123"
+
+        def predict(self, payload):
+            return {"predictions": []}
+
+    stdlib = ModelServer([Versioned("lm")], host="127.0.0.1", port=0)
+    stdlib.load_all()
+    native = NativeModelServer([Versioned("lm")], host="127.0.0.1",
+                               port=0)
+    native.load_all()
+    native.start()
+    try:
+        want = stdlib._readyz()[1]["models"]["lm"]["weights_version"]
+        assert want == "cafebabe0123"
+        got = _readyz_model(native.port)["weights_version"]
+        assert got == want
+    finally:
+        native.stop()
+
+
+def test_fleet_probe_learns_weights_versions():
+    """Probe bodies teach the router which replicas have rolled onto
+    the new artifact — the mid-rollout observability the fleet needs
+    to tell an already-swapped replica from a laggard."""
+    from kubernetes_cloud_tpu.serve.fleet import (
+        FleetConfig,
+        FleetRouter,
+        Replica,
+    )
+
+    class Scripted(Replica):
+        def __init__(self, rid, cfg, version):
+            super().__init__(rid, cfg)
+            self.version = version
+
+        def probe(self, timeout):
+            return 200, {"status": "ready", "models": {
+                "lm": {"ok": True, "queue_depth": 0,
+                       "heartbeat_age_s": 0.01,
+                       "weights_version": self.version}}}
+
+        def call(self, method, path, body, headers=None):
+            return 200, {}
+
+    cfg = FleetConfig(dispatch_timeout_s=5.0)
+    reps = [Scripted("old", cfg, "aaaaaaaaaaaa"),
+            Scripted("new", cfg, "bbbbbbbbbbbb")]
+    router = FleetRouter(reps, cfg, host="127.0.0.1", port=0)
+    router.probe_now()
+    assert reps[0].health.weights_versions == {"lm": "aaaaaaaaaaaa"}
+    assert reps[1].health.weights_versions == {"lm": "bbbbbbbbbbbb"}
+    snaps = {s["id"]: s for s in router.snapshot()["replicas"]}
+    assert snaps["old"]["weights_versions"]["lm"] == "aaaaaaaaaaaa"
+    assert snaps["new"]["weights_versions"]["lm"] == "bbbbbbbbbbbb"
+    # a replica mid-swap (rolled) updates on the next probe pass
+    reps[0].version = "bbbbbbbbbbbb"
+    router.probe_now()
+    assert reps[0].health.weights_versions == {"lm": "bbbbbbbbbbbb"}

@@ -1,5 +1,6 @@
 """Canned ``finetune-and-serve`` pipeline end-to-end on the CPU-simulated
-mesh: download → tokenize → train → serve smoke-test in one engine run
+mesh: download → tokenize → train → verify artifact → serve
+smoke-test in one engine run
 (the acceptance path for ``python -m kubernetes_cloud_tpu.workflow run
 finetune-and-serve``)."""
 
@@ -27,6 +28,7 @@ def test_finetune_and_serve_end_to_end(tmp_path):
         "dataset-downloader": "succeeded",
         "tokenizer": "succeeded",
         "finetuner": "succeeded",
+        "tensors-verify": "succeeded",
         "serve-smoke": "succeeded",
     }
     # every primitive's artifact contract held
@@ -50,4 +52,4 @@ def test_finetune_and_serve_end_to_end(tmp_path):
     assert result2["status"] == "succeeded"
     events = read_events(str(tmp_path / "events.jsonl"))
     starts = [e for e in events if e["event"] == "step_start"]
-    assert len(starts) == 5  # the first run's five, none added
+    assert len(starts) == 6  # the first run's six, none added
