@@ -152,6 +152,20 @@ SITES = {
                    "heartbeat watchdog — identical containment to "
                    "decode_step, chaos-locked so speculation can "
                    "never weaken the self-healing contract)",
+    "trace.export": "the GET /debug/trace span-store export (index, "
+                    "single trace, and the router-side assembler's "
+                    "replica pulls): raise 500s (only) that debug "
+                    "request, hang parks (only) its thread — the same "
+                    "containment contract as metrics.render/"
+                    "debug.render: the trace plane observes the data "
+                    "plane and can never wedge it or flip /readyz",
+    "slo.eval": "inside one SLO evaluation pass on the evaluator's "
+                "worker thread: raise is contained to an "
+                "outcome=\"error\" evaluation count with the last "
+                "good snapshot still served at /debug/slo; hang "
+                "parks (only) the lazy worker — the prober's poke() "
+                "never blocks, so a wedged evaluation can never "
+                "stall probing, dispatch, or /readyz",
 }
 
 

@@ -9,6 +9,13 @@ The observability plane the serving/workflow stack records into:
 * :mod:`~kubernetes_cloud_tpu.obs.tracing` — per-request lifecycle
   spans (``queued → admitted → prefill → decode → first_token →
   complete/shed/failed``) to the repo's shared JSONL sink.
+* :mod:`~kubernetes_cloud_tpu.obs.dtrace` — fleet-wide distributed
+  tracing: traceparent propagation, the bounded per-process span store
+  behind ``GET /debug/trace/<id>``, tail-based sampling, and the
+  critical-path analyzer.
+* :mod:`~kubernetes_cloud_tpu.obs.slo` — declarative SLO specs with
+  multi-window multi-burn-rate evaluation over the metrics registry
+  (``GET /debug/slo`` + the ``kct_slo_*`` families).
 * :mod:`~kubernetes_cloud_tpu.obs.flight` — the always-on flight
   recorder: bounded ring of per-iteration phase timings + batch
   composition, dumped by ``GET /debug/timeline``.
@@ -61,6 +68,17 @@ from kubernetes_cloud_tpu.obs.tracing import (  # noqa: F401
     RequestTracer,
     new_request_id,
     trace,
+)
+from kubernetes_cloud_tpu.obs import dtrace, slo  # noqa: F401
+from kubernetes_cloud_tpu.obs.dtrace import (  # noqa: F401
+    TRACEPARENT_HEADER,
+    TraceContext,
+)
+from kubernetes_cloud_tpu.obs.slo import (  # noqa: F401
+    BurnWindow,
+    SLOEvaluator,
+    SLOSpec,
+    default_specs,
 )
 
 

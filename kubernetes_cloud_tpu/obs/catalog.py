@@ -174,6 +174,22 @@ METRIC_FAMILIES = {
         "1 while the crash-loop circuit is open",
     "kct_supervisor_requeued_total":
         "queued requests transplanted into a replacement engine",
+    # distributed tracing (obs/dtrace.py)
+    "kct_trace_traces_total":
+        "trace retention decisions (kept_tail | kept_head | dropped)",
+    "kct_trace_spans_total":
+        "spans recorded into the in-process span store",
+    "kct_trace_store_traces":
+        "traces resident in the bounded span store",
+    # SLO burn-rate plane (obs/slo.py)
+    "kct_slo_burn_rate":
+        "error-budget burn rate per SLO and window pair",
+    "kct_slo_error_budget_remaining":
+        "error budget left per SLO over the trailing budget window",
+    "kct_slo_breaching":
+        "1 while an SLO's long+short windows both exceed max burn",
+    "kct_slo_evaluations_total":
+        "SLO evaluation passes by outcome",
     # workflow orchestrator (workflow/engine.py)
     "kct_workflow_step_seconds":
         "step execution wall time",

@@ -1,0 +1,613 @@
+"""Fleet-wide distributed tracing: trace-context propagation, a
+bounded per-process span store, tail-based sampling, and the
+critical-path analyzer ``scripts/perf_report.py --trace`` renders.
+
+The per-process tracer (:mod:`~kubernetes_cloud_tpu.obs.tracing`)
+predates everything that makes this system fleet-shaped: a request now
+crosses router → hedge/retry legs → tenant queue → engine, may hop
+prefill-role → decode-role through a KV handoff, get preempted,
+transplanted after a supervisor restart, or answered mid-hot-swap —
+and no single artifact showed that path end to end.  This module is
+the Dapper layer (Sigelman et al., 2010; PAPERS.md):
+
+* **Trace context** — a ``(trace_id, span_id, parent_id)`` triple per
+  span.  The wire format is a ``Traceparent`` header (or a payload
+  ``traceparent`` field for headerless hops): ``<trace_id>-<span_id>``
+  names the *caller's* span; the receiver minds its own span id and
+  parents into the caller, exactly the Dapper/W3C parent-id handoff.
+  Missing or garbage context falls back to minting — never a 400.
+* **Span store** — a bounded in-memory map ``trace_id → [span, ...]``
+  per process, exported at ``GET /debug/trace/<trace_id>`` (fault site
+  ``trace.export``; the same containment contract as the metrics
+  scrape).  The router assembles the full tree by pulling the same
+  endpoint from the replicas that served the request.
+* **Tail-based sampling** — the keep decision happens at trace *end*,
+  when the interesting-ness is known: traces that breached their
+  TTFT/inter-token target, were hedged/retried/preempted/transplanted,
+  or hit a 5xx are always retained; the boring rest is head-sampled at
+  ``head_sample``.  Exemplar trace_ids for the worst TTFTs ride
+  ``/debug/trace`` (and load_test's worst-p99 report) so "why was this
+  request slow" is one curl.
+* **Critical path** — :func:`analyze` attributes a finished trace's
+  wall time to named edges (router queue, hedge wait, tenant queue,
+  prefill, KV transfer, decode, retry amplification) and names the
+  dominant one; :func:`render_waterfall` draws the tree.
+
+The hot path stays near-free: engine span events reach
+:func:`on_event` through ``tracing.trace`` and cost one dict lookup
+when the request carries no bound context (ALL requests outside the
+HTTP data plane, e.g. bare ``engine.submit`` calls in tests and
+benches).  This module is stdlib-only (no jax) like the rest of
+``obs/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import re
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Iterable, Mapping, Optional
+
+from kubernetes_cloud_tpu.obs.metrics import counter, gauge
+
+#: inbound/outbound trace-context header.  Title-cased spelling so ONE
+#: lookup works on both front-ends (stdlib mapping is case-insensitive;
+#: the native front-end Title-Cases its raw header block).
+TRACEPARENT_HEADER = "Traceparent"
+
+#: trace retention decisions (bounded metric label vocabulary)
+DECISIONS = ("kept_tail", "kept_head", "dropped")
+
+#: per-pass stream events — one per active slot per scheduler
+#: iteration — stay in the JSONL tracer but are never recorded as
+#: distributed spans: on a busy engine they would dominate both the
+#: bounded store and the scheduler thread's time, and the critical-path
+#: analyzer derives the prefill/decode edges from the
+#: admitted/first_token/terminal span timestamps instead.
+STREAM_EVENTS = frozenset({"prefill", "decode"})
+
+_M_TRACES = counter(
+    "kct_trace_traces_total",
+    "Trace retention decisions at trace end (kept_tail = a tail-"
+    "sampling keep reason fired, kept_head = head-sampled survivor, "
+    "dropped = boring and unlucky).", ("decision",))
+_M_SPANS = counter(
+    "kct_trace_spans_total",
+    "Spans recorded into the in-process span store.")
+_M_STORE = gauge(
+    "kct_trace_store_traces",
+    "Traces currently resident in the bounded span store.")
+
+_HEX_RE = re.compile(r"^[0-9a-f]{8,32}$")
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """This process's own span identity within a trace: ``span_id`` is
+    the span the local server owns; ``parent_id`` points into the
+    remote caller (None at the trace root).  ``caller_decides`` is the
+    parsed flags token: the caller claimed the tail-sampling decision
+    (it has a store and will assemble this trace), so this process
+    must not drop spans the assembler still wants."""
+
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+    caller_decides: bool = False
+
+    def wire(self) -> str:
+        """Outbound header value: names *this* span as the callee's
+        parent.  No flags token — a plain client mint leaves the
+        sampling decision to the receiving server."""
+        return f"{self.trace_id}-{self.span_id}"
+
+    def child_wire(self, child_span_id: str) -> str:
+        """Outbound header value parenting the callee into an
+        intermediate local span (a router dispatch leg).  The ``-01``
+        flags token claims the sampling decision for the caller: the
+        router assembles the tree by pulling the replicas' stores, so
+        a replica must never tail-drop spans on its own."""
+        return f"{self.trace_id}-{child_span_id}-01"
+
+
+def mint() -> TraceContext:
+    """A fresh root context (no remote parent)."""
+    return TraceContext(new_trace_id(), new_span_id(), None)
+
+
+def parse(value: Optional[str]) -> Optional[TraceContext]:
+    """Parse an inbound ``Traceparent`` value into this process's
+    context: the wire names the caller's (trace_id, span_id); the
+    local span is minted and parented into the caller.  Accepts an
+    optional W3C-style 2-hex version prefix and trailing flags; any
+    garbage returns None — the door then falls back to minting, never
+    to a 400."""
+    if not value or not isinstance(value, str) or len(value) > 128:
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) >= 2 and re.fullmatch(r"[0-9a-f]{2}", parts[0]) \
+            and len(parts[0]) == 2 and len(parts) >= 3:
+        parts = parts[1:]  # W3C version prefix
+    if len(parts) < 2:
+        return None
+    trace_id, caller_span = parts[0], parts[1]
+    if not _HEX_RE.match(trace_id) or not _HEX_RE.match(caller_span):
+        return None
+    return TraceContext(trace_id, new_span_id(), caller_span,
+                        caller_decides="01" in parts[2:])
+
+
+class SpanStore:
+    """Bounded per-process span store + request-id → context bindings.
+
+    One instance per process (module-level :data:`_STORE`); every
+    front-end, router, engine, and supervisor in the process records
+    into it, and ``GET /debug/trace/<id>`` dumps it.  Bounded by
+    construction: at most ``max_traces`` traces of ``max_spans`` spans
+    each; the oldest trace is evicted first, retained (tail-kept)
+    traces last."""
+
+    def __init__(self, *, max_traces: int = 512, max_spans: int = 256,
+                 head_sample: float = 0.1,
+                 ttft_target_s: Optional[float] = None,
+                 inter_token_target_s: Optional[float] = None):
+        self.max_traces = int(max_traces)
+        self.max_spans = int(max_spans)
+        self.head_sample = float(head_sample)
+        self.ttft_target_s = ttft_target_s
+        self.inter_token_target_s = inter_token_target_s
+        self.enabled = True
+        self._lock = threading.Lock()
+        #: trace_id -> {"spans": [..], "keep": set[str], "decision": str|None}
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self._bindings: dict[str, TraceContext] = {}
+        #: kind -> [(value, trace_id)] worst-first, truncated
+        self._exemplars: dict[str, list[tuple[float, str]]] = {}
+
+    # -- bindings ----------------------------------------------------------
+
+    def bind(self, request_id: Optional[str], ctx: TraceContext) -> None:
+        if not request_id or not self.enabled:
+            return
+        with self._lock:
+            self._bindings[request_id] = ctx
+
+    def unbind(self, request_id: Optional[str],
+               ctx: Optional[TraceContext] = None
+               ) -> Optional[TraceContext]:
+        """Drop a binding.  With ``ctx``, drop it only if ``ctx`` is
+        the context that currently owns it: in-process replicas share
+        this store with their router, so a replica door REBINDS the
+        request id over the router's binding — the router's exit must
+        not strip the replica's binding while the replica's engine is
+        still emitting spans (the hedge-loser's ``cancelled`` span
+        races exactly this way)."""
+        if not request_id:
+            return None
+        with self._lock:
+            cur = self._bindings.get(request_id)
+            if cur is None or (ctx is not None and cur is not ctx):
+                return None
+            return self._bindings.pop(request_id)
+
+    def context_for(self, request_id: Optional[str]
+                    ) -> Optional[TraceContext]:
+        """Resolve a request id to its bound context.  Engine-level ids
+        carry suffixes the HTTP door never bound (``rid-0`` per prompt
+        instance, ``rid-h`` per hedge leg), so unmatched ids retry with
+        trailing ``-…`` segments stripped."""
+        if not request_id:
+            return None
+        with self._lock:
+            rid = request_id
+            for _ in range(3):
+                ctx = self._bindings.get(rid)
+                if ctx is not None:
+                    return ctx
+                base, sep, _ = rid.rpartition("-")
+                if not sep:
+                    return None
+                rid = base
+            return None
+
+    # -- span recording ----------------------------------------------------
+
+    def add_span(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str, *,
+                 ts: Optional[float] = None,
+                 dur_s: Optional[float] = None,
+                 **fields: Any) -> None:
+        if not self.enabled:
+            return
+        rec = {"trace_id": trace_id, "span_id": span_id,
+               "parent_id": parent_id, "name": name,
+               "ts": time.time() if ts is None else ts}
+        if dur_s is not None:
+            rec["dur_s"] = round(float(dur_s), 6)
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                entry = self._traces[trace_id] = {
+                    "spans": [], "keep": set(), "decision": None}
+                self._evict_locked()
+                # gauge touched only when the trace count changed —
+                # add_span runs on the scheduler thread per event
+                _M_STORE.set(len(self._traces))
+            if len(entry["spans"]) < self.max_spans:
+                entry["spans"].append(rec)
+        _M_SPANS.inc()
+
+    def on_event(self, request_id: str, span: str,
+                 fields: Mapping[str, Any]) -> Optional[dict]:
+        """A ``tracing.trace`` event: when the request carries a bound
+        context, record it as a child span of the local server span
+        and return the triple (the JSONL record rides it too); free
+        (one dict lookup) otherwise.  Per-pass stream events are
+        filtered before even that lookup — they fire once per active
+        slot per scheduler iteration, on the scheduler thread."""
+        if span in STREAM_EVENTS:
+            return None
+        ctx = self.context_for(request_id)
+        if ctx is None:
+            return None
+        span_id = new_span_id()
+        self.add_span(ctx.trace_id, span_id, ctx.span_id, span,
+                      request_id=request_id,
+                      **{k: v for k, v in fields.items()
+                         if isinstance(v, (str, int, float, bool))})
+        self._auto_keep(ctx.trace_id, span, fields)
+        return {"trace_id": ctx.trace_id, "span_id": span_id,
+                "parent_id": ctx.span_id}
+
+    def _auto_keep(self, trace_id: str, span: str,
+                   fields: Mapping[str, Any]) -> None:
+        """Tail-sampling keep reasons derivable from engine events."""
+        if span == "preempted":
+            self.note_keep(trace_id, "preempted")
+        elif span == "failed":
+            self.note_keep(trace_id, "error")
+        elif span == "requeued":
+            self.note_keep(trace_id, "transplanted")
+        elif span == "first_token":
+            ttft = fields.get("ttft_s")
+            if (self.ttft_target_s is not None and ttft is not None
+                    and float(ttft) > self.ttft_target_s):
+                self.note_keep(trace_id, "slo_ttft")
+        elif span == "complete":
+            dur = fields.get("duration_s")
+            tokens = fields.get("tokens")
+            ttft = fields.get("ttft_s")
+            if (self.inter_token_target_s is not None
+                    and dur is not None and tokens and int(tokens) > 1):
+                decode_s = float(dur) - float(ttft or 0.0)
+                if (decode_s / (int(tokens) - 1)
+                        > self.inter_token_target_s):
+                    self.note_keep(trace_id, "slo_inter_token")
+
+    # -- tail sampling -----------------------------------------------------
+
+    def note_keep(self, trace_id: Optional[str], reason: str) -> None:
+        if not trace_id or not self.enabled:
+            return
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is not None:
+                entry["keep"].add(reason)
+
+    def decide(self, trace_id: Optional[str]) -> Optional[str]:
+        """The tail-sampling decision, at trace end: keep when any keep
+        reason fired, head-sample the rest.  Returns the decision (one
+        of :data:`DECISIONS`) or None for an unknown trace."""
+        if not trace_id:
+            return None
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                return None
+            if entry["decision"] is not None:
+                return entry["decision"]  # idempotent (retries re-enter)
+            if entry["keep"]:
+                decision = "kept_tail"
+            elif random.random() < self.head_sample:
+                decision = "kept_head"
+            else:
+                decision = "dropped"
+                del self._traces[trace_id]
+                _M_STORE.set(len(self._traces))
+            if decision != "dropped":
+                entry["decision"] = decision
+        if decision == "kept_tail":
+            _M_TRACES.labels(decision="kept_tail").inc()
+        elif decision == "kept_head":
+            _M_TRACES.labels(decision="kept_head").inc()
+        else:
+            _M_TRACES.labels(decision="dropped").inc()
+        return decision
+
+    def _evict_locked(self) -> None:
+        """FIFO eviction over the bound, undecided/boring traces first
+        so a burst cannot wash retained evidence out of the store."""
+        while len(self._traces) > self.max_traces:
+            victim = next(
+                (tid for tid, e in self._traces.items()
+                 if e["decision"] is None and not e["keep"]),
+                next(iter(self._traces)))
+            del self._traces[victim]
+
+    # -- export ------------------------------------------------------------
+
+    def spans_for(self, trace_id: str) -> Optional[list[dict]]:
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                return None
+            return [dict(r) for r in entry["spans"]]
+
+    def keep_reasons(self, trace_id: str) -> set[str]:
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            return set(entry["keep"]) if entry else set()
+
+    def index(self, last: int = 64) -> list[dict]:
+        with self._lock:
+            out = []
+            for tid, entry in list(self._traces.items())[-last:]:
+                out.append({"trace_id": tid,
+                            "spans": len(entry["spans"]),
+                            "keep": sorted(entry["keep"]),
+                            "decision": entry["decision"]})
+            return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"traces": len(self._traces),
+                    "bindings": len(self._bindings),
+                    "max_traces": self.max_traces,
+                    "head_sample": self.head_sample,
+                    "ttft_target_s": self.ttft_target_s,
+                    "inter_token_target_s": self.inter_token_target_s}
+
+    # -- exemplars ---------------------------------------------------------
+
+    def note_exemplar(self, kind: str, value: float,
+                      trace_id: Optional[str], keep: int = 5) -> None:
+        """Track the worst-``kind`` trace ids (e.g. the slowest TTFTs)
+        — the exemplar ride-along for the fleet TTFT histograms, since
+        the zero-dep text exposition has no native exemplar syntax."""
+        if not trace_id or not self.enabled:
+            return
+        with self._lock:
+            worst = self._exemplars.setdefault(kind, [])
+            worst.append((float(value), trace_id))
+            worst.sort(key=lambda e: -e[0])
+            del worst[keep:]
+
+    def exemplars(self) -> dict[str, list[dict]]:
+        with self._lock:
+            return {kind: [{"value": round(v, 6), "trace_id": tid}
+                           for v, tid in worst]
+                    for kind, worst in self._exemplars.items()}
+
+
+#: the process-global store every layer records into
+_STORE = SpanStore()
+
+
+def store() -> SpanStore:
+    return _STORE
+
+
+def configure(**kw: Any) -> SpanStore:
+    """Tune the process store (targets, sampling, bounds, enabled) —
+    serve.boot and tests; unknown keys are a loud error."""
+    for key, value in kw.items():
+        if not hasattr(_STORE, key):
+            raise ValueError(f"unknown dtrace option: {key}")
+        setattr(_STORE, key, value)
+    return _STORE
+
+
+def reset(**kw: Any) -> SpanStore:
+    """Fresh process store (test isolation)."""
+    global _STORE
+    _STORE = SpanStore(**kw)
+    return _STORE
+
+
+# module-level conveniences over the global store (the call-site API)
+def bind(request_id: Optional[str], ctx: TraceContext) -> None:
+    _STORE.bind(request_id, ctx)
+
+
+def unbind(request_id: Optional[str],
+           ctx: Optional[TraceContext] = None) -> Optional[TraceContext]:
+    return _STORE.unbind(request_id, ctx)
+
+
+def context_for(request_id: Optional[str]) -> Optional[TraceContext]:
+    return _STORE.context_for(request_id)
+
+
+def add_span(trace_id: str, span_id: str, parent_id: Optional[str],
+             name: str, **kw: Any) -> None:
+    _STORE.add_span(trace_id, span_id, parent_id, name, **kw)
+
+
+def on_event(request_id: str, span: str,
+             fields: Mapping[str, Any]) -> Optional[dict]:
+    return _STORE.on_event(request_id, span, fields)
+
+
+def note_keep(trace_id: Optional[str], reason: str) -> None:
+    _STORE.note_keep(trace_id, reason)
+
+
+def decide(trace_id: Optional[str]) -> Optional[str]:
+    return _STORE.decide(trace_id)
+
+
+def note_exemplar(kind: str, value: float,
+                  trace_id: Optional[str]) -> None:
+    _STORE.note_exemplar(kind, value, trace_id)
+
+
+# -- assembly + critical path (pure functions; the router and ----------------
+# -- perf_report --trace both run these over merged span lists) --------------
+
+def merge_spans(spans: Iterable[Mapping[str, Any]]) -> list[dict]:
+    """Merge span lists pulled from several processes: dedup by
+    span_id (in-process replicas share one store, so the router's own
+    lookup and the replica pull overlap), order by start time."""
+    seen: dict[str, dict] = {}
+    for rec in spans:
+        sid = rec.get("span_id")
+        if sid and sid not in seen:
+            seen[sid] = dict(rec)
+    return sorted(seen.values(), key=lambda r: (r.get("ts") or 0.0))
+
+
+def _children(spans: list[dict]) -> dict[Optional[str], list[dict]]:
+    by_parent: dict[Optional[str], list[dict]] = {}
+    ids = {r["span_id"] for r in spans}
+    for rec in spans:
+        parent = rec.get("parent_id")
+        if parent not in ids:
+            parent = None  # orphan/root: parent lives outside the dump
+        by_parent.setdefault(parent, []).append(rec)
+    return by_parent
+
+
+def render_waterfall(spans: Iterable[Mapping[str, Any]]) -> str:
+    """ASCII tree + waterfall over one assembled trace: per span the
+    offset from trace start, duration (when recorded), and tags."""
+    merged = merge_spans(spans)
+    if not merged:
+        return "(no spans)"
+    t0 = min(r["ts"] for r in merged)
+    by_parent = _children(merged)
+    lines: list[str] = []
+
+    def wanted(rec: dict) -> str:
+        skip = ("trace_id", "span_id", "parent_id", "name", "ts",
+                "dur_s", "request_id")
+        return " ".join(f"{k}={rec[k]}" for k in rec if k not in skip)
+
+    def walk(rec: dict, depth: int) -> None:
+        off_ms = (rec["ts"] - t0) * 1e3
+        dur = rec.get("dur_s")
+        dur_txt = f" {dur * 1e3:8.2f}ms" if dur is not None else " " * 11
+        lines.append(f"{off_ms:9.2f}ms{dur_txt}  "
+                     f"{'  ' * depth}{rec['name']}  {wanted(rec)}")
+        for child in by_parent.get(rec["span_id"], ()):
+            walk(child, depth + 1)
+
+    for root in by_parent.get(None, ()):
+        walk(root, 0)
+    return "\n".join(lines)
+
+
+def _winner_path(merged: list[dict]) -> tuple[list[dict], list[dict]]:
+    """(winning-leg engine spans, dispatch spans).  With hedged/retried
+    dispatch legs in the tree, engine timings must come from the leg
+    that actually answered — the loser's cancelled half-life would
+    corrupt the attribution."""
+    dispatch = [r for r in merged if r["name"] == "dispatch"]
+    if not dispatch:
+        return merged, []
+    won = [d for d in dispatch if d.get("outcome") == "win"]
+    chosen = won[-1] if won else dispatch[-1]
+    by_parent = _children(merged)
+    path: list[dict] = []
+
+    def collect(span_id: str) -> None:
+        for child in by_parent.get(span_id, ()):
+            path.append(child)
+            collect(child["span_id"])
+
+    collect(chosen["span_id"])
+    return (path or merged), dispatch
+
+
+def analyze(spans: Iterable[Mapping[str, Any]]) -> dict:
+    """Critical-path attribution over one assembled trace: wall time
+    split into named edges, the dominant edge called out.  Edges:
+    ``router_queue`` (door → first dispatch), ``hedge_wait`` (primary
+    → hedge leg fire), ``tenant_queue`` (queued → admitted on the
+    winning engine), ``prefill`` (admitted → first token, chunked or
+    not), ``kv_transfer`` (disagg extract → install), ``decode``
+    (first token → terminal), ``retry_amplification`` (wall time spent
+    inside failed dispatch legs)."""
+    merged = merge_spans(spans)
+    if not merged:
+        return {"edges": {}, "dominant": None, "total_s": 0.0,
+                "spans": 0}
+    t0 = min(r["ts"] for r in merged)
+    roots = [r for r in merged if r["name"] == "server"
+             and r.get("parent_id") is None]
+    root = roots[0] if roots else merged[0]
+    total = root.get("dur_s") or (max(
+        r["ts"] + (r.get("dur_s") or 0.0) for r in merged) - t0)
+    path, dispatch = _winner_path(merged)
+    by_name: dict[str, list[dict]] = {}
+    for rec in path:
+        by_name.setdefault(rec["name"], []).append(rec)
+
+    def first(name: str) -> Optional[dict]:
+        got = by_name.get(name)
+        return got[0] if got else None
+
+    edges: dict[str, float] = {}
+    if dispatch:
+        edges["router_queue"] = max(
+            min(d["ts"] for d in dispatch) - root["ts"], 0.0)
+        hedges = [d for d in dispatch if d.get("leg") == "hedge"]
+        primaries = [d for d in dispatch if d.get("leg") == "primary"]
+        if hedges and primaries:
+            edges["hedge_wait"] = max(
+                hedges[0]["ts"] - primaries[0]["ts"], 0.0)
+        failed = [d for d in dispatch
+                  if d.get("outcome") in ("error", "timeout")]
+        if failed:
+            edges["retry_amplification"] = sum(
+                d.get("dur_s") or 0.0 for d in failed)
+    queued, admitted = first("queued"), first("admitted")
+    ft = first("first_token")
+    if queued is not None and admitted is not None:
+        edges["tenant_queue"] = max(admitted["ts"] - queued["ts"], 0.0)
+    elif ft is not None and ft.get("ttft_queue_s") is not None:
+        edges["tenant_queue"] = float(ft["ttft_queue_s"])
+    if admitted is not None and ft is not None:
+        edges["prefill"] = max(ft["ts"] - admitted["ts"], 0.0)
+    elif ft is not None and ft.get("ttft_prefill_s") is not None:
+        edges["prefill"] = float(ft["ttft_prefill_s"])
+    kv = [r for r in path
+          if r["name"] in ("kv_extract", "kv_transfer", "kv_install")]
+    if kv:
+        kv_s = sum(r.get("dur_s") or 0.0 for r in kv)
+        edges["kv_transfer"] = kv_s
+        if "prefill" in edges:  # the handoff window sits inside TTFT
+            edges["prefill"] = max(edges["prefill"] - kv_s, 0.0)
+    terminal = next((r for r in reversed(path)
+                     if r["name"] in ("complete", "shed", "failed",
+                                      "cancelled")), None)
+    if ft is not None and terminal is not None:
+        edges["decode"] = max(terminal["ts"] - ft["ts"], 0.0)
+    edges = {k: round(v, 6) for k, v in edges.items()}
+    dominant = max(edges, key=lambda k: edges[k]) if edges else None
+    return {"edges": edges, "dominant": dominant,
+            "total_s": round(float(total), 6), "spans": len(merged)}

@@ -1,0 +1,321 @@
+"""Declarative SLOs with multi-window multi-burn-rate evaluation.
+
+The metrics plane records *everything*; this module says which slices
+of it are **promises** — TTFT p95, inter-token p95, availability, per
+model/tenant/role — and continuously answers the only two questions an
+on-call needs: *are we burning error budget right now* and *how much
+is left*.  The method is the Google SRE multi-window multi-burn-rate
+alert (Beyer et al., "The Site Reliability Workbook" ch. 5): a page
+fires only when BOTH a long window and a short window burn faster than
+the threshold — the long window proves it matters, the short window
+proves it is still happening — which kills both flappy
+one-bad-scrape pages and the slow-leak outage nobody notices.
+
+An :class:`SLOSpec` measures a good/total pair straight off the
+process-global metrics registry text exposition (no second ingestion
+path, no new deps):
+
+* ``kind="latency"`` — ``{family}_bucket`` cumulative histograms:
+  total = the ``+Inf`` bucket, good = the largest bucket at or under
+  ``threshold_s``.  The objective "p95 ≤ 2s" is expressed as "≥95% of
+  observations land in the ≤2s bucket" — the same quantile promise,
+  measurable from cumulative counters without quantile math.
+* ``kind="availability"`` — a status-labeled request counter: total =
+  every sample matching ``match``, bad = the 5xx slice.
+
+The :class:`SLOEvaluator` keeps a ring of (ts, good, total) snapshots
+per spec and derives windowed burn rates (bad-fraction ÷ allowed
+bad-fraction — burn 1.0 spends exactly the budget over the period).
+It runs where the fleet view lives: the router's prober loop pokes a
+lazy worker thread (``poke()`` never blocks the prober), results land
+in ``kct_slo_*`` families and ``GET /debug/slo`` (which serves the
+last snapshot and never evaluates inline).  The evaluation body is a
+chaos surface (fault site ``slo.eval``): a raise is contained to an
+``outcome="error"`` count, a hang parks only the worker thread — the
+data plane, ``/readyz``, and the prober keep moving, the same
+containment contract as ``metrics.render``/``debug.render``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Iterable, Mapping, Optional, Sequence
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.obs.metrics import (
+    REGISTRY, counter, gauge, parse_text)
+
+#: multi-window pairs (SRE Workbook table 5-2, scaled to serving): the
+#: fast pair catches an active fire, the slow pair a smoldering leak.
+#: max_burn is the burn-rate threshold BOTH windows must exceed.
+@dataclasses.dataclass(frozen=True)
+class BurnWindow:
+    name: str        # bounded label value ("fast" | "slow" | custom)
+    long_s: float    # the it-matters window
+    short_s: float   # the still-happening window
+    max_burn: float  # threshold both must exceed
+
+
+DEFAULT_WINDOWS = (
+    BurnWindow("fast", long_s=300.0, short_s=60.0, max_burn=14.4),
+    BurnWindow("slow", long_s=1800.0, short_s=300.0, max_burn=6.0),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One promise over one registry slice."""
+
+    name: str                 # bounded label value ("ttft_p95", ...)
+    objective: float          # good/total floor, e.g. 0.95
+    family: str               # metric family measured
+    kind: str = "latency"     # "latency" | "availability"
+    threshold_s: Optional[float] = None   # latency bucket bound
+    match: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    windows: Sequence[BurnWindow] = DEFAULT_WINDOWS
+    budget_window_s: float = 3600.0
+
+    def __post_init__(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(f"{self.name}: objective must be in (0,1)")
+        if self.kind not in ("latency", "availability"):
+            raise ValueError(f"{self.name}: unknown kind {self.kind!r}")
+        if self.kind == "latency" and self.threshold_s is None:
+            raise ValueError(f"{self.name}: latency SLO needs threshold_s")
+
+
+def default_specs() -> tuple[SLOSpec, ...]:
+    """The promises the deploy manifests alert on (mirrored by
+    ``deploy/online-inference/prometheus-slo-rules.yaml``)."""
+    return (
+        SLOSpec(name="ttft_p95", objective=0.95,
+                family="kct_engine_ttft_seconds", threshold_s=2.0),
+        SLOSpec(name="inter_token_p95", objective=0.95,
+                family="kct_engine_iteration_seconds", threshold_s=0.25,
+                match={"phase": "decode"}),
+        SLOSpec(name="availability", objective=0.999,
+                family="kct_server_requests_total", kind="availability",
+                match={"route": "predict"}),
+    )
+
+
+def measure(spec: SLOSpec,
+            samples: Iterable[tuple[str, dict, float]]
+            ) -> tuple[float, float]:
+    """(good, total) cumulative counts for one spec from one parsed
+    scrape."""
+    samples = list(samples)
+    if spec.kind == "availability":
+        good = total = 0.0
+        for name, labels, value in samples:
+            if name != spec.family:
+                continue
+            if any(labels.get(k) != v for k, v in spec.match.items()):
+                continue
+            total += value
+            if not labels.get("status", "").startswith("5"):
+                good += value
+        return good, total
+    # latency: cumulative histogram buckets.  Good = the largest
+    # rendered bucket bound ≤ threshold (bucket counts are cumulative,
+    # so one bucket read IS "observations ≤ that bound").
+    bucket_name = spec.family + "_bucket"
+    good = total = 0.0
+    best_le: dict[int, float] = {}
+    rows: list[tuple[dict, float, float]] = []
+    for name, labels, value in samples:
+        if name != bucket_name:
+            continue
+        if any(labels.get(k) != v for k, v in spec.match.items()):
+            continue
+        le_raw = labels.get("le", "")
+        le = math.inf if le_raw == "+Inf" else float(le_raw)
+        rows.append((labels, le, value))
+    target = -math.inf
+    for _, le, _ in rows:
+        if le <= (spec.threshold_s or 0.0) and le > target:
+            target = le
+    for _, le, value in rows:
+        if math.isinf(le):
+            total += value
+        elif le == target:
+            good += value
+    return good, total
+
+
+_M_BURN = gauge(
+    "kct_slo_burn_rate",
+    "Error-budget burn rate per SLO over the long window of each "
+    "configured pair (1.0 = spending exactly the budget).",
+    ("slo", "window"))
+_M_BUDGET = gauge(
+    "kct_slo_error_budget_remaining",
+    "Fraction of the SLO's error budget left over the trailing budget "
+    "window (1.0 = untouched, 0.0 = spent, negative = overdrawn).",
+    ("slo",))
+_M_BREACH = gauge(
+    "kct_slo_breaching",
+    "1 while any window pair has BOTH long and short burn rates over "
+    "its threshold (the page condition).", ("slo",))
+_M_EVALS = counter(
+    "kct_slo_evaluations_total",
+    "SLO evaluation passes by outcome.", ("outcome",))
+
+
+class SLOEvaluator:
+    """Windowed burn-rate evaluation over (ts, good, total) history.
+
+    One instance rides the fleet router (``router.slo``); ``poke()``
+    from the prober loop wakes a lazy daemon worker, ``snapshot()``
+    serves the last result to ``/debug/slo``.  ``eval_now()`` is the
+    synchronous path for tests and jax-free tools."""
+
+    def __init__(self, specs: Optional[Sequence[SLOSpec]] = None, *,
+                 registry=None, clock=time.monotonic,
+                 history_s: float = 7200.0):
+        self.specs = tuple(specs if specs is not None else default_specs())
+        names = [s.name for s in self.specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO names: {names}")
+        self._registry = registry if registry is not None else REGISTRY
+        self._clock = clock
+        self._history_s = float(history_s)
+        self._lock = threading.Lock()
+        self._history: dict[str, list[tuple[float, float, float]]] = {
+            s.name: [] for s in self.specs}
+        self._last: dict = {"ts": None, "slos": {}}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def poke(self) -> None:
+        """Request an evaluation; never blocks (the prober-loop call).
+        The worker starts lazily on first poke and evaluates on its own
+        thread, so a hung ``slo.eval`` parks only the worker."""
+        if self._worker is None or not self._worker.is_alive():
+            with self._lock:
+                if self._worker is None or not self._worker.is_alive():
+                    self._worker = threading.Thread(
+                        target=self._run, name="slo-eval", daemon=True)
+                    self._worker.start()
+        self._wake.set()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait()
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.eval_now()
+            except Exception:
+                _M_EVALS.labels(outcome="error").inc()
+
+    # -- evaluation --------------------------------------------------------
+
+    def eval_now(self) -> dict:
+        """One synchronous evaluation pass (contained: a chaos raise at
+        ``slo.eval`` counts an error and keeps the last snapshot)."""
+        try:
+            faults.fire("slo.eval")  # raise/hang land HERE, contained
+            samples = parse_text(self._registry.render())
+            now = self._clock()
+            result = self._evaluate(now, samples)
+        except Exception as exc:
+            _M_EVALS.labels(outcome="error").inc()
+            with self._lock:
+                self._last.setdefault("errors", 0)
+                self._last["errors"] += 1
+                self._last["last_error"] = type(exc).__name__
+                return dict(self._last)
+        _M_EVALS.labels(outcome="ok").inc()
+        with self._lock:
+            self._last = result
+            return dict(result)
+
+    def _evaluate(self, now: float, samples) -> dict:
+        out: dict = {"ts": now, "slos": {}}
+        with self._lock:
+            for spec in self.specs:
+                good, total = measure(spec, samples)
+                hist = self._history[spec.name]
+                hist.append((now, good, total))
+                while hist and hist[0][0] < now - self._history_s:
+                    hist.pop(0)
+                out["slos"][spec.name] = self._judge(spec, hist, now)
+        for name, st in out["slos"].items():
+            for wname, burn in st["burn_rates"].items():
+                _M_BURN.labels(slo=name, window=wname).set(burn)
+            _M_BUDGET.labels(slo=name).set(st["budget_remaining"])
+            _M_BREACH.labels(slo=name).set(1.0 if st["breaching"] else 0.0)
+        return out
+
+    def _window_frac(self, hist: list[tuple[float, float, float]],
+                     now: float, window_s: float
+                     ) -> tuple[float, float, float]:
+        """(bad_fraction, good_delta, total_delta) over the trailing
+        window: baseline = the newest snapshot at or before the window
+        start (else the oldest we have — a young evaluator measures
+        over its whole life rather than claiming zeros)."""
+        end = hist[-1]
+        base = hist[0]
+        cutoff = now - window_s
+        for entry in reversed(hist):
+            if entry[0] <= cutoff:
+                base = entry
+                break
+        d_good = max(end[1] - base[1], 0.0)
+        d_total = max(end[2] - base[2], 0.0)
+        if d_total <= 0.0:
+            return 0.0, d_good, d_total
+        return max(1.0 - d_good / d_total, 0.0), d_good, d_total
+
+    def _judge(self, spec: SLOSpec,
+               hist: list[tuple[float, float, float]],
+               now: float) -> dict:
+        allowed = 1.0 - spec.objective
+        burn_rates: dict[str, float] = {}
+        breaching = False
+        for win in spec.windows:
+            long_frac, _, _ = self._window_frac(hist, now, win.long_s)
+            short_frac, _, _ = self._window_frac(hist, now, win.short_s)
+            long_burn = long_frac / allowed
+            short_burn = short_frac / allowed
+            burn_rates[win.name] = round(long_burn, 4)
+            if long_burn > win.max_burn and short_burn > win.max_burn:
+                breaching = True
+        bad_frac, _, d_total = self._window_frac(
+            hist, now, spec.budget_window_s)
+        if d_total > 0.0:
+            budget_remaining = 1.0 - (bad_frac * d_total) / (
+                allowed * d_total)
+        else:
+            budget_remaining = 1.0
+        return {
+            "objective": spec.objective,
+            "kind": spec.kind,
+            "family": spec.family,
+            "threshold_s": spec.threshold_s,
+            "window_total": d_total,
+            "burn_rates": burn_rates,
+            "budget_remaining": round(budget_remaining, 4),
+            "breaching": breaching,
+        }
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The last evaluation (``/debug/slo`` serves this verbatim;
+        it NEVER evaluates inline — a hung eval must not take the
+        debug surface with it)."""
+        with self._lock:
+            return dict(self._last)

@@ -35,13 +35,22 @@ import time
 import uuid
 from typing import Any, Iterator, Optional
 
+from kubernetes_cloud_tpu.obs import dtrace
+
 #: inbound correlation header (mesh/gateway request id), honored by
 #: both HTTP front-ends
 REQUEST_ID_HEADER = "X-Request-Id"
 
-#: engine span vocabulary, in lifecycle order (terminal spans last)
-SPANS = ("queued", "admitted", "prefill", "decode", "first_token",
-         "preempted", "dispatched", "complete", "shed", "failed",
+#: span vocabulary: the fleet/router layer first (server = one door
+#: crossing, dispatch = one router→replica leg, activator_hold = a
+#: scale-from-zero hold-and-replay window), then the engine lifecycle
+#: in order, the disagg KV handoff legs (extract on the prefill side,
+#: transfer on the wire, install on the decode side), requeue/
+#: transplant, and the terminal spans last
+SPANS = ("server", "dispatch", "activator_hold",
+         "queued", "admitted", "prefill", "decode", "first_token",
+         "preempted", "kv_extract", "kv_transfer", "kv_install",
+         "requeued", "dispatched", "complete", "shed", "failed",
          "cancelled")
 
 TERMINAL_SPANS = ("complete", "shed", "failed", "cancelled")
@@ -116,10 +125,21 @@ def uninstall() -> None:
 
 
 def trace(request_id: Optional[str], span: str, **fields: Any) -> None:
-    """The instrumentation call: free when disarmed or untagged."""
-    tr = _ACTIVE
-    if tr is None or not request_id:
+    """The instrumentation call: near-free when disarmed or untagged.
+
+    Every event is also offered to the distributed-trace span store
+    (:mod:`kubernetes_cloud_tpu.obs.dtrace`) — one dict lookup when
+    the request carries no bound trace context; when it does, the
+    event becomes a child span in the cross-process tree and the JSONL
+    record gains the (trace_id, span_id, parent_id) triple."""
+    if not request_id:
         return
+    ids = dtrace.on_event(request_id, span, fields)
+    tr = _ACTIVE
+    if tr is None:
+        return
+    if ids:
+        fields = {**fields, **ids}
     tr.span(request_id, span, **fields)
 
 

@@ -1431,6 +1431,8 @@ class ContinuousBatchingEngine:
             self._m_kv_transfer_in.inc(n_payload)
             self._m_kv_transfer_s.observe(
                 time.monotonic() - payload.started_at)
+            trace(req.request_id, "kv_install", model=self.name,
+                  dur_s=dt, pages=n_payload)
             rec = self._rec
             if rec is not None:
                 rec.phases["kv_transfer"] = \
@@ -1612,6 +1614,7 @@ class ContinuousBatchingEngine:
         # replacement re-prefills its context instead
         req.pinned_pages = None
         req.prefill_pos = 0
+        trace(req.request_id, "requeued", model=self.name)
         with self._qlock:
             self.tenants.append(req)
         self._work.set()
@@ -3083,6 +3086,8 @@ class ContinuousBatchingEngine:
         self.stats["handoffs"] += 1
         self.stats["kv_transfer_pages"] += n_prompt
         self._m_kv_transfer_out.inc(n_prompt)
+        trace(req.request_id, "kv_extract", model=self.name,
+              dur_s=dt, pages=n_prompt)
         rec = self._rec
         if rec is not None:
             rec.phases["kv_transfer"] = \

@@ -35,6 +35,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Any, Optional, Sequence
 
 from kubernetes_cloud_tpu.serve.continuous import (
@@ -305,7 +306,10 @@ class DisaggregatedEngine:
             if eng is None:
                 break
             try:
+                t0 = time.monotonic()
                 eng.adopt(req, payload)
+                trace(req.request_id, "kv_transfer", model=self.name,
+                      dur_s=time.monotonic() - t0, target=eng.name)
                 return
             except Exception as e:  # noqa: BLE001 - a dead slice is an
                 # outcome to fail over, never an unwound scheduler

@@ -102,6 +102,8 @@ import urllib.request
 from typing import Any, Mapping, Optional, Sequence
 
 from kubernetes_cloud_tpu import faults, obs
+from kubernetes_cloud_tpu.obs import dtrace
+from kubernetes_cloud_tpu.obs.slo import SLOEvaluator
 from kubernetes_cloud_tpu.serve.autoscaler import RollingDigest
 from kubernetes_cloud_tpu.serve.errors import (
     ReplicaUnavailableError,
@@ -758,6 +760,18 @@ class FleetRouter(ModelServer):
             attach = getattr(r, "attach_clock", None)
             if attach is not None:
                 attach(self.clock)
+        # the fleet view is where SLOs live: a default evaluator over
+        # the declared promises, kept warm by the prober loop (poke()
+        # never blocks it) and served at /debug/slo.  Its latency
+        # thresholds double as the tail-sampler's breach targets.
+        self.attach_slo(SLOEvaluator())
+        store = dtrace.store()
+        for spec in self.slo.specs:
+            if spec.name == "ttft_p95" and store.ttft_target_s is None:
+                store.ttft_target_s = spec.threshold_s
+            if (spec.name == "inter_token_p95"
+                    and store.inter_token_target_s is None):
+                store.inter_token_target_s = spec.threshold_s
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
@@ -853,6 +867,8 @@ class FleetRouter(ModelServer):
         """Stop the router AND its in-process replicas' workers (tests
         and the bench; a production router never owns remote pods)."""
         self.stop()
+        if self.slo is not None:
+            self.slo.close()
         for r in self.replicas:
             server = getattr(r, "server", None)
             if server is None:
@@ -907,6 +923,12 @@ class FleetRouter(ModelServer):
                 log.warning("%s: ejected (cause=%s)", r.id, event)
                 _M_EJECTIONS.labels(replica=r.id, cause=event).inc()
         self._refresh_state_gauge()
+        if self.slo is not None:
+            # fleet-wide burn-rate evaluation rides the prober cadence;
+            # poke() only wakes the evaluator's own worker thread, so a
+            # wedged evaluation (fault site slo.eval) can never stall
+            # this loop
+            self.slo.poke()
 
     def _refresh_state_gauge(self) -> None:
         counts = {s: 0 for s in STATES}
@@ -939,14 +961,16 @@ class FleetRouter(ModelServer):
         return None, None, skipped
 
     def _call_replica(self, replica: Replica, path: str, body: bytes,
-                      results: "queue.SimpleQueue", tag: str) -> None:
+                      results: "queue.SimpleQueue", tag: str,
+                      headers: Optional[Mapping[str, str]] = None
+                      ) -> None:
         """One dispatch on its own thread (bounded waits + hedging need
         the caller free); the result is tagged onto the shared queue.
         The thread owns the replica's in-flight count."""
         replica.inflight_inc()
         t0 = time.monotonic()
         try:
-            status, obj = replica.call("POST", path, body)
+            status, obj = replica.call("POST", path, body, headers)
         except RetryableError as e:
             status, obj = 503, {"error": str(e),
                                 "error_kind": type(e).__name__}
@@ -976,6 +1000,20 @@ class FleetRouter(ModelServer):
         success."""
         body = json.dumps(payload).encode()
         rid = payload.get("request_id")
+        #: the door's trace context for this request — every dispatch
+        #: leg becomes a "dispatch" child span of the router's server
+        #: span, each leg carrying its own span id on the wire so the
+        #: replica's tree parents into the right leg
+        ctx = dtrace.context_for(rid)
+        # the hedge leg re-ids the request with an "-h" suffix: the
+        # engines' prefix matching (cancel/request_phase) still reaches
+        # it, responses never echo request_id so clients can't tell,
+        # and the leg's engine spans bind to the hedge door context
+        # instead of colliding with the primary's
+        hedge_body = body
+        if rid:
+            hedge_body = json.dumps(
+                {**payload, "request_id": f"{rid}-h"}).encode()
         self.retry_budget.deposit()
         self._bump("arrivals")
         hold_deadline: Optional[float] = None
@@ -988,6 +1026,12 @@ class FleetRouter(ModelServer):
             # load tests can report retry amplification honestly (a
             # request that burned 4 dispatches before its 503 must not
             # read as one)
+            if ctx is not None:
+                # tail-sampling keep reasons the router alone knows
+                if hedged:
+                    dtrace.note_keep(ctx.trace_id, "hedged")
+                if retries:
+                    dtrace.note_keep(ctx.trace_id, "retried")
             obj = dict(obj)
             obj["fleet"] = {
                 "replica": replica_id, "retries": retries,
@@ -1027,10 +1071,30 @@ class FleetRouter(ModelServer):
                         hold_deadline = (time.monotonic()
                                          + act.max_hold_s)
                         self._bump("activator_held")
+                    hold_wall, hold_t0 = time.time(), time.monotonic()
                     if (time.monotonic() < hold_deadline
                             and act.hold(deadline=hold_deadline)):
                         self._bump("activator_replayed")
+                        if ctx is not None:
+                            # the scale-from-zero hold window is a span
+                            # of its own — cold-start wait must never
+                            # masquerade as router queue time
+                            dtrace.add_span(
+                                ctx.trace_id, dtrace.new_span_id(),
+                                ctx.span_id, "activator_hold",
+                                ts=hold_wall,
+                                dur_s=time.monotonic() - hold_t0,
+                                replayed=True)
+                            dtrace.note_keep(ctx.trace_id,
+                                             "activator_held")
                         continue
+                    if ctx is not None:
+                        dtrace.add_span(
+                            ctx.trace_id, dtrace.new_span_id(),
+                            ctx.span_id, "activator_hold",
+                            ts=hold_wall,
+                            dur_s=time.monotonic() - hold_t0,
+                            replayed=False)
                 self._bump("unplaceable")
                 _M_UNPLACEABLE.inc()
                 if last_failure is not None:
@@ -1050,8 +1114,8 @@ class FleetRouter(ModelServer):
             try:
                 faults.fire("fleet.dispatch")
                 status, obj, was_hedged, won_by_hedge, winner = \
-                    self._dispatch_one(replica, path, body, rid, trial,
-                                       tried)
+                    self._dispatch_one(replica, path, body, hedge_body,
+                                       rid, trial, tried, ctx, retries)
             except faults.FaultError as e:
                 # injected dispatch failure: contained to this request
                 # and charged to nobody (the replica never saw it)
@@ -1094,19 +1158,47 @@ class FleetRouter(ModelServer):
             self._bump("retries")
 
     def _dispatch_one(self, replica: Replica, path: str, body: bytes,
-                      rid: Optional[str], trial: bool,
-                      tried: list
+                      hedge_body: bytes, rid: Optional[str],
+                      trial: bool, tried: list,
+                      ctx: Optional[dtrace.TraceContext] = None,
+                      attempt: int = 0
                       ) -> tuple[int, dict, bool, bool, str]:
         """One (possibly hedged) dispatch: primary on a worker thread,
         a mirror on the least-loaded OTHER replica if the request is
         still queued-not-admitted at ``hedge_after_s``; first success
         wins, the loser is cancelled through the ``cancel()`` path.
+        With a trace context each leg is a sibling ``dispatch`` span
+        (winner/loser/error/timeout tagged) whose span id rides the
+        leg's Traceparent header, so the replica's tree parents into
+        the exact leg that carried it.
         Returns (status, body, hedged, won_by_hedge, winner_id)."""
         results: "queue.SimpleQueue" = queue.SimpleQueue()
-        threading.Thread(
-            target=self._call_replica,
-            args=(replica, path, body, results, "primary"),
-            daemon=True, name=f"dispatch-{replica.id}").start()
+        #: tag -> (leg span id, wall start, monotonic start)
+        leg_meta: dict[str, tuple[str, float, float]] = {}
+
+        def start_leg(tag: str, rep: Replica, leg_body: bytes) -> None:
+            headers = None
+            if ctx is not None:
+                sid = dtrace.new_span_id()
+                leg_meta[tag] = (sid, time.time(), time.monotonic())
+                headers = {dtrace.TRACEPARENT_HEADER:
+                           ctx.child_wire(sid)}
+            threading.Thread(
+                target=self._call_replica,
+                args=(rep, path, leg_body, results, tag, headers),
+                daemon=True, name=f"dispatch-{rep.id}").start()
+
+        def close_leg(tag: str, rep: Replica, outcome: str) -> None:
+            meta = leg_meta.pop(tag, None)
+            if ctx is None or meta is None:
+                return
+            sid, wall0, t0 = meta
+            dtrace.add_span(ctx.trace_id, sid, ctx.span_id, "dispatch",
+                            ts=wall0, dur_s=time.monotonic() - t0,
+                            replica=rep.id, leg=tag, outcome=outcome,
+                            retry=attempt)
+
+        start_leg("primary", replica, body)
         pending = {"primary": replica}
         hedge_replica: Optional[Replica] = None
         hedge_trial = False
@@ -1128,7 +1220,8 @@ class FleetRouter(ModelServer):
                 if hedge_at is not None and time.monotonic() >= hedge_at:
                     hedge_at = None  # fire at most one hedge
                     hedge_replica, hedge_trial = self._maybe_hedge(
-                        replica, path, body, rid, tried, results)
+                        replica, path, hedge_body, rid, tried, results,
+                        start_leg)
                     if hedge_replica is not None:
                         pending["hedge"] = hedge_replica
                 continue
@@ -1142,6 +1235,8 @@ class FleetRouter(ModelServer):
                 trial=is_trial)
             self._note_dispatch_metrics(rep, status, event)
             if ok:
+                close_leg(tag, rep,
+                          "win" if hedge_replica is not None else "ok")
                 self._observe_ttft(rep, obj)
                 # winner: cancel the losing leg through cancel(); a
                 # loser holding a half-open trial claim gets it back —
@@ -1149,6 +1244,7 @@ class FleetRouter(ModelServer):
                 # claim would park the replica in half_open forever
                 for other_tag, other in pending.items():
                     other.cancel(rid)
+                    close_leg(other_tag, other, "cancelled")
                     if (trial if other_tag == "primary"
                             else hedge_trial):
                         other.health.release_trial()
@@ -1159,6 +1255,7 @@ class FleetRouter(ModelServer):
                     _M_HEDGES.labels(outcome="win").inc()
                 return (status, obj, hedge_replica is not None,
                         tag == "hedge", rep.id)
+            close_leg(tag, rep, "error")
             if first_failure is None or status != 0:
                 first_failure = (status, obj, rep.id)
             if rep is not replica:
@@ -1179,6 +1276,7 @@ class FleetRouter(ModelServer):
                                                trial=is_trial)
                 self._note_dispatch_metrics(rep, -1, event)
                 rep.cancel(rid)
+                close_leg(tag, rep, "timeout")
                 if rep is not replica:
                     # a hedge replica pending at the deadline is as
                     # tried as the primary — the retry must not burn
@@ -1224,19 +1322,27 @@ class FleetRouter(ModelServer):
             digest = self._ttft_digests.setdefault(
                 role,
                 RollingDigest(window_s=self.cfg.hedge_ttft_window_s))
+        trace_id = obj.get("trace_id")
         for p in preds:
             ttft = p.get("ttft_s") if isinstance(p, dict) else None
             if ttft is not None:
                 digest.observe(float(ttft))
+                # exemplar ride-along for the fleet TTFT view: the
+                # worst observed TTFTs keep their trace ids, served at
+                # /debug/trace — "why was this request slow" is a curl
+                dtrace.note_exemplar("ttft", float(ttft), trace_id)
 
-    def _maybe_hedge(self, primary: Replica, path: str, body: bytes,
-                     rid: Optional[str], tried: Sequence[Replica],
-                     results: "queue.SimpleQueue"
+    def _maybe_hedge(self, primary: Replica, path: str,
+                     hedge_body: bytes, rid: Optional[str],
+                     tried: Sequence[Replica],
+                     results: "queue.SimpleQueue", start_leg
                      ) -> tuple[Optional[Replica], bool]:
         """Fire the hedge if the request is still queued-not-admitted
         on the primary (phase None = not even submitted yet counts;
         remote replicas report None and hedge on time alone) and a
-        healthy second replica exists."""
+        healthy second replica exists.  The hedge leg carries the
+        ``-h``-suffixed request id and its own leg span (sibling of
+        the primary's) via ``start_leg``."""
         if primary.request_phase(rid) == "active":
             return None, False  # decoding: its tokens are being paid for
         exclude = list(tried) + [primary]
@@ -1245,10 +1351,7 @@ class FleetRouter(ModelServer):
             return None, False
         self._bump("hedges")
         self._bump("dispatches")
-        threading.Thread(
-            target=self._call_replica,
-            args=(hedge, path, body, results, "hedge"),
-            daemon=True, name=f"hedge-{hedge.id}").start()
+        start_leg("hedge", hedge, hedge_body)
         return hedge, bool(hedge_trial)
 
     def _note_dispatch_metrics(self, replica: Replica, status: int,
@@ -1418,6 +1521,12 @@ class FleetRouter(ModelServer):
                         break
                 if placed:
                     moved += 1
+                    # a transplanted request's trace is tail-retained
+                    # (the engine's requeue() span marks it too; this
+                    # covers requests bound at the router door)
+                    tctx = dtrace.context_for(req.request_id)
+                    if tctx is not None:
+                        dtrace.note_keep(tctx.trace_id, "transplanted")
                 else:
                     # no in-process peer serves this model: fail it
                     # retryable so the waiter's own retry (or the
@@ -1458,6 +1567,33 @@ class FleetRouter(ModelServer):
                 return True
             time.sleep(min(0.05, self.cfg.probe_interval_s))
         return False
+
+    # -- distributed-trace assembly ----------------------------------------
+
+    def _trace_sampling_authority(self, ctx) -> bool:
+        """The router is ALWAYS the retention authority: a client-
+        minted traceparent gives the router's context a parent, but
+        the client has no span store to decide in — the buck stops
+        here (replicas see a router-parented context and defer)."""
+        return True
+
+    def _trace_spans(self, trace_id: str) -> Optional[list]:
+        """The assembler: the router's own spans plus a pull of
+        ``GET /debug/trace/<id>`` from every replica (the ones that
+        served the trace answer with their side of the tree; the rest
+        404).  In-process replicas share this store — merge_spans
+        dedups by span id.  A failing replica pull degrades to a
+        partial tree, never an error."""
+        spans = list(dtrace.store().spans_for(trace_id) or [])
+        for r in self.replicas:
+            try:
+                status, obj = r.call("GET", f"/debug/trace/{trace_id}",
+                                     b"")
+                if status == 200 and isinstance(obj, dict):
+                    spans.extend(obj.get("spans") or [])
+            except Exception:  # noqa: BLE001 - partial tree over error
+                log.debug("%s: trace pull failed", r.id)
+        return spans or None
 
     # -- introspection -----------------------------------------------------
 

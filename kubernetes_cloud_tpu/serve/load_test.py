@@ -47,6 +47,14 @@ scrapes the server's ``GET /metrics`` before and after the run and
 asserts the ``kct_server_request_seconds`` histogram's count delta for
 the driven route equals the number of requests this client sent — the
 client-vs-server bookkeeping cross-check (exit code 2 on disagreement).
+
+``--check-trace`` mints a distinct ``Traceparent`` per request (the
+client roots every distributed trace — :mod:`kubernetes_cloud_tpu.obs.
+dtrace`) and asserts every 2xx response echoes exactly the trace_id it
+was sent (exit code 2 otherwise) — the propagation cross-check.  Any
+run whose responses carry trace ids also reports the trace_ids of the
+5 worst-TTFT requests (``worst_ttft``), so the p99 straggler's full
+waterfall is one ``GET /debug/trace/<id>`` away.
 """
 
 from __future__ import annotations
@@ -94,6 +102,12 @@ class Result:
     retried_ok: bool = False
     hedge_win: bool = False
     rerouted: bool = False
+    #: distributed-trace correlation: the trace_id the response body
+    #: carries (servers echo it on every 2xx), and — when this client
+    #: minted the request's Traceparent — the trace_id it sent, so
+    #: --check-trace can assert the propagation round-trips
+    trace_id: str = ""
+    sent_trace_id: str = ""
 
     @property
     def ok(self) -> bool:
@@ -200,7 +214,23 @@ class Summary:
             # shedding visibility: how every request ended
             "outcomes": outcomes,
             **self._fleet_stats(),
+            **self._worst_ttft(),
         }
+
+    def _worst_ttft(self, keep: int = 5) -> dict:
+        """Exemplar trace_ids of the worst-TTFT requests: the p99
+        straggler's distributed-trace waterfall is then one ``GET
+        /debug/trace/<id>`` (or ``perf_report --trace <id>``) away
+        instead of a needle in the aggregate histogram."""
+        tagged = sorted(
+            ((r.ttft, r.trace_id) for r in self.results
+             if r.ok and r.ttft is not None and r.trace_id),
+            reverse=True)[:keep]
+        if not tagged:
+            return {}
+        return {"worst_ttft": [
+            {"ttft_s": round(t, 4), "trace_id": tid}
+            for t, tid in tagged]}
 
     def _fleet_stats(self) -> dict:
         """Fleet-router accounting when the target annotates responses
@@ -255,6 +285,7 @@ def _parse_response(body: bytes) -> dict:
                                  for p in preds),
             "cached_tokens": sum(int(p.get("cached_tokens", 0))
                                  for p in preds),
+            "trace_id": str(obj.get("trace_id") or ""),
             **_parse_fleet(obj),
         }
     except (ValueError, TypeError, AttributeError):
@@ -262,14 +293,25 @@ def _parse_response(body: bytes) -> dict:
 
 
 def _one_request(url: str, payload: bytes, timeout: float,
-                 headers: Optional[Mapping[str, str]] = None) -> Result:
+                 headers: Optional[Mapping[str, str]] = None,
+                 mint_trace: bool = False) -> Result:
     t0 = time.monotonic()
     hdrs = {"Content-Type": "application/json", **(headers or {})}
+    sent_trace = ""
+    if mint_trace:
+        # the client roots the distributed trace: a DISTINCT id per
+        # request, carried on the wire header both front-ends honor
+        from kubernetes_cloud_tpu.obs import dtrace
+
+        ctx = dtrace.mint()
+        hdrs[dtrace.TRACEPARENT_HEADER] = ctx.wire()
+        sent_trace = ctx.trace_id
     try:
         req = urllib.request.Request(url, data=payload, headers=hdrs)
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             body = resp.read()
             return Result(time.monotonic() - t0, resp.status,
+                          sent_trace_id=sent_trace,
                           **_parse_response(body))
     except urllib.error.HTTPError as e:
         # keep the real status — the outcome breakdown needs to tell a
@@ -283,9 +325,11 @@ def _one_request(url: str, payload: bytes, timeout: float,
         except (ValueError, TypeError, AttributeError):
             pass
         return Result(time.monotonic() - t0, e.code,
-                      e.reason or f"HTTP {e.code}", **fleet)
+                      e.reason or f"HTTP {e.code}",
+                      sent_trace_id=sent_trace, **fleet)
     except Exception as e:  # noqa: BLE001 - goodput counts all failures
-        return Result(time.monotonic() - t0, 0, str(e))
+        return Result(time.monotonic() - t0, 0, str(e),
+                      sent_trace_id=sent_trace)
 
 
 def _norm_urls(url) -> list[str]:
@@ -299,24 +343,28 @@ def _norm_urls(url) -> list[str]:
 
 
 def run_sync(url, payloads: list[bytes], *, timeout: float = 300.0,
-             headers: Optional[Mapping[str, str]] = None) -> Summary:
+             headers: Optional[Mapping[str, str]] = None,
+             mint_trace: bool = False) -> Summary:
     urls = _norm_urls(url)
     t0 = time.monotonic()
-    results = [_one_request(urls[i % len(urls)], p, timeout, headers)
+    results = [_one_request(urls[i % len(urls)], p, timeout, headers,
+                            mint_trace)
                for i, p in enumerate(payloads)]
     return Summary(time.monotonic() - t0, results)
 
 
 def run_concurrent(url, payloads: list[bytes], *, concurrency: int = 8,
                    timeout: float = 300.0,
-                   headers: Optional[Mapping[str, str]] = None) -> Summary:
+                   headers: Optional[Mapping[str, str]] = None,
+                   mint_trace: bool = False) -> Summary:
     """The async mode: ``concurrency`` in-flight requests until the payload
     list drains (thread pool; stats match the aiohttp original)."""
     urls = _norm_urls(url)
     t0 = time.monotonic()
     with ThreadPoolExecutor(max_workers=concurrency) as pool:
         results = list(pool.map(
-            lambda up: _one_request(up[0], up[1], timeout, headers),
+            lambda up: _one_request(up[0], up[1], timeout, headers,
+                                    mint_trace),
             [(urls[i % len(urls)], p) for i, p in enumerate(payloads)]))
     return Summary(time.monotonic() - t0, results)
 
@@ -324,7 +372,8 @@ def run_concurrent(url, payloads: list[bytes], *, concurrency: int = 8,
 def run_ramp(url, payload_pool: list[bytes], *,
              stages: list[int], stage_duration: float,
              timeout: float = 300.0,
-             headers: Optional[Mapping[str, str]] = None) -> dict:
+             headers: Optional[Mapping[str, str]] = None,
+             mint_trace: bool = False) -> dict:
     """Locust-style ramping profile (reference
     ``tensorizer-isvc/benchmark/locustfile.py``): each stage holds a
     concurrency level for ``stage_duration`` seconds — workers loop
@@ -342,7 +391,7 @@ def run_ramp(url, payload_pool: list[bytes], *,
             got = []
             while time.monotonic() < deadline:
                 got.append(_one_request(next(targets), next(cycle),
-                                        timeout, headers))
+                                        timeout, headers, mint_trace))
             return got
 
         t0 = time.monotonic()
@@ -432,6 +481,23 @@ def check_metrics(before: list, after: list, target_url,
             "client_responded": lo,
             "server_requests": server_n,
             "ok": lo <= server_n <= client_count}
+
+
+def check_trace(results: list[Result]) -> dict:
+    """Propagation cross-check for ``--check-trace`` runs: every 2xx
+    response must echo exactly the trace_id this client minted into its
+    request's ``Traceparent`` — a missing id means the door dropped the
+    header; a different id means some hop re-rooted the trace instead
+    of joining it."""
+    ok_results = [r for r in results if r.ok]
+    missing = sum(1 for r in ok_results if not r.trace_id)
+    mismatched = sum(1 for r in ok_results
+                     if r.trace_id and r.sent_trace_id
+                     and r.trace_id != r.sent_trace_id)
+    return {"requests_2xx": len(ok_results),
+            "missing_trace_id": missing,
+            "mismatched_trace_id": mismatched,
+            "ok": missing == 0 and mismatched == 0}
 
 
 def _with_shared_prefix(payload: bytes, prefix: str) -> bytes:
@@ -544,6 +610,11 @@ def main(argv=None) -> dict:
                          "the server's request histogram count delta "
                          "matches this client's request count (exit 2 "
                          "on disagreement)")
+    ap.add_argument("--check-trace", action="store_true",
+                    help="mint a distinct Traceparent per request and "
+                         "assert every 2xx response echoes exactly the "
+                         "trace_id it was sent (exit 2 otherwise) — "
+                         "the distributed-trace propagation check")
     ap.add_argument("--timeline", action="store_true",
                     help="snapshot GET /debug/timeline after the run "
                          "and embed each model's phase-share + MFU "
@@ -583,6 +654,9 @@ def main(argv=None) -> dict:
 
     if not urls:
         ap.error("--url is required")
+    if args.check_trace and args.mode == "ramp":
+        ap.error("--check-trace needs per-result bookkeeping; "
+                 "use --mode async or sync")
     payloads = build_payloads(args)
     before = ([scrape_metrics(metrics_endpoint(u)) for u in urls]
               if args.check_metrics else None)
@@ -598,18 +672,23 @@ def main(argv=None) -> dict:
         responded = client_n - sum(
             s["outcomes"].get("client_timeout", 0)
             + s["outcomes"].get("error", 0) for s in stats["stages"])
+        summary = None
     elif args.mode == "sync":
         summary = run_sync(urls, payloads, timeout=args.timeout,
-                           headers=headers)
+                           headers=headers,
+                           mint_trace=args.check_trace)
         stats, client_n = summary.stats(), summary.n
         responded = sum(1 for r in summary.results if r.status != 0)
     else:
         summary = run_concurrent(urls, payloads,
                                  concurrency=args.concurrency,
                                  timeout=args.timeout,
-                                 headers=headers)
+                                 headers=headers,
+                                 mint_trace=args.check_trace)
         stats, client_n = summary.stats(), summary.n
         responded = sum(1 for r in summary.results if r.status != 0)
+    if args.check_trace and summary is not None:
+        stats["trace_check"] = check_trace(summary.results)
     if args.check_metrics:
         after = [scrape_metrics(metrics_endpoint(u)) for u in urls]
         stats["metrics_check"] = check_metrics(
@@ -629,6 +708,8 @@ def main(argv=None) -> dict:
     print(json.dumps(stats))
     if args.check_metrics and not stats["metrics_check"]["ok"]:
         raise SystemExit(2)  # server lost (or double-counted) requests
+    if args.check_trace and not stats["trace_check"]["ok"]:
+        raise SystemExit(2)  # a 2xx lost or re-rooted its trace_id
     return stats
 
 

@@ -28,6 +28,12 @@ the exact REST surface the reference's InferenceServices expose
   prefix-cache contents (block hashes, never prompt content)
 * ``GET  /debug/profile?seconds=N``  arm one ``jax.profiler`` trace
   window (409 while one is already running)
+* ``GET  /debug/trace[/<id>]``       distributed-trace span store:
+  retained-trace index + worst-TTFT exemplars, or one assembled trace
+  (spans, waterfall, critical-path attribution); the fleet router's
+  copy also pulls the replicas that served the trace
+* ``GET  /debug/slo``                last SLO burn-rate evaluation
+  (error budgets per promise; the fleet router's prober keeps it warm)
 
 Error mapping (:mod:`kubernetes_cloud_tpu.serve.errors`): ValueError →
 400, RetryableError (queue full / engine restarted / stream stalled /
@@ -59,7 +65,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterable, Mapping, Optional
 
 from kubernetes_cloud_tpu import faults, obs
-from kubernetes_cloud_tpu.obs import tracing
+from kubernetes_cloud_tpu.obs import dtrace, tracing
 from kubernetes_cloud_tpu.serve.errors import (
     DeadlineExceededError,
     NoModelsLoadedError,
@@ -149,6 +155,14 @@ class ModelServer:
         #: per-window deep profiling armed via GET /debug/profile
         #: (serve.boot points trace_dir at --profile-dir)
         self.profiler = obs.ProfileWindow()
+        #: SLO evaluator behind GET /debug/slo (attach_slo; the fleet
+        #: router attaches one by default and pokes it from the prober)
+        self.slo = None
+
+    def attach_slo(self, evaluator) -> None:
+        """Attach an :class:`~kubernetes_cloud_tpu.obs.slo.SLOEvaluator`
+        for ``GET /debug/slo`` to serve snapshots of."""
+        self.slo = evaluator
 
     def load_all(self) -> None:
         """Load every registered model, continuing past failures: a
@@ -253,26 +267,94 @@ class ModelServer:
                     # id ties HTTP, engine spans, and the client together
                     payload.setdefault("request_id",
                                        tracing.new_request_id())
-                if path.endswith(":predict") and path.startswith(
-                        "/v1/models/"):
-                    name = path[len("/v1/models/"):-len(":predict")]
-                    return self._predict(name, payload)
-                if path.endswith(":cancel") and path.startswith(
-                        "/v1/models/"):
-                    name = path[len("/v1/models/"):-len(":cancel")]
-                    return self._cancel(name, payload)
-                if path.endswith(":swap") and path.startswith(
-                        "/v1/models/"):
-                    name = path[len("/v1/models/"):-len(":swap")]
-                    return self._swap(name, payload)
-                if path == "/completion":
-                    return self._completion(payload)
-                return 404, {"error": "not found"}
+                    # distributed-trace context at the same door: honor
+                    # an inbound Traceparent (header, or payload field
+                    # for headerless hops), mint when absent or garbage
+                    # — never a 400 — and bind it so every engine span
+                    # this request emits parents into this crossing
+                    ctx = self._trace_door_enter(path, payload, headers)
+                    if ctx is not None:
+                        t0c, wall0 = time.monotonic(), time.time()
+                        status, obj = self._route_post(path, payload)
+                        return self._trace_door_exit(
+                            path, payload, ctx, status, obj, wall0,
+                            time.monotonic() - t0c)
+                return self._route_post(path, payload)
             finally:
                 with self._inflight_lock:
                     self._inflight -= 1
 
         return 405, {"error": "method not allowed"}
+
+    def _route_post(self, path: str,
+                    payload: dict) -> tuple[int, dict]:
+        if path.endswith(":predict") and path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):-len(":predict")]
+            return self._predict(name, payload)
+        if path.endswith(":cancel") and path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):-len(":cancel")]
+            return self._cancel(name, payload)
+        if path.endswith(":swap") and path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):-len(":swap")]
+            return self._swap(name, payload)
+        if path == "/completion":
+            return self._completion(payload)
+        return 404, {"error": "not found"}
+
+    # -- distributed tracing at the door -----------------------------------
+
+    def _trace_door_enter(self, path: str, payload: dict,
+                          headers: Optional[Mapping[str, str]]
+                          ) -> Optional[dtrace.TraceContext]:
+        """Data-plane requests (predict/completion) get a trace
+        context: parsed from the inbound ``Traceparent`` header (or a
+        ``traceparent`` payload field), minted otherwise.  The payload
+        field is rewritten to OUR span so a further door crossing
+        parents into this one; the header a router sends per dispatch
+        leg still wins at that door."""
+        if not (path.endswith(":predict") or path == "/completion"):
+            return None
+        raw = headers.get(dtrace.TRACEPARENT_HEADER) if headers else None
+        if not raw:
+            raw = payload.get("traceparent")
+        ctx = dtrace.parse(raw) or dtrace.mint()
+        payload["traceparent"] = ctx.wire()
+        dtrace.bind(payload.get("request_id"), ctx)
+        return ctx
+
+    def _trace_door_exit(self, path: str, payload: dict,
+                         ctx: dtrace.TraceContext, status: int, obj,
+                         wall0: float, dur_s: float) -> tuple[int, dict]:
+        """Close the door crossing: record the ``server`` span, echo
+        the trace id on served answers, mark 5xx traces keep-worthy,
+        and — when this process is the sampling authority — make the
+        tail-based retention decision."""
+        rid = payload.get("request_id")
+        # conditional: an in-process replica door rebinds the SAME id
+        # in the shared store — only the door that bound it unbinds it
+        dtrace.unbind(rid, ctx)
+        trace_status = int(status)
+        dtrace.add_span(ctx.trace_id, ctx.span_id, ctx.parent_id,
+                        "server", ts=wall0, dur_s=dur_s,
+                        status=trace_status, route=route_label(path),
+                        request_id=rid)
+        if isinstance(obj, dict) and 200 <= status < 300:
+            obj.setdefault("trace_id", ctx.trace_id)
+        if status >= 500:
+            dtrace.note_keep(ctx.trace_id, "5xx")
+        if self._trace_sampling_authority(ctx):
+            dtrace.decide(ctx.trace_id)
+        return status, obj
+
+    def _trace_sampling_authority(self, ctx: dtrace.TraceContext) -> bool:
+        """A standalone server decides retention for traces it roots
+        AND for client-minted contexts (the client has no span store;
+        somebody must decide or the store fills with undecided traces).
+        Only a caller that claimed the decision on the wire — the
+        fleet router's dispatch legs, which assemble the tree by
+        pulling this store — suppresses the local decision (the router
+        itself overrides this to always decide)."""
+        return not ctx.caller_decides
 
     def _metrics(self) -> tuple[int, dict | TextResponse]:
         """Render the registry.  Failure is CONTAINED: a raising (or,
@@ -307,9 +389,15 @@ class ModelServer:
                 return self._debug_pages(params)
             if path == "/debug/profile":
                 return self._debug_profile(params)
+            if path == "/debug/trace" or path.startswith("/debug/trace/"):
+                trace_id = path[len("/debug/trace"):].strip("/") or None
+                return self._debug_trace(trace_id, params)
+            if path == "/debug/slo":
+                return self._debug_slo(params)
             return 404, {"error": "unknown debug endpoint", "endpoints": [
                 "/debug/timeline?last=N", "/debug/slots", "/debug/pages",
-                "/debug/profile?seconds=N"]}
+                "/debug/profile?seconds=N", "/debug/trace[/<trace_id>]",
+                "/debug/slo"]}
         except ValueError as e:  # bad query parameters
             return 400, {"error": str(e)}
         except Exception as e:  # noqa: BLE001 - debug must stay isolated
@@ -372,6 +460,47 @@ class ModelServer:
                 continue
             models[name] = pages()  # None for the dense slot pool
         return 200, {"models": models}
+
+    def _debug_trace(self, trace_id: Optional[str],
+                     params) -> tuple[int, dict]:
+        """``GET /debug/trace`` (retained-trace index + worst-TTFT
+        exemplars) and ``GET /debug/trace/<id>`` (one assembled trace:
+        spans, rendered waterfall, critical-path attribution).  Fault
+        site ``trace.export`` — failure stays contained to this debug
+        request, same contract as the metrics scrape."""
+        faults.fire("trace.export")
+        store = dtrace.store()
+        if not trace_id:
+            return 200, {"traces": store.index(),
+                         "exemplars": store.exemplars(),
+                         "store": store.snapshot()}
+        spans = self._trace_spans(trace_id)
+        if not spans:
+            return 404, {"error": f"trace {trace_id} not found "
+                                  "(dropped by sampling, evicted, or "
+                                  "never seen)"}
+        merged = dtrace.merge_spans(spans)
+        return 200, {"trace_id": trace_id, "spans": merged,
+                     "keep": sorted(store.keep_reasons(trace_id)),
+                     "tree": dtrace.render_waterfall(merged),
+                     "analysis": dtrace.analyze(merged)}
+
+    def _trace_spans(self, trace_id: str) -> Optional[list]:
+        """Local spans only; the fleet router overrides this with the
+        assembler that also pulls the replicas that served the trace."""
+        return dtrace.store().spans_for(trace_id)
+
+    def _debug_slo(self, params) -> tuple[int, dict]:
+        """``GET /debug/slo`` — the LAST burn-rate evaluation, verbatim
+        (never evaluates inline: a hung evaluation parks the worker
+        thread, not this debug request)."""
+        if self.slo is None:
+            return 404, {"error": "no SLO evaluator attached (the "
+                                  "fleet router attaches one)"}
+        snap = self.slo.snapshot()
+        return 200, {"specs": [s.name for s in self.slo.specs],
+                     "evaluated": snap.get("ts") is not None,
+                     **snap}
 
     def _debug_profile(self, params) -> tuple[int, dict]:
         from kubernetes_cloud_tpu.obs.flight import ProfileActiveError

@@ -2009,6 +2009,114 @@ def run_autoscale(args) -> int:
     return 0
 
 
+def run_trace_overhead(args, svc) -> int:
+    """--trace-overhead: the distributed-tracing tax, measured as an
+    interleaved A/B over one continuous-batching server (BENCHMARKS.md
+    "Tracing overhead").  The traced arm runs the full production
+    path — client-minted ``Traceparent`` per request, door parsing +
+    binding, a span per engine lifecycle event into the bounded store,
+    tail-sampling decisions — and the untraced arm disables the store
+    (``dtrace.configure(enabled=False)``), which is the only knob
+    production has.  The design is PAIRED: arms alternate within each
+    repeat AND the within-pair order flips every repeat (so "first
+    window after a pause" bias cancels), and the headline number is
+    the MEDIAN of per-pair overheads — on a single-core box ambient
+    scheduling jitter swings individual windows by tens of percent,
+    which a mean-of-means inherits and a paired median does not.  The
+    acceptance budget is <2% on median paired latency overhead.  The
+    record also reports the tail-sampling keep rate observed over the
+    traced windows (kct_trace_traces_total deltas)."""
+    import statistics
+    import time
+
+    from kubernetes_cloud_tpu import obs
+    from kubernetes_cloud_tpu.obs import dtrace
+    from kubernetes_cloud_tpu.serve.continuous import (
+        ContinuousBatchingModel,
+        EngineConfig,
+    )
+    from kubernetes_cloud_tpu.serve.load_test import (
+        run_concurrent,
+        scrape_metrics,
+    )
+    from kubernetes_cloud_tpu.serve.server import ModelServer
+
+    model = ContinuousBatchingModel("lm", svc, EngineConfig(
+        slots=args.slots, max_len=args.pool_max_len))
+    model.load()
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    rng = random.Random(args.seed)
+    pool = _payload_pool(rng, args.requests)
+    url = f"http://127.0.0.1:{server.port}/v1/models/lm:predict"
+    metrics_url = f"http://127.0.0.1:{server.port}/metrics"
+    conc = max(int(s) for s in args.stages.split(",") if s)
+    lat: dict[str, list] = {"traced": [], "untraced": []}
+    tps: dict[str, list] = {"traced": [], "untraced": []}
+    try:
+        # warmup compiles every (bucket, max_new) program first — the
+        # A/B must measure tracing, not XLA
+        run_concurrent(url, pool[:24], concurrency=4)
+        before = scrape_metrics(metrics_url)
+        for rep in range(max(1, args.trace_repeats)):
+            order = ("traced", "untraced") if rep % 2 == 0 \
+                else ("untraced", "traced")
+            for arm in order:
+                dtrace.configure(enabled=(arm == "traced"))
+                summary = run_concurrent(
+                    url, pool, concurrency=conc,
+                    mint_trace=(arm == "traced"))
+                s = summary.stats()
+                if s["latency_mean_s"] is None:
+                    raise RuntimeError(f"{arm} window had no successes")
+                lat[arm].append(s["latency_mean_s"])
+                tps[arm].append(s["tokens_out_per_sec"])
+        after = scrape_metrics(metrics_url)
+    finally:
+        dtrace.configure(enabled=True)
+        server.stop()
+        model.stop()
+
+    def mean(vals):
+        return statistics.mean(vals)
+
+    def delta(decision):
+        return obs.sample_value(after, "kct_trace_traces_total",
+                                {"decision": decision}) - \
+            obs.sample_value(before, "kct_trace_traces_total",
+                             {"decision": decision})
+
+    kept = delta("kept_tail") + delta("kept_head")
+    decided = kept + delta("dropped")
+    pair_pcts = [
+        (t - u) / max(u, 1e-9) * 100.0
+        for t, u in zip(lat["traced"], lat["untraced"])]
+    overhead = statistics.median(pair_pcts)
+    record = {
+        "metric": "serving_trace_overhead_pct",
+        "value": round(overhead, 2),
+        "unit": "percent of median paired latency",
+        "pair_overheads_pct": [round(p, 2) for p in pair_pcts],
+        "preset": args.preset,
+        "slots": args.slots,
+        "concurrency": conc,
+        "repeats": max(1, args.trace_repeats),
+        "requests_per_window": len(pool),
+        "latency_mean_s": {k: round(mean(v), 4)
+                           for k, v in lat.items()},
+        "tokens_out_per_sec": {k: round(mean(v), 2)
+                               for k, v in tps.items()},
+        "throughput_overhead_pct": round(
+            (mean(tps["untraced"]) - mean(tps["traced"]))
+            / max(mean(tps["untraced"]), 1e-9) * 100.0, 2),
+        "traces_decided": int(decided),
+        "tail_keep_rate": round(kept / decided, 4) if decided else None,
+        "within_budget": overhead < 2.0,
+    }
+    print(json.dumps(record))
+    return 0
+
+
 def run_cold_start(args) -> int:
     """--cold-start: streamed vs whole-file-read weight loading,
     measured as startup→first-token (BENCHMARKS.md "Streaming cold
@@ -2261,6 +2369,15 @@ def main(argv=None) -> int:
                     help="autoscale mode: autoscaler max_replicas")
     ap.add_argument("--as-tick", type=float, default=0.25,
                     help="autoscale mode: simulator tick seconds")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="interleaved A/B: distributed tracing armed "
+                         "(per-request Traceparent + span store + tail "
+                         "sampling) vs disarmed, on one continuous-"
+                         "batching server; reports the latency/"
+                         "throughput tax against the <2%% budget and "
+                         "the observed tail-sampling keep rate")
+    ap.add_argument("--trace-repeats", type=int, default=3,
+                    help="trace-overhead A/B repeat pairs")
     ap.add_argument("--cold-start", action="store_true",
                     help="streamed vs whole-file weight loading, "
                          "measured startup→first-token with warmed "
@@ -2324,6 +2441,9 @@ def main(argv=None) -> int:
 
     if args.fleet:
         return run_fleet(args, svc)
+
+    if args.trace_overhead:
+        return run_trace_overhead(args, svc)
 
     # --attn-ab wins over --kv-dtype so the decode-kernel A/B can run
     # on a QUANTIZED arena (kv_dtype feeds both engines' storage mode)

@@ -28,6 +28,14 @@ CLI::
     python scripts/perf_report.py --train --url http://trainer:9090
     python scripts/perf_report.py --train --file run.metrics.jsonl
 
+    # ONE request's distributed trace: the span waterfall plus the
+    # per-edge latency attribution (router queue / hedge wait / tenant
+    # queue / prefill / KV transfer / decode / retry amplification),
+    # naming the dominant edge — pointed at the router's assembler
+    # (GET /debug/trace/<id>) or a saved response body
+    python scripts/perf_report.py --trace <trace_id> --url http://pod:8080
+    python scripts/perf_report.py --trace <trace_id> --file trace.json
+
 ``--peak-flops`` declares the hardware peak when the device table
 doesn't know it (CPU dev boxes) — MFU is reported only against a
 declared or detected peak, never guessed.
@@ -43,6 +51,7 @@ import argparse
 import json
 import pathlib
 import sys
+import urllib.error
 import urllib.request
 
 _REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
@@ -96,6 +105,70 @@ def load_file(path: str, train: bool = False) -> dict:
         "nor a JSONL of iteration records")
 
 
+def fetch_trace(url: str, trace_id: str,
+                timeout: float = report.DEBUG_HTTP_TIMEOUT_S) -> dict:
+    """GET one assembled trace from a router/server's debug plane."""
+    endpoint = report.debug_endpoint(url, f"/debug/trace/{trace_id}")
+    with urllib.request.urlopen(endpoint, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def load_trace_file(path: str, trace_id: str) -> dict:
+    """A saved ``/debug/trace/<id>`` response body, or a bare span
+    list (the ``spans`` field alone)."""
+    with open(path) as f:
+        obj = json.load(f)
+    if isinstance(obj, list):
+        obj = {"spans": obj}
+    if not isinstance(obj, dict) or "spans" not in obj:
+        raise ValueError(f"{path} is not a saved trace "
+                         "(/debug/trace/<id> response or span list)")
+    spans = [s for s in obj["spans"]
+             if s.get("trace_id") in (None, trace_id)]
+    return {**obj, "trace_id": trace_id, "spans": spans}
+
+
+def trace_report(args) -> int:
+    """``--trace <id>``: render the waterfall + per-edge attribution
+    (the dtrace critical-path analyzer) for ONE request's tree."""
+    from kubernetes_cloud_tpu.obs import dtrace
+
+    try:
+        obj = (fetch_trace(args.url, args.trace) if args.url
+               else load_trace_file(args.file, args.trace))
+    except urllib.error.HTTPError as e:
+        print(f"trace {args.trace!r}: HTTP {e.code} "
+              f"(sampled out, expired from the bounded store, or "
+              f"never seen by this pod)", file=sys.stderr)
+        return 1
+    spans = dtrace.merge_spans(obj.get("spans") or [])
+    if not spans:
+        print(f"trace {args.trace!r}: no spans", file=sys.stderr)
+        return 1
+    analysis = obj.get("analysis") or dtrace.analyze(spans)
+    if args.json:
+        print(json.dumps({"trace_id": args.trace, "spans": spans,
+                          "keep": obj.get("keep", []),
+                          "analysis": analysis}))
+        return 0
+    print(f"trace {args.trace}  "
+          f"({len(spans)} spans, {analysis['total_s'] * 1e3:.1f} ms"
+          + (", kept: " + ",".join(obj["keep"]) if obj.get("keep")
+             else "") + ")")
+    print()
+    print(dtrace.render_waterfall(spans))
+    print()
+    edges = analysis.get("edges", {})
+    width = max((len(k) for k in edges), default=0)
+    for name, secs in sorted(edges.items(), key=lambda kv: -kv[1]):
+        mark = "  <-- dominant" if name == analysis.get("dominant") \
+            else ""
+        print(f"  {name:<{width}}  {secs * 1e3:9.2f} ms{mark}")
+    if analysis.get("dominant"):
+        print(f"\ndominant edge: {analysis['dominant']}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     src = ap.add_mutually_exclusive_group(required=True)
@@ -118,8 +191,17 @@ def main(argv=None) -> int:
                          "/ straggler sections (accepts the trainer "
                          "sidecar's /debug/timeline or the run's "
                          "metrics JSONL)")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="report ONE request's distributed trace "
+                         "instead of the timeline: span waterfall + "
+                         "per-edge latency attribution naming the "
+                         "dominant edge (--url hits the assembler at "
+                         "/debug/trace/<id>; --file reads a saved "
+                         "response)")
     args = ap.parse_args(argv)
 
+    if args.trace:
+        return trace_report(args)
     dump = (fetch_timeline(args.url, args.last) if args.url
             else load_file(args.file, train=args.train))
     models = dump.get("models", {})

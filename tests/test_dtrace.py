@@ -1,0 +1,310 @@
+"""Distributed-trace unit lane (``obs/dtrace.py``, jax-free): wire
+parsing (plain / W3C / flags / garbage), the bounded span store and
+its eviction order, request-id binding with engine-suffix stripping,
+tail-sampling decide semantics, exemplars, span merging, and the
+critical-path analyzer's edge attribution."""
+
+import pytest
+
+from kubernetes_cloud_tpu.obs import dtrace
+
+
+@pytest.fixture()
+def st():
+    """A fresh process store per test; the previous store object is
+    restored afterward so module-scoped servers in other files keep
+    their bindings."""
+    prev = dtrace.store()
+    store = dtrace.reset(head_sample=1.0)
+    yield store
+    dtrace._STORE = prev
+
+
+# -- wire format -------------------------------------------------------------
+
+def test_wire_roundtrip_plain(st):
+    ctx = dtrace.mint()
+    parsed = dtrace.parse(ctx.wire())
+    assert parsed is not None
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.parent_id == ctx.span_id  # callee parents the caller
+    assert parsed.span_id != ctx.span_id    # own span, freshly minted
+    assert parsed.caller_decides is False   # plain client mint
+
+
+def test_child_wire_claims_sampling_authority(st):
+    ctx = dtrace.mint()
+    leg = dtrace.new_span_id()
+    parsed = dtrace.parse(ctx.child_wire(leg))
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.parent_id == leg
+    assert parsed.caller_decides is True  # the -01 flags token
+
+
+def test_parse_w3c_versioned_form(st):
+    tid, sid = "ab" * 16, "cd" * 8
+    parsed = dtrace.parse(f"00-{tid}-{sid}-01")
+    assert (parsed.trace_id, parsed.parent_id) == (tid, sid)
+    assert parsed.caller_decides is True
+    # without flags the version prefix still drops
+    parsed = dtrace.parse(f"00-{tid}-{sid}")
+    assert parsed.trace_id == tid and parsed.caller_decides is False
+
+
+@pytest.mark.parametrize("garbage", [
+    None, "", "nonsense", "not-hex-!!-stuff", "deadbeef",      # 1 token
+    "zzzzzzzzzzzz-zzzzzzzzzzzz",                               # non-hex
+    "deadbeef-cafe",                                           # too short
+    "x" * 200,                                                 # too long
+    42,                                                        # non-str
+])
+def test_parse_garbage_returns_none(st, garbage):
+    assert dtrace.parse(garbage) is None
+
+
+# -- bindings ----------------------------------------------------------------
+
+def test_binding_strips_engine_suffixes(st):
+    ctx = dtrace.mint()
+    dtrace.bind("rid", ctx)
+    # per-instance and hedge-leg ids the door never bound resolve to
+    # the base binding (up to 3 trailing segments stripped)
+    for rid in ("rid", "rid-0", "rid-h", "rid-h-0"):
+        assert dtrace.context_for(rid) is ctx
+    assert dtrace.context_for("other") is None
+    assert dtrace.context_for(None) is None
+    assert dtrace.unbind("rid") is ctx
+    assert dtrace.context_for("rid") is None
+
+
+def test_conditional_unbind_respects_the_rebinding_owner(st):
+    """In-process replicas REBIND a request id over the router's
+    binding in the shared store; the router's door exit must not
+    strip the replica's binding (and vice versa)."""
+    router_ctx, replica_ctx = dtrace.mint(), dtrace.mint()
+    dtrace.bind("rid", router_ctx)
+    dtrace.bind("rid", replica_ctx)  # the replica door rebinds
+    assert dtrace.unbind("rid", router_ctx) is None  # not the owner
+    assert dtrace.context_for("rid") is replica_ctx
+    assert dtrace.unbind("rid", replica_ctx) is replica_ctx
+    assert dtrace.unbind("rid", replica_ctx) is None  # already gone
+
+
+# -- bounded store -----------------------------------------------------------
+
+def test_span_cap_per_trace(st):
+    st.max_spans = 3
+    for i in range(5):
+        st.add_span("t1", f"s{i}", None, "decode")
+    assert len(st.spans_for("t1")) == 3
+
+
+def test_eviction_prefers_undecided_boring_traces(st):
+    st.max_traces = 4
+    st.add_span("keepme", "s0", None, "server")
+    st.note_keep("keepme", "hedged")
+    for i in range(10):
+        st.add_span(f"boring{i}", "s0", None, "server")
+    assert st.spans_for("keepme") is not None  # survived the burst
+    assert len(st.index(last=100)) == 4        # bound held
+
+
+def test_disabled_store_is_inert(st):
+    st.enabled = False
+    st.bind("rid", dtrace.mint())
+    st.add_span("t1", "s1", None, "server")
+    st.note_keep("t1", "hedged")
+    assert st.context_for("rid") is None
+    assert st.spans_for("t1") is None
+
+
+def test_configure_rejects_unknown_keys(st):
+    with pytest.raises(ValueError, match="unknown dtrace option"):
+        dtrace.configure(max_tracez=7)
+    assert dtrace.configure(max_traces=7).max_traces == 7
+
+
+# -- tail sampling -----------------------------------------------------------
+
+def test_decide_drops_boring_and_deletes(st):
+    st.head_sample = 0.0
+    st.add_span("t1", "s1", None, "server")
+    assert st.decide("t1") == "dropped"
+    assert st.spans_for("t1") is None  # dropped = gone
+    assert st.decide("unknown") is None
+
+
+def test_decide_keeps_tail_reasons_and_is_idempotent(st):
+    st.head_sample = 0.0
+    st.add_span("t1", "s1", None, "server")
+    st.note_keep("t1", "retried")
+    assert st.decide("t1") == "kept_tail"
+    assert st.decide("t1") == "kept_tail"  # retries re-enter safely
+    assert st.spans_for("t1")
+    assert st.keep_reasons("t1") == {"retried"}
+
+
+def test_decide_head_samples_the_boring(st):
+    st.head_sample = 1.0
+    st.add_span("t1", "s1", None, "server")
+    assert st.decide("t1") == "kept_head"
+    assert st.spans_for("t1")
+
+
+def test_auto_keep_from_engine_events(st):
+    st.ttft_target_s = 0.5
+    st.inter_token_target_s = 0.1
+    cases = [
+        ("preempted", {}, "preempted"),
+        ("failed", {}, "error"),
+        ("requeued", {}, "transplanted"),
+        ("first_token", {"ttft_s": 0.9}, "slo_ttft"),
+        # decode (2.0 - 0.2) / 9 tokens = 0.2 s/token > 0.1 target
+        ("complete", {"duration_s": 2.0, "tokens": 10, "ttft_s": 0.2},
+         "slo_inter_token"),
+    ]
+    for i, (span, fields, reason) in enumerate(cases):
+        rid = f"r{i}"
+        ctx = dtrace.mint()
+        st.bind(rid, ctx)
+        ids = st.on_event(rid, span, fields)
+        assert ids["trace_id"] == ctx.trace_id
+        assert ids["parent_id"] == ctx.span_id
+        assert reason in st.keep_reasons(ctx.trace_id), span
+
+
+def test_auto_keep_not_fired_under_target(st):
+    st.ttft_target_s = 2.0
+    ctx = dtrace.mint()
+    st.bind("r", ctx)
+    st.on_event("r", "first_token", {"ttft_s": 0.01})
+    assert st.keep_reasons(ctx.trace_id) == set()
+
+
+def test_on_event_without_binding_is_free(st):
+    assert st.on_event("nobody", "queued", {}) is None
+    assert st.index(last=10) == []
+
+
+# -- exemplars ---------------------------------------------------------------
+
+def test_exemplars_worst_first_truncated(st):
+    for i in range(8):
+        st.note_exemplar("ttft", float(i), f"t{i}", keep=5)
+    got = st.exemplars()["ttft"]
+    assert [e["trace_id"] for e in got] == ["t7", "t6", "t5", "t4", "t3"]
+    assert got[0]["value"] == 7.0
+
+
+# -- merge + waterfall -------------------------------------------------------
+
+def test_merge_spans_dedups_and_orders(st):
+    a = {"trace_id": "t", "span_id": "a", "parent_id": None,
+         "name": "server", "ts": 2.0}
+    b = {"trace_id": "t", "span_id": "b", "parent_id": "a",
+         "name": "queued", "ts": 1.0}
+    merged = dtrace.merge_spans([a, dict(a), b, dict(b)])
+    assert [s["span_id"] for s in merged] == ["b", "a"]  # ts order
+
+
+def test_render_waterfall_tree(st):
+    spans = [
+        {"trace_id": "t", "span_id": "a", "parent_id": None,
+         "name": "server", "ts": 100.0, "dur_s": 0.5, "status": 200},
+        {"trace_id": "t", "span_id": "b", "parent_id": "a",
+         "name": "prefill", "ts": 100.1, "model": "lm"},
+    ]
+    out = dtrace.render_waterfall(spans)
+    assert "server" in out and "prefill" in out
+    assert "status=200" in out and "model=lm" in out
+    assert dtrace.render_waterfall([]) == "(no spans)"
+
+
+# -- critical path -----------------------------------------------------------
+
+def _hedged_trace():
+    """Synthetic assembled tree: root server span, a cancelled primary
+    leg, a winning hedge leg whose engine saw queue → admit → first
+    token → complete plus a KV handoff."""
+    t0 = 1000.0
+    spans = [
+        {"span_id": "root", "parent_id": None, "name": "server",
+         "ts": t0, "dur_s": 1.0, "status": 200},
+        {"span_id": "leg_p", "parent_id": "root", "name": "dispatch",
+         "ts": t0 + 0.01, "dur_s": 0.15, "leg": "primary",
+         "outcome": "cancelled", "replica": "r0", "retry": 0},
+        {"span_id": "leg_h", "parent_id": "root", "name": "dispatch",
+         "ts": t0 + 0.11, "dur_s": 0.8, "leg": "hedge",
+         "outcome": "win", "replica": "r1", "retry": 0},
+        {"span_id": "rs", "parent_id": "leg_h", "name": "server",
+         "ts": t0 + 0.12, "dur_s": 0.78},
+        {"span_id": "q", "parent_id": "rs", "name": "queued",
+         "ts": t0 + 0.12},
+        {"span_id": "ad", "parent_id": "rs", "name": "admitted",
+         "ts": t0 + 0.20},
+        {"span_id": "kv", "parent_id": "rs", "name": "kv_transfer",
+         "ts": t0 + 0.30, "dur_s": 0.05},
+        {"span_id": "ft", "parent_id": "rs", "name": "first_token",
+         "ts": t0 + 0.50},
+        {"span_id": "cp", "parent_id": "rs", "name": "complete",
+         "ts": t0 + 0.95},
+    ]
+    for s in spans:
+        s["trace_id"] = "t"
+    return spans
+
+
+def test_analyze_attributes_edges_and_dominant(st):
+    got = dtrace.analyze(_hedged_trace())
+    edges = got["edges"]
+    assert edges["router_queue"] == pytest.approx(0.01, abs=1e-6)
+    assert edges["hedge_wait"] == pytest.approx(0.10, abs=1e-6)
+    assert edges["tenant_queue"] == pytest.approx(0.08, abs=1e-6)
+    assert edges["kv_transfer"] == pytest.approx(0.05, abs=1e-6)
+    # prefill (admit -> first token) minus the KV window inside it
+    assert edges["prefill"] == pytest.approx(0.25, abs=1e-6)
+    assert edges["decode"] == pytest.approx(0.45, abs=1e-6)
+    assert got["dominant"] == "decode"
+    assert got["total_s"] == pytest.approx(1.0, abs=1e-6)
+    assert got["spans"] == len(_hedged_trace())
+
+
+def test_analyze_winner_path_excludes_loser_and_counts_retries(st):
+    """Engine spans under a failed leg never pollute the attribution;
+    failed-leg wall time lands in retry_amplification."""
+    t0 = 1000.0
+    spans = [
+        {"span_id": "root", "parent_id": None, "name": "server",
+         "ts": t0, "dur_s": 1.0, "status": 200},
+        {"span_id": "leg0", "parent_id": "root", "name": "dispatch",
+         "ts": t0 + 0.01, "dur_s": 0.4, "leg": "primary",
+         "outcome": "error", "retry": 0},
+        # the dead replica got as far as admitting before it crashed
+        {"span_id": "q0", "parent_id": "leg0", "name": "queued",
+         "ts": t0 + 0.02},
+        {"span_id": "a0", "parent_id": "leg0", "name": "admitted",
+         "ts": t0 + 0.03},
+        {"span_id": "leg1", "parent_id": "root", "name": "dispatch",
+         "ts": t0 + 0.45, "dur_s": 0.5, "leg": "primary",
+         "outcome": "ok", "retry": 1},
+        {"span_id": "q1", "parent_id": "leg1", "name": "queued",
+         "ts": t0 + 0.46},
+        {"span_id": "a1", "parent_id": "leg1", "name": "admitted",
+         "ts": t0 + 0.56},
+        {"span_id": "f1", "parent_id": "leg1", "name": "first_token",
+         "ts": t0 + 0.66},
+        {"span_id": "c1", "parent_id": "leg1", "name": "complete",
+         "ts": t0 + 0.9},
+    ]
+    for s in spans:
+        s["trace_id"] = "t"
+    got = dtrace.analyze(spans)
+    assert got["edges"]["retry_amplification"] == pytest.approx(0.4)
+    # tenant_queue measured on the WINNING leg (0.10), not the dead one
+    assert got["edges"]["tenant_queue"] == pytest.approx(0.10, abs=1e-6)
+    assert "hedge_wait" not in got["edges"]  # retries are not hedges
+
+
+def test_analyze_empty():
+    assert dtrace.analyze([]) == {"edges": {}, "dominant": None,
+                                  "total_s": 0.0, "spans": 0}

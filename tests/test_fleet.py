@@ -640,7 +640,7 @@ def test_load_test_multi_url_round_robins():
     seen = []
     orig = lt._one_request
 
-    def fake(url, payload, timeout, headers=None):
+    def fake(url, payload, timeout, headers=None, mint_trace=False):
         seen.append(url)
         return lt.Result(0.01, 200)
 

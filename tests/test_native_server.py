@@ -52,9 +52,13 @@ def test_v1_surface_parity(server):
     assert _req(server.port, "/v1/models") == (200, {"models": ["echo"]})
     code, body = _req(server.port, "/v1/models/echo:predict",
                       {"instances": ["a", "b"]})
-    assert (code, body) == (200, {"predictions": ["a", "b"]})
-    assert _req(server.port, "/completion", {"prompt": "hi"}) \
-        == (200, {"completion": "hi!"})
+    # every served 2xx carries the trace id it ran under (the
+    # distributed-tracing door mints one when the client sent none)
+    assert code == 200 and body.pop("trace_id")
+    assert body == {"predictions": ["a", "b"]}
+    code, body = _req(server.port, "/completion", {"prompt": "hi"})
+    assert code == 200 and body.pop("trace_id")
+    assert body == {"completion": "hi!"}
     assert _req(server.port, "/v1/models/nope:predict", {})[0] == 404
     assert _req(server.port, "/nope")[0] == 404
 

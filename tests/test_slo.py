@@ -1,0 +1,245 @@
+"""SLO plane unit + chaos lane (``obs/slo.py``, jax-free): spec
+validation, good/total measurement off the text exposition (latency
+buckets + availability counters), multi-window multi-burn-rate
+judgment over a fake clock, error-budget accounting, the lazy worker
+behind ``poke()``, ``/debug/slo`` serving the LAST snapshot, and the
+``slo.eval`` fault site's raise/hang containment contract."""
+
+import time
+
+import pytest
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.obs import metrics, slo
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _avail_spec(**kw):
+    kw.setdefault("name", "avail")
+    kw.setdefault("objective", 0.99)
+    kw.setdefault("family", "req_total")
+    kw.setdefault("kind", "availability")
+    kw.setdefault("windows", (slo.BurnWindow("fast", long_s=300.0,
+                                             short_s=60.0,
+                                             max_burn=10.0),))
+    kw.setdefault("budget_window_s", 600.0)
+    return slo.SLOSpec(**kw)
+
+
+# -- spec validation ---------------------------------------------------------
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="objective"):
+        slo.SLOSpec(name="x", objective=1.5, family="f",
+                    threshold_s=1.0)
+    with pytest.raises(ValueError, match="unknown kind"):
+        slo.SLOSpec(name="x", objective=0.9, family="f", kind="weird")
+    with pytest.raises(ValueError, match="threshold_s"):
+        slo.SLOSpec(name="x", objective=0.9, family="f")
+    with pytest.raises(ValueError, match="duplicate"):
+        slo.SLOEvaluator([_avail_spec(), _avail_spec()])
+
+
+def test_default_specs_cover_the_deploy_promises():
+    names = {s.name for s in slo.default_specs()}
+    assert names == {"ttft_p95", "inter_token_p95", "availability"}
+
+
+# -- measurement -------------------------------------------------------------
+
+def test_measure_latency_from_histogram_buckets():
+    reg = metrics.Registry()
+    h = reg.histogram("kct_engine_ttft_seconds", "t", ("model",),
+                      buckets=(0.5, 2.0, 8.0))
+    for _ in range(19):
+        h.labels(model="lm").observe(0.1)
+    h.labels(model="lm").observe(5.0)  # breaches the 2.0 s threshold
+    spec = slo.SLOSpec(name="ttft", objective=0.95,
+                       family="kct_engine_ttft_seconds",
+                       threshold_s=2.0)
+    good, total = slo.measure(spec, metrics.parse_text(reg.render()))
+    assert (good, total) == (19.0, 20.0)
+
+
+def test_measure_latency_match_filters_labels():
+    reg = metrics.Registry()
+    h = reg.histogram("it_s", "t", ("phase",), buckets=(0.25, 1.0))
+    h.labels(phase="decode").observe(0.1)
+    h.labels(phase="prefill").observe(9.0)  # filtered out
+    spec = slo.SLOSpec(name="it", objective=0.95, family="it_s",
+                       threshold_s=0.25, match={"phase": "decode"})
+    good, total = slo.measure(spec, metrics.parse_text(reg.render()))
+    assert (good, total) == (1.0, 1.0)
+
+
+def test_measure_availability_5xx_slice():
+    reg = metrics.Registry()
+    c = reg.counter("req_total", "t", ("route", "status"))
+    c.labels(route="predict", status="200").inc(97)
+    c.labels(route="predict", status="503").inc(2)
+    c.labels(route="predict", status="504").inc(1)
+    c.labels(route="cancel", status="500").inc(5)  # other route
+    spec = _avail_spec(match={"route": "predict"})
+    good, total = slo.measure(spec, metrics.parse_text(reg.render()))
+    assert (good, total) == (97.0, 100.0)
+
+
+# -- burn rates / budget -----------------------------------------------------
+
+def _evaluator(reg, clock):
+    return slo.SLOEvaluator([_avail_spec()], registry=reg, clock=clock)
+
+
+def test_good_traffic_no_breach_full_budget():
+    reg = metrics.Registry()
+    c = reg.counter("req_total", "t", ("status",))
+    clock = Clock()
+    ev = _evaluator(reg, clock)
+    c.labels(status="200").inc(100)
+    ev.eval_now()
+    clock.t += 60
+    c.labels(status="200").inc(100)
+    st = ev.eval_now()["slos"]["avail"]
+    assert st["burn_rates"]["fast"] == 0.0
+    assert st["breaching"] is False
+    assert st["budget_remaining"] == 1.0
+    assert st["window_total"] == 100.0
+
+
+def test_burning_both_windows_breaches_and_overdraws_budget():
+    reg = metrics.Registry()
+    c = reg.counter("req_total", "t", ("status",))
+    clock = Clock()
+    ev = _evaluator(reg, clock)
+    c.labels(status="200").inc(100)
+    ev.eval_now()
+    clock.t += 60
+    c.labels(status="200").inc(50)
+    c.labels(status="503").inc(50)  # 50% errors vs 1% allowed
+    st = ev.eval_now()["slos"]["avail"]
+    # bad_frac 0.5 / allowed 0.01 = burn 50 on BOTH windows (the
+    # baseline snapshot covers long and short alike here)
+    assert st["burn_rates"]["fast"] == pytest.approx(50.0)
+    assert st["breaching"] is True
+    assert st["budget_remaining"] == pytest.approx(-49.0)
+
+
+def test_long_window_alone_does_not_page():
+    """An old burst inside the long window but outside the short one
+    must NOT breach — the short window proves it is still happening."""
+    reg = metrics.Registry()
+    c = reg.counter("req_total", "t", ("status",))
+    clock = Clock()
+    ev = _evaluator(reg, clock)
+    c.labels(status="200").inc(100)
+    ev.eval_now()                       # t=1000 baseline
+    clock.t += 120
+    c.labels(status="503").inc(50)      # burst, then recovery
+    ev.eval_now()                       # t=1120
+    clock.t += 110
+    c.labels(status="200").inc(400)     # clean traffic since
+    st = ev.eval_now()["slos"]["avail"]  # t=1230
+    # long window (300 s) sees the burst: 50/550 bad -> burn ~9;
+    # short window (60 s, baseline t=1120) is clean -> burn 0
+    assert st["burn_rates"]["fast"] > 5.0
+    assert st["breaching"] is False
+
+
+def test_empty_registry_is_calm():
+    ev = _evaluator(metrics.Registry(), Clock())
+    st = ev.eval_now()["slos"]["avail"]
+    assert st["breaching"] is False
+    assert st["budget_remaining"] == 1.0
+    assert st["window_total"] == 0.0
+
+
+def test_poke_runs_worker_and_snapshot_serves(monkeypatch):
+    ev = _evaluator(metrics.Registry(), Clock())
+    assert ev.snapshot()["ts"] is None
+    ev.poke()
+    deadline = time.monotonic() + 10
+    while ev.snapshot()["ts"] is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ev.snapshot()["ts"] is not None
+    assert "avail" in ev.snapshot()["slos"]
+    ev.close()
+
+
+# -- chaos: slo.eval containment --------------------------------------------
+
+def test_slo_eval_raise_contained_to_error_count():
+    reg = metrics.Registry()
+    c = reg.counter("req_total", "t", ("status",))
+    c.labels(status="200").inc(10)
+    ev = _evaluator(reg, Clock())
+    good = ev.eval_now()
+    assert good["slos"]["avail"]["breaching"] is False
+    faults.install(faults.FaultInjector(
+        [FaultSpec("slo.eval", mode="raise", at=1, times=1)]))
+    got = ev.eval_now()
+    # the LAST GOOD snapshot is still served, error accounted
+    assert got["ts"] == good["ts"]
+    assert got["errors"] == 1 and got["last_error"] == "FaultError"
+    assert ev.snapshot()["errors"] == 1
+    # the next pass (fault exhausted) recovers
+    assert "errors" not in ev.eval_now().get("slos", {})
+    assert ev.snapshot()["slos"]["avail"]["breaching"] is False
+
+
+def test_slo_eval_hang_parks_only_the_worker():
+    """A hung evaluation wedges the lazy worker thread, nothing else:
+    ``poke()`` (the prober-loop call) returns immediately and
+    ``/debug/slo`` keeps serving the last snapshot."""
+    ev = _evaluator(metrics.Registry(), Clock())
+    faults.install(faults.FaultInjector(
+        [FaultSpec("slo.eval", mode="hang", at=1, times=1,
+                   delay_s=30.0)]))
+    t0 = time.monotonic()
+    ev.poke()       # wakes the worker, which parks in the hang
+    ev.poke()       # re-poke while wedged: still instant
+    assert time.monotonic() - t0 < 1.0
+    # the debug surface never routes through the evaluation
+    server = ModelServer([], host="127.0.0.1", port=0)
+    server.attach_slo(ev)
+    t0 = time.monotonic()
+    status, obj = server._route("GET", "/debug/slo", b"", None)
+    assert time.monotonic() - t0 < 1.0
+    assert status == 200 and obj["evaluated"] is False
+    faults.uninstall()  # releases the parked worker
+    ev.close()
+
+
+def test_debug_slo_404_without_evaluator():
+    server = ModelServer([], host="127.0.0.1", port=0)
+    status, obj = server._route("GET", "/debug/slo", b"", None)
+    assert status == 404 and "no SLO evaluator" in obj["error"]
+
+
+def test_debug_slo_serves_specs_and_snapshot():
+    reg = metrics.Registry()
+    reg.counter("req_total", "t", ("status",)).labels(status="200").inc(5)
+    ev = _evaluator(reg, Clock())
+    ev.eval_now()
+    server = ModelServer([], host="127.0.0.1", port=0)
+    server.attach_slo(ev)
+    status, obj = server._route("GET", "/debug/slo", b"", None)
+    assert status == 200
+    assert obj["specs"] == ["avail"]
+    assert obj["evaluated"] is True
+    assert obj["slos"]["avail"]["objective"] == 0.99

@@ -1,0 +1,576 @@
+"""Distributed-trace end-to-end lane: real engines behind real
+front-ends, asserting the ISSUE's acceptance scenario — ONE trace tree
+assembled at the router's ``/debug/trace/<id>`` across hedged dispatch
+(loser cancelled), retries, the disagg KV handoff, and transplant —
+plus tail-sampling retention, the worst-TTFT exemplar ride-along,
+stdlib/native front-end parity at the door, the ``trace.export``
+chaos containment contract, ``perf_report --trace``'s dominant-edge
+attribution, and load_test's client-minted traceparent cross-check.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_cloud_tpu import faults
+from kubernetes_cloud_tpu.faults import FaultSpec
+from kubernetes_cloud_tpu.models import PRESETS, init_params
+from kubernetes_cloud_tpu.obs import dtrace
+from kubernetes_cloud_tpu.serve import load_test, native_server
+from kubernetes_cloud_tpu.serve.continuous import (
+    ContinuousBatchingModel,
+    EngineConfig,
+)
+from kubernetes_cloud_tpu.serve.disagg import build_disaggregated_engine
+from kubernetes_cloud_tpu.serve.fleet import (
+    FleetConfig,
+    FleetRouter,
+    LocalReplica,
+)
+from kubernetes_cloud_tpu.serve.lm_service import CausalLMService
+from kubernetes_cloud_tpu.serve.model import Model
+from kubernetes_cloud_tpu.serve.server import ModelServer
+
+pytestmark = [pytest.mark.fleet]
+
+CFG = dataclasses.replace(PRESETS["test-tiny"], vocab_size=512,
+                          dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace_store():
+    """Every test gets a fresh span store with head sampling pinned ON
+    (``decide`` deletes dropped traces — tests asserting span presence
+    must not roll dice); a clean default store is left behind."""
+    dtrace.reset(head_sample=1.0)
+    yield
+    dtrace.reset()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def service(params):
+    svc = CausalLMService("lm", CFG, params=params, dtype=jnp.float32)
+    svc.load()
+    return svc
+
+
+def make_fleet(service, n, fcfg, engine_kw=None):
+    kw = {"slots": 2, "max_len": 96}
+    kw.update(engine_kw or {})
+    replicas = []
+    for i in range(n):
+        model = ContinuousBatchingModel("lm", service,
+                                        EngineConfig(**kw))
+        model.load()
+        server = ModelServer([model], host="127.0.0.1", port=0)
+        replicas.append(LocalReplica(f"r{i}", server, fcfg))
+    router = FleetRouter(replicas, fcfg, host="127.0.0.1", port=0)
+    return router, replicas
+
+
+def warm_all(replicas):
+    for r in replicas:
+        eng = r.server.models["lm"].engine
+        eng.submit([1, 2, 3], max_new_tokens=2, temperature=0.0).wait()
+
+
+def warm_http(replicas, prompt, max_new=2):
+    """Compile the exact prompt-shape program on EVERY replica before
+    a race-sensitive test: a first-hit XLA compile on one leg would
+    decide hedge races by compiler luck, not dispatch order."""
+    for r in replicas:
+        status, _ = r.call(
+            "POST", "/v1/models/lm:predict",
+            json.dumps({"instances": [prompt],
+                        "parameters": {"max_new_tokens": max_new,
+                                       "temperature": 0.0}}).encode(),
+            None)
+        assert status == 200
+
+
+def _predict(port, prompt, max_new, timeout=60, rid=None, headers=None):
+    payload = {"instances": [prompt],
+               "parameters": {"max_new_tokens": max_new,
+                              "temperature": 0.0}}
+    if rid:
+        payload["request_id"] = rid
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/lm:predict",
+        data=json.dumps(payload).encode(), headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path, timeout=30):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _wait_until(cond, timeout=15.0, interval=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _by_name(spans, name):
+    return [s for s in spans if s["name"] == name]
+
+
+# -- the acceptance tree: hedge with a cancelled loser ----------------------
+
+def test_hedged_request_assembles_one_tree(service, capsys):
+    """Router -> hedged dispatch: the winning hedge leg and the
+    cancelled primary leg are sibling ``dispatch`` spans under ONE
+    root; the replica trees parent into their exact legs; the loser's
+    span is closed (dur_s recorded, outcome=cancelled); the trace is
+    tail-retained as ``hedged``; perf_report --trace renders the tree
+    and names the dominant edge."""
+    fcfg = FleetConfig(dispatch_timeout_s=30.0, hedge_after_s=0.05,
+                       probe_interval_s=30.0)
+    router, replicas = make_fleet(service, 2, fcfg,
+                                  engine_kw={"slots": 1})
+    warm_all(replicas)
+    warm_http(replicas, "hedge me")
+    router.start()
+    try:
+        # keep auto SLO keeps out of the way: retention must come from
+        # the "hedged" reason alone
+        dtrace.configure(ttft_target_s=None, inter_token_target_s=None,
+                         head_sample=0.0)
+        # r0's only slot is busy -> the request parks queued there and
+        # the hedge fires onto r1, which wins
+        blocker = replicas[0].server.models["lm"].engine.submit(
+            [5, 6, 7], max_new_tokens=80, temperature=0.0)
+        status, obj = _predict(router.port, "hedge me", 4)
+        assert status == 200
+        assert obj["fleet"]["hedged"] and obj["fleet"]["hedge_win"]
+        tid = obj["trace_id"]
+
+        # the loser's engine-side cancelled span lands asynchronously
+        _wait_until(lambda: _by_name(dtrace.store().spans_for(tid)
+                                     or [], "cancelled"),
+                    what="loser's cancelled span")
+        status, tree = _get(router.port, f"/debug/trace/{tid}")
+        assert status == 200
+        spans = tree["spans"]
+
+        roots = [s for s in spans if s["name"] == "server"
+                 and s.get("parent_id") is None]
+        assert len(roots) == 1  # ONE tree, rooted at the router door
+        root = roots[0]
+        assert root["status"] == 200 and root["route"] == "predict"
+
+        legs = _by_name(spans, "dispatch")
+        assert {d["leg"] for d in legs} == {"primary", "hedge"}
+        assert all(d["parent_id"] == root["span_id"] for d in legs)
+        winner = next(d for d in legs if d["leg"] == "hedge")
+        loser = next(d for d in legs if d["leg"] == "primary")
+        assert winner["outcome"] == "win" and winner["replica"] == "r1"
+        # hedge-loser cancellation CLOSED its span
+        assert loser["outcome"] == "cancelled" and "dur_s" in loser
+        assert winner["retry"] == 0 and loser["retry"] == 0
+
+        # each replica's door span parents into its own leg, and the
+        # winning engine's lifecycle parents into the replica door
+        leg_ids = {d["span_id"] for d in legs}
+        doors = [s for s in _by_name(spans, "server")
+                 if s.get("parent_id") in leg_ids]
+        assert doors, "replica server spans must parent into the legs"
+        win_door = next(s for s in doors
+                        if s["parent_id"] == winner["span_id"])
+        for name in ("queued", "admitted", "first_token", "complete"):
+            assert any(s["parent_id"] == win_door["span_id"]
+                       for s in _by_name(spans, name)), name
+        # the cancelled loser re-parents its engine spans into r0's door
+        cancelled = _by_name(spans, "cancelled")
+        assert cancelled and all(s["parent_id"] not in
+                                 (win_door["span_id"],)
+                                 for s in cancelled)
+
+        # tail sampling: hedged traces are ALWAYS retained (head
+        # sampling is pinned to 0 above, so retention is the reason)
+        assert "hedged" in tree["keep"]
+        assert "hedge_wait" in tree["analysis"]["edges"]
+        assert tree["analysis"]["dominant"]
+        assert tid in tree["tree"] or "server" in tree["tree"]
+
+        # the index + worst-TTFT exemplars ride GET /debug/trace
+        status, idx = _get(router.port, "/debug/trace")
+        assert status == 200
+        assert any(e["trace_id"] == tid for e in idx["traces"])
+        assert any(e["trace_id"] == tid
+                   for e in idx["exemplars"].get("ttft", []))
+
+        # perf_report --trace against the live assembler
+        from scripts.perf_report import main as perf_main
+        url = f"http://127.0.0.1:{router.port}"
+        assert perf_main(["--url", url, "--trace", tid]) == 0
+        out = capsys.readouterr().out
+        assert "dominant edge:" in out and "dispatch" in out
+        assert perf_main(["--url", url, "--trace", tid, "--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["analysis"]["dominant"] == \
+            tree["analysis"]["dominant"]
+        blocker.wait()
+    finally:
+        router.shutdown()
+
+
+def test_retry_leg_recorded_with_error_outcome(service):
+    """A mid-flight engine crash -> the router retries on the peer:
+    the trace carries BOTH dispatch legs (the failed one closed with
+    outcome=error, the winner tagged with its retry ordinal) and is
+    tail-retained as ``retried``."""
+    fcfg = FleetConfig(dispatch_timeout_s=30.0, probe_interval_s=30.0)
+    router, replicas = make_fleet(service, 2, fcfg)
+    warm_all(replicas)
+    router.start()
+    try:
+        dtrace.configure(ttft_target_s=None, inter_token_target_s=None,
+                         head_sample=0.0)
+        faults.install(faults.FaultInjector(
+            [FaultSpec("decode_step", at=2, times=1)]))
+        status, obj = _predict(router.port, "after the storm", 6)
+        assert status == 200 and obj["fleet"]["retried_ok"]
+        tid = obj["trace_id"]
+        faults.uninstall()
+
+        status, tree = _get(router.port, f"/debug/trace/{tid}")
+        assert status == 200
+        legs = sorted(_by_name(tree["spans"], "dispatch"),
+                      key=lambda d: d["retry"])
+        assert [d["outcome"] for d in legs] == ["error", "ok"]
+        assert [d["retry"] for d in legs] == [0, 1]
+        assert legs[0]["replica"] != legs[1]["replica"]
+        assert "retried" in tree["keep"]
+        assert tree["analysis"]["edges"].get(
+            "retry_amplification", 0.0) > 0.0
+    finally:
+        faults.uninstall()
+        router.shutdown()
+
+
+# -- disagg: the KV handoff keeps the prefill-side trace --------------------
+
+def test_disagg_adoption_keeps_prefill_trace(params):
+    """Prefill-role -> decode-role adoption stays inside ONE trace:
+    the extract/transfer/install spans and the decode-side lifecycle
+    all parent into the context bound on the prefill door."""
+    pair = build_disaggregated_engine(
+        CFG, params, EngineConfig(slots=2, max_len=64, paged=True,
+                                  page_size=8, role="prefill",
+                                  decode_slices=1),
+        eos_token_id=None, pad_token_id=0, mesh=None, name="pair")
+    pair.start()
+    try:
+        ctx = dtrace.mint()
+        dtrace.bind("rid-dis", ctx)
+        req = pair.submit(list(range(1, 12)), max_new_tokens=5,
+                          temperature=0.0, request_id="rid-dis")
+        req.wait()
+        assert req.error is None
+        _wait_until(lambda: _by_name(dtrace.store().spans_for(
+            ctx.trace_id) or [], "complete"), what="completion span")
+        spans = dtrace.store().spans_for(ctx.trace_id)
+        for name in ("kv_extract", "kv_transfer", "kv_install",
+                     "first_token", "complete"):
+            got = _by_name(spans, name)
+            assert got, f"missing {name} span"
+            # every hop bound back into the SAME prefill-door context
+            assert all(s["trace_id"] == ctx.trace_id
+                       and s["parent_id"] == ctx.span_id for s in got)
+        assert _by_name(spans, "kv_extract")[0]["pages"] >= 1
+    finally:
+        dtrace.unbind("rid-dis")
+        pair.stop()
+
+
+# -- transplant keeps the trace and re-parents the requeue ------------------
+
+def test_transplant_reparents_and_tail_retains(service):
+    """A queued request transplanted off a draining replica finishes
+    on the survivor with the SAME trace: the ``requeued`` span joins
+    the tree and the trace is tail-retained as ``transplanted``."""
+    fcfg = FleetConfig(dispatch_timeout_s=60.0, probe_interval_s=30.0)
+    router, replicas = make_fleet(service, 2, fcfg,
+                                  engine_kw={"slots": 1})
+    warm_all(replicas)
+    router.start()
+    try:
+        dtrace.configure(ttft_target_s=None, inter_token_target_s=None,
+                         head_sample=0.0)
+        # r0's slot busy -> the routed request parks in r0's queue
+        blocker = replicas[0].server.models["lm"].engine.submit(
+            [9, 8, 7], max_new_tokens=48, temperature=0.0)
+        got = {}
+
+        def call():
+            got["resp"] = _predict(router.port, "move me", 4,
+                                   rid="rid-tp")
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        _wait_until(lambda: replicas[0].request_phase("rid-tp")
+                    == "queued", what="request to queue on r0")
+        moved = router._transplant_from(replicas[0])
+        assert moved == 1
+        t.join(timeout=60)
+        assert not t.is_alive()
+        status, obj = got["resp"]
+        assert status == 200
+        tid = obj["trace_id"]
+        status, tree = _get(router.port, f"/debug/trace/{tid}")
+        assert status == 200
+        assert "transplanted" in tree["keep"]
+        requeued = _by_name(tree["spans"], "requeued")
+        assert requeued, "transplant must record the requeued span"
+        span_ids = {s["span_id"] for s in tree["spans"]}
+        assert all(s["parent_id"] in span_ids for s in requeued)
+        assert _by_name(tree["spans"], "complete")
+        blocker.wait()
+    finally:
+        router.shutdown()
+
+
+# -- door parity: stdlib vs native front-end --------------------------------
+
+class Echo(Model):
+    def predict(self, payload):
+        return {"predictions": payload.get("instances", [])}
+
+
+def _door_contract(port):
+    """Same three assertions against either front-end: a client-minted
+    Traceparent is joined and echoed; garbage mints (never a 400); an
+    absent header mints too."""
+    url = f"http://127.0.0.1:{port}/v1/models/echo:predict"
+
+    def post(headers):
+        req = urllib.request.Request(
+            url, data=json.dumps({"instances": ["x"]}).encode(),
+            headers={"Content-Type": "application/json", **headers})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+
+    ctx = dtrace.mint()
+    status, obj = post({dtrace.TRACEPARENT_HEADER: ctx.wire()})
+    assert status == 200 and obj["trace_id"] == ctx.trace_id
+    spans = dtrace.store().spans_for(ctx.trace_id)
+    assert spans and spans[0]["name"] == "server"
+    assert spans[0]["parent_id"] == ctx.span_id  # joined, not re-rooted
+
+    status, obj = post({dtrace.TRACEPARENT_HEADER: "total-!garbage!"})
+    assert status == 200  # garbage mints, NEVER a 400
+    assert obj["trace_id"] and obj["trace_id"] != ctx.trace_id
+
+    status, obj = post({})
+    assert status == 200 and obj["trace_id"]
+
+
+def test_stdlib_door_joins_and_mints():
+    server = ModelServer([Echo("echo")], host="127.0.0.1", port=0)
+    server.load_all()
+    server.start()
+    try:
+        _door_contract(server.port)
+    finally:
+        server.stop()
+
+
+def test_native_door_joins_and_mints():
+    """Front-end parity: the native C front-end's raw header block
+    feeds the SAME door, so Traceparent join/mint/garbage behave
+    identically."""
+    assert native_server.available()
+    server = native_server.NativeModelServer(
+        [Echo("echo")], host="127.0.0.1", port=0)
+    server.load_all()
+    server.start()
+    try:
+        _door_contract(server.port)
+    finally:
+        server.stop()
+
+
+def test_payload_traceparent_field_honored():
+    """Headerless hops carry the context as a payload field; the door
+    honors it and rewrites it to its own span."""
+    server = ModelServer([Echo("echo")], host="127.0.0.1", port=0)
+    server.load_all()
+    ctx = dtrace.mint()
+    status, obj = server._route(
+        "POST", "/v1/models/echo:predict",
+        json.dumps({"instances": ["x"],
+                    "traceparent": ctx.wire()}).encode(), None)
+    assert status == 200 and obj["trace_id"] == ctx.trace_id
+
+
+# -- tail sampling end to end ------------------------------------------------
+
+def test_tail_sampling_drops_boring_keeps_interesting(service):
+    """head_sample=0: a plain request's trace is dropped at decide
+    time (404 at the assembler), a hedged one is retained — the
+    kct_trace decision counters account for both."""
+    from kubernetes_cloud_tpu import obs
+
+    fcfg = FleetConfig(dispatch_timeout_s=30.0, hedge_after_s=0.05,
+                       probe_interval_s=30.0)
+    router, replicas = make_fleet(service, 2, fcfg,
+                                  engine_kw={"slots": 1})
+    warm_all(replicas)
+    warm_http(replicas, "keep me")
+    router.start()
+    try:
+        dtrace.configure(head_sample=0.0, ttft_target_s=None,
+                         inter_token_target_s=None)
+        before = obs.render_text()
+        status, boring = _predict(router.port, "plain sailing", 3)
+        assert status == 200 and not boring["fleet"]["hedged"]
+        status, _404 = _get(router.port,
+                            f"/debug/trace/{boring['trace_id']}")
+        assert status == 404  # dropped at the router's decide
+
+        blocker = replicas[0].server.models["lm"].engine.submit(
+            [4, 4, 4], max_new_tokens=80, temperature=0.0)
+        status, hedged = _predict(router.port, "keep me", 3)
+        assert status == 200 and hedged["fleet"]["hedged"]
+        status, tree = _get(router.port,
+                            f"/debug/trace/{hedged['trace_id']}")
+        assert status == 200 and "hedged" in tree["keep"]
+
+        after = obs.render_text()
+        delta = lambda d: (obs.sample_value(  # noqa: E731
+            obs.parse_text(after), "kct_trace_traces_total",
+            {"decision": d}) or 0) - (obs.sample_value(
+                obs.parse_text(before), "kct_trace_traces_total",
+                {"decision": d}) or 0)
+        assert delta("dropped") >= 1
+        assert delta("kept_tail") >= 1
+        blocker.wait()
+    finally:
+        router.shutdown()
+
+
+# -- trace.export chaos containment -----------------------------------------
+
+def test_trace_export_raise_contained():
+    server = ModelServer([Echo("echo")], host="127.0.0.1", port=0)
+    server.load_all()
+    server.start()
+    try:
+        faults.install(faults.FaultInjector(
+            [FaultSpec("trace.export", mode="raise", at=1, times=1)]))
+        status, obj = _get(server.port, "/debug/trace")
+        assert status == 500  # contained to THIS debug request
+        # data plane and readiness never route through the export
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/models/echo:predict",
+            data=json.dumps({"instances": ["x"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        assert _get(server.port, "/healthz")[0] == 200
+        # fault exhausted: the export recovers
+        status, obj = _get(server.port, "/debug/trace")
+        assert status == 200 and "traces" in obj
+    finally:
+        server.stop()
+
+
+def test_trace_export_hang_parks_only_that_request():
+    server = ModelServer([Echo("echo")], host="127.0.0.1", port=0)
+    server.load_all()
+    server.start()
+    try:
+        faults.install(faults.FaultInjector(
+            [FaultSpec("trace.export", mode="hang", at=1, times=1,
+                       delay_s=30.0)]))
+        parked = {}
+
+        def debug_call():
+            parked["resp"] = _get(server.port, "/debug/trace",
+                                  timeout=60)
+        t = threading.Thread(target=debug_call, daemon=True)
+        t.start()
+        _wait_until(lambda: (faults.active() or object()) and
+                    faults.active().hits("trace.export") >= 1,
+                    what="export to park")
+        # the wedged export holds ONLY its own thread
+        t0 = time.monotonic()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/models/echo:predict",
+            data=json.dumps({"instances": ["x"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        assert _get(server.port, "/healthz")[0] == 200
+        assert time.monotonic() - t0 < 5.0
+        faults.uninstall()  # releases the parked export
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert parked["resp"][0] == 200
+    finally:
+        faults.uninstall()
+        server.stop()
+
+
+# -- load_test: client-minted traceparent cross-check ------------------------
+
+def test_load_test_minted_traces_echoed_and_worst_ttft(service):
+    model = ContinuousBatchingModel("lm", service,
+                                    EngineConfig(slots=2, max_len=96))
+    model.load()
+    server = ModelServer([model], host="127.0.0.1", port=0)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/v1/models/lm:predict"
+        payloads = [json.dumps(
+            {"instances": [f"load {i}"],
+             "parameters": {"max_new_tokens": 3,
+                            "temperature": 0.0}}).encode()
+            for i in range(6)]
+        summary = load_test.run_concurrent(url, payloads,
+                                           concurrency=3,
+                                           mint_trace=True)
+        assert summary.n_ok == 6
+        # every 2xx echoed exactly the trace id the client minted
+        check = load_test.check_trace(summary.results)
+        assert check == {"requests_2xx": 6, "missing_trace_id": 0,
+                         "mismatched_trace_id": 0, "ok": True}
+        ids = {r.trace_id for r in summary.results}
+        assert len(ids) == 6  # a DISTINCT trace per request
+        stats = summary.stats()
+        worst = stats["worst_ttft"]
+        assert 1 <= len(worst) <= 5
+        assert all(w["trace_id"] in ids for w in worst)
+        assert worst == sorted(worst, key=lambda w: -w["ttft_s"])
+    finally:
+        server.stop()
+        model.stop()
