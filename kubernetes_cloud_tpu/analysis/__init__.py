@@ -29,11 +29,18 @@ Rule families (see ``deploy/README.md`` § Static analysis):
 
 =============  ==========================================================
 ``KCT-LOCK``   no blocking work / fault points while holding a lock
+``KCT-RACE``   whole-program races, lock-order cycles, condition misuse
 ``KCT-JIT``    trace purity + donation discipline inside jitted programs
 ``KCT-REG``    fault-site / metric / span registry + docs-catalog drift
 ``KCT-ERR``    typed error taxonomy on the serving data plane
 ``KCT-MAN``    declarative rules over the ``deploy/**/*.yaml`` surface
 =============  ==========================================================
+
+``KCT-RACE`` is whole-program: it builds a cross-module concurrency
+model (:mod:`kubernetes_cloud_tpu.analysis.concurrency`) — thread
+roots resolved through partials/lambdas/bound methods into a call
+graph, majority-vote guarded-by inference per class attribute, a
+cross-method lock-order graph — still AST-only and jax-free.
 """
 
 from kubernetes_cloud_tpu.analysis.engine import (  # noqa: F401

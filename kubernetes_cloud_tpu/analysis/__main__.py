@@ -1,4 +1,9 @@
-"""``python -m kubernetes_cloud_tpu.analysis`` — the kct-lint CLI."""
+"""``python -m kubernetes_cloud_tpu.analysis`` — the kct-lint CLI.
+
+Same entry point as the ``kct-lint`` console script and
+``scripts/lint.py``; ``--changed [REF]`` is the documented pre-commit
+mode (see ``cli.py`` for the exit-code contract).
+"""
 
 import sys
 

@@ -1,4 +1,4 @@
-"""kct-lint command line — text/json output, baseline diff, exit codes.
+"""kct-lint command line — text/json/sarif output, baseline, exit codes.
 
 Exit codes (CI contract):
 
@@ -6,8 +6,13 @@ Exit codes (CI contract):
 * ``1`` — new findings (not baselined, not inline-suppressed)
 * ``2`` — NO new findings but stale baseline suppressions: a
   suppressed finding no longer fires, so the entry must be deleted
-  (the baseline only ever shrinks)
+  (``--prune-baseline`` deletes them for you, then exits 0/1)
 * ``3`` — usage/internal error
+
+``--changed [REF]`` is the pre-commit mode: the program model is still
+built whole-repo (KCT-RACE reasons across modules), but findings and
+stale-baseline checks are scoped to files changed vs REF plus
+untracked files, so the output only talks about your diff.
 
 ``python -m kubernetes_cloud_tpu.analysis``, the ``kct-lint`` console
 script, and ``scripts/lint.py`` all enter here, so CI and humans can
@@ -19,7 +24,9 @@ from __future__ import annotations
 import argparse
 import json
 import pathlib
+import subprocess
 import sys
+from collections import Counter
 from typing import Optional, Sequence
 
 from kubernetes_cloud_tpu.analysis.engine import (
@@ -29,6 +36,7 @@ from kubernetes_cloud_tpu.analysis.engine import (
     load_baseline,
     run,
     write_baseline,
+    write_baseline_entries,
 )
 
 
@@ -52,7 +60,8 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--root", default=None,
                    help="repository root (default: auto-detected from "
                         "the working directory)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--baseline", default=None,
                    help=f"baseline suppressions file (default: "
                         f"<root>/{BASELINE_FILE})")
@@ -61,12 +70,85 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="write all current findings to the baseline "
                         "file and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="rewrite the baseline file dropping stale "
+                        "suppressions, then report as usual (the "
+                        "pruned file round-trips to exit 0)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="pre-commit mode: report only findings in "
+                        "files changed vs REF (default HEAD) plus "
+                        "untracked files; the program model is still "
+                        "built whole-repo")
     p.add_argument("--select", default=None,
                    help="comma-separated rule ids or family prefixes "
                         "(e.g. KCT-LOCK,KCT-MAN-004)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog with rationale")
     return p
+
+
+def _changed_paths(root: pathlib.Path, ref: str) -> Optional[set[str]]:
+    """Repo-relative posix paths changed vs ``ref`` (tracked diff +
+    untracked files); None (usage error) when git fails."""
+    out: set[str] = set()
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True,
+                timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            print(f"kct-lint: --changed: {' '.join(cmd)} failed: {e}",
+                  file=sys.stderr)
+            return None
+        if proc.returncode != 0:
+            print(f"kct-lint: --changed: {' '.join(cmd)} failed: "
+                  f"{proc.stderr.strip()}", file=sys.stderr)
+            return None
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
+
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _sarif(findings) -> dict:
+    """SARIF 2.1.0 log for code-scanning upload: the full rule catalog
+    in the driver, one ``error``-level result per NEW finding."""
+    rules = all_rules()
+    index = {r.id: i for i, r in enumerate(rules)}
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "kct-lint",
+                "informationUri":
+                    "deploy/README.md#static-analysis-kct-lint",
+                "rules": [{
+                    "id": r.id,
+                    "shortDescription": {"text": r.title},
+                    "fullDescription": {"text": r.rationale},
+                    "defaultConfiguration": {"level": "error"},
+                } for r in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                # parse failures (KCT-AST) are not in the catalog
+                **({"ruleIndex": index[f.rule]}
+                   if f.rule in index else {}),
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                }}],
+            } for f in findings],
+        }],
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -102,7 +184,34 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
               "--select (it would truncate the baseline to the "
               "selected family)", file=sys.stderr)
         return 3
+    if args.prune_baseline and (select or args.changed is not None
+                                or args.no_baseline
+                                or args.write_baseline):
+        # pruning needs the FULL finding set diffed against the FULL
+        # baseline: any scoped view would misread out-of-scope entries
+        # as stale and delete live suppressions
+        print("kct-lint: --prune-baseline cannot be combined with "
+              "--select/--changed/--no-baseline/--write-baseline "
+              "(a scoped run would prune live suppressions)",
+              file=sys.stderr)
+        return 3
+    if args.write_baseline and args.changed is not None:
+        print("kct-lint: --write-baseline cannot be combined with "
+              "--changed (it would truncate the baseline to the "
+              "changed files)", file=sys.stderr)
+        return 3
+
+    changed_paths: Optional[set[str]] = None
+    if args.changed is not None:
+        changed_paths = _changed_paths(root, args.changed)
+        if changed_paths is None:
+            return 3
+
     findings = run(root, select=select)
+    if changed_paths is not None:
+        # the model above was still built whole-repo (cross-module
+        # races need it); only the REPORTING is diff-scoped
+        findings = [f for f in findings if f.path in changed_paths]
 
     baseline_path = pathlib.Path(args.baseline) if args.baseline \
         else root / BASELINE_FILE
@@ -125,9 +234,30 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
         entries = [e for e in entries
                    if any(e["rule"] == s or e["rule"].startswith(s)
                           for s in select)]
+    if changed_paths is not None:
+        # likewise, only entries for changed files can be stale in a
+        # diff-scoped run
+        entries = [e for e in entries if e["path"] in changed_paths]
     new, stale = apply_baseline(findings, entries)
 
-    if args.format == "json":
+    if args.prune_baseline and stale:
+        drop = Counter(f"{e['rule']}|{e['path']}|{e['message']}"
+                       for e in stale)
+        kept = []
+        for e in entries:
+            key = f"{e['rule']}|{e['path']}|{e['message']}"
+            if drop.get(key, 0) > 0:
+                drop[key] -= 1
+                continue
+            kept.append(e)
+        write_baseline_entries(baseline_path, kept)
+        print(f"kct-lint: pruned {len(stale)} stale suppression(s) "
+              f"from {baseline_path}; {len(kept)} remain")
+        stale = []
+
+    if args.format == "sarif":
+        print(json.dumps(_sarif(new), indent=2))
+    elif args.format == "json":
         print(json.dumps({
             "root": str(root),
             "findings": [f.to_dict() for f in new],

@@ -1,0 +1,1049 @@
+"""Whole-program concurrency model for the KCT-RACE rule family.
+
+The serve plane is a web of cooperating threads — the continuous-
+batching scheduler, the fleet prober, the autoscaler control loop and
+its spawner/drainer threads, the supervisor watchdog, HTTP handler
+threads — all mutating shared object state.  The per-file rules
+(KCT-LOCK) can check what happens *inside* a lock body; this module
+builds the cross-module model needed to check the inverse: which state
+is shared between which threads, and which lock (if any) the code
+itself treats as that state's guard.
+
+The model is RacerD-style and purely syntactic (AST only, never
+imports jax or the analyzed code):
+
+* **thread roots** — every site where a callable escapes to another
+  thread: ``threading.Thread(target=…)``, ``threading.Timer``,
+  ``Executor.submit(fn, …)``, plus the HTTP-handler entry points
+  (``handle``/``do_*`` methods), which are *concurrent with
+  themselves* (many handler threads run the same root).
+* **call graph** — name-based, package-internal resolution:
+  ``self.m()`` through the class chain *and* subclass overrides,
+  ``mod.f()`` through import aliases, ``self._attr.m()`` through
+  attribute types inferred from ``self._attr = ClassName(…)``
+  assignments, ``functools.partial``/lambdas unwrapped.  Dynamic
+  dispatch we cannot resolve is dropped (under-approximate), so
+  reachability errs toward *fewer* reported races.
+* **guarded-by inference** — for every ``self._attr`` of every class,
+  each access is recorded with the set of locks lexically held.  The
+  majority lock among guarded accesses is the attr's inferred guard
+  (``__init__`` accesses excluded: the object is not yet published).
+* **lock-order graph** — an edge A→B whenever B is acquired (directly
+  or via a resolved call) while A is held; cycles are potential ABBA
+  deadlocks.
+* **condition discipline** — ``Condition.wait`` sites with their
+  enclosing-loop context and ``notify`` sites with their lexical lock
+  context, for the wait-without-predicate-loop / notify-outside-lock
+  rules.
+
+Everything here is *model*; judgement (thresholds, rule ids, messages)
+lives in :mod:`kubernetes_cloud_tpu.analysis.rules.races`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional, Union
+
+from kubernetes_cloud_tpu.analysis.engine import PyModule, Repo, dotted
+
+FuncKey = tuple[str, str]    # (module rel path, qualname)
+ClassKey = tuple[str, str]   # (module rel path, class name)
+
+#: receiver-name fragments that mark a ``with`` item as a lock even
+#: when the attribute's constructor assignment was not seen
+_LOCKY = ("lock", "mutex")
+
+#: constructors that create a lock / condition / mutable container
+_LOCK_CTORS = ("Lock", "RLock")
+_COND_CTORS = ("Condition",)
+_MUTABLE_CTORS = ("list", "dict", "set", "deque", "defaultdict",
+                  "OrderedDict", "Counter")
+
+#: container method calls that mutate the receiver's contents —
+#: treated as writes to the attribute for guard inference
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear",
+    "update", "setdefault", "rotate", "sort", "reverse"})
+
+#: HTTP-handler entry points: every request runs one of these on its
+#: own handler thread, so the root is concurrent with itself
+_HTTP_ROOT_NAMES = ("handle", "do_GET", "do_POST", "do_PUT",
+                    "do_DELETE", "do_HEAD")
+
+_EXECUTOR_HINTS = ("pool", "executor", "_ex")
+
+#: method names too generic for the unique-definition fallback — they
+#: collide with stdlib/container methods on unresolvable receivers
+_GENERIC_METHODS = frozenset({
+    "get", "put", "set", "pop", "add", "remove", "clear", "update",
+    "append", "extend", "insert", "items", "keys", "values", "copy",
+    "sort", "index", "count", "join", "split", "strip", "format",
+    "encode", "decode", "read", "write", "flush", "close", "open",
+    "send", "recv", "connect", "shutdown", "start", "stop", "run",
+    "submit", "result", "done", "cancel", "wait", "notify", "acquire",
+    "release", "lock", "unlock", "reset", "next", "send_response",
+    "end_headers", "log_message", "getvalue", "total_seconds"})
+
+
+@dataclasses.dataclass(frozen=True)
+class LockId:
+    """Identity of one lock object: the class (or module) that owns the
+    attribute, plus the attribute name.  A subclass acquiring an
+    inherited ``self._lock`` unifies with the base class's id."""
+
+    rel: str
+    owner: Optional[str]    # class name; None = module-level
+    attr: str
+
+    def __str__(self) -> str:
+        if self.owner:
+            return f"{self.owner}.{self.attr}"
+        return f"{self.rel.rsplit('/', 1)[-1]}:{self.attr}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadRoot:
+    """One place a callable escapes to another thread of control."""
+
+    kind: str                    # thread | timer | executor | http
+    rel: str
+    line: int
+    entry: Optional[FuncKey]
+    name: str                    # display: "<rel>:<qualname>"
+
+    @property
+    def concurrent(self) -> bool:
+        """True when many instances of this root run at once (HTTP
+        handler threads, executor pools) — the root races itself."""
+        return self.kind in ("http", "executor")
+
+
+@dataclasses.dataclass
+class Access:
+    """One syntactic touch of ``self.<attr>`` inside a method."""
+
+    attr: str
+    kind: str                    # read | write
+    rmw: bool                    # +=, x = f(x), check-then-set
+    rel: str
+    line: int
+    fkey: FuncKey
+    locks: frozenset[LockId]
+
+
+@dataclasses.dataclass
+class LeakSite:
+    """``return self._attr`` / ``yield self._attr`` under a lock."""
+
+    attr: str
+    rel: str
+    line: int
+    fkey: FuncKey
+    locks: frozenset[LockId]
+
+
+@dataclasses.dataclass
+class CondOp:
+    """One ``.wait()`` / ``.notify()`` on an inferred Condition."""
+
+    op: str                      # wait | wait_for | notify | notify_all
+    cond: LockId
+    rel: str
+    line: int
+    fkey: FuncKey
+    in_loop: bool                # lexically inside a while/for
+    holds_cond: bool             # condition lock lexically held
+
+
+@dataclasses.dataclass
+class CallSite:
+    callee: FuncKey
+    line: int
+    locks: frozenset[LockId]
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    fkey: FuncKey
+    node: Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+    rel: str
+    qualname: str
+    class_key: Optional[ClassKey] = None
+
+    @property
+    def method_name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    ckey: ClassKey
+    bases: list[str] = dataclasses.field(default_factory=list)
+    methods: dict[str, FuncKey] = dataclasses.field(default_factory=dict)
+    lock_attrs: set[str] = dataclasses.field(default_factory=set)
+    cond_attrs: set[str] = dataclasses.field(default_factory=set)
+    mutable_attrs: set[str] = dataclasses.field(default_factory=set)
+    plain_attrs: set[str] = dataclasses.field(default_factory=set)
+    #: self._x = ClassName(...) → {_x: {ClassKey, ...}}
+    attr_types: dict[str, set[ClassKey]] = dataclasses.field(
+        default_factory=dict)
+
+
+class ProgramModel:
+    """The assembled whole-program view.  Build via
+    :func:`build_model` (or ``Repo.program()``, which caches)."""
+
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        self.functions: dict[FuncKey, FunctionInfo] = {}
+        self.classes: dict[ClassKey, ClassInfo] = {}
+        self.roots: list[ThreadRoot] = []
+        self.calls: dict[FuncKey, list[CallSite]] = {}
+        self.accesses: dict[tuple[ClassKey, str], list[Access]] = {}
+        self.leaks: list[LeakSite] = []
+        self.cond_ops: list[CondOp] = []
+        #: direct lock acquisitions per function: [(lock, line)]
+        self.acquires: dict[FuncKey, list[tuple[LockId, int]]] = {}
+        #: lock-order edges: (held, acquired, rel, line, via)
+        self.lock_edges: list[tuple[LockId, LockId, str, int, str]] = []
+        #: function -> indices into ``roots`` that reach it
+        self.roots_reaching: dict[FuncKey, set[int]] = {}
+        #: locks provably held at EVERY known call site (fixpoint)
+        self.always_held: dict[FuncKey, frozenset[LockId]] = {}
+        # internal indexes
+        self._node_fkey: dict[int, FuncKey] = {}
+        self._class_by_name: dict[str, list[ClassKey]] = {}
+        self._methods_by_name: dict[str, list[FuncKey]] = {}
+        self._module_locks: dict[str, set[str]] = {}
+        self._module_conds: dict[str, set[str]] = {}
+        self._module_aliases: dict[str, dict[str, str]] = {}
+
+    # -- class hierarchy ---------------------------------------------------
+
+    def chain(self, ckey: ClassKey) -> list[ClassKey]:
+        """The class plus its resolvable base classes, base-first
+        lookup order (an approximation of the MRO)."""
+        out, seen, work = [], set(), [ckey]
+        while work:
+            ck = work.pop(0)
+            if ck in seen or ck not in self.classes:
+                continue
+            seen.add(ck)
+            out.append(ck)
+            for base in self.classes[ck].bases:
+                resolved = self._resolve_class_name(ck[0], base)
+                if resolved is not None:
+                    work.append(resolved)
+        return out
+
+    def subclasses(self, ckey: ClassKey) -> set[ClassKey]:
+        out: set[ClassKey] = set()
+        for ck, info in self.classes.items():
+            if ck == ckey:
+                continue
+            if ckey in self.chain(ck)[1:]:
+                out.add(ck)
+        return out
+
+    def _resolve_class_name(self, rel: str, name: str
+                            ) -> Optional[ClassKey]:
+        simple = name.rsplit(".", 1)[-1]
+        if (rel, simple) in self.classes:
+            return (rel, simple)
+        mod = self.repo.module(rel)
+        if mod is not None:
+            src = mod.import_sources().get(simple)
+            if src and src.startswith(Repo.PACKAGE):
+                target = _module_rel(self.repo, src)
+                if target and (target, simple) in self.classes:
+                    return (target, simple)
+        candidates = self._class_by_name.get(simple, [])
+        if len(candidates) == 1:
+            return candidates[0]
+        return None
+
+    # -- guard inference ---------------------------------------------------
+
+    def lock_owner(self, ckey: ClassKey, attr: str) -> LockId:
+        """Unify an acquired ``self.<attr>`` with the class in the
+        chain that constructs it, so base and subclass acquisitions of
+        an inherited lock compare equal."""
+        for ck in self.chain(ckey):
+            info = self.classes[ck]
+            if attr in info.lock_attrs or attr in info.cond_attrs:
+                return LockId(ck[0], ck[1], attr)
+        return LockId(ckey[0], ckey[1], attr)
+
+    def inferred_guard(self, ckey: ClassKey, attr: str
+                       ) -> Optional[LockId]:
+        """The majority lock among guarded accesses, provided the
+        discipline is real: at least two accesses hold the winner and
+        at least half of ALL (non-``__init__``) accesses hold *some*
+        lock.  Attrs the code deliberately touches lock-free (the
+        GIL-atomic counter idiom) therefore infer no guard and stay
+        quiet."""
+        accs = self.accesses.get((ckey, attr), [])
+        if not accs:
+            return None
+        counts: dict[LockId, int] = {}
+        guarded = 0
+        for a in accs:
+            if a.locks:
+                guarded += 1
+                for lock in a.locks:
+                    counts[lock] = counts.get(lock, 0) + 1
+        if not counts:
+            return None
+        winner = max(counts, key=lambda k: (counts[k], str(k)))
+        if counts[winner] < 2 or guarded * 2 < len(accs):
+            return None
+        return winner
+
+    def attr_roots(self, ckey: ClassKey, attr: str) -> set[int]:
+        out: set[int] = set()
+        for a in self.accesses.get((ckey, attr), []):
+            out |= self.roots_reaching.get(a.fkey, set())
+        return out
+
+    def racy(self, root_idxs: Iterable[int]) -> bool:
+        idxs = set(root_idxs)
+        if len(idxs) >= 2:
+            return True
+        return any(self.roots[i].concurrent for i in idxs)
+
+    def root_names(self, root_idxs: Iterable[int]) -> list[str]:
+        return sorted(self.roots[i].name for i in set(root_idxs))
+
+
+def _module_rel(repo: Repo, module_dotted: str) -> Optional[str]:
+    rel = module_dotted.replace(".", "/") + ".py"
+    if repo.module(rel) is not None:
+        return rel
+    rel = module_dotted.replace(".", "/") + "/__init__.py"
+    if repo.module(rel) is not None:
+        return rel
+    return None
+
+
+def _ctor_name(value: ast.AST) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        name = dotted(value.func)
+        return name.rsplit(".", 1)[-1] if name else None
+    return None
+
+
+def _is_mutable_literal(value: ast.AST) -> bool:
+    if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    return _ctor_name(value) in _MUTABLE_CTORS
+
+
+# ---------------------------------------------------------------------------
+# pass 1: index classes, functions, module-level locks
+# ---------------------------------------------------------------------------
+
+class _Indexer(ast.NodeVisitor):
+    def __init__(self, model: ProgramModel, rel: str):
+        self.model = model
+        self.rel = rel
+        self.stack: list[str] = []
+        self.class_stack: list[ClassKey] = []
+
+    def _register_function(self, node, name: str) -> None:
+        qual = ".".join((*self.stack, name))
+        fkey = (self.rel, qual)
+        cls = self.class_stack[-1] if self.class_stack else None
+        # only direct methods register in the method table; nested
+        # defs/lambdas still keep the class key because they close
+        # over ``self`` of the enclosing instance
+        is_method = bool(cls) and self.stack \
+            and self.stack[-1] == cls[1]
+        info = FunctionInfo(fkey, node, self.rel, qual, cls)
+        self.model.functions[fkey] = info
+        self.model._node_fkey[id(node)] = fkey
+        if is_method:
+            self.model.classes[cls].methods.setdefault(name, fkey)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._register_function(node, node.name)
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._register_function(node, f"<lambda:{node.lineno}>")
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        ckey = (self.rel, node.name)
+        info = ClassInfo(ckey, bases=[dotted(b) or "" for b in node.bases])
+        self.model.classes[ckey] = info
+        self.model._class_by_name.setdefault(node.name, []).append(ckey)
+        self.class_stack.append(ckey)
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+        self.class_stack.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # module-level `_LOCK = threading.Lock()` (no enclosing def)
+        if not self.stack:
+            ctor = _ctor_name(node.value)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    if ctor in _LOCK_CTORS:
+                        self.model._module_locks.setdefault(
+                            self.rel, set()).add(tgt.id)
+                    elif ctor in _COND_CTORS:
+                        self.model._module_conds.setdefault(
+                            self.rel, set()).add(tgt.id)
+        # `self.X = <expr>` inside a method of the innermost class
+        if self.class_stack:
+            cls = self.model.classes[self.class_stack[-1]]
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    self._classify_attr(cls, tgt.attr, node.value)
+        self.generic_visit(node)
+
+    def _classify_attr(self, cls: ClassInfo, attr: str,
+                       value: ast.AST) -> None:
+        ctor = _ctor_name(value)
+        if ctor in _LOCK_CTORS:
+            cls.lock_attrs.add(attr)
+        elif ctor in _COND_CTORS:
+            cls.cond_attrs.add(attr)
+        elif _is_mutable_literal(value):
+            cls.mutable_attrs.add(attr)
+        else:
+            cls.plain_attrs.add(attr)
+            if isinstance(value, ast.Call):
+                name = dotted(value.func)
+                simple = name.rsplit(".", 1)[-1] if name else None
+                if simple and simple[:1].isupper():
+                    resolved = self.model._resolve_class_name(
+                        self.rel, simple)
+                    if resolved is not None:
+                        cls.attr_types.setdefault(
+                            attr, set()).add(resolved)
+
+
+def _index_imports(model: ProgramModel, rel: str, mod: PyModule) -> None:
+    """Local name -> package module path, for ``mod.f()`` resolution."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith(Repo.PACKAGE):
+                    aliases[alias.asname or alias.name.split(".")[0]] \
+                        = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                candidate = f"{node.module}.{alias.name}"
+                if candidate.startswith(Repo.PACKAGE) \
+                        and _module_rel(model.repo, candidate):
+                    aliases[alias.asname or alias.name] = candidate
+    model._module_aliases[rel] = aliases
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-function body scan (accesses, calls, roots, locks, conds)
+# ---------------------------------------------------------------------------
+
+class _BodyScanner:
+    """Scans ONE function body, stopping at nested defs/lambdas (they
+    are scanned as their own functions), tracking lexical lock and
+    loop context."""
+
+    def __init__(self, model: ProgramModel, info: FunctionInfo):
+        self.model = model
+        self.info = info
+        self.mod = model.repo.module(info.rel)
+
+    # -- resolution --------------------------------------------------------
+
+    def _fkey_for_node(self, node: ast.AST) -> Optional[FuncKey]:
+        return self.model._node_fkey.get(id(node))
+
+    def resolve_callable(self, node: ast.AST) -> list[FuncKey]:
+        """Best-effort static resolution of a callable expression to
+        package function keys."""
+        if isinstance(node, ast.Lambda):
+            fkey = self._fkey_for_node(node)
+            return [fkey] if fkey else []
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in ("functools.partial", "partial") and node.args:
+                return self.resolve_callable(node.args[0])
+            return []
+        if isinstance(node, ast.Name):
+            return self._resolve_name(node.id)
+        if isinstance(node, ast.Attribute):
+            return self._resolve_attribute(node)
+        return []
+
+    def _resolve_name(self, name: str) -> list[FuncKey]:
+        local = self.mod.defs_by_name().get(name)
+        if local is not None:
+            fkey = self._fkey_for_node(local)
+            return [fkey] if fkey else []
+        ck = self.model._resolve_class_name(self.info.rel, name)
+        if ck is not None:
+            init = self.model.classes[ck].methods.get("__init__")
+            return [init] if init else []
+        src = self.mod.import_sources().get(name)
+        if src and src.startswith(Repo.PACKAGE):
+            target_rel = _module_rel(self.model.repo, src)
+            if target_rel:
+                target_mod = self.model.repo.module(target_rel)
+                target = target_mod.defs_by_name().get(name)
+                if target is not None:
+                    fkey = self._fkey_for_node(target)
+                    return [fkey] if fkey else []
+        return []
+
+    def _resolve_attribute(self, node: ast.Attribute) -> list[FuncKey]:
+        base = node.value
+        meth = node.attr
+        # self.m() / self._attr.m()
+        if isinstance(base, ast.Name) and base.id == "self" \
+                and self.info.class_key:
+            return self._resolve_method(self.info.class_key, meth)
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and self.info.class_key:
+            out: list[FuncKey] = []
+            for ck in self.model.chain(self.info.class_key):
+                types = self.model.classes[ck].attr_types.get(
+                    base.attr, ())
+                for tck in types:
+                    out.extend(self._resolve_method(tck, meth))
+            if out:
+                return out
+            return self._resolve_unique_method(meth)
+        # mod.f() through an import alias
+        if isinstance(base, ast.Name):
+            aliased = self.model._module_aliases.get(
+                self.info.rel, {}).get(base.id)
+            if aliased:
+                target_rel = _module_rel(self.model.repo, aliased)
+                if target_rel:
+                    target_mod = self.model.repo.module(target_rel)
+                    target = target_mod.defs_by_name().get(meth)
+                    if target is not None:
+                        fkey = self._fkey_for_node(target)
+                        return [fkey] if fkey else []
+            # ClassName.method(...)
+            ck = self.model._resolve_class_name(self.info.rel, base.id)
+            if ck is not None:
+                return self._resolve_method(ck, meth)
+        # the receiver is a local/loop variable we cannot type: fall
+        # back to the method name IF the package defines it exactly
+        # once and it is not a generic container/stdlib name
+        return self._resolve_unique_method(meth)
+
+    def _resolve_unique_method(self, meth: str) -> list[FuncKey]:
+        if meth in _GENERIC_METHODS:
+            return []
+        candidates = self.model._methods_by_name.get(meth, [])
+        if len(candidates) == 1:
+            return list(candidates)
+        return []
+
+    def _resolve_method(self, ckey: ClassKey, meth: str
+                        ) -> list[FuncKey]:
+        """Method in the class chain, plus overrides in subclasses
+        (``self`` may be a subclass instance at runtime)."""
+        out: list[FuncKey] = []
+        for ck in self.model.chain(ckey):
+            fkey = self.model.classes[ck].methods.get(meth)
+            if fkey is not None:
+                out.append(fkey)
+                break
+        for sub in self.model.subclasses(ckey):
+            fkey = self.model.classes[sub].methods.get(meth)
+            if fkey is not None:
+                out.append(fkey)
+        return out
+
+    # -- lock identification -----------------------------------------------
+
+    def lock_for_expr(self, expr: ast.AST) -> Optional[LockId]:
+        name = dotted(expr)
+        if name is None:
+            return None
+        if name.startswith("self.") and "." not in name[5:] \
+                and self.info.class_key:
+            attr = name[5:]
+            for ck in self.model.chain(self.info.class_key):
+                info = self.model.classes[ck]
+                if attr in info.lock_attrs or attr in info.cond_attrs:
+                    return self.model.lock_owner(
+                        self.info.class_key, attr)
+            if any(tag in attr.lower() for tag in _LOCKY):
+                return self.model.lock_owner(self.info.class_key, attr)
+            return None
+        if "." not in name:
+            if name in self.model._module_locks.get(self.info.rel, ()):
+                return LockId(self.info.rel, None, name)
+            if name in self.model._module_conds.get(self.info.rel, ()):
+                return LockId(self.info.rel, None, name)
+            if any(tag in name.lower() for tag in _LOCKY):
+                return LockId(self.info.rel, None, name)
+        return None
+
+    def _cond_for_expr(self, expr: ast.AST) -> Optional[LockId]:
+        name = dotted(expr)
+        if name is None:
+            return None
+        if name.startswith("self.") and "." not in name[5:] \
+                and self.info.class_key:
+            attr = name[5:]
+            for ck in self.model.chain(self.info.class_key):
+                if attr in self.model.classes[ck].cond_attrs:
+                    return self.model.lock_owner(
+                        self.info.class_key, attr)
+        elif "." not in name and name in self.model._module_conds.get(
+                self.info.rel, ()):
+            return LockId(self.info.rel, None, name)
+        return None
+
+    # -- the scan ----------------------------------------------------------
+
+    def scan(self) -> None:
+        node = self.info.node
+        if isinstance(node, ast.Lambda):
+            # a lambda body is a bare expression
+            self._scan_expr(node.body, frozenset(), frozenset())
+            return
+        self._scan_stmts(node.body, frozenset(), in_loop=False,
+                         rmw_attrs=frozenset())
+
+    def _scan_stmts(self, stmts, locks: frozenset[LockId],
+                    in_loop: bool, rmw_attrs: frozenset[str]) -> None:
+        for stmt in stmts:
+            self._scan_stmt(stmt, locks, in_loop, rmw_attrs)
+
+    def _scan_stmt(self, node: ast.AST, locks: frozenset[LockId],
+                   in_loop: bool, rmw_attrs: frozenset[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return   # scanned as its own function / class
+        if isinstance(node, ast.With):
+            acquired: list[LockId] = []
+            for item in node.items:
+                self._scan_expr(item.context_expr, locks, rmw_attrs)
+                lock = self.lock_for_expr(item.context_expr)
+                if lock is not None and lock not in locks:
+                    acquired.append(lock)
+                    self.model.acquires.setdefault(
+                        self.info.fkey, []).append((lock, node.lineno))
+                    for held in locks:
+                        if held != lock:
+                            self.model.lock_edges.append(
+                                (held, lock, self.info.rel,
+                                 node.lineno, "nested with"))
+            self._scan_stmts(node.body, locks | frozenset(acquired),
+                             in_loop, rmw_attrs)
+            return
+        if isinstance(node, (ast.While, ast.For)):
+            if isinstance(node, ast.While):
+                self._scan_expr(node.test, locks, rmw_attrs)
+            else:
+                self._scan_expr(node.iter, locks, rmw_attrs)
+                self._scan_target(node.target, locks)
+            self._scan_stmts(node.body, locks, True, rmw_attrs)
+            self._scan_stmts(node.orelse, locks, in_loop, rmw_attrs)
+            return
+        if isinstance(node, ast.If):
+            self._scan_expr(node.test, locks, rmw_attrs)
+            # check-then-set: a write in the branch to an attr the test
+            # just read is one read-modify-write spanning both
+            tested = {n.attr for n in ast.walk(node.test)
+                      if isinstance(n, ast.Attribute)
+                      and isinstance(n.value, ast.Name)
+                      and n.value.id == "self"}
+            self._scan_stmts(node.body, locks, in_loop,
+                             rmw_attrs | frozenset(tested))
+            self._scan_stmts(node.orelse, locks, in_loop, rmw_attrs)
+            return
+        if isinstance(node, ast.Try):
+            self._scan_stmts(node.body, locks, in_loop, rmw_attrs)
+            for handler in node.handlers:
+                self._scan_stmts(handler.body, locks, in_loop,
+                                 rmw_attrs)
+            self._scan_stmts(node.orelse, locks, in_loop, rmw_attrs)
+            self._scan_stmts(node.finalbody, locks, in_loop, rmw_attrs)
+            return
+        if isinstance(node, (ast.Return, ast.Expr)) \
+                and getattr(node, "value", None) is not None:
+            value = node.value
+            if isinstance(node, ast.Return) or isinstance(value,
+                                                          ast.Yield):
+                leaked = value.value if isinstance(value, ast.Yield) \
+                    else value
+                if locks and isinstance(leaked, ast.Attribute) \
+                        and isinstance(leaked.value, ast.Name) \
+                        and leaked.value.id == "self":
+                    self.model.leaks.append(LeakSite(
+                        leaked.attr, self.info.rel, node.lineno,
+                        self.info.fkey, locks))
+            self._scan_expr(value, locks, rmw_attrs)
+            return
+        if isinstance(node, ast.Assign):
+            reads = {n.attr for n in ast.walk(node.value)
+                     if isinstance(n, ast.Attribute)
+                     and isinstance(n.value, ast.Name)
+                     and n.value.id == "self"}
+            self._scan_expr(node.value, locks, rmw_attrs)
+            for tgt in node.targets:
+                self._scan_target(tgt, locks,
+                                  rmw_attrs | frozenset(reads))
+            return
+        if isinstance(node, ast.AugAssign):
+            self._scan_expr(node.value, locks, rmw_attrs)
+            self._scan_target(node.target, locks, None, force_rmw=True)
+            return
+        if isinstance(node, (ast.AnnAssign,)) and node.value is not None:
+            self._scan_expr(node.value, locks, rmw_attrs)
+            if node.target is not None:
+                self._scan_target(node.target, locks, rmw_attrs)
+            return
+        # generic: scan expressions, recurse into compound bodies
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, locks, rmw_attrs)
+            elif isinstance(child, ast.stmt):
+                self._scan_stmt(child, locks, in_loop, rmw_attrs)
+            elif isinstance(child, (ast.excepthandler,)):
+                self._scan_stmts(child.body, locks, in_loop, rmw_attrs)
+
+    def _scan_target(self, tgt: ast.AST, locks: frozenset[LockId],
+                     rmw_attrs: Optional[frozenset[str]] = None,
+                     force_rmw: bool = False) -> None:
+        rmw_attrs = rmw_attrs or frozenset()
+        if isinstance(tgt, ast.Attribute) \
+                and isinstance(tgt.value, ast.Name) \
+                and tgt.value.id == "self":
+            self._record_access(tgt.attr, "write",
+                                force_rmw or tgt.attr in rmw_attrs,
+                                tgt.lineno, locks)
+            return
+        if isinstance(tgt, ast.Subscript):
+            # self._d[k] = v mutates _d's contents
+            if isinstance(tgt.value, ast.Attribute) \
+                    and isinstance(tgt.value.value, ast.Name) \
+                    and tgt.value.value.id == "self":
+                self._record_access(
+                    tgt.value.attr, "write",
+                    force_rmw or tgt.value.attr in rmw_attrs,
+                    tgt.lineno, locks)
+            else:
+                self._scan_expr(tgt.value, locks, frozenset())
+            self._scan_expr(tgt.slice, locks, frozenset())
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._scan_target(elt, locks, rmw_attrs, force_rmw)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._scan_target(tgt.value, locks, rmw_attrs, force_rmw)
+
+    def _scan_expr(self, node: Optional[ast.AST],
+                   locks: frozenset[LockId],
+                   rmw_attrs: frozenset[str]) -> None:
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Lambda,)):
+                continue
+            if isinstance(sub, ast.Call):
+                self._scan_call(sub, locks)
+            elif isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == "self" \
+                    and isinstance(sub.ctx, ast.Load):
+                self._record_access(sub.attr, "read", False,
+                                    sub.lineno, locks)
+
+    def _scan_call(self, call: ast.Call, locks: frozenset[LockId]
+                   ) -> None:
+        func = call.func
+        name = dotted(func)
+        # thread roots
+        self._maybe_root(call, name)
+        # condition ops + container mutation through self._attr.m()
+        if isinstance(func, ast.Attribute):
+            if func.attr in ("wait", "wait_for", "notify",
+                             "notify_all"):
+                cond = self._cond_for_expr(func.value)
+                if cond is not None:
+                    self.model.cond_ops.append(CondOp(
+                        func.attr, cond, self.info.rel, call.lineno,
+                        self.info.fkey,
+                        in_loop=self._in_loop_at(call),
+                        holds_cond=cond in locks))
+            if isinstance(func.value, ast.Attribute) \
+                    and isinstance(func.value.value, ast.Name) \
+                    and func.value.value.id == "self" \
+                    and func.attr in _MUTATORS:
+                self._record_access(func.value.attr, "write", False,
+                                    call.lineno, locks)
+        # call-graph edges
+        for callee in self.resolve_callable(func):
+            self.model.calls.setdefault(self.info.fkey, []).append(
+                CallSite(callee, call.lineno, locks))
+
+    # in_loop is tracked statement-wise in _scan_stmt; expression-level
+    # calls need it too, so remember loop extents up front
+    def _in_loop_at(self, node: ast.AST) -> bool:
+        if self._loop_spans is None:
+            self._loop_spans = []
+            for n in ast.walk(self.info.node):
+                if isinstance(n, (ast.While, ast.For)):
+                    end = getattr(n, "end_lineno", n.lineno)
+                    self._loop_spans.append((n.lineno, end))
+        return any(lo <= node.lineno <= hi
+                   for lo, hi in self._loop_spans)
+
+    _loop_spans: Optional[list[tuple[int, int]]] = None
+
+    def _record_access(self, attr: str, kind: str, rmw: bool,
+                       line: int, locks: frozenset[LockId]) -> None:
+        ck = self.info.class_key
+        if ck is None:
+            return
+        if self.info.method_name == "__init__":
+            return   # pre-publication: not yet shared
+        for chain_ck in self.model.chain(ck):
+            info = self.model.classes[chain_ck]
+            if attr in info.lock_attrs or attr in info.cond_attrs:
+                return   # the lock itself is not guarded state
+        # attribute identity: the chain class that initializes it,
+        # else the accessing class itself — unifies base/sub accesses
+        owner = self._attr_home(ck, attr)
+        self.model.accesses.setdefault((owner, attr), []).append(
+            Access(attr, kind, rmw, self.info.rel, line,
+                   self.info.fkey, locks))
+
+    def _attr_home(self, ckey: ClassKey, attr: str) -> ClassKey:
+        for ck in self.model.chain(ckey):
+            info = self.model.classes[ck]
+            if (attr in info.mutable_attrs or attr in info.attr_types
+                    or attr in info.plain_attrs):
+                return ck
+        return ckey
+
+    # -- thread roots ------------------------------------------------------
+
+    def _maybe_root(self, call: ast.Call, name: Optional[str]) -> None:
+        if name is None:
+            return
+        simple = name.rsplit(".", 1)[-1]
+        target_expr: Optional[ast.AST] = None
+        kind = None
+        if simple == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target_expr, kind = kw.value, "thread"
+        elif simple == "Timer":
+            kind = "timer"
+            if len(call.args) >= 2:
+                target_expr = call.args[1]
+            for kw in call.keywords:
+                if kw.arg == "function":
+                    target_expr = kw.value
+        elif simple == "submit" and isinstance(call.func, ast.Attribute):
+            recv = dotted(call.func.value) or ""
+            recv_l = recv.lower()
+            if any(h in recv_l for h in _EXECUTOR_HINTS) and call.args:
+                target_expr, kind = call.args[0], "executor"
+        if target_expr is None or kind is None:
+            return
+        for entry in self.resolve_callable(target_expr):
+            qual = self.model.functions[entry].qualname
+            self.model.roots.append(ThreadRoot(
+                kind, self.info.rel, call.lineno, entry,
+                f"{self.model.functions[entry].rel}:{qual}"))
+
+
+# ---------------------------------------------------------------------------
+# assembly
+# ---------------------------------------------------------------------------
+
+def build_model(repo: Repo) -> ProgramModel:
+    model = ProgramModel(repo)
+    modules = repo.py_modules()
+    for rel, mod in modules.items():
+        _Indexer(model, rel).visit(mod.tree)
+        _index_imports(model, rel, mod)
+    # HTTP-handler roots: shared front-end entry + stdlib do_* methods
+    for fkey, info in model.functions.items():
+        if info.class_key and info.method_name in _HTTP_ROOT_NAMES:
+            model.roots.append(ThreadRoot(
+                "http", info.rel, info.node.lineno, fkey,
+                f"{info.rel}:{info.qualname}"))
+    for cinfo in model.classes.values():
+        for mname, fkey in cinfo.methods.items():
+            model._methods_by_name.setdefault(mname, []).append(fkey)
+    for info in list(model.functions.values()):
+        _BodyScanner(model, info).scan()
+    _dedupe_roots(model)
+    _compute_reachability(model)
+    _compute_always_held(model)
+    _apply_effective_locks(model)
+    _interprocedural_lock_edges(model)
+    return model
+
+
+def _dedupe_roots(model: ProgramModel) -> None:
+    seen: set[tuple[str, Optional[FuncKey]]] = set()
+    uniq: list[ThreadRoot] = []
+    for root in model.roots:
+        key = (root.kind, root.entry)
+        if key in seen:
+            continue
+        seen.add(key)
+        uniq.append(root)
+    model.roots = uniq
+
+
+def _compute_reachability(model: ProgramModel) -> None:
+    for idx, root in enumerate(model.roots):
+        if root.entry is None:
+            continue
+        work, seen = [root.entry], {root.entry}
+        while work:
+            fkey = work.pop()
+            model.roots_reaching.setdefault(fkey, set()).add(idx)
+            for site in model.calls.get(fkey, ()):
+                if site.callee not in seen:
+                    seen.add(site.callee)
+                    work.append(site.callee)
+
+
+def _compute_always_held(model: ProgramModel) -> None:
+    """For each function, the locks held at EVERY known call site —
+    interprocedural guard context, so a transition helper only ever
+    called under ``with self._lock:`` counts as guarded.  Meet is set
+    intersection over call sites (caller context included), bottom is
+    the empty set at thread-root entries and functions with no known
+    callers (they may be called from anywhere)."""
+    callers: dict[FuncKey, list[tuple[FuncKey, frozenset[LockId]]]] = {}
+    for fkey, sites in model.calls.items():
+        for site in sites:
+            callers.setdefault(site.callee, []).append(
+                (fkey, site.locks))
+    root_entries = {r.entry for r in model.roots if r.entry}
+    TOP = None   # "not yet constrained" — absorbs in the meet
+    held: dict[FuncKey, Optional[frozenset[LockId]]] = {}
+    for fkey in model.functions:
+        if fkey in root_entries or fkey not in callers:
+            held[fkey] = frozenset()
+        else:
+            held[fkey] = TOP
+    changed = True
+    while changed:
+        changed = False
+        for fkey, sites in callers.items():
+            if fkey in root_entries:
+                continue
+            acc: Optional[frozenset[LockId]] = TOP
+            for caller, locks in sites:
+                ctx = held.get(caller, frozenset())
+                contrib = TOP if ctx is TOP else locks | ctx
+                if contrib is TOP:
+                    continue
+                acc = contrib if acc is TOP else acc & contrib
+            if acc is not TOP and held[fkey] != acc \
+                    and (held[fkey] is TOP or acc < held[fkey]):
+                held[fkey] = acc
+                changed = True
+    model.always_held = {
+        fkey: (v if v is not TOP else frozenset())
+        for fkey, v in held.items()}
+
+
+def _apply_effective_locks(model: ProgramModel) -> None:
+    """Fold the always-held caller context into every recorded access,
+    leak site and condition op."""
+    for accs in model.accesses.values():
+        for a in accs:
+            extra = model.always_held.get(a.fkey)
+            if extra:
+                a.locks = a.locks | extra
+    for leak in model.leaks:
+        extra = model.always_held.get(leak.fkey)
+        if extra:
+            leak.locks = leak.locks | extra
+    for op in model.cond_ops:
+        if not op.holds_cond \
+                and op.cond in model.always_held.get(op.fkey, ()):
+            op.holds_cond = True
+
+
+def _interprocedural_lock_edges(model: ProgramModel) -> None:
+    """Edges for locks acquired by a callee while the caller holds one.
+    ``may_acquire`` is the transitive closure of direct acquisitions
+    over the call graph (fixpoint)."""
+    may_acquire: dict[FuncKey, set[LockId]] = {
+        fkey: {lock for lock, _ in acqs}
+        for fkey, acqs in model.acquires.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fkey, sites in model.calls.items():
+            cur = may_acquire.setdefault(fkey, set())
+            before = len(cur)
+            for site in sites:
+                cur |= may_acquire.get(site.callee, set())
+            if len(cur) != before:
+                changed = True
+    for fkey, sites in model.calls.items():
+        for site in sites:
+            effective = site.locks | model.always_held.get(
+                fkey, frozenset())
+            if not effective:
+                continue
+            callee_qual = model.functions[site.callee].qualname
+            for acquired in may_acquire.get(site.callee, ()):
+                for held in effective:
+                    if held != acquired:
+                        model.lock_edges.append(
+                            (held, acquired, model.functions[fkey].rel,
+                             site.line, f"call to {callee_qual}"))
+
+
+def find_lock_cycles(model: ProgramModel
+                     ) -> list[list[tuple[LockId, LockId, str, int, str]]]:
+    """Cycles in the lock-order graph, each as its list of edges.
+    Deduplicated on the cycle's lock set; deterministic order."""
+    graph: dict[LockId, dict[LockId, tuple[LockId, LockId, str, int,
+                                           str]]] = {}
+    for edge in model.lock_edges:
+        graph.setdefault(edge[0], {}).setdefault(edge[1], edge)
+    cycles: list[list[tuple[LockId, LockId, str, int, str]]] = []
+    seen_sets: set[frozenset[LockId]] = set()
+    for start in sorted(graph, key=str):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, {}), key=str):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        edges = [graph[path[i]][path[(i + 1)
+                                                     % len(path)]]
+                                 for i in range(len(path))]
+                        cycles.append(edges)
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + [nxt]))
+    return cycles

@@ -126,6 +126,17 @@ class Repo:
         self._modules: Optional[dict[str, PyModule]] = None
         self._parse_failures: list[Finding] = []
         self._texts: dict[str, Optional[str]] = {}
+        self._program = None
+
+    def program(self):
+        """The whole-program concurrency model (thread roots, call
+        graph, guarded-by access sets), built once per run and shared
+        by every rule that needs cross-module reasoning."""
+        if self._program is None:
+            from kubernetes_cloud_tpu.analysis import concurrency
+
+            self._program = concurrency.build_model(self)
+        return self._program
 
     # -- python ------------------------------------------------------------
 
@@ -304,8 +315,15 @@ def load_baseline(path: str | pathlib.Path) -> list[dict]:
 
 def write_baseline(path: str | pathlib.Path,
                    findings: Sequence[Finding]) -> None:
-    entries = [{"rule": f.rule, "path": f.path, "message": f.message}
-               for f in findings]
+    write_baseline_entries(path, [
+        {"rule": f.rule, "path": f.path, "message": f.message}
+        for f in findings])
+
+
+def write_baseline_entries(path: str | pathlib.Path,
+                           entries: Sequence[dict]) -> None:
+    """Write pre-built baseline entries (``--prune-baseline`` rewrites
+    the committed file minus its stale suppressions)."""
     pathlib.Path(path).write_text(json.dumps(
         {"version": 1,
          "comment": ("Pre-existing kct-lint debt. Entries match on "

@@ -12,10 +12,11 @@ from kubernetes_cloud_tpu.analysis.rules import (
     locks,
     manifests,
     purity,
+    races,
     taxonomy,
 )
 
-_MODULES = (locks, purity, drift, taxonomy, manifests)
+_MODULES = (locks, races, purity, drift, taxonomy, manifests)
 
 ALL_RULE_DEFS = [r for mod in _MODULES for r in mod.RULES]
 ALL_CHECKS = [mod.check for mod in _MODULES]
@@ -24,6 +25,7 @@ ALL_CHECKS = [mod.check for mod in _MODULES]
 #: selected families (a manifest-only run skips the package AST rules)
 CHECKS_BY_FAMILY = {
     "KCT-LOCK": locks.check,
+    "KCT-RACE": races.check,
     "KCT-JIT": purity.check,
     "KCT-REG": drift.check,
     "KCT-ERR": taxonomy.check,

@@ -1,0 +1,195 @@
+"""KCT-RACE — whole-program race & deadlock detection for the serve plane.
+
+Built on :mod:`kubernetes_cloud_tpu.analysis.concurrency`'s program
+model (thread roots, call graph, per-attr lock-held access sets).  The
+judgement layer here is RacerD-shaped and deliberately skewed toward
+precision over recall:
+
+* An attribute only gets an **inferred guard** when the code itself
+  shows a discipline: ≥2 accesses hold the majority lock AND at least
+  half of all non-``__init__`` accesses hold *some* lock.  Attributes
+  the repo deliberately reads/writes lock-free under GIL atomicity
+  (monotonic counters, published-once floats) infer no guard and stay
+  quiet.
+* Only **writes** outside the guard are flagged, and only when the
+  attribute is reachable from ≥2 thread roots (or one self-concurrent
+  root: HTTP handler threads, executor pools) *and* the offending
+  method itself is root-reachable.  A lock-free *read* of guarded
+  state is the repo's documented snapshot idiom and is not reported.
+* Deadlock detection reports **cycles** in the cross-method lock-order
+  graph only; same-function nested acquisition is already KCT-LOCK-001
+  and re-entrant self-edges (RLock) are skipped.
+
+A benign race that survives review gets an inline
+``# kct-lint: ignore[KCT-RACE-00x] - reason`` at the site, never a
+silent baseline entry.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from kubernetes_cloud_tpu.analysis.concurrency import (
+    LockId,
+    ProgramModel,
+    find_lock_cycles,
+)
+from kubernetes_cloud_tpu.analysis.engine import Finding, Repo, Rule
+
+RULES = [
+    Rule("KCT-RACE-001", "unguarded shared write",
+         "A write to a field the class otherwise protects with a lock, "
+         "performed outside that lock, from code reachable by multiple "
+         "threads — the classic data race: concurrent readers see torn "
+         "or stale state."),
+    Rule("KCT-RACE-002", "read-modify-write outside the guard",
+         "`x += 1` / check-then-set on guarded shared state without "
+         "the lock is a lost-update race even when each individual "
+         "read and write looks atomic under the GIL."),
+    Rule("KCT-RACE-003", "guarded mutable state leaks out of lock scope",
+         "Returning/yielding a reference to a lock-protected container "
+         "hands the caller an unsynchronized alias — every later "
+         "iteration races with guarded mutation. Return a copy."),
+    Rule("KCT-RACE-004", "lock-order cycle (potential ABBA deadlock)",
+         "Two threads taking the same pair of locks in opposite orders "
+         "can each hold one and wait forever on the other, freezing "
+         "the data plane. Edges follow nested `with` blocks AND lock "
+         "acquisitions inside transitively-called functions."),
+    Rule("KCT-RACE-005", "Condition.wait without a predicate loop",
+         "`wait()` can return spuriously or after the predicate was "
+         "re-falsified; only `while not pred: wait()` (or `wait_for`) "
+         "is correct."),
+    Rule("KCT-RACE-006", "notify outside the condition's lock",
+         "Calling `notify()` without holding the condition raises at "
+         "runtime or (with a separate guard) lets the wakeup slip "
+         "between a waiter's predicate check and its wait()."),
+]
+
+
+def _roots_phrase(model: ProgramModel, idxs: set[int]) -> str:
+    names = model.root_names(idxs)
+    shown = ", ".join(names[:3])
+    if len(names) > 3:
+        shown += f", +{len(names) - 3} more"
+    return shown
+
+
+def _guarded_counts(model: ProgramModel, key, guard: LockId
+                    ) -> tuple[int, int]:
+    accs = model.accesses.get(key, [])
+    return (sum(1 for a in accs if guard in a.locks), len(accs))
+
+
+def _check_unguarded_writes(model: ProgramModel) -> Iterator[Finding]:
+    for (ckey, attr), accs in sorted(model.accesses.items(),
+                                     key=lambda kv: (kv[0][0][0],
+                                                     kv[0][0][1],
+                                                     kv[0][1])):
+        guard = model.inferred_guard(ckey, attr)
+        if guard is None:
+            continue
+        root_idxs = model.attr_roots(ckey, attr)
+        if not model.racy(root_idxs):
+            continue
+        held, total = _guarded_counts(model, (ckey, attr), guard)
+        label = f"{ckey[1]}.{attr}"
+        for a in accs:
+            if a.kind != "write" or guard in a.locks:
+                continue
+            if not model.roots_reaching.get(a.fkey):
+                continue   # not on any thread-root path we can prove
+            if a.rmw:
+                yield Finding(
+                    "KCT-RACE-002", a.rel, a.line,
+                    f"read-modify-write of `{label}` outside its "
+                    f"inferred guard `{guard}` (held on {held}/{total} "
+                    "accesses) — lost-update race across threads: "
+                    f"{_roots_phrase(model, root_idxs)}")
+            else:
+                yield Finding(
+                    "KCT-RACE-001", a.rel, a.line,
+                    f"write to `{label}` outside its inferred guard "
+                    f"`{guard}` (held on {held}/{total} accesses) — "
+                    "shared with threads: "
+                    f"{_roots_phrase(model, root_idxs)}")
+
+
+def _leak_guard(model: ProgramModel, fkey, attr
+                ) -> Optional[tuple[LockId, str]]:
+    """The inferred guard of ``self.<attr>`` as seen from ``fkey``'s
+    class, provided the attr is a known mutable container."""
+    info = model.functions.get(fkey)
+    if info is None or info.class_key is None:
+        return None
+    mutable = False
+    for ck in model.chain(info.class_key):
+        if attr in model.classes[ck].mutable_attrs:
+            mutable = True
+            break
+    if not mutable:
+        return None
+    for ck in model.chain(info.class_key):
+        guard = model.inferred_guard(ck, attr)
+        if guard is not None:
+            return guard, f"{ck[1]}.{attr}"
+    return None
+
+
+def _check_leaks(model: ProgramModel) -> Iterator[Finding]:
+    for leak in model.leaks:
+        resolved = _leak_guard(model, leak.fkey, leak.attr)
+        if resolved is None:
+            continue
+        guard, label = resolved
+        if guard not in leak.locks:
+            continue   # the lock held is not this attr's guard
+        yield Finding(
+            "KCT-RACE-003", leak.rel, leak.line,
+            f"returns a reference to `{label}` from inside `with "
+            f"{guard}:` — the caller iterates it unsynchronized while "
+            "guarded mutation continues; return a copy instead")
+
+
+def _check_lock_cycles(model: ProgramModel) -> Iterator[Finding]:
+    for cycle in find_lock_cycles(model):
+        order = " -> ".join(str(e[0]) for e in cycle)
+        order += f" -> {cycle[0][0]}"
+        vias = "; ".join(
+            f"`{a}`->`{b}` ({via})" for a, b, _rel, _line, via in cycle)
+        rel, line = cycle[0][2], cycle[0][3]
+        yield Finding(
+            "KCT-RACE-004", rel, line,
+            f"potential ABBA deadlock: lock-order cycle {order} "
+            f"[{vias}]")
+
+
+def _check_cond_discipline(model: ProgramModel) -> Iterator[Finding]:
+    # callers map for the interprocedural notify check
+    caller_holds: dict = {}
+    for fkey, sites in model.calls.items():
+        for site in sites:
+            caller_holds.setdefault(site.callee, []).append(site.locks)
+    for op in model.cond_ops:
+        if op.op == "wait" and not op.in_loop:
+            yield Finding(
+                "KCT-RACE-005", op.rel, op.line,
+                f"`{op.cond}.wait()` outside a predicate loop — "
+                "spurious wakeups and missed re-checks; use `while "
+                "not pred: wait()` or `wait_for(pred)`")
+        elif op.op in ("notify", "notify_all") and not op.holds_cond:
+            contexts = caller_holds.get(op.fkey, [])
+            if contexts and all(op.cond in locks for locks in contexts):
+                continue   # every known caller holds the condition
+            yield Finding(
+                "KCT-RACE-006", op.rel, op.line,
+                f"`{op.cond}.{op.op}()` without holding `with "
+                f"{op.cond}:` — raises RuntimeError at runtime, or "
+                "(under a different lock) loses the wakeup")
+
+
+def check(repo: Repo) -> Iterator[Finding]:
+    model = repo.program()
+    yield from _check_unguarded_writes(model)
+    yield from _check_leaks(model)
+    yield from _check_lock_cycles(model)
+    yield from _check_cond_discipline(model)

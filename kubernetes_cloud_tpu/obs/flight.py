@@ -295,8 +295,13 @@ class ProfileWindow:
             raise
         timer = threading.Timer(seconds, self._stop)
         timer.daemon = True
+        # publish under the lock BEFORE starting: a concurrent
+        # disarm() swaps _timer under the same lock, and an
+        # unpublished-but-started timer would survive the disarm and
+        # kill the NEXT window when it fires
+        with self._lock:
+            self._timer = timer
         timer.start()
-        self._timer = timer
         return {"profiling_s": seconds, "trace_dir": self.trace_dir}
 
     def disarm(self) -> None:

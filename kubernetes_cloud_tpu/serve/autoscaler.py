@@ -432,6 +432,10 @@ class Autoscaler:
         self.clock = clock
         self._states: dict[str, _RoleState] = {}
         self._cold_start_ewma: dict[str, float] = {}
+        #: guards the cold-start prior fold: note_cold_start runs on
+        #: every spawner thread (a scale-up of N spawns N at once) and
+        #: the EWMA read-fold-store would drop measurements unguarded
+        self._prior_lock = threading.Lock()
         self._kick = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -455,12 +459,13 @@ class Autoscaler:
         """Fold one measured spawn→healthy duration into the prior
         (targets call this; the histogram feeds dashboards)."""
         seconds = float(seconds)
-        self.stats["cold_starts"] += 1
         _M_COLD_START.labels(role=role).observe(seconds)
-        prev = self._cold_start_ewma.get(role)
-        a = self.cfg.cold_start_ewma_alpha
-        self._cold_start_ewma[role] = (
-            seconds if prev is None else a * seconds + (1 - a) * prev)
+        with self._prior_lock:
+            self.stats["cold_starts"] += 1
+            prev = self._cold_start_ewma.get(role)
+            a = self.cfg.cold_start_ewma_alpha
+            self._cold_start_ewma[role] = (
+                seconds if prev is None else a * seconds + (1 - a) * prev)
 
     def seed_cold_start(self, role: str, seconds: float) -> None:
         """Pre-populate the prior from an out-of-band measurement
@@ -471,7 +476,8 @@ class Autoscaler:
         seconds = float(seconds)
         if seconds <= 0:
             raise ValueError("cold-start seed must be > 0 seconds")
-        self._cold_start_ewma.setdefault(role, seconds)
+        with self._prior_lock:
+            self._cold_start_ewma.setdefault(role, seconds)
 
     def seed_from_benchmark(self, record: Any) -> int:
         """Seed priors from a ``bench_serving.py --cold-start`` JSON

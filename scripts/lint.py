@@ -8,9 +8,17 @@ CI and humans can never disagree about what was checked.
 
 Usage (repo root is auto-detected from this file's location)::
 
-    python scripts/lint.py                # text report vs the baseline
-    python scripts/lint.py --format json  # machine-readable
-    python scripts/lint.py --list-rules   # rule catalog
+    python scripts/lint.py                  # text report vs the baseline
+    python scripts/lint.py --changed        # THE pre-commit command
+    python scripts/lint.py --format json    # machine-readable
+    python scripts/lint.py --format sarif   # code-scanning upload
+    python scripts/lint.py --prune-baseline # drop stale suppressions
+    python scripts/lint.py --list-rules     # rule catalog
+
+``--changed [REF]`` (default ``HEAD``) is the documented pre-commit
+command: the cross-module program model is still built whole-repo —
+a race is a property of the program, not of a file — but findings and
+stale-baseline checks are scoped to your diff plus untracked files.
 """
 
 import pathlib

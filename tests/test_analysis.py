@@ -12,6 +12,7 @@ import json
 import pathlib
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -156,6 +157,336 @@ def test_lock_inline_suppression(tmp_path):
     root = make_repo(tmp_path, extra={
         "kubernetes_cloud_tpu/serve/locked.py": src})
     assert rules_fired(root, ["KCT-LOCK"]) == []
+
+
+# ---------------------------------------------------------------------------
+# KCT-RACE — whole-program races, lock-order cycles, condition misuse
+# ---------------------------------------------------------------------------
+
+#: two thread roots, a lock discipline (2/3 accesses guarded), and one
+#: plain write outside the guard
+_RACE_WRITE = '''\
+import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def start(self):
+        threading.Thread(target=self._a).start()
+        threading.Thread(target=self._b).start()
+
+    def _a(self):
+        with self._lock:
+            self._n = 1
+        with self._lock:
+            self._n = 2
+
+    def _b(self):
+        self._n = 3
+'''
+
+_RACE_GUARDED = _RACE_WRITE.replace(
+    "    def _b(self):\n        self._n = 3\n",
+    "    def _b(self):\n        with self._lock:\n"
+    "            self._n = 3\n")
+
+
+def _race_repo(tmp_path, src):
+    return make_repo(tmp_path, extra={
+        "kubernetes_cloud_tpu/serve/shared.py": src})
+
+
+def test_race_unguarded_write_fires(tmp_path):
+    root = _race_repo(tmp_path, _RACE_WRITE)
+    assert rules_fired(root, ["KCT-RACE"]) == ["KCT-RACE-001"]
+
+
+def test_race_guarded_twin_quiet(tmp_path):
+    root = _race_repo(tmp_path, _RACE_GUARDED)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+def test_race_single_root_quiet(tmp_path):
+    # same unguarded write, but only ONE thread ever runs the code:
+    # no second root, no race
+    single = _RACE_WRITE.replace(
+        "        threading.Thread(target=self._b).start()\n", "")
+    root = _race_repo(tmp_path, single)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+def test_race_rmw_fires(tmp_path):
+    src = _RACE_WRITE.replace("        self._n = 3\n",
+                              "        self._n += 1\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == ["KCT-RACE-002"]
+
+
+def test_race_check_then_set_is_rmw(tmp_path):
+    src = _RACE_WRITE.replace(
+        "        self._n = 3\n",
+        "        if self._n == 0:\n            self._n = 3\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == ["KCT-RACE-002"]
+
+
+def test_race_rmw_guarded_twin_quiet(tmp_path):
+    src = _RACE_WRITE.replace(
+        "    def _b(self):\n        self._n = 3\n",
+        "    def _b(self):\n        with self._lock:\n"
+        "            self._n += 1\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+def test_race_helper_called_under_lock_is_guarded(tmp_path):
+    # interprocedural guard context: the write happens in a helper
+    # only ever called with the lock held, so it counts as guarded
+    src = _RACE_WRITE.replace(
+        "    def _b(self):\n        self._n = 3\n",
+        "    def _b(self):\n        with self._lock:\n"
+        "            self._set()\n\n"
+        "    def _set(self):\n        self._n = 3\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+def test_race_init_writes_exempt(tmp_path):
+    # __init__ runs before the object is published to other threads
+    src = _RACE_GUARDED.replace(
+        "        self._n = 0\n",
+        "        self._n = 0\n        self._n = 1\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+_RACE_LEAK = '''\
+import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def start(self):
+        threading.Thread(target=self._a).start()
+        threading.Thread(target=self._b).start()
+
+    def _a(self):
+        with self._lock:
+            self._items.append(1)
+        with self._lock:
+            self._items.append(2)
+
+    def _b(self):
+        with self._lock:
+            return self._items
+'''
+
+
+def test_race_leak_fires(tmp_path):
+    root = _race_repo(tmp_path, _RACE_LEAK)
+    assert rules_fired(root, ["KCT-RACE"]) == ["KCT-RACE-003"]
+
+
+def test_race_leak_copy_quiet(tmp_path):
+    src = _RACE_LEAK.replace("return self._items",
+                             "return list(self._items)")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+_RACE_ABBA = '''\
+import threading
+
+
+class C:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self.one).start()
+        threading.Thread(target=self.two).start()
+
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def two(self):
+        with self._b_lock:
+            self.helper()
+
+    def helper(self):
+        with self._a_lock:
+            pass
+'''
+
+
+def test_race_abba_cycle_fires(tmp_path):
+    # the B->A edge goes through a method call: only the whole-program
+    # lock-order graph sees it
+    root = _race_repo(tmp_path, _RACE_ABBA)
+    assert rules_fired(root, ["KCT-RACE"]) == ["KCT-RACE-004"]
+
+
+def test_race_consistent_order_quiet(tmp_path):
+    src = _RACE_ABBA.replace(
+        "    def two(self):\n        with self._b_lock:\n"
+        "            self.helper()\n",
+        "    def two(self):\n        with self._a_lock:\n"
+        "            self.helper()\n").replace(
+        "    def helper(self):\n        with self._a_lock:\n",
+        "    def helper(self):\n        with self._b_lock:\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+_RACE_WAIT = '''\
+import threading
+
+
+class C:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def start(self):
+        threading.Thread(target=self.consume).start()
+
+    def consume(self):
+        with self._cond:
+            self._cond.wait(timeout=1.0)
+'''
+
+
+def test_race_wait_without_loop_fires(tmp_path):
+    root = _race_repo(tmp_path, _RACE_WAIT)
+    assert rules_fired(root, ["KCT-RACE"]) == ["KCT-RACE-005"]
+
+
+def test_race_wait_in_predicate_loop_quiet(tmp_path):
+    src = _RACE_WAIT.replace(
+        "        with self._cond:\n"
+        "            self._cond.wait(timeout=1.0)\n",
+        "        with self._cond:\n"
+        "            while not self._ready:\n"
+        "                self._cond.wait(timeout=1.0)\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+def test_race_wait_for_quiet(tmp_path):
+    src = _RACE_WAIT.replace(
+        "            self._cond.wait(timeout=1.0)\n",
+        "            self._cond.wait_for(lambda: self._ready,\n"
+        "                                timeout=1.0)\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+_RACE_NOTIFY = '''\
+import threading
+
+
+class C:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def start(self):
+        threading.Thread(target=self.produce).start()
+
+    def produce(self):
+        self._cond.notify_all()
+'''
+
+
+def test_race_notify_outside_lock_fires(tmp_path):
+    root = _race_repo(tmp_path, _RACE_NOTIFY)
+    assert rules_fired(root, ["KCT-RACE"]) == ["KCT-RACE-006"]
+
+
+def test_race_notify_under_lock_quiet(tmp_path):
+    src = _RACE_NOTIFY.replace(
+        "    def produce(self):\n        self._cond.notify_all()\n",
+        "    def produce(self):\n        with self._cond:\n"
+        "            self._cond.notify_all()\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+def test_race_notify_in_helper_with_locked_callers_quiet(tmp_path):
+    # the notify lives in a helper whose every call site holds the
+    # condition — the interprocedural context keeps it quiet
+    src = _RACE_NOTIFY.replace(
+        "    def produce(self):\n        self._cond.notify_all()\n",
+        "    def produce(self):\n        with self._cond:\n"
+        "            self._wake()\n\n"
+        "    def _wake(self):\n        self._cond.notify_all()\n")
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == []
+
+
+def test_race_timer_and_executor_roots(tmp_path):
+    # a Timer callback and a pool.submit callable are thread roots; an
+    # executor root is concurrent with ITSELF, so one root suffices
+    src = '''\
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def start(self):
+        pool = ThreadPoolExecutor(4)
+        for _ in range(4):
+            pool.submit(self._work)
+
+    def _work(self):
+        with self._lock:
+            self._n = 1
+        with self._lock:
+            self._n = 2
+        self._n = 3
+'''
+    root = _race_repo(tmp_path, src)
+    assert rules_fired(root, ["KCT-RACE"]) == ["KCT-RACE-001"]
+
+
+def test_thread_root_discovery_whole_repo():
+    # the model must find the serve plane's known daemon loops — the
+    # continuous scheduler, autoscaler loop, supervisor, prober,
+    # spawner — plus the HTTP entry; and the activator's capacity
+    # notification must be reachable from the spawner root
+    from kubernetes_cloud_tpu.analysis.engine import Repo
+
+    model = Repo(REPO_ROOT).program()
+    names = {r.name for r in model.roots}
+    for expected in (
+            "serve/continuous.py:ContinuousBatchingEngine._loop",
+            "serve/autoscaler.py:Autoscaler._run",
+            "serve/supervisor.py:ServingSupervisor._loop",
+            "serve/fleet.py:FleetRouter._probe_loop",
+            "serve/autoscaler.py:ElasticFleet._spawn",
+            "serve/server.py:ModelServer.handle"):
+        assert any(n.endswith(expected) for n in names), \
+            f"thread root {expected} not discovered; got {sorted(names)}"
+    spawn = next(i for i, r in enumerate(model.roots)
+                 if r.name.endswith("ElasticFleet._spawn"))
+    notify = [fkey for fkey in model.functions
+              if fkey[1].endswith("Activator.notify_capacity")]
+    assert notify and any(
+        spawn in model.roots_reaching.get(fkey, set())
+        for fkey in notify), \
+        "Activator.notify_capacity not reachable from the spawner root"
 
 
 # ---------------------------------------------------------------------------
@@ -581,17 +912,169 @@ def test_json_format_and_exit_codes(tmp_path, capsys):
 def test_list_rules_covers_all_families(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for family in ("KCT-LOCK", "KCT-JIT", "KCT-REG", "KCT-ERR",
-                   "KCT-MAN"):
+    for family in ("KCT-LOCK", "KCT-RACE", "KCT-JIT", "KCT-REG",
+                   "KCT-ERR", "KCT-MAN"):
         assert family in out, f"{family} missing from --list-rules"
+
+
+# ---------------------------------------------------------------------------
+# sarif output, --prune-baseline, --changed
+# ---------------------------------------------------------------------------
+
+def test_sarif_format_shape(tmp_path, capsys):
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n",
+        "kubernetes_cloud_tpu/serve/locked.py": _LOCKED_SLEEP})
+    rc = lint_main(["--root", str(root), "--format", "sarif"])
+    assert rc == 1
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0"
+    driver = log["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "kct-lint"
+    ids = {r["id"] for r in driver["rules"]}
+    assert {"KCT-LOCK-001", "KCT-RACE-001", "KCT-RACE-004"} <= ids
+    results = log["runs"][0]["results"]
+    assert len(results) == 1
+    r = results[0]
+    assert r["ruleId"] == "KCT-LOCK-001"
+    assert r["level"] == "error"
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == \
+        "kubernetes_cloud_tpu/serve/locked.py"
+    assert loc["region"]["startLine"] == 11
+
+
+def test_sarif_clean_run_has_no_results(tmp_path, capsys):
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n"})
+    rc = lint_main(["--root", str(root), "--format", "sarif"])
+    assert rc == 0
+    log = json.loads(capsys.readouterr().out)
+    assert log["runs"][0]["results"] == []
+
+
+def test_prune_baseline_roundtrips_to_zero(tmp_path, capsys):
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n",
+        "kubernetes_cloud_tpu/serve/locked.py": _LOCKED_SLEEP})
+    assert lint_main(["--root", str(root), "--write-baseline"]) == 0
+    capsys.readouterr()
+    # fix the violation: the entry is stale (exit 2)
+    (root / "kubernetes_cloud_tpu/serve/locked.py").write_text(
+        _LOCKED_SLEEP.replace("time.sleep(1.0)", "x = 1"))
+    assert lint_main(["--root", str(root)]) == 2
+    capsys.readouterr()
+    # prune rewrites the file and the run is clean in one pass...
+    assert lint_main(["--root", str(root), "--prune-baseline"]) == 0
+    assert "pruned 1 stale suppression" in capsys.readouterr().out
+    # ...and the pruned file round-trips to exit 0 with no flags
+    assert lint_main(["--root", str(root)]) == 0
+    capsys.readouterr()
+    data = json.loads((root / BASELINE_FILE).read_text())
+    assert data["suppressions"] == []
+
+
+def test_prune_baseline_keeps_live_entries(tmp_path, capsys):
+    # two baselined findings, one fixed: prune drops exactly the stale
+    # entry and keeps the live one
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n",
+        "kubernetes_cloud_tpu/serve/locked.py": _LOCKED_SLEEP,
+        "kubernetes_cloud_tpu/serve/bad.py": "raise Exception('x')\n"})
+    assert lint_main(["--root", str(root), "--write-baseline"]) == 0
+    capsys.readouterr()
+    (root / "kubernetes_cloud_tpu/serve/locked.py").write_text(
+        _LOCKED_SLEEP.replace("time.sleep(1.0)", "x = 1"))
+    assert lint_main(["--root", str(root), "--prune-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads((root / BASELINE_FILE).read_text())
+    assert [e["rule"] for e in data["suppressions"]] == ["KCT-ERR-002"]
+    assert lint_main(["--root", str(root)]) == 0
+    capsys.readouterr()
+
+
+def test_prune_baseline_refuses_scoped_runs(tmp_path, capsys):
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n"})
+    for extra in (["--select", "KCT-RACE"], ["--changed"],
+                  ["--no-baseline"], ["--write-baseline"]):
+        rc = lint_main(["--root", str(root), "--prune-baseline",
+                        *extra])
+        assert rc == 3, extra
+        assert "prune-baseline" in capsys.readouterr().err
+
+
+def _git(root, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@example.com", "-c", "user.name=t",
+         *args],
+        cwd=root, check=True, capture_output=True, text=True)
+
+
+def test_changed_scopes_findings_to_the_diff(tmp_path, capsys):
+    # a committed violation is invisible to --changed HEAD; a freshly
+    # added one is reported — pre-commit only talks about your diff
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n",
+        "kubernetes_cloud_tpu/serve/locked.py": _LOCKED_SLEEP})
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-q", "-m", "base")
+    assert lint_main(["--root", str(root), "--changed"]) == 0
+    capsys.readouterr()
+    (root / "kubernetes_cloud_tpu/serve/fresh.py").write_text(
+        _LOCKED_SLEEP)
+    rc = lint_main(["--root", str(root), "--changed", "--format",
+                    "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["path"] for f in out["findings"]] == \
+        ["kubernetes_cloud_tpu/serve/fresh.py"]
+
+
+def test_changed_ignores_unchanged_files_stale_entries(tmp_path,
+                                                       capsys):
+    # baseline entries for files OUTSIDE the diff must not be reported
+    # stale by a diff-scoped run
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n",
+        "kubernetes_cloud_tpu/serve/locked.py": _LOCKED_SLEEP})
+    assert lint_main(["--root", str(root), "--write-baseline"]) == 0
+    capsys.readouterr()
+    (root / "kubernetes_cloud_tpu/serve/locked.py").write_text(
+        _LOCKED_SLEEP.replace("time.sleep(1.0)", "x = 1"))
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-q", "-m", "base")
+    # nothing changed vs HEAD: the (globally stale) entry is out of
+    # scope, so the scoped run exits clean
+    assert lint_main(["--root", str(root), "--changed"]) == 0
+    capsys.readouterr()
+
+
+def test_changed_bad_ref_is_usage_error(tmp_path, capsys):
+    root = make_repo(tmp_path, extra={
+        "pyproject.toml": "[project]\nname = 'fixture'\n"})
+    _git(root, "init", "-q")
+    rc = lint_main(["--root", str(root), "--changed",
+                    "not-a-ref-at-all"])
+    assert rc == 3
+    assert "--changed" in capsys.readouterr().err
 
 
 # ---------------------------------------------------------------------------
 # the actual gate: whole repo, committed baseline, no jax
 # ---------------------------------------------------------------------------
 
+#: quick-lane ceiling for the whole-repo run INCLUDING the program-
+#: model build (measured ~4 s on the CI box; generous for slow ones)
+_GATE_BUDGET_S = 60.0
+
+
 def test_whole_repo_clean_modulo_baseline():
+    t0 = time.monotonic()
     findings = run(REPO_ROOT)
+    elapsed = time.monotonic() - t0
     entries = load_baseline(REPO_ROOT / BASELINE_FILE)
     new, stale = apply_baseline(findings, entries)
     assert not new, "new findings:\n" + "\n".join(
@@ -599,6 +1082,10 @@ def test_whole_repo_clean_modulo_baseline():
     assert not stale, "stale baseline suppressions (delete them):\n" + \
         "\n".join(f"{e['rule']} {e['path']}: {e['message']}"
                   for e in stale)
+    assert elapsed < _GATE_BUDGET_S, (
+        f"whole-repo lint took {elapsed:.1f}s — over the quick-lane "
+        f"budget of {_GATE_BUDGET_S:.0f}s; the program model must "
+        "stay cheap")
 
 
 def test_module_entry_point_exits_zero():
